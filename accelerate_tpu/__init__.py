@@ -1,0 +1,25 @@
+"""accelerate_tpu — a TPU-native training & inference framework.
+
+A from-scratch rebuild of the capability surface of HuggingFace Accelerate
+(reference snapshot surveyed in SURVEY.md) designed for JAX/XLA/Pallas on
+Cloud TPU: one SPMD program over a ``jax.sharding.Mesh`` replaces the
+reference's ten process backends; FSDP/TP/SP/PP are mesh-axis layouts, not
+wrapper modules; collectives are compiled into the step by XLA and ride ICI.
+"""
+
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, GradientState, PartialState
+from .logging import get_logger
+from .utils.dataclasses import (
+    DataLoaderConfiguration,
+    DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    InitProcessGroupKwargs,
+    ParallelismConfig,
+    ProfileKwargs,
+    ProjectConfiguration,
+    SequenceParallelPlugin,
+    TensorParallelPlugin,
+)

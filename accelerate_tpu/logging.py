@@ -1,0 +1,56 @@
+"""Rank-aware logging (reference: /root/reference/src/accelerate/logging.py).
+
+``get_logger(__name__)`` returns an adapter whose records can be restricted to
+the main process (``main_process_only=True``, the default behaviour of the
+reference's MultiProcessAdapter :22) or emitted once per process in process
+order (``in_order=True``).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        from .state import PartialState
+
+        state = PartialState()
+        return not main_process_only or state.is_main_process
+
+    def log(self, level, msg, *args, **kwargs):
+        if not self.isEnabledFor(level):
+            return
+        from .state import PartialState
+
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        kwargs.setdefault("stacklevel", 2)
+        state = PartialState()
+        if in_order and state.num_processes > 1:
+            for i in range(state.num_processes):
+                if i == state.process_index:
+                    msg, kw = self.process(msg, kwargs)
+                    self.logger.log(level, msg, *args, **kw)
+                state.wait_for_everyone()
+            return
+        if self._should_log(main_process_only):
+            msg, kwargs = self.process(msg, kwargs)
+            self.logger.log(level, msg, *args, **kwargs)
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_LOG_LEVEL", None)
+    logger = logging.getLogger(name)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

@@ -1,0 +1,540 @@
+"""Process/device state singletons — the L1 layer.
+
+Counterpart of ``/root/reference/src/accelerate/state.py`` (PartialState :123,
+AcceleratorState :850, GradientState :1181), rebuilt on PJRT:
+
+* process discovery = ``jax.distributed.initialize`` (multi-host DCN rendezvous
+  via coordinator address, the MASTER_ADDR analog) instead of
+  ``torch.distributed.init_process_group`` with ten backend strings;
+* topology (hosts, slices, chips) read off PJRT device attributes instead of
+  LOCAL_RANK/WORLD_SIZE env protocol;
+* the distributed "type" collapses to mesh-axis layout (see
+  ``utils/dataclasses.ParallelismConfig``) because SPMD replaces
+  DDP/FSDP/TP-as-separate-code-paths.
+
+Like the reference, states are Borg singletons: any object anywhere can call
+``PartialState()`` and observe the same initialised state.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from contextlib import contextmanager
+from functools import partial, wraps
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from .parallel.mesh import batch_sharding_size, make_mesh
+from .utils.dataclasses import (
+    DistributedType,
+    GradientAccumulationPlugin,
+    InitProcessGroupKwargs,
+    ParallelismConfig,
+    PrecisionType,
+)
+from .utils.environment import (
+    get_coordinator_address,
+    get_num_processes_env,
+    get_process_index_env,
+    parse_choice_from_env,
+    parse_flag_from_env,
+)
+
+logger = logging.getLogger(__name__)
+
+_jax_distributed_initialized = False
+
+
+def _maybe_init_jax_distributed(kwargs: Optional[InitProcessGroupKwargs]) -> None:
+    """Join the multi-host rendezvous if the launch env asks for one.
+
+    Reference boundary: state.py:226,267 (init_process_group).  Here the
+    boundary is ``jax.distributed.initialize``, which blocks on all peers —
+    exactly like the reference's process-group rendezvous.
+    """
+    global _jax_distributed_initialized
+    if _jax_distributed_initialized:
+        return
+    num_processes = (kwargs.num_processes if kwargs else None) or get_num_processes_env()
+    if num_processes is None or num_processes <= 1:
+        return
+    coordinator = (
+        (kwargs.coordinator_address if kwargs else None) or get_coordinator_address()
+    )
+    process_id = (
+        kwargs.process_id if kwargs and kwargs.process_id is not None else None
+    )
+    if process_id is None:
+        process_id = get_process_index_env()
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _jax_distributed_initialized = True
+
+
+class PartialState:
+    """Borg singleton for process topology and process control.
+
+    Reference: PartialState state.py:123.  ``num_processes`` counts *host
+    processes* (the unit of data loading and checkpoint IO); ``num_devices``
+    counts global chips (the unit of SPMD compute).  The reference's
+    one-process-per-GPU model makes these equal; on TPU they differ and both
+    are exposed.
+    """
+
+    _shared_state: dict[str, Any] = {}
+    _known_attrs = [
+        "_cpu",
+        "backend",
+        "device",
+        "devices",
+        "local_devices",
+        "distributed_type",
+        "num_processes",
+        "process_index",
+        "local_process_index",
+        "debug",
+    ]
+
+    def __init__(self, cpu: bool = False, **kwargs):
+        self.__dict__ = self._shared_state
+        if self.initialized:
+            return
+        init_kwargs = kwargs.pop("init_process_group_kwargs", None)
+        if kwargs and init_kwargs is None:
+            import dataclasses as _dc
+
+            recognized = {f.name for f in _dc.fields(InitProcessGroupKwargs)}
+            unknown = set(kwargs) - recognized
+            if unknown:
+                raise TypeError(
+                    f"PartialState got unexpected keyword arguments {sorted(unknown)}; "
+                    f"recognized distributed-init kwargs: {sorted(recognized)}"
+                )
+            init_kwargs = InitProcessGroupKwargs(**kwargs)
+        self._cpu = cpu
+        self.debug = parse_flag_from_env("ACCELERATE_DEBUG_MODE")
+        if cpu:
+            # The env var alone is ignored once another platform is pinned
+            # (e.g. by a sitecustomize); the config update is authoritative.
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except RuntimeError as e:
+                raise RuntimeError(
+                    "PartialState(cpu=True) requested after the JAX backend was "
+                    "already initialized on another platform; construct the "
+                    "state before any jax.devices()/jit call."
+                ) from e
+        _maybe_init_jax_distributed(init_kwargs)
+        self.devices = jax.devices()
+        self.local_devices = jax.local_devices()
+        self.backend = self.devices[0].platform
+        self.num_processes = jax.process_count()
+        self.process_index = jax.process_index()
+        # One process per host on TPU → every process is its own host's local
+        # main process (index 0). A launcher running several processes per
+        # host (CPU simulation) overrides via env.
+        self.local_process_index = int(
+            os.environ.get("ACCELERATE_LOCAL_PROCESS_INDEX", 0)
+        )
+        self.device = self.local_devices[0]
+        if self.num_processes > 1:
+            self.distributed_type = DistributedType.MULTI_HOST
+        elif self.backend in ("tpu", "axon") or len(self.devices) > 1:
+            self.distributed_type = DistributedType.TPU
+        else:
+            self.distributed_type = DistributedType.NO
+
+    @property
+    def initialized(self) -> bool:
+        return "distributed_type" in self.__dict__
+
+    @staticmethod
+    def _reset_state() -> None:
+        """Reset the Borg state (testing only; reference state.py:1175)."""
+        PartialState._shared_state.clear()
+        AcceleratorState._shared_state.clear()
+        GradientState._shared_state.clear()
+
+    def __repr__(self) -> str:
+        return (
+            f"Distributed environment: {self.distributed_type}\n"
+            f"Num host processes: {self.num_processes}\n"
+            f"Process index: {self.process_index}\n"
+            f"Local process index: {self.local_process_index}\n"
+            f"Num devices: {self.num_devices}\n"
+            f"Device: {self.device}\n"
+        )
+
+    # -- topology -----------------------------------------------------------
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    @property
+    def local_device_count(self) -> int:
+        return len(self.local_devices)
+
+    @property
+    def use_distributed(self) -> bool:
+        return self.num_processes > 1 or self.num_devices > 1
+
+    @property
+    def is_main_process(self) -> bool:
+        return self.process_index == 0
+
+    @property
+    def is_local_main_process(self) -> bool:
+        return self.local_process_index == 0
+
+    @property
+    def is_last_process(self) -> bool:
+        return self.process_index == self.num_processes - 1
+
+    # -- process control ----------------------------------------------------
+    def wait_for_everyone(self) -> None:
+        """Cross-host barrier (reference state.py:359).
+
+        Implemented as a named sync over global devices — a tiny psum that
+        every host must join, the SPMD analog of ``dist.barrier()``.
+        """
+        if self.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("accelerate_tpu.wait_for_everyone")
+
+    def _goes_first(self, is_main: bool):
+        if not is_main:
+            self.wait_for_everyone()
+        yield
+        if is_main:
+            self.wait_for_everyone()
+
+    @contextmanager
+    def main_process_first(self):
+        yield from self._goes_first(self.is_main_process)
+
+    @contextmanager
+    def local_main_process_first(self):
+        yield from self._goes_first(self.is_local_main_process)
+
+    @contextmanager
+    def split_between_processes(
+        self, inputs, apply_padding: bool = False
+    ):
+        """Split a list/tuple/dict-of-lists evenly across host processes.
+
+        Pure-Python logic matching reference semantics (state.py:407): each
+        process receives a contiguous chunk; with ``apply_padding`` the last
+        element is repeated so every process gets the same count (needed when
+        the downstream op is collective).
+        """
+        if self.num_processes == 1:
+            yield inputs
+            return
+        if isinstance(inputs, dict):
+            lengths = {k: len(v) for k, v in inputs.items()}
+            if len(set(lengths.values())) > 1:
+                raise ValueError(
+                    "split_between_processes requires all dict values to have "
+                    f"the same length, got {lengths}"
+                )
+            length = next(iter(lengths.values())) if lengths else 0
+        else:
+            length = len(inputs)
+        split_sizes = [length // self.num_processes] * self.num_processes
+        for i in range(length % self.num_processes):
+            split_sizes[i] += 1
+        start = sum(split_sizes[: self.process_index])
+        end = start + split_sizes[self.process_index]
+
+        def _slice(obj):
+            chunk = list(obj[start:end])
+            if apply_padding and len(chunk) < max(split_sizes) and len(obj) > 0:
+                chunk = chunk + list(obj[-1:]) * (max(split_sizes) - len(chunk))
+            return chunk
+
+        if isinstance(inputs, dict):
+            yield {k: _slice(v) for k, v in inputs.items()}
+        else:
+            yield _slice(list(inputs) if isinstance(inputs, tuple) else inputs)
+
+    def on_main_process(self, function: Callable = None):
+        """Decorator: run only on the global main process (state.py:537).
+
+        Supports both ``@state.on_main_process`` and the parenthesized factory
+        form ``@state.on_main_process()``.
+        """
+        if function is None:
+            return partial(self.on_main_process)
+
+        @wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.is_main_process:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    def on_local_main_process(self, function: Callable = None):
+        if function is None:
+            return partial(self.on_local_main_process)
+
+        @wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.is_local_main_process:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    def on_process(self, function: Callable = None, process_index: int = None):
+        if function is None:
+            return partial(self.on_process, process_index=process_index)
+        if process_index is None:
+            process_index = 0
+
+        @wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.process_index == process_index:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    def on_last_process(self, function: Callable):
+        return self.on_process(function, process_index=self.num_processes - 1)
+
+    def print(self, *args, **kwargs) -> None:
+        if self.is_local_main_process:
+            print(*args, **kwargs)
+
+    def destroy_process_group(self) -> None:
+        """Tear down the multi-host rendezvous (reference state.py:333)."""
+        global _jax_distributed_initialized
+        if _jax_distributed_initialized:
+            jax.distributed.shutdown()
+            _jax_distributed_initialized = False
+
+
+class AcceleratorState:
+    """Adds precision policy, parallelism layout, and the Mesh to PartialState.
+
+    Reference: AcceleratorState state.py:850.  Where the reference resolves a
+    DistributedType override chain (env flags promoting MULTI_GPU→FSDP etc.,
+    state.py:958-970), here the same env flags resolve to mesh axis sizes.
+    """
+
+    _shared_state: dict[str, Any] = {}
+
+    def __init__(
+        self,
+        mixed_precision: Optional[str] = None,
+        cpu: bool = False,
+        parallelism_config: Optional[ParallelismConfig] = None,
+        fsdp_plugin=None,
+        tp_plugin=None,
+        sp_plugin=None,
+        pp_plugin=None,
+        ep_plugin=None,
+        _from_accelerator: bool = False,
+        **kwargs,
+    ):
+        self.__dict__ = self._shared_state
+        if self.initialized:
+            conflicts = []
+            if mixed_precision is not None and mixed_precision != self.mixed_precision:
+                conflicts.append(
+                    f"mixed_precision {self.mixed_precision!r} → {mixed_precision!r}"
+                )
+            if (
+                parallelism_config is not None
+                and parallelism_config != self.parallelism_config
+            ):
+                conflicts.append(
+                    f"parallelism_config {self.parallelism_config!r} → {parallelism_config!r}"
+                )
+            for name, new in (
+                ("fsdp_plugin", fsdp_plugin),
+                ("tp_plugin", tp_plugin),
+                ("sp_plugin", sp_plugin),
+                ("pp_plugin", pp_plugin),
+                ("ep_plugin", ep_plugin),
+            ):
+                if new is not None and new != getattr(self, name):
+                    conflicts.append(name)
+            if conflicts:
+                raise ValueError(
+                    "AcceleratorState is already initialized; conflicting "
+                    f"re-init of: {', '.join(conflicts)}. Call "
+                    "AcceleratorState._reset_state() first."
+                )
+            return
+        self._partial = PartialState(cpu=cpu, **kwargs)
+        mixed_precision = (
+            mixed_precision
+            if mixed_precision is not None
+            else parse_choice_from_env("ACCELERATE_MIXED_PRECISION", "no")
+        )
+        mixed_precision = str(mixed_precision).lower()
+        if mixed_precision not in PrecisionType.list():
+            raise ValueError(
+                f"mixed_precision must be one of {PrecisionType.list()}, got "
+                f"{mixed_precision!r}"
+            )
+        self.mixed_precision = mixed_precision
+        self.fsdp_plugin = fsdp_plugin
+        self.tp_plugin = tp_plugin
+        self.sp_plugin = sp_plugin
+        self.pp_plugin = pp_plugin
+        self.ep_plugin = ep_plugin
+
+        if parallelism_config is None:
+            parallelism_config = ParallelismConfig.from_env()
+            if fsdp_plugin is not None:
+                parallelism_config.fsdp_size = (
+                    fsdp_plugin.fsdp_size or self._partial.num_devices
+                )
+            if tp_plugin is not None:
+                parallelism_config.tp_size = tp_plugin.tp_size
+            if sp_plugin is not None:
+                parallelism_config.sp_size = sp_plugin.sp_size
+            if pp_plugin is not None:
+                parallelism_config.pp_size = pp_plugin.pp_size
+            if ep_plugin is not None:
+                parallelism_config.ep_size = ep_plugin.ep_size
+        self.parallelism_config = parallelism_config
+        axis_sizes = parallelism_config.axis_sizes(self._partial.num_devices)
+        self.mesh = make_mesh(axis_sizes)
+
+    # Everything PartialState exposes is reachable here too.
+    def __getattr__(self, name: str):
+        partial = self.__dict__.get("_partial")
+        if partial is not None and (
+            name in partial.__dict__ or hasattr(PartialState, name)
+        ):
+            return getattr(partial, name)
+        raise AttributeError(
+            f"`AcceleratorState` object has no attribute `{name}`"
+        )
+
+    @property
+    def initialized(self) -> bool:
+        return "mesh" in self.__dict__
+
+    @staticmethod
+    def _reset_state(reset_partial_state: bool = False) -> None:
+        AcceleratorState._shared_state.clear()
+        if reset_partial_state:
+            PartialState._reset_state()
+
+    @property
+    def num_batch_shards(self) -> int:
+        """Distinct batch shards across the mesh (dp×fsdp axes)."""
+        return batch_sharding_size(self.mesh)
+
+    @property
+    def use_fsdp(self) -> bool:
+        return self.parallelism_config.fsdp_size > 1 or self.fsdp_plugin is not None
+
+    @property
+    def use_tp(self) -> bool:
+        return self.parallelism_config.tp_size > 1
+
+    @property
+    def use_sp(self) -> bool:
+        return self.parallelism_config.sp_size > 1
+
+
+class GradientState:
+    """Gradient-accumulation bookkeeping shared across all wrappers.
+
+    Reference: GradientState state.py:1181.  ``sync_gradients`` tells the
+    optimizer wrapper whether this micro-step should apply an update;
+    ``end_of_dataloader``/``remainder`` drive uneven-tail handling in
+    ``gather_for_metrics``.  The reference's XLA-specific
+    ``is_xla_gradients_synced`` flag has no analog: under SPMD the gradient
+    all-reduce is part of the compiled step, never manually deferred.
+    """
+
+    _shared_state: dict[str, Any] = {}
+
+    def __init__(self, gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None):
+        self.__dict__ = self._shared_state
+        if not self.initialized:
+            self.sync_gradients = True
+            self.active_dataloader = None
+            self.dataloader_references = [None]
+            self.plugin_kwargs = (
+                gradient_accumulation_plugin.to_dict()
+                if gradient_accumulation_plugin is not None
+                else {}
+            )
+            self._is_accumulating = False
+        if gradient_accumulation_plugin is not None and (
+            self.plugin_kwargs != gradient_accumulation_plugin.to_dict()
+        ):
+            self.plugin_kwargs = gradient_accumulation_plugin.to_dict()
+
+    @property
+    def initialized(self) -> bool:
+        return "sync_gradients" in self.__dict__
+
+    @property
+    def num_steps(self) -> int:
+        return self.plugin_kwargs.get("num_steps") or 1
+
+    @property
+    def adjust_scheduler(self) -> bool:
+        return self.plugin_kwargs.get("adjust_scheduler", False)
+
+    @property
+    def sync_with_dataloader(self) -> bool:
+        return self.plugin_kwargs.get("sync_with_dataloader", True)
+
+    @property
+    def sync_each_batch(self) -> bool:
+        return self.plugin_kwargs.get("sync_each_batch", False)
+
+    @property
+    def end_of_dataloader(self) -> bool:
+        if not self.in_dataloader:
+            return False
+        return self.active_dataloader.end_of_dataloader
+
+    @property
+    def remainder(self) -> int:
+        if not self.in_dataloader:
+            return -1
+        return self.active_dataloader.remainder
+
+    @property
+    def in_dataloader(self) -> bool:
+        return self.active_dataloader is not None
+
+    def _set_sync_gradients(self, sync_gradients: bool) -> None:
+        self.sync_gradients = sync_gradients
+
+    def _add_dataloader(self, dataloader) -> None:
+        self.active_dataloader = dataloader
+        self.dataloader_references.append(dataloader)
+
+    def _remove_dataloader(self, dataloader) -> None:
+        if dataloader in self.dataloader_references:
+            self.dataloader_references.remove(dataloader)
+        self.active_dataloader = self.dataloader_references[-1]
+
+    @staticmethod
+    def _reset_state() -> None:
+        GradientState._shared_state.clear()
+
+    def __repr__(self) -> str:
+        return (
+            f"Sync Gradients: {self.sync_gradients}\n"
+            f"At end of current dataloader: {self.end_of_dataloader}\n"
+            f"Extra samples added: {self.remainder}\n"
+            f"Gradient accumulation plugin: {self.plugin_kwargs}\n"
+        )

@@ -1,0 +1,50 @@
+"""Shared filename / naming constants.
+
+Mirrors the on-disk checkpoint naming contract of the reference
+(``/root/reference/src/accelerate/utils/constants.py:18-31``) so checkpoints
+written by either framework are recognisable, while the payload format here is
+TPU-native (msgpack/safetensors pytrees rather than torch pickles).
+"""
+
+MODEL_NAME = "pytree_model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+RNG_STATE_NAME = "random_states"
+CUSTOM_STATES_NAME = "custom_checkpoint"
+PROFILE_PATTERN_NAME = "profile_{suffix}"
+
+WEIGHTS_NAME = f"{MODEL_NAME}.safetensors"
+WEIGHTS_INDEX_NAME = f"{MODEL_NAME}.safetensors.index.json"
+OPTIMIZER_STATE_NAME = f"{OPTIMIZER_NAME}.msgpack"
+SCHEDULER_STATE_NAME = f"{SCHEDULER_NAME}.json"
+SAMPLER_STATE_NAME = f"{SAMPLER_NAME}.json"
+
+# Default sequence pad multiple: MXU lane width is 128; padding sequence
+# lengths to a multiple of 128 avoids XLA recompiles and keeps matmuls tiled.
+TPU_PAD_MULTIPLE = 128
+
+# Mesh axis names used across the framework.  One mesh, many layouts: data
+# parallelism ("dp"), parameter/optimizer sharding a la ZeRO/FSDP ("fsdp"),
+# tensor parallelism ("tp"), sequence/context parallelism ("sp"), expert
+# parallelism ("ep"), pipeline stages ("pp").
+MESH_AXIS_DP = "dp"
+MESH_AXIS_FSDP = "fsdp"
+MESH_AXIS_TP = "tp"
+MESH_AXIS_SP = "sp"
+MESH_AXIS_EP = "ep"
+MESH_AXIS_PP = "pp"
+ALL_MESH_AXES = (
+    MESH_AXIS_DP,
+    MESH_AXIS_FSDP,
+    MESH_AXIS_TP,
+    MESH_AXIS_SP,
+    MESH_AXIS_EP,
+    MESH_AXIS_PP,
+)
+
+# Environment-variable protocol between `accelerate-tpu launch` and child
+# processes (reference: /root/reference/src/accelerate/utils/launch.py:98-325).
+ACCELERATE_ENV_PREFIX = "ACCELERATE_"
+
+SAFE_GLOBALS = ()

@@ -1,0 +1,120 @@
+"""Environment parsing helpers.
+
+Behavioural counterpart of ``/root/reference/src/accelerate/utils/environment.py``
+(str_to_bool :41, parse_flag_from_env :69, patch_environment :326) rebuilt for a
+PJRT/libtpu world: instead of CUDA_VISIBLE_DEVICES / NUMA affinity, the helpers
+here surface TPU topology hints (TPU_WORKER_ID, MEGASCALE_*, JAX coordination
+env vars).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any
+
+
+def str_to_bool(value: str) -> int:
+    """Convert a truthy/falsy env string to 1/0. Raises on garbage."""
+    value = value.lower().strip()
+    if value in ("y", "yes", "t", "true", "on", "1"):
+        return 1
+    if value in ("n", "no", "f", "false", "off", "0", ""):
+        return 0
+    raise ValueError(f"invalid truth value {value!r}")
+
+
+def get_int_from_env(env_keys, default: int) -> int:
+    """Return the first env var in ``env_keys`` that is set, as an int."""
+    for key in env_keys:
+        val = int(os.environ.get(key, -1))
+        if val >= 0:
+            return val
+    return default
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    value = os.environ.get(key, None)
+    if value is None:
+        return default
+    return bool(str_to_bool(value))
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    return os.environ.get(key, default)
+
+
+def are_libraries_initialized(*library_names: str) -> list[str]:
+    """Return the subset of ``library_names`` already imported in this process."""
+    import sys
+
+    return [name for name in library_names if name in sys.modules]
+
+
+@contextmanager
+def patch_environment(**kwargs: Any):
+    """Temporarily set env vars (upper-cased keys), restoring previous values.
+
+    Reference behaviour: /root/reference/src/accelerate/utils/environment.py:326.
+    """
+    existing = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        if key in os.environ:
+            existing[key] = os.environ[key]
+        os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key in kwargs:
+            key = key.upper()
+            if key in existing:
+                os.environ[key] = existing[key]
+            else:
+                os.environ.pop(key, None)
+
+
+def get_tpu_worker_id() -> int:
+    """Host/worker index within a TPU pod slice (0 on single host)."""
+    return get_int_from_env(
+        ["TPU_WORKER_ID", "CLOUD_TPU_TASK_ID", "JAX_PROCESS_INDEX"], 0
+    )
+
+
+def get_coordinator_address() -> str | None:
+    """Coordinator address for jax.distributed.initialize (MASTER_ADDR analog)."""
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "ACCELERATE_COORDINATOR_ADDRESS"
+    )
+    if addr:
+        return addr
+    master_addr = os.environ.get("MASTER_ADDR")
+    if master_addr:
+        port = os.environ.get("MASTER_PORT", "8476")
+        return f"{master_addr}:{port}"
+    return None
+
+
+def get_num_processes_env() -> int | None:
+    """Global process (host) count from the launch env protocol, if set."""
+    for key in ("ACCELERATE_NUM_PROCESSES", "JAX_NUM_PROCESSES", "WORLD_SIZE"):
+        if key in os.environ:
+            return int(os.environ[key])
+    return None
+
+
+def get_process_index_env() -> int | None:
+    for key in ("ACCELERATE_PROCESS_INDEX", "JAX_PROCESS_INDEX", "RANK"):
+        if key in os.environ:
+            return int(os.environ[key])
+    return None
+
+
+def get_cpu_affinity(local_process_index: int) -> None:
+    """Best-effort CPU affinity pinning for the host process.
+
+    TPU hosts do not need NUMA/GPU affinity mapping (reference:
+    utils/environment.py:273); we simply leave scheduling to the OS. Kept as an
+    API no-op for drop-in compatibility.
+    """
+    return None

@@ -1,0 +1,38 @@
+"""Version comparison helpers (reference: /root/reference/src/accelerate/utils/versions.py)."""
+
+from __future__ import annotations
+
+import importlib.metadata
+import operator
+
+from packaging.version import Version, parse
+
+STR_OPERATION_TO_FUNC = {
+    ">": operator.gt,
+    ">=": operator.ge,
+    "==": operator.eq,
+    "!=": operator.ne,
+    "<=": operator.le,
+    "<": operator.lt,
+}
+
+
+def compare_versions(library_or_version, operation: str, requirement_version: str) -> bool:
+    """Compare an installed library version (or a Version) against a requirement."""
+    if operation not in STR_OPERATION_TO_FUNC:
+        raise ValueError(
+            f"`operation` must be one of {list(STR_OPERATION_TO_FUNC)}, got {operation}"
+        )
+    if isinstance(library_or_version, str):
+        library_or_version = parse(importlib.metadata.version(library_or_version))
+    elif not isinstance(library_or_version, Version):
+        library_or_version = parse(str(library_or_version))
+    return STR_OPERATION_TO_FUNC[operation](
+        library_or_version, parse(requirement_version)
+    )
+
+
+def is_jax_version(operation: str, version: str) -> bool:
+    import jax
+
+    return compare_versions(parse(jax.__version__), operation, version)

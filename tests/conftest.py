@@ -1,0 +1,38 @@
+"""Test config: force an 8-device virtual CPU mesh before jax imports.
+
+This is the TPU-native analog of the reference's Pattern-3 CPU multi-"device"
+simulation (SURVEY.md §4): instead of spawning gloo processes, XLA itself
+exposes N host devices via --xla_force_host_platform_device_count, so every
+sharding/collective path runs exactly the SPMD code it would on a pod.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The container's sitecustomize registers the axon TPU backend and pins the
+# platform; override back to the virtual 8-device CPU mesh for tests.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    """Each test gets fresh Borg state (mirrors reference test hygiene)."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()

@@ -1,0 +1,149 @@
+import os
+
+import jax
+import pytest
+
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils.dataclasses import (
+    GradientAccumulationPlugin,
+    ParallelismConfig,
+)
+
+
+def test_partial_state_singleton():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+    assert a.num_devices == 8
+    assert a.num_processes == 1
+    assert a.is_main_process
+
+
+def test_partial_state_repr():
+    s = PartialState()
+    r = repr(s)
+    assert "Num devices: 8" in r
+
+
+def test_split_between_processes_single():
+    s = PartialState()
+    with s.split_between_processes([1, 2, 3]) as chunk:
+        assert chunk == [1, 2, 3]
+
+
+def test_on_main_process_decorator():
+    s = PartialState()
+    calls = []
+    fn = s.on_main_process(lambda: calls.append(1))
+    fn()
+    assert calls == [1]
+
+
+def test_accelerator_state_default_mesh():
+    state = AcceleratorState()
+    assert state.mesh.shape["dp"] == 8
+    assert state.mesh.shape["tp"] == 1
+    assert state.num_batch_shards == 8
+    # PartialState attrs pass through
+    assert state.num_devices == 8
+    assert state.is_main_process
+
+
+def test_accelerator_state_parallelism_config():
+    cfg = ParallelismConfig(fsdp_size=2, tp_size=2)
+    state = AcceleratorState(parallelism_config=cfg)
+    assert state.mesh.shape["dp"] == 2
+    assert state.mesh.shape["fsdp"] == 2
+    assert state.mesh.shape["tp"] == 2
+    assert state.use_fsdp and state.use_tp
+
+
+def test_accelerator_state_env_parallelism(monkeypatch):
+    monkeypatch.setenv("TP_SIZE", "4")
+    state = AcceleratorState()
+    assert state.mesh.shape["tp"] == 4
+    assert state.mesh.shape["dp"] == 2
+
+
+def test_accelerator_state_bad_mesh():
+    with pytest.raises(ValueError):
+        AcceleratorState(parallelism_config=ParallelismConfig(tp_size=3))
+
+
+def test_mixed_precision_validation():
+    with pytest.raises(ValueError):
+        AcceleratorState(mixed_precision="fp64")
+
+
+def test_mixed_precision_conflict():
+    AcceleratorState(mixed_precision="bf16")
+    with pytest.raises(ValueError):
+        AcceleratorState(mixed_precision="fp16")
+
+
+def test_on_process_decorator_factory_form():
+    s = PartialState()
+    calls = []
+
+    @s.on_process(process_index=0)
+    def fn():
+        calls.append("ran")
+
+    fn()
+    assert calls == ["ran"]
+
+    @s.on_main_process()
+    def fn2():
+        calls.append("main")
+
+    fn2()
+    assert calls == ["ran", "main"]
+
+
+def test_split_between_processes_tuple_dict_values(monkeypatch):
+    s = PartialState()
+    # Simulate being process 1 of 2: the short chunk gets padded from a tuple.
+    monkeypatch.setitem(s.__dict__, "num_processes", 2)
+    monkeypatch.setitem(s.__dict__, "process_index", 1)
+    monkeypatch.setattr(s, "wait_for_everyone", lambda: None)
+    with s.split_between_processes({"a": (1, 2, 3)}, apply_padding=True) as chunk:
+        assert chunk == {"a": [3, 3]}
+    with s.split_between_processes((10, 20, 30)) as chunk:
+        assert chunk == [30]
+
+
+def test_partial_state_rejects_unknown_kwargs():
+    with pytest.raises(TypeError):
+        PartialState(bogus_kwarg=1)
+
+
+def test_accelerator_state_conflicting_parallelism_reinit():
+    AcceleratorState(parallelism_config=ParallelismConfig())
+    with pytest.raises(ValueError):
+        AcceleratorState(parallelism_config=ParallelismConfig(tp_size=2))
+
+
+def test_gradient_state():
+    gs = GradientState(GradientAccumulationPlugin(num_steps=4))
+    assert gs.num_steps == 4
+    assert gs.sync_gradients
+    assert not gs.in_dataloader
+    assert gs.remainder == -1
+    gs._set_sync_gradients(False)
+    assert not GradientState().sync_gradients
+
+
+def test_gradient_state_dataloader_registry():
+    gs = GradientState()
+
+    class FakeDL:
+        end_of_dataloader = True
+        remainder = 3
+
+    dl = FakeDL()
+    gs._add_dataloader(dl)
+    assert gs.in_dataloader
+    assert gs.end_of_dataloader
+    assert gs.remainder == 3
+    gs._remove_dataloader(dl)
+    assert not gs.in_dataloader
