@@ -1,0 +1,427 @@
+"""Pytree collective operations — the L2 layer.
+
+Counterpart of ``/root/reference/src/accelerate/utils/operations.py`` (867 LoC).
+The reference branches per DistributedType into NCCL/gloo/xm calls; here there
+is exactly one distribution model:
+
+* **device-level** collectives (the hot path) never appear in this file — they
+  are emitted by XLA from sharding specs inside the compiled step and ride ICI;
+* **host-level** utilities below move data between host processes over the
+  PJRT/DCN fabric (``jax.experimental.multihost_utils``) or between host and
+  device (``jax.device_put``).  These are the cold-path analogues of
+  ``gather``/``broadcast``/``reduce``/``pad_across_processes``.
+
+All ops are pytree-recursive over nested list/tuple/dict/namedtuple structures
+(reference ``recursively_apply`` operations.py:84) and accept jax.Array, numpy,
+and Python scalars.
+"""
+
+from __future__ import annotations
+
+import pickle
+from functools import wraps
+from typing import Any, Callable, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DistributedOperationException(Exception):
+    """Raised when an operation cannot run consistently across processes
+    (reference operations.py:355)."""
+
+
+def is_tensor_like(obj: Any) -> bool:
+    return isinstance(obj, (jax.Array, np.ndarray))
+
+
+def honor_type(obj, generator):
+    """Rebuild ``obj``'s container type from ``generator`` (namedtuple-aware;
+    reference operations.py:60)."""
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*list(generator))
+    return type(obj)(generator)
+
+
+def recursively_apply(
+    func: Callable,
+    data: Any,
+    *args,
+    test_type: Callable[[Any], bool] = is_tensor_like,
+    error_on_other_type: bool = False,
+    **kwargs,
+):
+    """Apply ``func`` to every leaf of ``data`` passing ``test_type``.
+
+    Reference pytree engine: operations.py:84.  Implemented directly (not via
+    jax.tree_util) so Mapping subclasses and namedtuples round-trip with their
+    own types, and non-tensor leaves pass through untouched.
+    """
+    if test_type(data):
+        return func(data, *args, **kwargs)
+    if isinstance(data, (tuple, list)):
+        return honor_type(
+            data,
+            (
+                recursively_apply(
+                    func,
+                    o,
+                    *args,
+                    test_type=test_type,
+                    error_on_other_type=error_on_other_type,
+                    **kwargs,
+                )
+                for o in data
+            ),
+        )
+    if isinstance(data, Mapping):
+        return type(data)(
+            {
+                k: recursively_apply(
+                    func,
+                    v,
+                    *args,
+                    test_type=test_type,
+                    error_on_other_type=error_on_other_type,
+                    **kwargs,
+                )
+                for k, v in data.items()
+            }
+        )
+    if error_on_other_type:
+        raise TypeError(
+            f"Unsupported type {type(data)} passed to a collective op; only "
+            "nested list/tuple/dicts of arrays are supported."
+        )
+    return data
+
+
+# ---------------------------------------------------------------------------
+# Host ↔ device movement
+# ---------------------------------------------------------------------------
+def send_to_device(tensor, device=None, non_blocking: bool = False, skip_keys=None):
+    """Recursively move arrays to a device or sharding (reference :135).
+
+    ``device`` may be a jax.Device, a Sharding, or None (default device).
+    Transfers are always async under PJRT; ``non_blocking`` kept for parity.
+    """
+    if skip_keys is not None and isinstance(tensor, Mapping):
+        skip = (skip_keys,) if isinstance(skip_keys, str) else tuple(skip_keys)
+        return type(tensor)(
+            {
+                k: (v if k in skip else send_to_device(v, device))
+                for k, v in tensor.items()
+            }
+        )
+
+    def _send(t):
+        return jax.device_put(t, device)
+
+    return recursively_apply(_send, tensor)
+
+
+def get_data_structure(data):
+    """Shape/dtype skeleton of a pytree (reference :169) for broadcast of
+    structure before payload."""
+
+    def _describe(t):
+        return {"shape": tuple(np.shape(t)), "dtype": str(np.asarray(t).dtype)}
+
+    return recursively_apply(_describe, data)
+
+
+def initialize_tensors(data_structure):
+    """Materialize zeros matching a skeleton from ``get_data_structure``."""
+
+    def _init(desc):
+        return jnp.zeros(desc["shape"], dtype=desc["dtype"])
+
+    return recursively_apply(
+        _init, data_structure, test_type=lambda o: isinstance(o, dict) and "shape" in o
+    )
+
+
+def find_device(data):
+    """First device found in a pytree (reference :1010)."""
+    if isinstance(data, (tuple, list)):
+        for obj in data:
+            device = find_device(obj)
+            if device is not None:
+                return device
+    elif isinstance(data, Mapping):
+        for obj in data.values():
+            device = find_device(obj)
+            if device is not None:
+                return device
+    elif isinstance(data, jax.Array):
+        devs = getattr(data.sharding, "device_set", None)
+        if devs:
+            return next(iter(devs))
+    return None
+
+
+def find_batch_size(data) -> Optional[int]:
+    """Batch size (dim 0) of the first array leaf (reference :254)."""
+    if isinstance(data, (tuple, list)):
+        for obj in data:
+            result = find_batch_size(obj)
+            if result is not None:
+                return result
+    elif isinstance(data, Mapping):
+        for obj in data.values():
+            result = find_batch_size(obj)
+            if result is not None:
+                return result
+    elif is_tensor_like(data) and np.ndim(data) > 0:
+        return int(np.shape(data)[0])
+    return None
+
+
+def listify(data):
+    """Convert array leaves to nested Python lists (reference :273)."""
+
+    def _to_list(t):
+        return np.asarray(jax.device_get(t)).tolist()
+
+    return recursively_apply(_to_list, data)
+
+
+def slice_tensors(data, tensor_slice, process_index=None, num_processes=None):
+    """Slice every array leaf (reference :570)."""
+
+    def _slice(t, s):
+        return t[s]
+
+    return recursively_apply(_slice, data, tensor_slice)
+
+
+def concatenate(data, dim: int = 0):
+    """Concatenate a list of pytrees leaf-wise (reference :600)."""
+    if isinstance(data[0], (tuple, list)):
+        return honor_type(
+            data[0], (concatenate([d[i] for d in data], dim=dim) for i in range(len(data[0])))
+        )
+    if isinstance(data[0], Mapping):
+        return type(data[0])(
+            {k: concatenate([d[k] for d in data], dim=dim) for k in data[0].keys()}
+        )
+    if not is_tensor_like(data[0]):
+        raise TypeError(f"Can only concatenate arrays/containers, got {type(data[0])}.")
+    if isinstance(data[0], np.ndarray):
+        return np.concatenate(data, axis=dim)
+    return jnp.concatenate(data, axis=dim)
+
+
+# ---------------------------------------------------------------------------
+# Cross-process (host-level) collectives
+# ---------------------------------------------------------------------------
+def _num_processes() -> int:
+    return jax.process_count()
+
+
+def verify_operation(function: Callable):
+    """Debug-mode shape verification before a collective (reference :364).
+
+    With ``ACCELERATE_DEBUG_MODE=1`` every rank's pytree shape skeleton is
+    all-gathered and compared before the real op, turning silent hangs from
+    mismatched collectives into a loud DistributedOperationException.
+    """
+
+    @wraps(function)
+    def wrapper(*args, **kwargs):
+        from ..state import PartialState
+
+        state = PartialState()
+        if not state.debug or state.num_processes == 1:
+            return function(*args, **kwargs)
+        operation = f"{function.__module__}.{function.__name__}"
+        tensor = kwargs.get("tensor", args[0] if args else None)
+        shapes = get_data_structure(tensor)
+        output = gather_object([shapes])
+        if output[0] is not None and not all(o == output[0] for o in output[1:]):
+            raise DistributedOperationException(
+                f"Cannot apply the desired operation ({operation}) due to "
+                f"distributed shape mismatch across processes: {output}"
+            )
+        return function(*args, **kwargs)
+
+    return wrapper
+
+
+@verify_operation
+def gather(tensor):
+    """Gather across host processes, concatenating along dim 0 (reference :419).
+
+    For a globally-sharded jax.Array the data is already the concatenation —
+    the op reshards to fully-replicated so every host can address all of it.
+    For host-local (numpy / single-device) arrays it all-gathers across
+    processes.
+    """
+
+    def _gather(t):
+        if isinstance(t, jax.Array) and not t.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(t, tiled=True)
+        if _num_processes() == 1:
+            return t
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(np.asarray(t), tiled=True)
+
+    return recursively_apply(_gather, tensor, error_on_other_type=True)
+
+
+def gather_object(object: Any):
+    """Gather arbitrary picklable objects from all processes into a list
+    (reference :445)."""
+    if _num_processes() == 1:
+        return [object]
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(object), dtype=np.uint8)
+    size = np.array([payload.size], dtype=np.int64)
+    all_sizes = multihost_utils.process_allgather(size)
+    max_size = int(all_sizes.max())
+    padded = np.zeros(max_size, dtype=np.uint8)
+    padded[: payload.size] = payload
+    gathered = multihost_utils.process_allgather(padded)
+    return [
+        pickle.loads(gathered[i, : int(all_sizes[i, 0])].tobytes())
+        for i in range(gathered.shape[0])
+    ]
+
+
+@verify_operation
+def broadcast(tensor, from_process: int = 0):
+    """Broadcast array leaves from ``from_process`` to all (reference :539)."""
+
+    def _broadcast(t):
+        if _num_processes() == 1:
+            return t
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(
+            np.asarray(jax.device_get(t)), is_source=jax.process_index() == from_process
+        )
+
+    return recursively_apply(_broadcast, tensor, error_on_other_type=True)
+
+
+def broadcast_object_list(object_list: list, from_process: int = 0):
+    """Broadcast picklable objects from one process, in place (reference :560)."""
+    if _num_processes() == 1:
+        return object_list
+    results = gather_object(list(object_list))
+    src = results[from_process]
+    for i in range(len(object_list)):
+        object_list[i] = src[i]
+    return object_list
+
+
+@verify_operation
+def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
+    """Sum/mean each leaf across host processes (reference :724)."""
+
+    def _reduce(t):
+        if _num_processes() == 1:
+            arr = jnp.asarray(t)
+            return arr * scale if scale != 1.0 else arr
+        from jax.experimental import multihost_utils
+
+        stacked = multihost_utils.process_allgather(np.asarray(jax.device_get(t)))
+        out = stacked.sum(axis=0) * scale
+        if reduction == "mean":
+            out = out / _num_processes()
+        return jnp.asarray(out)
+
+    return recursively_apply(_reduce, tensor, error_on_other_type=True)
+
+
+@verify_operation
+def pad_across_processes(tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+    """Pad each leaf to the max size across processes on ``dim`` (reference :628)."""
+
+    def _pad(t):
+        if np.ndim(t) == 0:
+            return t
+        ndim = np.ndim(t)
+        d = dim % ndim if ndim else 0
+        size = np.array(np.shape(t), dtype=np.int64)
+        if _num_processes() == 1:
+            return t
+        from jax.experimental import multihost_utils
+
+        sizes = multihost_utils.process_allgather(size)
+        max_size = int(sizes[:, d].max())
+        if max_size == np.shape(t)[d]:
+            return t
+        old_size = np.shape(t)
+        new_size = list(old_size)
+        new_size[d] = max_size
+        new_tensor = jnp.full(new_size, pad_index, dtype=jnp.asarray(t).dtype)
+        if pad_first:
+            indices = tuple(
+                slice(max_size - old_size[d], max_size) if i == d else slice(None)
+                for i in range(ndim)
+            )
+        else:
+            indices = tuple(
+                slice(0, old_size[d]) if i == d else slice(None) for i in range(ndim)
+            )
+        return new_tensor.at[indices].set(jnp.asarray(t))
+
+    return recursively_apply(_pad, tensor, error_on_other_type=True)
+
+
+def pad_input_tensors(tensor, batch_size: int, num_processes: int, dim: int = 0):
+    """Pad dim 0 so batch splits evenly across processes (reference :680)."""
+    remainder = batch_size % num_processes
+    if remainder == 0:
+        return tensor
+    missing = num_processes - remainder
+
+    def _pad(t):
+        if np.ndim(t) == 0 or np.shape(t)[0] != batch_size:
+            return t
+        arr = jnp.asarray(t)
+        pad = jnp.repeat(arr[-1:], missing, axis=0)
+        return jnp.concatenate([arr, pad], axis=0)
+
+    return recursively_apply(_pad, tensor, error_on_other_type=True)
+
+
+# ---------------------------------------------------------------------------
+# Precision conversion
+# ---------------------------------------------------------------------------
+def convert_to_fp32(tensor):
+    """Upcast half-precision leaves to float32 (reference :786)."""
+
+    def _convert(t):
+        return jnp.asarray(t, dtype=jnp.float32)
+
+    def _is_half(t):
+        return is_tensor_like(t) and t.dtype in (
+            np.dtype("float16"),
+            np.dtype(jnp.bfloat16),
+        )
+
+    return recursively_apply(_convert, tensor, test_type=_is_half)
+
+
+class ConvertOutputsToFp32:
+    """Wrap a forward so its float outputs come back fp32 (reference :800).
+
+    Kept as a class (not a closure) so wrapped models stay picklable.
+    """
+
+    def __init__(self, model_forward):
+        self.model_forward = model_forward
+        wraps(model_forward)(self)
+
+    def __call__(self, *args, **kwargs):
+        return convert_to_fp32(self.model_forward(*args, **kwargs))
+
+
+convert_outputs_to_fp32 = ConvertOutputsToFp32

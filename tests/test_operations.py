@@ -1,0 +1,117 @@
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils import operations as ops
+
+Point = collections.namedtuple("Point", ["x", "y"])
+
+
+def test_recursively_apply_containers():
+    data = {"a": [jnp.ones(2), (jnp.zeros(3), 5)], "b": Point(jnp.ones(1), "s")}
+    out = ops.recursively_apply(lambda t: t + 1, data)
+    assert isinstance(out["b"], Point)
+    np.testing.assert_array_equal(out["a"][0], np.full(2, 2.0))
+    np.testing.assert_array_equal(out["a"][1][0], np.ones(3))
+    assert out["a"][1][1] == 5  # non-tensor passthrough
+    assert out["b"].y == "s"
+
+
+def test_recursively_apply_error_on_other_type():
+    with pytest.raises(TypeError):
+        ops.recursively_apply(lambda t: t, {"a": object()}, error_on_other_type=True)
+
+
+def test_send_to_device_and_skip_keys():
+    batch = {"x": np.ones((2, 2)), "y": np.zeros(3), "meta": np.ones(1)}
+    out = ops.send_to_device(batch, jax.devices()[0], skip_keys="meta")
+    assert isinstance(out["x"], jax.Array)
+    assert isinstance(out["meta"], np.ndarray)
+
+
+def test_get_data_structure_and_initialize():
+    data = {"a": jnp.ones((2, 3), dtype=jnp.bfloat16)}
+    skel = ops.get_data_structure(data)
+    assert skel == {"a": {"shape": (2, 3), "dtype": "bfloat16"}}
+    rebuilt = ops.initialize_tensors(skel)
+    assert rebuilt["a"].shape == (2, 3)
+    assert rebuilt["a"].dtype == jnp.bfloat16
+
+
+def test_find_batch_size_and_device():
+    data = [{"labels": 3}, {"x": jnp.ones((4, 2))}]
+    assert ops.find_batch_size(data) == 4
+    assert ops.find_device(data) is not None
+    assert ops.find_batch_size({"a": 1}) is None
+
+
+def test_listify():
+    out = ops.listify({"a": jnp.arange(3)})
+    assert out == {"a": [0, 1, 2]}
+
+
+def test_concatenate():
+    chunks = [{"x": jnp.ones((2, 2))}, {"x": jnp.zeros((3, 2))}]
+    out = ops.concatenate(chunks)
+    assert out["x"].shape == (5, 2)
+    nt = [Point(np.ones(2), np.ones(1)), Point(np.zeros(2), np.zeros(1))]
+    out = ops.concatenate(nt)
+    assert isinstance(out, Point)
+    assert out.x.shape == (4,)
+
+
+def test_single_process_collectives_are_identity():
+    x = {"t": jnp.arange(4)}
+    np.testing.assert_array_equal(ops.gather(x)["t"], np.arange(4))
+    np.testing.assert_array_equal(ops.broadcast(x)["t"], np.arange(4))
+    assert ops.gather_object(["obj"]) == [["obj"]]
+    lst = [1, 2]
+    assert ops.broadcast_object_list(lst) == [1, 2]
+
+
+def test_reduce_scale():
+    out = ops.reduce({"t": jnp.full(3, 2.0)}, scale=0.5)
+    np.testing.assert_array_equal(out["t"], np.full(3, 1.0))
+
+
+def test_pad_across_processes_single_is_identity():
+    x = jnp.ones((2, 3))
+    np.testing.assert_array_equal(ops.pad_across_processes(x), np.ones((2, 3)))
+
+
+def test_pad_input_tensors():
+    batch = {"x": jnp.arange(10).reshape(5, 2), "flag": jnp.asarray(1)}
+    out = ops.pad_input_tensors(batch, batch_size=5, num_processes=4, dim=0)
+    assert out["x"].shape == (8, 2)
+    np.testing.assert_array_equal(out["x"][5], out["x"][4])
+    out = ops.pad_input_tensors(batch, batch_size=5, num_processes=5)
+    assert out["x"].shape == (5, 2)
+
+
+def test_convert_to_fp32():
+    data = {"h": jnp.ones(2, dtype=jnp.bfloat16), "i": jnp.ones(2, dtype=jnp.int32)}
+    out = ops.convert_to_fp32(data)
+    assert out["h"].dtype == jnp.float32
+    assert out["i"].dtype == jnp.int32  # ints untouched
+
+
+def test_convert_outputs_to_fp32_wrapper():
+    fn = ops.convert_outputs_to_fp32(lambda x: {"y": x})
+    out = fn(jnp.ones(2, dtype=jnp.float16))
+    assert out["y"].dtype == jnp.float32
+
+
+def test_sharded_gather_on_mesh():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu.state import AcceleratorState
+
+    state = AcceleratorState()
+    x = jax.device_put(
+        jnp.arange(16).reshape(8, 2), NamedSharding(state.mesh, P("dp", None))
+    )
+    out = ops.gather(x)
+    np.testing.assert_array_equal(np.asarray(out), np.arange(16).reshape(8, 2))
