@@ -1,0 +1,24 @@
+from . import functional
+from . import functional as F
+from . import random
+from .layers import (
+    AvgPool2d,
+    Conv2d,
+    CrossEntropyLoss,
+    Dropout,
+    Embedding,
+    GELU,
+    Identity,
+    LayerNorm,
+    Linear,
+    MaxPool2d,
+    MSELoss,
+    ReLU,
+    RMSNorm,
+    SiLU,
+    Softmax,
+    Tanh,
+)
+from .module import Buffer, Module, ModuleDict, ModuleList, Parameter, Sequential
+from .random import manual_seed
+from .tape import Tensor, backward, enable_grad, is_grad_enabled, no_grad, tape_op

@@ -1,0 +1,194 @@
+"""Functional ops (``F.*``) over tape Tensors.
+
+Every op is a thin ``tape_op`` around a pure jnp/lax function, so gradients
+come from ``jax.vjp`` and the whole thing fuses under jit.  Attention routes
+to the Pallas flash kernel on TPU when shapes allow (ops/flash_attention.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import random as nn_random
+from .tape import Tensor, tape_op, _unwrap, is_grad_enabled
+
+
+# -- activations ------------------------------------------------------------
+def relu(x):
+    return tape_op(jax.nn.relu, x)
+
+
+def gelu(x, approximate: bool = True):
+    return tape_op(lambda v: jax.nn.gelu(v, approximate=approximate), x)
+
+
+def silu(x):
+    return tape_op(jax.nn.silu, x)
+
+
+def sigmoid(x):
+    return tape_op(jax.nn.sigmoid, x)
+
+
+def tanh(x):
+    return tape_op(jnp.tanh, x)
+
+
+def softmax(x, axis: int = -1):
+    return tape_op(lambda v: jax.nn.softmax(v, axis=axis), x)
+
+
+def log_softmax(x, axis: int = -1):
+    return tape_op(lambda v: jax.nn.log_softmax(v, axis=axis), x)
+
+
+# -- linear algebra ---------------------------------------------------------
+def linear(x, weight, bias=None):
+    """x @ W^T + b with torch weight layout (out, in)."""
+    if bias is None:
+        return tape_op(lambda v, w: v @ w.T, x, weight)
+    return tape_op(lambda v, w, b: v @ w.T + b, x, weight, bias)
+
+
+def embedding(ids, weight):
+    ids = _unwrap(ids) if isinstance(ids, Tensor) else jnp.asarray(ids)
+    return tape_op(lambda w: jnp.take(w, ids, axis=0), weight)
+
+
+def one_hot(ids, num_classes: int):
+    ids = _unwrap(ids)
+    return Tensor(jax.nn.one_hot(ids, num_classes))
+
+
+# -- normalization ----------------------------------------------------------
+def layer_norm(x, normalized_shape, weight=None, bias=None, eps: float = 1e-5):
+    def _ln(v, *wb):
+        mean = v.mean(axis=-1, keepdims=True)
+        var = ((v - mean) ** 2).mean(axis=-1, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        if len(wb) >= 1:
+            out = out * wb[0]
+        if len(wb) == 2:
+            out = out + wb[1]
+        return out
+
+    args = [a for a in (weight, bias) if a is not None]
+    return tape_op(_ln, x, *args)
+
+
+def rms_norm(x, weight=None, eps: float = 1e-6):
+    def _rms(v, *w):
+        # normalise in fp32 for stability, cast back (standard TPU practice)
+        dtype = v.dtype
+        v32 = v.astype(jnp.float32)
+        out = v32 * jax.lax.rsqrt((v32**2).mean(axis=-1, keepdims=True) + eps)
+        out = out.astype(dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    args = [weight] if weight is not None else []
+    return tape_op(_rms, x, *args)
+
+
+# -- losses -----------------------------------------------------------------
+def cross_entropy(logits, labels, ignore_index: Optional[int] = -100, label_smoothing: float = 0.0):
+    """Mean token-level cross entropy; labels are int ids.
+
+    Matches torch.nn.functional.cross_entropy semantics for (N, C) logits /
+    (N,) labels and the flattened LM case, including ``ignore_index`` masking.
+    """
+    labels = _unwrap(labels) if isinstance(labels, Tensor) else jnp.asarray(labels)
+
+    def _ce(lg):
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        num_classes = lg.shape[-1]
+        safe_labels = jnp.where(labels == ignore_index, 0, labels) if ignore_index is not None else labels
+        nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        if label_smoothing > 0.0:
+            smooth = -logp.mean(axis=-1)
+            nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+        if ignore_index is not None:
+            mask = (labels != ignore_index).astype(nll.dtype)
+            return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return nll.mean()
+
+    return tape_op(_ce, logits)
+
+
+def nll_loss(log_probs, labels):
+    labels = _unwrap(labels) if isinstance(labels, Tensor) else jnp.asarray(labels)
+
+    def _nll(lp):
+        return -jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0].mean()
+
+    return tape_op(_nll, log_probs)
+
+
+def mse_loss(pred, target):
+    return tape_op(lambda p, t: ((p - t) ** 2).mean(), pred, target)
+
+
+def binary_cross_entropy_with_logits(logits, targets):
+    def _bce(lg, t):
+        return jnp.mean(jnp.maximum(lg, 0) - lg * t + jnp.log1p(jnp.exp(-jnp.abs(lg))))
+
+    return tape_op(_bce, logits, targets)
+
+
+# -- dropout ----------------------------------------------------------------
+def dropout(x, p: float = 0.5, training: bool = True):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = nn_random.next_key()
+
+    def _drop(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, shape=v.shape)
+        return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+
+    return tape_op(_drop, x)
+
+
+# -- attention --------------------------------------------------------------
+def scaled_dot_product_attention(
+    q, k, v, attn_mask=None, is_causal: bool = False, scale: Optional[float] = None,
+    dropout_p: float = 0.0,
+):
+    """SDPA with (batch, heads, seq, head_dim) layout (torch parity).
+
+    Routes to the Pallas flash-attention kernel on TPU for supported shapes;
+    falls back to the XLA-fused reference implementation elsewhere (CPU tests,
+    tiny shapes, exotic masks).
+    """
+    mask_arr = _unwrap(attn_mask) if attn_mask is not None else None
+
+    def _sdpa(q_, k_, v_):
+        from ..ops.attention import sdpa_reference, sdpa_tpu
+
+        return sdpa_tpu(q_, k_, v_, mask=mask_arr, is_causal=is_causal, scale=scale)
+
+    out = tape_op(_sdpa, q, k, v)
+    if dropout_p > 0.0:
+        out = dropout(out, dropout_p)
+    return out
+
+
+# -- misc -------------------------------------------------------------------
+def pad(x, pad_width, value=0.0):
+    return tape_op(lambda v: jnp.pad(v, pad_width, constant_values=value), x)
+
+
+def cat(tensors, dim: int = 0):
+    return tape_op(lambda *ts: jnp.concatenate(ts, axis=dim), *tensors)
+
+
+def stack(tensors, dim: int = 0):
+    return tape_op(lambda *ts: jnp.stack(ts, axis=dim), *tensors)
+
+
+def where(cond, a, b):
+    cond = _unwrap(cond)
+    return tape_op(lambda x, y: jnp.where(cond, x, y), a, b)

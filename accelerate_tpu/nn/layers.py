@@ -1,0 +1,265 @@
+"""Standard layers on the tape Module system.
+
+Initialisations follow torch defaults (kaiming-uniform Linear, N(0,1)
+Embedding scaled) so models built here converge like their reference-world
+counterparts; everything computes through ``F.*`` → jnp → XLA.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from . import random as nn_random
+from .module import Buffer, Module, Parameter
+from .tape import Tensor
+
+
+def _uniform(key, shape, bound, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, minval=-bound, maxval=bound, dtype=dtype)
+
+
+class Linear(Module):
+    def __init__(self, in_features: int, out_features: int, bias: bool = True, dtype=jnp.float32):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        bound = 1.0 / math.sqrt(in_features)
+        self.weight = Parameter(
+            _uniform(nn_random.next_key(), (out_features, in_features), bound, dtype)
+        )
+        if bias:
+            self.bias = Parameter(
+                _uniform(nn_random.next_key(), (out_features,), bound, dtype)
+            )
+        else:
+            self.register_parameter("bias", None)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def __repr__(self):
+        return f"Linear(in={self.in_features}, out={self.out_features}, bias={self.bias is not None})"
+
+
+class Embedding(Module):
+    def __init__(self, num_embeddings: int, embedding_dim: int, dtype=jnp.float32):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = Parameter(
+            jax.random.normal(
+                nn_random.next_key(), (num_embeddings, embedding_dim), dtype
+            )
+        )
+
+    def forward(self, ids):
+        return F.embedding(ids, self.weight)
+
+    def __repr__(self):
+        return f"Embedding({self.num_embeddings}, {self.embedding_dim})"
+
+
+class LayerNorm(Module):
+    def __init__(self, normalized_shape, eps: float = 1e-5, elementwise_affine: bool = True, dtype=jnp.float32):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = eps
+        if elementwise_affine:
+            self.weight = Parameter(jnp.ones(self.normalized_shape, dtype))
+            self.bias = Parameter(jnp.zeros(self.normalized_shape, dtype))
+        else:
+            self.register_parameter("weight", None)
+            self.register_parameter("bias", None)
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias, self.eps)
+
+
+class RMSNorm(Module):
+    def __init__(self, dim: int, eps: float = 1e-6, dtype=jnp.float32):
+        super().__init__()
+        self.eps = eps
+        self.weight = Parameter(jnp.ones((dim,), dtype))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.eps)
+
+
+class Dropout(Module):
+    def __init__(self, p: float = 0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training)
+
+    def __repr__(self):
+        return f"Dropout(p={self.p})"
+
+
+class Identity(Module):
+    def forward(self, x):
+        return x
+
+
+class ReLU(Module):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class GELU(Module):
+    def __init__(self, approximate: str | bool = "tanh"):
+        super().__init__()
+        self.approximate = approximate in ("tanh", True)
+
+    def forward(self, x):
+        return F.gelu(x, approximate=self.approximate)
+
+
+class SiLU(Module):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Tanh(Module):
+    def forward(self, x):
+        return F.tanh(x)
+
+
+class Softmax(Module):
+    def __init__(self, dim: int = -1):
+        super().__init__()
+        self.dim = dim
+
+    def forward(self, x):
+        return F.softmax(x, axis=self.dim)
+
+
+class Conv2d(Module):
+    """NCHW conv (torch layout) lowered to lax.conv_general_dilated.
+
+    XLA maps this straight onto the MXU; for image models prefer channel
+    counts that are multiples of 128 on TPU.
+    """
+
+    def __init__(
+        self,
+        in_channels: int,
+        out_channels: int,
+        kernel_size,
+        stride=1,
+        padding=0,
+        bias: bool = True,
+        dtype=jnp.float32,
+    ):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        self.padding = (
+            ((padding, padding), (padding, padding))
+            if isinstance(padding, int)
+            else tuple((p, p) if isinstance(p, int) else p for p in padding)
+        )
+        fan_in = in_channels * kernel_size[0] * kernel_size[1]
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = Parameter(
+            _uniform(
+                nn_random.next_key(),
+                (out_channels, in_channels, *kernel_size),
+                bound,
+                dtype,
+            )
+        )
+        if bias:
+            self.bias = Parameter(
+                _uniform(nn_random.next_key(), (out_channels,), bound, dtype)
+            )
+        else:
+            self.register_parameter("bias", None)
+
+    def forward(self, x):
+        from .tape import tape_op
+
+        def _conv(v, w, *b):
+            out = jax.lax.conv_general_dilated(
+                v,
+                w,
+                window_strides=self.stride,
+                padding=self.padding,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            )
+            if b:
+                out = out + b[0][None, :, None, None]
+            return out
+
+        args = [x, self.weight] + ([self.bias] if self.bias is not None else [])
+        return tape_op(_conv, *args)
+
+
+class MaxPool2d(Module):
+    def __init__(self, kernel_size, stride=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) else kernel_size
+        s = stride or kernel_size
+        self.k = k
+        self.s = (s, s) if isinstance(s, int) else s
+
+    def forward(self, x):
+        from .tape import tape_op
+
+        def _pool(v):
+            return jax.lax.reduce_window(
+                v,
+                -jnp.inf,
+                jax.lax.max,
+                (1, 1, *self.k),
+                (1, 1, *self.s),
+                "VALID",
+            )
+
+        return tape_op(_pool, x)
+
+
+class AvgPool2d(Module):
+    def __init__(self, kernel_size, stride=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) else kernel_size
+        s = stride or kernel_size
+        self.k = k
+        self.s = (s, s) if isinstance(s, int) else s
+
+    def forward(self, x):
+        from .tape import tape_op
+
+        def _pool(v):
+            summed = jax.lax.reduce_window(
+                v, 0.0, jax.lax.add, (1, 1, *self.k), (1, 1, *self.s), "VALID"
+            )
+            return summed / (self.k[0] * self.k[1])
+
+        return tape_op(_pool, x)
+
+
+class CrossEntropyLoss(Module):
+    def __init__(self, ignore_index: Optional[int] = -100, label_smoothing: float = 0.0):
+        super().__init__()
+        self.ignore_index = ignore_index
+        self.label_smoothing = label_smoothing
+
+    def forward(self, logits, labels):
+        return F.cross_entropy(
+            logits, labels, ignore_index=self.ignore_index, label_smoothing=self.label_smoothing
+        )
+
+
+class MSELoss(Module):
+    def forward(self, pred, target):
+        return F.mse_loss(pred, target)

@@ -1,0 +1,298 @@
+"""Torch-like Module system over JAX arrays.
+
+Gives reference-style imperative ergonomics (``model(x)``, ``state_dict()``,
+``named_parameters()``) while staying purely functional underneath: parameters
+are a flat ``{dotted.path: jax.Array}`` pytree that can be swapped wholesale
+(`_functional_call`) — which is what lets ``Accelerator`` jit the user's whole
+loop body and shard params on the mesh without the user noticing.
+
+The reference manipulates torch ``nn.Module``s it does not own
+(accelerator.py:1421 prepare_model); here the module system is ours, so
+"prepare" is a re-binding of ``.data`` arrays (device_put with shardings)
+rather than a wrapper-module dance.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tape import Tensor, no_grad
+
+
+class Parameter(Tensor):
+    """A Tensor registered as a learnable leaf of a Module."""
+
+    def __init__(self, data, requires_grad: bool = True):
+        super().__init__(data, requires_grad=requires_grad)
+
+    def __repr__(self):
+        return f"Parameter(shape={tuple(self.shape)}, dtype={self.dtype})"
+
+
+class Buffer(Tensor):
+    """Non-learnable state (e.g. rotary caches, BN running stats)."""
+
+    def __init__(self, data):
+        super().__init__(data, requires_grad=False)
+
+
+class Module:
+    """Base class. Subclasses define ``__init__`` (register params/submodules
+    by attribute assignment) and ``forward``."""
+
+    def __init__(self):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_modules", OrderedDict())
+        object.__setattr__(self, "training", True)
+
+    # -- registration -------------------------------------------------------
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Parameter):
+            self._parameters[name] = value
+            self._buffers.pop(name, None)
+            self._modules.pop(name, None)
+        elif isinstance(value, Buffer):
+            self._buffers[name] = value
+            self._parameters.pop(name, None)
+        elif isinstance(value, Module):
+            self._modules[name] = value
+            self._parameters.pop(name, None)
+        object.__setattr__(self, name, value)
+
+    def register_buffer(self, name: str, tensor) -> None:
+        buf = tensor if isinstance(tensor, Buffer) else Buffer(tensor)
+        setattr(self, name, buf)
+
+    def register_parameter(self, name: str, param: Optional[Parameter]) -> None:
+        if param is None:
+            self._parameters.pop(name, None)
+            object.__setattr__(self, name, None)
+        else:
+            setattr(self, name, param)
+
+    def add_module(self, name: str, module: "Module") -> None:
+        setattr(self, name, module)
+
+    # -- traversal ----------------------------------------------------------
+    def named_modules(self, prefix: str = "") -> Iterator[tuple[str, "Module"]]:
+        yield prefix, self
+        for name, module in self._modules.items():
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from module.named_modules(sub_prefix)
+
+    def modules(self) -> Iterator["Module"]:
+        for _, m in self.named_modules():
+            yield m
+
+    def children(self) -> Iterator["Module"]:
+        yield from self._modules.values()
+
+    def named_children(self) -> Iterator[tuple[str, "Module"]]:
+        yield from self._modules.items()
+
+    def named_parameters(self, prefix: str = "") -> Iterator[tuple[str, Parameter]]:
+        for mod_name, module in self.named_modules(prefix):
+            for name, param in module._parameters.items():
+                yield (f"{mod_name}.{name}" if mod_name else name), param
+
+    def parameters(self) -> Iterator[Parameter]:
+        for _, p in self.named_parameters():
+            yield p
+
+    def named_buffers(self, prefix: str = "") -> Iterator[tuple[str, Buffer]]:
+        for mod_name, module in self.named_modules(prefix):
+            for name, buf in module._buffers.items():
+                yield (f"{mod_name}.{name}" if mod_name else name), buf
+
+    def buffers(self) -> Iterator[Buffer]:
+        for _, b in self.named_buffers():
+            yield b
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self) -> "OrderedDict[str, jax.Array]":
+        out: OrderedDict[str, jax.Array] = OrderedDict()
+        for name, p in self.named_parameters():
+            out[name] = p.data
+        for name, b in self.named_buffers():
+            out[name] = b.data
+        return out
+
+    def load_state_dict(self, state_dict, strict: bool = True):
+        own = dict(self.named_parameters())
+        own.update(dict(self.named_buffers()))
+        missing = [k for k in own if k not in state_dict]
+        unexpected = [k for k in state_dict if k not in own]
+        if strict and (missing or unexpected):
+            raise KeyError(
+                f"load_state_dict mismatch: missing={missing}, unexpected={unexpected}"
+            )
+        for key, value in state_dict.items():
+            if key in own:
+                target = own[key]
+                value = jnp.asarray(value)
+                if tuple(value.shape) != tuple(target.shape):
+                    raise ValueError(
+                        f"shape mismatch for {key}: checkpoint {value.shape} vs "
+                        f"model {target.shape}"
+                    )
+                target.data = value.astype(target.dtype)
+        return missing, unexpected
+
+    # -- mode / dtype / device ----------------------------------------------
+    def train(self, mode: bool = True) -> "Module":
+        for m in self.modules():
+            object.__setattr__(m, "training", mode)
+        return self
+
+    def eval(self) -> "Module":
+        return self.train(False)
+
+    def zero_grad(self, set_to_none: bool = True) -> None:
+        for p in self.parameters():
+            p.grad = None
+
+    def apply(self, fn: Callable[["Module"], None]) -> "Module":
+        for m in self.modules():
+            fn(m)
+        return self
+
+    def to(self, device_or_dtype=None) -> "Module":
+        """Move/cast all params+buffers. Accepts a dtype, Device, or Sharding."""
+        import numpy as _np
+
+        if device_or_dtype is None:
+            return self
+        if isinstance(device_or_dtype, (jnp.dtype, _np.dtype, type)) or (
+            isinstance(device_or_dtype, str) and not device_or_dtype.startswith(("tpu", "cpu"))
+        ):
+            dtype = jnp.dtype(device_or_dtype)
+            for t in list(self.parameters()) + list(self.buffers()):
+                t.data = t.data.astype(dtype)
+        else:
+            for t in list(self.parameters()) + list(self.buffers()):
+                t.data = jax.device_put(t.data, device_or_dtype)
+        return self
+
+    def astype(self, dtype) -> "Module":
+        return self.to(dtype)
+
+    # -- functional bridge --------------------------------------------------
+    def param_pytree(self) -> dict[str, jax.Array]:
+        """Flat {path: array} of parameters — the functional view."""
+        return {name: p.data for name, p in self.named_parameters()}
+
+    def buffer_pytree(self) -> dict[str, jax.Array]:
+        return {name: b.data for name, b in self.named_buffers()}
+
+    def bind_params(self, pytree: dict[str, Any]) -> None:
+        """Point ``.data`` of each named parameter at ``pytree[name]``.
+
+        This is the re-binding trick behind step capture: bind tracers, run
+        the Python forward, collect outputs — the jitted function is pure.
+        """
+        params = dict(self.named_parameters())
+        for name, value in pytree.items():
+            params[name].data = value
+
+    def bind_buffers(self, pytree: dict[str, Any]) -> None:
+        bufs = dict(self.named_buffers())
+        for name, value in pytree.items():
+            bufs[name].data = value
+
+    def _functional_call(self, params: dict[str, Any], *args, **kwargs):
+        """Pure-ish call: swap params in, run forward, restore."""
+        old = self.param_pytree()
+        try:
+            self.bind_params(params)
+            return self(*args, **kwargs)
+        finally:
+            self.bind_params(old)
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def __repr__(self):
+        lines = [self.__class__.__name__ + "("]
+        for name, module in self._modules.items():
+            sub = repr(module).split("\n")
+            lines.append(f"  ({name}): " + "\n  ".join(sub))
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else f"{self.__class__.__name__}()"
+
+    @property
+    def num_parameters(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+
+class Sequential(Module):
+    def __init__(self, *modules: Module):
+        super().__init__()
+        for i, m in enumerate(modules):
+            self.add_module(str(i), m)
+
+    def forward(self, x):
+        for m in self._modules.values():
+            x = m(x)
+        return x
+
+    def __getitem__(self, idx: int) -> Module:
+        return list(self._modules.values())[idx]
+
+    def __len__(self):
+        return len(self._modules)
+
+
+class ModuleList(Module):
+    def __init__(self, modules=()):
+        super().__init__()
+        for i, m in enumerate(modules):
+            self.add_module(str(i), m)
+
+    def append(self, module: Module) -> "ModuleList":
+        self.add_module(str(len(self._modules)), module)
+        return self
+
+    def __iter__(self) -> Iterator[Module]:
+        return iter(self._modules.values())
+
+    def __getitem__(self, idx: int) -> Module:
+        return list(self._modules.values())[idx]
+
+    def __len__(self):
+        return len(self._modules)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError("ModuleList is a container; call its items")
+
+
+class ModuleDict(Module):
+    def __init__(self, modules: Optional[dict[str, Module]] = None):
+        super().__init__()
+        if modules:
+            for k, v in modules.items():
+                self.add_module(k, v)
+
+    def __getitem__(self, key: str) -> Module:
+        return self._modules[key]
+
+    def __setitem__(self, key: str, module: Module) -> None:
+        self.add_module(key, module)
+
+    def keys(self):
+        return self._modules.keys()
+
+    def items(self):
+        return self._modules.items()
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError("ModuleDict is a container; call its items")

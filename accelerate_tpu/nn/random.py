@@ -1,0 +1,59 @@
+"""Global RNG for the imperative nn layer.
+
+Torch-style code expects implicit randomness (dropout just works); JAX wants
+explicit keys.  Bridge: a counter-based global RNG — each draw is
+``fold_in(base_key, counter)``.  Eagerly the base key comes from ``manual_seed``;
+under step capture the ``Accelerator`` swaps in a *traced* per-step key so
+dropout masks differ across steps inside one compiled program, and checkpoint
+resume restores determinism by saving (seed, counter).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class GlobalRNG:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._base_key = None
+        self._counter = 0
+
+    def manual_seed(self, seed: int) -> None:
+        self._seed = seed
+        self._base_key = jax.random.key(seed)
+        self._counter = 0
+
+    def set_key(self, key) -> None:
+        """Swap in an externally-managed (possibly traced) base key."""
+        self._base_key = key
+        self._counter = 0
+
+    def next_key(self):
+        if self._base_key is None:
+            self.manual_seed(self._seed)
+        k = jax.random.fold_in(self._base_key, self._counter)
+        self._counter += 1
+        return k
+
+    def get_state(self) -> dict:
+        return {"seed": self._seed, "counter": self._counter}
+
+    def set_state(self, state: dict) -> None:
+        # lazy: creating the key here would stage a tracer when called inside
+        # a jit trace (e.g. restoring after step capture); next_key() rebuilds
+        # it outside the trace instead
+        self._seed = state["seed"]
+        self._base_key = None
+        self._counter = state["counter"]
+
+
+default_rng = GlobalRNG()
+
+
+def manual_seed(seed: int) -> None:
+    default_rng.manual_seed(seed)
+
+
+def next_key():
+    return default_rng.next_key()

@@ -1,0 +1,371 @@
+"""Eager autodiff tape over JAX — the torch→JAX bridge core.
+
+The reference wraps an *imperative* torch loop (``loss.backward()``,
+``optimizer.step()``); JAX wants one pure ``train_step``.  This module closes
+the gap (SURVEY.md §7 hard-part #1/#2) without porting torch: a lightweight
+:class:`Tensor` wrapper records every op's ``jax.vjp`` closure on a tape, so
+
+* eagerly, ``Tensor.backward()`` walks the tape and fills ``param.grad`` —
+  imperative semantics for debugging and unmodified reference-style loops;
+* under ``Accelerator``'s step capture, the same Python code runs inside one
+  ``jax.jit`` trace: the tape ops become traced ops, the vjp closures compose
+  into the backward graph, and XLA fuses forward+backward+update into a single
+  TPU program — the performance path.
+
+Because each op's transpose comes from ``jax.vjp``, gradients are exactly
+JAX's, not a hand-written ruleset.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+
+
+_state = _TapeState()
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording (torch parity)."""
+
+    def __enter__(self):
+        self.prev = _state.grad_enabled
+        _state.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self.prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self.prev = _state.grad_enabled
+        _state.grad_enabled = True
+        return self
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+class Node:
+    """One tape entry: output ← fn(inputs) with its vjp closure."""
+
+    __slots__ = ("inputs", "vjp_fn")
+
+    def __init__(self, inputs: Sequence["Tensor"], vjp_fn: Callable):
+        self.inputs = inputs
+        self.vjp_fn = vjp_fn
+
+
+def _unwrap(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+class Tensor:
+    """An array with an optional autograd tape behind it.
+
+    Not a jax pytree node on purpose: jitted code sees only raw ``.data``
+    arrays; the wrapper lives in Python land.
+    """
+
+    __slots__ = ("data", "requires_grad", "grad", "_node")
+
+    def __init__(self, data, requires_grad: bool = False, _node: Optional[Node] = None):
+        if isinstance(data, Tensor):
+            data = data.data
+        self.data = data if isinstance(data, jax.Array) else jnp.asarray(data)
+        self.requires_grad = requires_grad
+        self.grad: Optional[jax.Array] = None
+        self._node = _node
+
+    # -- array-ish surface --------------------------------------------------
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def size(self):
+        return self.data.size
+
+    @property
+    def T(self):
+        return tape_op(lambda x: x.T, self)
+
+    def __len__(self):
+        return self.data.shape[0]
+
+    def __repr__(self):
+        grad_str = ", requires_grad=True" if self.requires_grad else ""
+        return f"Tensor({self.data!r}{grad_str})"
+
+    def numpy(self):
+        return np.asarray(jax.device_get(self.data))
+
+    def item(self):
+        return self.data.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def detach(self) -> "Tensor":
+        return Tensor(self.data)
+
+    def clone(self) -> "Tensor":
+        return tape_op(lambda x: x + 0, self)
+
+    def astype(self, dtype) -> "Tensor":
+        return tape_op(lambda x: x.astype(dtype), self)
+
+    # torch-spelling conveniences
+    def float(self):
+        return self.astype(jnp.float32)
+
+    def to(self, dtype):
+        return self.astype(dtype)
+
+    def cpu(self):
+        return self
+
+    # -- arithmetic ---------------------------------------------------------
+    def __add__(self, other):
+        return tape_op(jnp.add, self, other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return tape_op(jnp.subtract, self, other)
+
+    def __rsub__(self, other):
+        return tape_op(jnp.subtract, other, self)
+
+    def __mul__(self, other):
+        return tape_op(jnp.multiply, self, other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return tape_op(jnp.divide, self, other)
+
+    def __rtruediv__(self, other):
+        return tape_op(jnp.divide, other, self)
+
+    def __matmul__(self, other):
+        return tape_op(jnp.matmul, self, other)
+
+    def __rmatmul__(self, other):
+        return tape_op(jnp.matmul, other, self)
+
+    def __pow__(self, other):
+        return tape_op(jnp.power, self, other)
+
+    def __neg__(self):
+        return tape_op(jnp.negative, self)
+
+    def __getitem__(self, idx):
+        idx = _unwrap(idx) if isinstance(idx, Tensor) else idx
+        return tape_op(lambda x: x[idx], self)
+
+    # comparisons produce plain (non-diff) tensors
+    def __eq__(self, other):  # noqa: E721
+        return Tensor(self.data == _unwrap(other))
+
+    def __ne__(self, other):
+        return Tensor(self.data != _unwrap(other))
+
+    def __lt__(self, other):
+        return Tensor(self.data < _unwrap(other))
+
+    def __le__(self, other):
+        return Tensor(self.data <= _unwrap(other))
+
+    def __gt__(self, other):
+        return Tensor(self.data > _unwrap(other))
+
+    def __ge__(self, other):
+        return Tensor(self.data >= _unwrap(other))
+
+    def __hash__(self):
+        return id(self)
+
+    # -- shape ops ----------------------------------------------------------
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return tape_op(lambda x: x.reshape(shape), self)
+
+    view = reshape
+
+    def transpose(self, *axes):
+        if not axes:
+            axes = None
+        elif len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return tape_op(lambda x: jnp.transpose(x, axes), self)
+
+    def swapaxes(self, a, b):
+        return tape_op(lambda x: jnp.swapaxes(x, a, b), self)
+
+    def squeeze(self, axis=None):
+        return tape_op(lambda x: jnp.squeeze(x, axis), self)
+
+    def unsqueeze(self, axis):
+        return tape_op(lambda x: jnp.expand_dims(x, axis), self)
+
+    def flatten(self, start_dim=0, end_dim=-1):
+        def _flatten(x):
+            shape = x.shape
+            end = end_dim % x.ndim
+            new_shape = shape[:start_dim] + (-1,) + shape[end + 1 :]
+            return x.reshape(new_shape)
+
+        return tape_op(_flatten, self)
+
+    # -- reductions ---------------------------------------------------------
+    def sum(self, axis=None, keepdims=False):
+        return tape_op(lambda x: jnp.sum(x, axis=axis, keepdims=keepdims), self)
+
+    def mean(self, axis=None, keepdims=False):
+        return tape_op(lambda x: jnp.mean(x, axis=axis, keepdims=keepdims), self)
+
+    def max(self, axis=None, keepdims=False):
+        return tape_op(lambda x: jnp.max(x, axis=axis, keepdims=keepdims), self)
+
+    def min(self, axis=None, keepdims=False):
+        return tape_op(lambda x: jnp.min(x, axis=axis, keepdims=keepdims), self)
+
+    def var(self, axis=None, keepdims=False):
+        return tape_op(lambda x: jnp.var(x, axis=axis, keepdims=keepdims), self)
+
+    def argmax(self, axis=None):
+        return Tensor(jnp.argmax(self.data, axis=axis))
+
+    def argmin(self, axis=None):
+        return Tensor(jnp.argmin(self.data, axis=axis))
+
+    # -- elementwise --------------------------------------------------------
+    def exp(self):
+        return tape_op(jnp.exp, self)
+
+    def log(self):
+        return tape_op(jnp.log, self)
+
+    def sqrt(self):
+        return tape_op(jnp.sqrt, self)
+
+    def tanh(self):
+        return tape_op(jnp.tanh, self)
+
+    def abs(self):
+        return tape_op(jnp.abs, self)
+
+    def clip(self, min=None, max=None):
+        return tape_op(lambda x: jnp.clip(x, min, max), self)
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, gradient=None) -> None:
+        """Reverse-walk the tape, accumulating into ``.grad`` of leaves."""
+        if gradient is None:
+            if self.data.ndim != 0:
+                raise RuntimeError(
+                    "backward() on a non-scalar requires an explicit `gradient`"
+                )
+            gradient = jnp.ones_like(self.data)
+        else:
+            gradient = _unwrap(gradient)
+        backward(self, gradient)
+
+
+def tape_op(fn: Callable, *inputs) -> "Tensor":
+    """Run ``fn`` (single array out) on raw arrays; record its vjp if any
+    input needs grad."""
+    raws = tuple(_unwrap(x) for x in inputs)
+    tensor_inputs = [x for x in inputs if isinstance(x, Tensor)]
+    needs_grad = _state.grad_enabled and any(
+        t.requires_grad or t._node is not None for t in tensor_inputs
+    )
+    if not needs_grad:
+        return Tensor(fn(*raws))
+    out, vjp_fn = jax.vjp(fn, *raws)
+    return Tensor(out, _node=Node(tuple(inputs), vjp_fn))
+
+
+def backward(root: Tensor, root_grad) -> None:
+    """Reverse-mode accumulation over the recorded tape.
+
+    Topological order via iterative DFS (no recursion limits on deep nets).
+    Multi-output nodes are rare (we currently emit per-output nodes that share
+    a vjp; cotangents for sibling outputs are zero).
+    """
+    # 1. topo-sort nodes reachable from root
+    topo: list[Tensor] = []
+    visited: set[int] = set()
+    stack: list[tuple[Tensor, bool]] = [(root, False)]
+    while stack:
+        tensor, processed = stack.pop()
+        if processed:
+            topo.append(tensor)
+            continue
+        if id(tensor) in visited:
+            continue
+        visited.add(id(tensor))
+        stack.append((tensor, True))
+        if tensor._node is not None:
+            for inp in tensor._node.inputs:
+                if isinstance(inp, Tensor) and id(inp) not in visited:
+                    if inp._node is not None or inp.requires_grad:
+                        stack.append((inp, False))
+
+    # 2. reverse accumulate
+    grads: dict[int, jax.Array] = {id(root): root_grad}
+    for tensor in reversed(topo):
+        g = grads.pop(id(tensor), None)
+        if g is None:
+            continue
+        if tensor.requires_grad:
+            tensor.grad = g if tensor.grad is None else tensor.grad + g
+        node = tensor._node
+        if node is None:
+            continue
+        input_grads = node.vjp_fn(g)
+        for inp, ig in zip(node.inputs, input_grads):
+            if not isinstance(inp, Tensor) or ig is None:
+                continue
+            if getattr(ig, "dtype", None) == jax.dtypes.float0:
+                continue  # integer-typed input (e.g. token ids): no gradient
+            if not (inp.requires_grad or inp._node is not None):
+                continue
+            key = id(inp)
+            if key in grads:
+                grads[key] = grads[key] + ig
+            else:
+                grads[key] = ig
+
+
+def grad_of(params: Iterable[Tensor]) -> list[Optional[jax.Array]]:
+    return [p.grad for p in params]

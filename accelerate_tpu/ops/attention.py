@@ -1,0 +1,95 @@
+"""Attention ops: XLA reference implementation + TPU routing.
+
+``sdpa_tpu`` picks the Pallas flash-attention kernel
+(ops/flash_attention.py) when running on TPU with MXU-friendly shapes, else
+the jnp reference (which XLA still fuses into a few kernels on any backend).
+
+Layout convention everywhere: (batch, num_heads, seq, head_dim) — torch SDPA
+parity so reference-style model code ports untouched.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def sdpa_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    is_causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    # accumulate logits/softmax in fp32 regardless of input dtype
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if is_causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((q_len, k_len), dtype=bool), k_len - q_len)
+        logits = jnp.where(causal, logits, _NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, _NEG_INF)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def _on_tpu(x: jax.Array) -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def sdpa_tpu(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    is_causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Dispatch: Pallas flash kernel on TPU for MXU-tileable shapes."""
+    seq_q, seq_k, head_dim = q.shape[-2], k.shape[-2], q.shape[-1]
+    use_flash = (
+        _on_tpu(q)
+        and mask is None
+        and seq_q % 128 == 0
+        and seq_k % 128 == 0
+        and head_dim in (64, 128, 256)
+    )
+    if use_flash:
+        try:
+            from .flash_attention import flash_attention
+        except ImportError:
+            _warn_no_flash_once()
+        else:
+            return flash_attention(q, k, v, is_causal=is_causal, scale=scale)
+    return sdpa_reference(q, k, v, mask=mask, is_causal=is_causal, scale=scale)
+
+
+_warned_no_flash = False
+
+
+def _warn_no_flash_once() -> None:
+    global _warned_no_flash
+    if not _warned_no_flash:
+        _warned_no_flash = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "Pallas flash-attention kernel unavailable; using the XLA "
+            "reference attention path."
+        )

@@ -1,0 +1,211 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+from accelerate_tpu.nn import F, Tensor
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    nn.manual_seed(0)
+
+
+def test_tensor_basic_ops():
+    x = Tensor(jnp.arange(4.0))
+    y = (x + 1) * 2 - 0.5
+    np.testing.assert_allclose(y.numpy(), (np.arange(4.0) + 1) * 2 - 0.5)
+    assert (x @ x).item() == pytest.approx(14.0)
+    assert x.reshape(2, 2).shape == (2, 2)
+    assert x.unsqueeze(0).shape == (1, 4)
+
+
+def test_backward_simple():
+    x = Tensor(jnp.array(3.0), requires_grad=True)
+    y = x * x + 2 * x  # dy/dx = 2x + 2 = 8
+    y.backward()
+    assert float(x.grad) == pytest.approx(8.0)
+
+
+def test_backward_matches_jax_grad():
+    w = jax.random.normal(jax.random.key(1), (4, 3))
+    b = jax.random.normal(jax.random.key(2), (3,))
+    x = jax.random.normal(jax.random.key(3), (5, 4))
+
+    def loss_fn(w_, b_):
+        return jnp.tanh(x @ w_ + b_).sum()
+
+    gw, gb = jax.grad(loss_fn, argnums=(0, 1))(w, b)
+
+    tw = Tensor(w, requires_grad=True)
+    tb = Tensor(b, requires_grad=True)
+    loss = (Tensor(x) @ tw + tb).tanh().sum()
+    loss.backward()
+    np.testing.assert_allclose(tw.grad, gw, rtol=1e-5)
+    np.testing.assert_allclose(tb.grad, gb, rtol=1e-5)
+
+
+def test_grad_accumulates():
+    x = Tensor(jnp.array(2.0), requires_grad=True)
+    (x * x).backward()
+    (x * x).backward()
+    assert float(x.grad) == pytest.approx(8.0)  # 4 + 4
+
+
+def test_diamond_graph():
+    x = Tensor(jnp.array(2.0), requires_grad=True)
+    a = x * 3
+    b = x + 1
+    y = a * b  # y = 3x(x+1) = 3x^2+3x → dy/dx = 6x+3 = 15
+    y.backward()
+    assert float(x.grad) == pytest.approx(15.0)
+
+
+def test_no_grad():
+    x = Tensor(jnp.array(2.0), requires_grad=True)
+    with nn.no_grad():
+        y = x * x
+    assert y._node is None
+    y2 = x * x
+    assert y2._node is not None
+
+
+def test_integer_input_no_grad_crash():
+    ids = Tensor(jnp.array([0, 1]))
+    emb = Tensor(jnp.ones((3, 2)), requires_grad=True)
+    out = F.embedding(ids, emb)
+    out.sum().backward()
+    assert emb.grad is not None
+    np.testing.assert_allclose(np.asarray(emb.grad).sum(), 4.0)
+
+
+def test_linear_layer_grads():
+    layer = nn.Linear(4, 2)
+    x = Tensor(jnp.ones((3, 4)))
+    out = layer(x)
+    assert out.shape == (3, 2)
+    out.sum().backward()
+    assert layer.weight.grad.shape == (2, 4)
+    assert layer.bias.grad.shape == (2,)
+    np.testing.assert_allclose(layer.weight.grad, np.ones((2, 4)) * 3, rtol=1e-6)
+
+
+def test_module_traversal_and_state_dict():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    names = [n for n, _ in model.named_parameters()]
+    assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+    sd = model.state_dict()
+    assert sd["0.weight"].shape == (8, 4)
+    model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model2.load_state_dict(sd)
+    np.testing.assert_array_equal(model2.state_dict()["2.bias"], sd["2.bias"])
+
+
+def test_load_state_dict_strict_mismatch():
+    model = nn.Linear(2, 2)
+    with pytest.raises(KeyError):
+        model.load_state_dict({"nope": jnp.ones(2)})
+
+
+def test_train_eval_dropout():
+    drop = nn.Dropout(0.5)
+    x = Tensor(jnp.ones((100,)))
+    drop.eval()
+    np.testing.assert_array_equal(drop(x).numpy(), np.ones(100))
+    drop.train()
+    out = drop(x).numpy()
+    assert (out == 0).any() and (out > 1).any()
+
+
+def test_cross_entropy_matches_manual():
+    logits = jnp.array([[2.0, 0.5, 0.1], [0.1, 3.0, 0.2]])
+    labels = jnp.array([0, 1])
+    loss = F.cross_entropy(Tensor(logits), labels)
+    expected = -np.mean(
+        [
+            jax.nn.log_softmax(logits[0])[0],
+            jax.nn.log_softmax(logits[1])[1],
+        ]
+    )
+    assert loss.item() == pytest.approx(float(expected), rel=1e-6)
+
+
+def test_cross_entropy_ignore_index():
+    logits = jnp.array([[2.0, 0.5], [0.1, 3.0], [1.0, 1.0]])
+    labels = jnp.array([0, 1, -100])
+    loss = F.cross_entropy(Tensor(logits), labels, ignore_index=-100)
+    expected = -np.mean(
+        [jax.nn.log_softmax(logits[0])[0], jax.nn.log_softmax(logits[1])[1]]
+    )
+    assert loss.item() == pytest.approx(float(expected), rel=1e-6)
+
+
+def test_layer_norm_stats():
+    ln = nn.LayerNorm(16)
+    x = Tensor(jax.random.normal(jax.random.key(0), (4, 16)) * 5 + 3)
+    out = ln(x).numpy()
+    np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+
+def test_sdpa_causal():
+    q = jax.random.normal(jax.random.key(0), (2, 2, 8, 4))
+    out = F.scaled_dot_product_attention(Tensor(q), Tensor(q), Tensor(q), is_causal=True)
+    assert out.shape == (2, 2, 8, 4)
+    # first position can only attend to itself → output == v[..., 0, :]
+    np.testing.assert_allclose(out.numpy()[:, :, 0], q[:, :, 0], rtol=2e-5)
+
+
+def test_sdpa_grads_flow():
+    q = Tensor(jax.random.normal(jax.random.key(0), (1, 1, 4, 4)), requires_grad=True)
+    out = F.scaled_dot_product_attention(q, q, q)
+    out.sum().backward()
+    assert q.grad is not None and q.grad.shape == (1, 1, 4, 4)
+
+
+def test_conv2d_shapes_and_grads():
+    conv = nn.Conv2d(3, 8, 3, stride=1, padding=1)
+    x = Tensor(jnp.ones((2, 3, 8, 8)))
+    out = conv(x)
+    assert out.shape == (2, 8, 8, 8)
+    out.mean().backward()
+    assert conv.weight.grad.shape == (8, 3, 3, 3)
+
+
+def test_functional_call_restores():
+    layer = nn.Linear(2, 2)
+    orig = layer.param_pytree()
+    new_params = {k: jnp.zeros_like(v) for k, v in orig.items()}
+    out = layer._functional_call(new_params, Tensor(jnp.ones((1, 2))))
+    np.testing.assert_array_equal(out.numpy(), np.zeros((1, 2)))
+    np.testing.assert_array_equal(layer.weight.data, orig["weight"])
+
+
+def test_tape_under_jit_capture():
+    """The same imperative code traced under jax.jit must produce a fused
+    step: params in, (loss, grads) out."""
+    layer = nn.Linear(4, 1)
+
+    def step(params, x, y):
+        layer.bind_params(params)
+        pred = layer(Tensor(x))
+        loss = F.mse_loss(pred.squeeze(-1), Tensor(y))
+        loss.backward()
+        grads = {name: p.grad for name, p in layer.named_parameters()}
+        for p in layer.parameters():
+            p.grad = None
+        return loss.data, grads
+
+    jitted = jax.jit(step)
+    x = jax.random.normal(jax.random.key(0), (8, 4))
+    y = jax.random.normal(jax.random.key(1), (8,))
+    params = layer.param_pytree()
+    loss, grads = jitted(params, x, y)
+
+    def pure_loss(p):
+        return jnp.mean((x @ p["weight"].T + p["bias"])[:, 0] - y) ** 2 if False else jnp.mean(((x @ p["weight"].T)[:, 0] + p["bias"][0] - y) ** 2)
+
+    expected_grads = jax.grad(pure_loss)(params)
+    np.testing.assert_allclose(grads["weight"], expected_grads["weight"], rtol=1e-4)
+    np.testing.assert_allclose(grads["bias"], expected_grads["bias"], rtol=1e-4)
