@@ -1,0 +1,767 @@
+"""Data sharding & device feeding — the L3 data layer.
+
+Counterpart of ``/root/reference/src/accelerate/data_loader.py`` (1425 LoC).
+Same user-visible semantics — per-shard batch distribution, ``even_batches``
+tail looping, seedable shuffling, mid-epoch resume — rebuilt for SPMD:
+
+* the reference gives each of N processes its own torch DataLoader slice; here
+  one *global* batch per step is assembled host-side and laid onto the mesh's
+  data axes as a single ``jax.Array`` (``jax.make_array_from_process_local_data``
+  on pods, sharded ``device_put`` on one host);
+* the XLA ``MpDeviceLoader`` prefetch (reference :643-693) becomes an explicit
+  double-buffered host→device pipeline: the next batch's transfer is in flight
+  while the current step computes — keeping HBM fed off the critical path;
+* uneven tails: SPMD requires every device to see identical shapes, so the
+  ``even_batches`` loop-back semantics of the reference
+  (BatchSamplerShard data_loader.py:195-262) are the *only* mode on the hot
+  path; the duplicate count is tracked in ``GradientState.remainder`` for
+  ``gather_for_metrics`` truncation.
+
+Works with torch ``DataLoader``/``Dataset`` objects (torch CPU tensors are
+converted at the boundary) and with plain indexables/iterables.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .logging import get_logger
+from .state import AcceleratorState, GradientState, PartialState
+from .utils.dataclasses import DataLoaderConfiguration
+
+logger = get_logger(__name__)
+
+_PYTORCH_DATALOADER_KWARGS = {
+    "batch_size": 1,
+    "shuffle": False,
+    "sampler": None,
+    "batch_sampler": None,
+    "num_workers": 0,
+    "collate_fn": None,
+    "pin_memory": False,
+    "drop_last": False,
+    "timeout": 0,
+    "worker_init_fn": None,
+    "multiprocessing_context": None,
+    "generator": None,
+    "prefetch_factor": 2,
+    "persistent_workers": False,
+}
+
+
+# ---------------------------------------------------------------------------
+# Samplers
+# ---------------------------------------------------------------------------
+class SeedableRandomSampler:
+    """Deterministic shuffling: permutation seeded by ``seed + epoch``.
+
+    Reference: SeedableRandomSampler data_loader.py:72 — identical contract
+    (same seed+epoch → same order on every process/host).
+    """
+
+    def __init__(self, data_source_len: int, seed: int = 0, epoch: int = 0):
+        self.data_source_len = data_source_len
+        self.seed = seed
+        self.epoch = epoch
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[int]:
+        rng = np.random.default_rng(self.seed + self.epoch)
+        yield from rng.permutation(self.data_source_len).tolist()
+
+    def __len__(self) -> int:
+        return self.data_source_len
+
+    def state_dict(self) -> dict:
+        return {"seed": self.seed, "epoch": self.epoch}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.seed = state["seed"]
+        self.epoch = state["epoch"]
+
+
+class SequentialSampler:
+    def __init__(self, data_source_len: int):
+        self.data_source_len = data_source_len
+
+    def set_epoch(self, epoch: int) -> None:
+        pass
+
+    def __iter__(self) -> Iterator[int]:
+        yield from range(self.data_source_len)
+
+    def __len__(self) -> int:
+        return self.data_source_len
+
+
+class BatchSampler:
+    """Group sampler indices into batches (torch parity)."""
+
+    def __init__(self, sampler, batch_size: int, drop_last: bool = False):
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.sampler, "set_epoch"):
+            self.sampler.set_epoch(epoch)
+
+    def __iter__(self) -> Iterator[list[int]]:
+        batch: list[int] = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self) -> int:
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return math.ceil(n / self.batch_size)
+
+
+class GlobalBatchSampler:
+    """Yield, per step, the list of ``num_shards`` per-shard index batches.
+
+    This is the engine behind both BatchSamplerShard (one shard's view) and
+    the SPMD global loader (all shards concatenated).  Tail semantics follow
+    the reference (data_loader.py:195-262):
+
+    * ``even_batches=True`` (default): when the epoch doesn't fill the final
+      group of ``num_shards`` batches — or the final batch is short — indices
+      loop back to the beginning of the epoch's stream until every shard has a
+      full ``batch_size`` batch.  ``remainder`` records how many samples are
+      duplicates.
+    * ``even_batches=False``: the final partial group is dropped for shards
+      beyond what exists (callers must handle ragged step counts; incompatible
+      with single-program SPMD, used only for host-level iteration).
+    * ``split_batches=True``: each underlying batch is one *global* batch,
+      split ``num_shards``-ways (batch_size must divide evenly).
+    """
+
+    def __init__(
+        self,
+        batch_sampler: BatchSampler,
+        num_shards: int,
+        split_batches: bool = False,
+        even_batches: bool = True,
+    ):
+        self.batch_sampler = batch_sampler
+        self.num_shards = num_shards
+        self.split_batches = split_batches
+        self.even_batches = even_batches
+        self.batch_size = getattr(batch_sampler, "batch_size", None)
+        if split_batches and self.batch_size is not None and self.batch_size % num_shards != 0:
+            raise ValueError(
+                f"split_batches=True requires batch_size ({self.batch_size}) to be a "
+                f"round multiple of num_shards ({num_shards})."
+            )
+        self.remainder = 0  # duplicated samples in the final step (set per epoch)
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(epoch)
+
+    def __iter__(self) -> Iterator[list[list[int]]]:
+        self.remainder = 0
+        if self.split_batches:
+            for batch in self.batch_sampler:
+                if len(batch) % self.num_shards != 0:
+                    if not self.even_batches:
+                        continue
+                    # pad the short global batch by looping back into itself
+                    needed = (
+                        self.num_shards * math.ceil(len(batch) / self.num_shards)
+                        - len(batch)
+                    )
+                    self.remainder = needed
+                    batch = batch + batch[:needed]
+                shard_size = len(batch) // self.num_shards
+                yield [
+                    batch[i * shard_size : (i + 1) * shard_size]
+                    for i in range(self.num_shards)
+                ]
+            return
+
+        group: list[list[int]] = []
+        seen: list[int] = []
+        target = self.batch_size
+        for batch in self.batch_sampler:
+            seen.extend(batch)
+            group.append(batch)
+            if len(group) == self.num_shards and all(
+                target is None or len(b) == target for b in group
+            ):
+                yield group
+                group = []
+        if not group or (len(group) == self.num_shards and all(
+            target is None or len(b) == target for b in group
+        )):
+            if group:
+                yield group
+            return
+        if not self.even_batches:
+            # ragged tail: emit what exists (host-level iteration only)
+            yield group
+            return
+        # loop back to the start of the epoch's sample stream to even out
+    # (reference semantics: indices restart from the first samples)
+        flat = list(itertools.chain.from_iterable(group))
+        needed_total = self.num_shards * (target or len(group[0]))
+        dup_source = seen if len(seen) >= needed_total else (seen * math.ceil(needed_total / max(len(seen), 1)))
+        padded = flat + dup_source[: needed_total - len(flat)]
+        self.remainder = needed_total - len(flat)
+        size = target or len(group[0])
+        yield [padded[i * size : (i + 1) * size] for i in range(self.num_shards)]
+
+    def __len__(self) -> int:
+        if self.split_batches:
+            return len(self.batch_sampler)
+        n = len(self.batch_sampler)
+        if self.even_batches:
+            return math.ceil(n / self.num_shards)
+        return math.ceil(n / self.num_shards)
+
+    @property
+    def total_batch_size(self) -> int:
+        if self.split_batches:
+            return self.batch_size
+        return (self.batch_size or 0) * self.num_shards
+
+
+class BatchSamplerShard:
+    """One shard's view of a GlobalBatchSampler (reference data_loader.py:109).
+
+    Provided for reference-API parity and multi-process host sharding; the
+    SPMD loader uses the underlying GlobalBatchSampler directly.
+    """
+
+    def __init__(
+        self,
+        batch_sampler,
+        num_processes: int = 1,
+        process_index: int = 0,
+        split_batches: bool = False,
+        even_batches: bool = True,
+    ):
+        self.global_sampler = GlobalBatchSampler(
+            batch_sampler, num_processes, split_batches=split_batches, even_batches=even_batches
+        )
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.split_batches = split_batches
+        self.even_batches = even_batches
+        self.batch_size = getattr(batch_sampler, "batch_size", None)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.global_sampler.set_epoch(epoch)
+
+    def __iter__(self) -> Iterator[list[int]]:
+        for group in self.global_sampler:
+            if self.process_index < len(group):
+                yield group[self.process_index]
+
+    def __len__(self) -> int:
+        return len(self.global_sampler)
+
+    @property
+    def total_batch_size(self) -> int:
+        return self.global_sampler.total_batch_size
+
+
+class IterableDatasetShard:
+    """Shard an iterable dataset across processes (reference :265).
+
+    Buffers ``batch_size * num_processes`` items and hands each process its
+    slice; the tail loops back to the first buffered items when
+    ``even_batches`` requires it.
+    """
+
+    def __init__(
+        self,
+        dataset: Iterable,
+        batch_size: int = 1,
+        drop_last: bool = False,
+        num_processes: int = 1,
+        process_index: int = 0,
+        split_batches: bool = False,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.split_batches = split_batches
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def __iter__(self):
+        real_batch_size = (
+            self.batch_size if self.split_batches else self.batch_size * self.num_processes
+        )
+        process_slice = range(
+            self.process_index * (real_batch_size // self.num_processes),
+            (self.process_index + 1) * (real_batch_size // self.num_processes),
+        )
+        first_batch = None
+        current_batch: list = []
+        for element in self.dataset:
+            current_batch.append(element)
+            if len(current_batch) == real_batch_size:
+                for i in process_slice:
+                    yield current_batch[i]
+                if first_batch is None:
+                    first_batch = current_batch.copy()
+                current_batch = []
+        if not self.drop_last and len(current_batch) > 0:
+            if first_batch is None:
+                first_batch = current_batch.copy()
+            while len(current_batch) < real_batch_size:
+                current_batch += first_batch
+            for i in process_slice:
+                yield current_batch[i]
+
+
+# ---------------------------------------------------------------------------
+# Collation
+# ---------------------------------------------------------------------------
+def _to_numpy(x):
+    if isinstance(x, np.ndarray):
+        return x
+    if hasattr(x, "detach") and hasattr(x, "numpy"):  # torch tensor / our Tensor
+        return np.asarray(x.detach().numpy() if hasattr(x.detach(), "numpy") else x.numpy())
+    if isinstance(x, jax.Array):
+        return np.asarray(x)
+    return np.asarray(x)
+
+
+def default_collate(samples: Sequence[Any]):
+    """Stack a list of samples into batched numpy arrays (torch parity)."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)) and not isinstance(first, str):
+        return type(first)(default_collate(list(col)) for col in zip(*samples))
+    return np.stack([_to_numpy(s) for s in samples])
+
+
+# ---------------------------------------------------------------------------
+# Device placement
+# ---------------------------------------------------------------------------
+def batch_to_global_array(batch, mesh=None, sharding=None):
+    """Host batch (numpy pytree) → sharded global jax.Array pytree.
+
+    Single host: ``device_put`` with a batch-dim NamedSharding (XLA splits
+    across local devices).  Multi-host: each host contributes its local shard
+    via ``jax.make_array_from_process_local_data``.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .parallel.mesh import data_axes
+
+    if sharding is None:
+        if mesh is None:
+            mesh = AcceleratorState().mesh
+        sharding = NamedSharding(mesh, P(data_axes(mesh)))
+
+    multi_host = jax.process_count() > 1
+
+    def _place(x):
+        x = np.asarray(x)
+        spec_ndim = len(sharding.spec)
+        if x.ndim == 0:
+            return jnp.asarray(x)
+        if multi_host:
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.device_put(x, sharding)
+
+    from .utils.operations import recursively_apply
+
+    return recursively_apply(
+        _place, batch, test_type=lambda o: isinstance(o, (np.ndarray, jax.Array))
+    )
+
+
+# ---------------------------------------------------------------------------
+# DataLoaders
+# ---------------------------------------------------------------------------
+class DataLoaderStateMixin:
+    """Tracks end-of-iteration + remainder in GradientState (reference :407)."""
+
+    def begin(self):
+        self.end_of_dataloader = False
+        self.remainder = -1
+        self.gradient_state._add_dataloader(self)
+
+    def end(self):
+        self.gradient_state._remove_dataloader(self)
+
+
+class DataLoaderShard(DataLoaderStateMixin):
+    """The SPMD data loader: one global sharded batch per step.
+
+    Replaces both reference DataLoaderShard (:499) and the XLA
+    MpDeviceLoaderWrapper (:643): iteration yields jax.Arrays already laid out
+    on the mesh's data axes, with ``prefetch_size`` transfers in flight.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        global_batch_sampler: Optional[GlobalBatchSampler] = None,
+        collate_fn: Optional[Callable] = None,
+        device_placement: bool = True,
+        mesh=None,
+        prefetch_size: int = 2,
+        rng_types: Optional[list] = None,
+        synchronized_generator=None,
+        skip_batches: int = 0,
+        _drop_last: bool = False,
+        **kwargs,
+    ):
+        self.dataset = dataset
+        self.global_batch_sampler = global_batch_sampler
+        self.collate_fn = collate_fn or default_collate
+        self.device_placement = device_placement
+        self.mesh = mesh
+        self.prefetch_size = max(1, prefetch_size)
+        self.rng_types = rng_types
+        self.synchronized_generator = synchronized_generator
+        self.skip_batches = skip_batches
+        self.gradient_state = GradientState()
+        self.epoch = 0
+        self.end_of_dataloader = False
+        self.remainder = -1
+        self._iteration = 0
+        # streaming-mode settings (used when global_batch_sampler is None)
+        self._stream_global_batch = kwargs.pop("stream_global_batch", 1)
+        self._stream_drop_last = _drop_last
+
+    # -- epoch / length -----------------------------------------------------
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        if self.global_batch_sampler is not None:
+            self.global_batch_sampler.set_epoch(epoch)
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        if self.global_batch_sampler is None:
+            raise TypeError("streaming DataLoaderShard has no length")
+        return len(self.global_batch_sampler) - self.skip_batches
+
+    @property
+    def total_batch_size(self) -> int:
+        if self.global_batch_sampler is None:
+            return self._stream_global_batch
+        return self.global_batch_sampler.total_batch_size
+
+    @property
+    def batch_sampler(self):
+        return self.global_batch_sampler
+
+    # -- iteration ----------------------------------------------------------
+    def _host_batches(self) -> Iterator[tuple[Any, int]]:
+        """Yield (collated numpy global batch, remainder_if_final_else_0)."""
+        if self.global_batch_sampler is None:
+            yield from self._iterable_host_batches()
+            return
+        sampler_iter = iter(self.global_batch_sampler)
+        prev_group = None
+        for group in sampler_iter:
+            if prev_group is not None:
+                yield self._collate_group(prev_group), 0
+            prev_group = group
+        if prev_group is not None:
+            yield self._collate_group(prev_group), self.global_batch_sampler.remainder
+
+    def _iterable_host_batches(self) -> Iterator[tuple[Any, int]]:
+        """Streaming path: batch an iterable dataset into global batches,
+        looping the tail back to the first samples (IterableDatasetShard
+        semantics, reference data_loader.py:265)."""
+        size = self._stream_global_batch
+        first_batch: Optional[list] = None
+        current: list = []
+        pending: Optional[list] = None
+        pending_remainder = 0
+        for element in self.dataset:
+            current.append(element)
+            if len(current) == size:
+                if pending is not None:
+                    yield self.collate_fn(pending), 0
+                pending, pending_remainder = current, 0
+                if first_batch is None:
+                    first_batch = current.copy()
+                current = []
+        if current and not self._stream_drop_last:
+            if pending is not None:
+                yield self.collate_fn(pending), 0
+            remainder = size - len(current)
+            source = first_batch if first_batch is not None else current
+            while len(current) < size:
+                current += source
+            pending, pending_remainder = current[:size], remainder
+        if pending is not None:
+            yield self.collate_fn(pending), pending_remainder
+
+    def _collate_group(self, group: list[list[int]]):
+        flat_indices = list(itertools.chain.from_iterable(group))
+        samples = [self.dataset[i] for i in flat_indices]
+        return self.collate_fn(samples)
+
+    def __iter__(self):
+        self.begin()
+        self.set_epoch(self.epoch)
+        self._iteration = self.skip_batches  # in-epoch position (for resume)
+        try:
+            batches = self._host_batches()
+            # skip for mid-epoch resume
+            for _ in range(self.skip_batches):
+                next(batches, None)
+
+            # double-buffered device feed
+            pending: list[tuple[Any, int]] = []
+            exhausted = False
+            host_iter = iter(batches)
+            while True:
+                while not exhausted and len(pending) < self.prefetch_size:
+                    try:
+                        host_batch, remainder = next(host_iter)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    if self.device_placement:
+                        placed = batch_to_global_array(host_batch, mesh=self.mesh)
+                    else:
+                        placed = host_batch
+                    pending.append((placed, remainder))
+                if not pending:
+                    break
+                batch, remainder = pending.pop(0)
+                if exhausted and not pending:
+                    self.end_of_dataloader = True
+                    self.remainder = remainder
+                yield batch
+                self._iteration += 1
+        finally:
+            self.skip_batches = 0
+            self.end()
+        # epoch completed in full: advance and reset the in-epoch position
+        self.epoch += 1
+        self._iteration = 0
+
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch, "iteration": self._iteration}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = state.get("epoch", 0)
+        self.skip_batches = state.get("iteration", 0)
+
+
+class DataLoaderDispatcher(DataLoaderShard):
+    """Main-process-reads, broadcast-to-all loader (reference :696).
+
+    On TPU pods the default DataLoaderShard already forms one global batch
+    per step, so dispatch mode differs only in *who reads the data*: process 0
+    reads the full global batch and broadcasts host-level shards to peers
+    (useful when the dataset lives only on host 0).
+    """
+
+    def _host_batches(self):
+        state = PartialState()
+        if state.num_processes == 1:
+            yield from super()._host_batches()
+            return
+        from .utils import operations as ops
+
+        if state.is_main_process:
+            for host_batch, remainder in super()._host_batches():
+                skeleton = ops.get_data_structure(host_batch)
+                ops.broadcast_object_list([("batch", remainder, skeleton)])
+                yield ops.broadcast(host_batch), remainder
+            ops.broadcast_object_list([("stop", 0, None)])
+        else:
+            while True:
+                signal = ops.broadcast_object_list([None])[0]
+                if signal is None or signal[0] == "stop":
+                    break
+                _, remainder, skeleton = signal
+                batch = ops.broadcast(ops.initialize_tensors(skeleton))
+                yield batch, remainder
+
+
+class SkipBatchSampler:
+    """Batch sampler skipping the first ``skip_batches`` (reference :1309)."""
+
+    def __init__(self, batch_sampler, skip_batches: int = 0):
+        self.batch_sampler = batch_sampler
+        self.skip_batches = skip_batches
+
+    def __iter__(self):
+        for index, samples in enumerate(self.batch_sampler):
+            if index >= self.skip_batches:
+                yield samples
+
+    def __len__(self):
+        return len(self.batch_sampler) - self.skip_batches
+
+    @property
+    def total_batch_size(self):
+        return self.batch_sampler.total_batch_size
+
+
+def skip_first_batches(dataloader, num_batches: int = 0):
+    """New loader resuming ``num_batches`` into the epoch (reference :1349)."""
+    if isinstance(dataloader, DataLoaderShard):
+        new = type(dataloader)(
+            dataloader.dataset,
+            global_batch_sampler=dataloader.global_batch_sampler,
+            collate_fn=dataloader.collate_fn,
+            device_placement=dataloader.device_placement,
+            mesh=dataloader.mesh,
+            prefetch_size=dataloader.prefetch_size,
+            skip_batches=num_batches,
+            _drop_last=dataloader._stream_drop_last,
+            stream_global_batch=dataloader._stream_global_batch,
+        )
+        new.epoch = dataloader.epoch
+        return new
+    # generic iterable fallback
+    def _gen():
+        for i, batch in enumerate(dataloader):
+            if i >= num_batches:
+                yield batch
+
+    return _gen()
+
+
+# ---------------------------------------------------------------------------
+# prepare_data_loader
+# ---------------------------------------------------------------------------
+def _extract_torch_dataloader(dataloader):
+    """Pull (dataset, batch_size, shuffle, collate_fn, drop_last) out of a
+    torch DataLoader without importing torch at module scope."""
+    dataset = dataloader.dataset
+    batch_size = dataloader.batch_size
+    drop_last = getattr(dataloader, "drop_last", False)
+    collate = getattr(dataloader, "collate_fn", None)
+    sampler = getattr(dataloader, "sampler", None)
+    shuffle = type(sampler).__name__ == "RandomSampler"
+    # torch default_collate produces torch tensors; replace with ours unless custom
+    if collate is not None and getattr(collate, "__module__", "").startswith("torch"):
+        collate = None
+    return dataset, batch_size, shuffle, collate, drop_last
+
+
+def prepare_data_loader(
+    dataloader=None,
+    device=None,
+    num_processes: Optional[int] = None,
+    process_index: Optional[int] = None,
+    split_batches: bool = False,
+    put_on_device: bool = True,
+    rng_types: Optional[list] = None,
+    dispatch_batches: Optional[bool] = None,
+    even_batches: bool = True,
+    slice_fn_for_dispatch=None,
+    use_seedable_sampler: bool = True,
+    data_seed: Optional[int] = None,
+    non_blocking: bool = False,
+    use_stateful_dataloader: bool = False,
+    *,
+    dataset=None,
+    batch_size: Optional[int] = None,
+    shuffle: bool = False,
+    collate_fn: Optional[Callable] = None,
+    drop_last: bool = False,
+    mesh=None,
+    prefetch_size: int = 2,
+) -> DataLoaderShard:
+    """Build the SPMD loader from a torch DataLoader, our kwargs, or both.
+
+    Reference: prepare_data_loader data_loader.py:988.  ``num_processes`` here
+    is the number of *batch shards* — mesh dp×fsdp size — not host count;
+    host-level sharding happens inside via process_index slicing of the global
+    batch.
+    """
+    state = AcceleratorState() if AcceleratorState._shared_state else None
+    if mesh is None and state is not None:
+        mesh = state.mesh
+    if num_processes is None:
+        from .parallel.mesh import batch_sharding_size
+
+        num_processes = batch_sharding_size(mesh) if mesh is not None else 1
+
+    if dataloader is not None and dataset is None:
+        if isinstance(dataloader, DataLoaderShard):
+            return dataloader
+        if hasattr(dataloader, "dataset"):  # torch DataLoader or similar
+            dataset, batch_size, shuffle, collate_fn, drop_last = _extract_torch_dataloader(
+                dataloader
+            )
+        else:
+            dataset = dataloader
+            batch_size = batch_size or 1
+
+    if dataset is None:
+        raise ValueError("prepare_data_loader needs a dataloader or a dataset")
+    if batch_size is None:
+        batch_size = 1
+
+    has_len = hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__")
+    if not has_len:
+        # streaming (iterable) dataset path
+        global_batch = batch_size if split_batches else batch_size * num_processes
+        return DataLoaderShard(
+            dataset,
+            global_batch_sampler=None,
+            collate_fn=collate_fn,
+            device_placement=put_on_device,
+            mesh=mesh,
+            prefetch_size=prefetch_size,
+            rng_types=rng_types,
+            _drop_last=drop_last,
+            stream_global_batch=global_batch,
+        )
+
+    n = len(dataset)
+    if use_seedable_sampler or shuffle:
+        sampler = (
+            SeedableRandomSampler(n, seed=data_seed or 0)
+            if shuffle
+            else SequentialSampler(n)
+        )
+    else:
+        sampler = SequentialSampler(n)
+    # with split_batches the user batch_size is already the global size;
+    # otherwise it is per-shard and the global sampler groups num_shards of them
+    batch_sampler = BatchSampler(sampler, batch_size, drop_last=drop_last)
+    global_sampler = GlobalBatchSampler(
+        batch_sampler,
+        num_shards=num_processes,
+        split_batches=split_batches,
+        even_batches=even_batches,
+    )
+    cls = DataLoaderDispatcher if dispatch_batches else DataLoaderShard
+    return cls(
+        dataset,
+        global_batch_sampler=global_sampler,
+        collate_fn=collate_fn,
+        device_placement=put_on_device,
+        mesh=mesh,
+        prefetch_size=prefetch_size,
+        rng_types=rng_types,
+    )
