@@ -1,0 +1,294 @@
+"""Torch-style optimizers backed by optax.
+
+The reference wraps a user's torch optimizer (optimizer.py:37); here the
+optimizer itself is ours: imperative surface (``opt.step()`` consumes
+``param.grad``), optax transform underneath, hyperparameters injected via
+``optax.inject_hyperparams`` so LR schedules mutate state instead of
+rebuilding the transform (and stay jit-capturable: the whole
+step→update→apply chain traces into one XLA program).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .nn.module import Parameter
+from .nn.tape import Tensor
+
+
+class Optimizer:
+    """Base: holds Parameter references + optax state."""
+
+    def __init__(self, params: Iterable[Parameter], tx: optax.GradientTransformation, defaults: dict):
+        self.param_list = list(params)
+        if not self.param_list:
+            raise ValueError("optimizer got an empty parameter list")
+        self.tx = tx
+        self.defaults = defaults
+        self.opt_state = tx.init(
+            [p.data.astype(jnp.float32) for p in self.param_list]
+        )
+        # fp32 master copies for half-precision params (created lazily after
+        # prepare() may have cast params to bf16); update math runs on these.
+        self.master_params: list[Optional[jax.Array]] = [None] * len(self.param_list)
+        self._step_count = 0
+
+    def _ensure_master(self) -> None:
+        for i, p in enumerate(self.param_list):
+            if p.dtype != jnp.float32 and self.master_params[i] is None:
+                self.master_params[i] = p.data.astype(jnp.float32)
+            elif p.dtype == jnp.float32:
+                self.master_params[i] = None
+
+    # -- torch-parity surface ------------------------------------------------
+    @property
+    def param_groups(self) -> list[dict]:
+        return [{"params": self.param_list, **self.defaults, "lr": self.lr}]
+
+    @property
+    def lr(self) -> float:
+        hp = getattr(self.opt_state, "hyperparams", None)
+        if hp and "learning_rate" in hp:
+            return hp["learning_rate"]
+        return self.defaults.get("lr", 0.0)
+
+    @lr.setter
+    def lr(self, value) -> None:
+        hp = getattr(self.opt_state, "hyperparams", None)
+        if hp is not None and "learning_rate" in hp:
+            hp["learning_rate"] = value if isinstance(value, jax.Array) else jnp.asarray(value, dtype=jnp.float32)
+        else:
+            self.defaults["lr"] = float(value)
+
+    def zero_grad(self, set_to_none: bool = True) -> None:
+        for p in self.param_list:
+            p.grad = None
+
+    def step(self, closure: Optional[Callable] = None, grad_scale=None) -> None:
+        """Apply one optax update from accumulated ``.grad``s.
+
+        ``grad_scale``: optional multiplier applied to grads before the update
+        (used by grad-accumulation averaging and fp16 unscaling).
+        """
+        if closure is not None:
+            closure()
+        self._ensure_master()
+        # update math in fp32 against master weights (mixed-precision safe)
+        params = [
+            m if m is not None else p.data
+            for m, p in zip(self.master_params, self.param_list)
+        ]
+        grads = [
+            (p.grad if p.grad is not None else jnp.zeros_like(p.data))
+            for p in self.param_list
+        ]
+        if grad_scale is not None:
+            grads = [g * grad_scale for g in grads]
+        grads = [g.astype(jnp.float32) for g in grads]
+        updates, self.opt_state = self.tx.update(grads, self.opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        for i, (p, new) in enumerate(zip(self.param_list, new_params)):
+            if self.master_params[i] is not None:
+                self.master_params[i] = new
+                p.data = new.astype(p.dtype)
+            else:
+                p.data = new
+        self._step_count += 1
+
+    # -- functional bridge (used by Accelerator's step capture) --------------
+    def capture_state(self) -> dict:
+        self._ensure_master()
+        return {"opt_state": self.opt_state, "master": list(self.master_params)}
+
+    def bind_capture_state(self, state: dict) -> None:
+        self.opt_state = state["opt_state"]
+        self.master_params = list(state["master"])
+
+    # -- checkpointing -------------------------------------------------------
+    def state_dict(self) -> dict:
+        flat, treedef = jax.tree_util.tree_flatten(self.opt_state)
+        return {
+            "opt_state_leaves": [jax.device_get(x) for x in flat],
+            "master_params": [
+                None if m is None else jax.device_get(m) for m in self.master_params
+            ],
+            "step_count": self._step_count,
+            "defaults": dict(self.defaults),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        flat, treedef = jax.tree_util.tree_flatten(self.opt_state)
+        loaded = state["opt_state_leaves"]
+        if len(loaded) != len(flat):
+            raise ValueError(
+                f"optimizer state mismatch: checkpoint has {len(loaded)} leaves, "
+                f"optimizer expects {len(flat)}"
+            )
+        self.opt_state = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(x) for x in loaded]
+        )
+        for i, m in enumerate(state.get("master_params", [])):
+            if i < len(self.master_params):
+                self.master_params[i] = None if m is None else jnp.asarray(m)
+        self._step_count = state.get("step_count", 0)
+        self.defaults.update(state.get("defaults", {}))
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr}, params={len(self.param_list)})"
+
+
+def _inject(opt_fn, lr, **kwargs):
+    return optax.inject_hyperparams(opt_fn)(learning_rate=lr, **kwargs)
+
+
+class SGD(Optimizer):
+    def __init__(self, params, lr: float = 1e-3, momentum: float = 0.0, weight_decay: float = 0.0, nesterov: bool = False):
+        def make(learning_rate):
+            tx = optax.sgd(learning_rate, momentum=momentum or None, nesterov=nesterov)
+            if weight_decay:
+                tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+            return tx
+
+        tx = optax.inject_hyperparams(make)(learning_rate=lr)
+        super().__init__(params, tx, {"lr": lr, "momentum": momentum, "weight_decay": weight_decay})
+
+
+class Adam(Optimizer):
+    def __init__(self, params, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8, weight_decay: float = 0.0):
+        def make(learning_rate):
+            tx = optax.adam(learning_rate, b1=betas[0], b2=betas[1], eps=eps)
+            if weight_decay:
+                tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+            return tx
+
+        tx = optax.inject_hyperparams(make)(learning_rate=lr)
+        super().__init__(params, tx, {"lr": lr, "betas": betas, "eps": eps, "weight_decay": weight_decay})
+
+
+class AdamW(Optimizer):
+    def __init__(self, params, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8, weight_decay: float = 0.01):
+        tx = _inject(
+            optax.adamw, lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay
+        )
+        super().__init__(params, tx, {"lr": lr, "betas": betas, "eps": eps, "weight_decay": weight_decay})
+
+
+class Adafactor(Optimizer):
+    """Memory-frugal choice for large models on TPU (factored second moment)."""
+
+    def __init__(self, params, lr: float = 1e-3, weight_decay: float = 0.0):
+        def make(learning_rate):
+            tx = optax.adafactor(learning_rate)
+            if weight_decay:
+                tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+            return tx
+
+        tx = optax.inject_hyperparams(make)(learning_rate=lr)
+        super().__init__(params, tx, {"lr": lr, "weight_decay": weight_decay})
+
+
+class Lion(Optimizer):
+    def __init__(self, params, lr: float = 1e-4, betas=(0.9, 0.99), weight_decay: float = 0.0):
+        tx = _inject(optax.lion, lr, b1=betas[0], b2=betas[1], weight_decay=weight_decay)
+        super().__init__(params, tx, {"lr": lr, "betas": betas, "weight_decay": weight_decay})
+
+
+# ---------------------------------------------------------------------------
+# LR schedulers (torch.optim.lr_scheduler-shaped)
+# ---------------------------------------------------------------------------
+class LRScheduler:
+    def __init__(self, optimizer: Optimizer, last_epoch: int = -1):
+        self.optimizer = optimizer
+        self.base_lr = float(optimizer.defaults.get("lr", optimizer.lr))
+        self.last_epoch = last_epoch
+        self.step()
+
+    def get_lr(self) -> float:
+        raise NotImplementedError
+
+    def step(self) -> None:
+        self.last_epoch += 1
+        self.optimizer.lr = self.get_lr()
+
+    def get_last_lr(self) -> list[float]:
+        lr = self.optimizer.lr
+        return [float(lr) if not isinstance(lr, jax.Array) else float(jax.device_get(lr))]
+
+    def state_dict(self) -> dict:
+        return {"last_epoch": self.last_epoch, "base_lr": self.base_lr}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.last_epoch = state["last_epoch"]
+        self.base_lr = state.get("base_lr", self.base_lr)
+        self.optimizer.lr = self.get_lr()
+
+
+class LambdaLR(LRScheduler):
+    def __init__(self, optimizer, lr_lambda: Callable[[int], float], last_epoch: int = -1):
+        self.lr_lambda = lr_lambda
+        super().__init__(optimizer, last_epoch)
+
+    def get_lr(self) -> float:
+        return self.base_lr * self.lr_lambda(self.last_epoch)
+
+
+class StepLR(LRScheduler):
+    def __init__(self, optimizer, step_size: int, gamma: float = 0.1, last_epoch: int = -1):
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(optimizer, last_epoch)
+
+    def get_lr(self) -> float:
+        return self.base_lr * self.gamma ** (self.last_epoch // self.step_size)
+
+
+class CosineAnnealingLR(LRScheduler):
+    def __init__(self, optimizer, T_max: int, eta_min: float = 0.0, last_epoch: int = -1):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(optimizer, last_epoch)
+
+    def get_lr(self) -> float:
+        import math
+
+        t = min(self.last_epoch, self.T_max)
+        return self.eta_min + (self.base_lr - self.eta_min) * 0.5 * (
+            1 + math.cos(math.pi * t / self.T_max)
+        )
+
+
+def get_linear_schedule_with_warmup(
+    optimizer, num_warmup_steps: int, num_training_steps: int, last_epoch: int = -1
+) -> LambdaLR:
+    """transformers-parity helper (used by reference examples/nlp_example.py)."""
+
+    def lr_lambda(current_step: int) -> float:
+        if current_step < num_warmup_steps:
+            return current_step / max(1, num_warmup_steps)
+        return max(
+            0.0,
+            (num_training_steps - current_step)
+            / max(1, num_training_steps - num_warmup_steps),
+        )
+
+    return LambdaLR(optimizer, lr_lambda, last_epoch)
+
+
+def get_cosine_schedule_with_warmup(
+    optimizer, num_warmup_steps: int, num_training_steps: int, last_epoch: int = -1
+) -> LambdaLR:
+    import math
+
+    def lr_lambda(current_step: int) -> float:
+        if current_step < num_warmup_steps:
+            return current_step / max(1, num_warmup_steps)
+        progress = (current_step - num_warmup_steps) / max(
+            1, num_training_steps - num_warmup_steps
+        )
+        return max(0.0, 0.5 * (1.0 + math.cos(math.pi * progress)))
+
+    return LambdaLR(optimizer, lr_lambda, last_epoch)

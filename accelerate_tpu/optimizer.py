@@ -1,0 +1,140 @@
+"""AcceleratedOptimizer — accumulation-aware optimizer wrapper.
+
+Counterpart of ``/root/reference/src/accelerate/optimizer.py`` (212 LoC).
+Differences born of SPMD: there is no XLA gradient all-reduce here (reference
+optimizer.py:148-154) — under GSPMD the mean over the global batch already
+produces identical gradients on every device, compiled into the step.  What
+remains is the reference's accumulation contract: ``step``/``zero_grad`` are
+no-ops while ``GradientState.sync_gradients`` is False, and fp16 loss-scale
+handling wraps the real step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .state import AcceleratorState, GradientState
+from .utils.dataclasses import GradScalerKwargs
+
+
+class DynamicLossScaler:
+    """Dynamic fp16 loss scaling (GradScaler parity, reference via torch).
+
+    bf16 — the TPU default — never needs this; it exists for
+    ``mixed_precision='fp16'`` parity and numerics experiments.
+    """
+
+    def __init__(self, kwargs: Optional[GradScalerKwargs] = None):
+        kwargs = kwargs or GradScalerKwargs()
+        self.scale = float(kwargs.init_scale)
+        self.growth_factor = kwargs.growth_factor
+        self.backoff_factor = kwargs.backoff_factor
+        self.growth_interval = kwargs.growth_interval
+        self.enabled = kwargs.enabled
+        self._growth_tracker = 0
+
+    def scale_loss(self, loss):
+        return loss * self.scale if self.enabled else loss
+
+    def unscale_(self) -> float:
+        return 1.0 / self.scale if self.enabled else 1.0
+
+    def update(self, found_inf: bool) -> None:
+        if not self.enabled:
+            return
+        if found_inf:
+            self.scale = max(self.scale * self.backoff_factor, 1.0)
+            self._growth_tracker = 0
+        else:
+            self._growth_tracker += 1
+            if self._growth_tracker >= self.growth_interval:
+                self.scale *= self.growth_factor
+                self._growth_tracker = 0
+
+    def state_dict(self) -> dict:
+        return {"scale": self.scale, "growth_tracker": self._growth_tracker}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.scale = state["scale"]
+        self._growth_tracker = state["growth_tracker"]
+
+
+class AcceleratedOptimizer:
+    """Wraps an ``accelerate_tpu.optim.Optimizer`` (or anything with
+    step/zero_grad/state_dict) with accumulation + scaler semantics."""
+
+    def __init__(self, optimizer, device_placement: bool = True, scaler: Optional[DynamicLossScaler] = None):
+        self.optimizer = optimizer
+        self.scaler = scaler
+        self.accelerator_state = AcceleratorState() if AcceleratorState._shared_state else None
+        self.gradient_state = GradientState()
+        self.device_placement = device_placement
+        self._is_overflow = False
+        self._accelerate_step_called = False
+
+    # pass-throughs ----------------------------------------------------------
+    @property
+    def param_groups(self):
+        return self.optimizer.param_groups
+
+    @property
+    def defaults(self):
+        return self.optimizer.defaults
+
+    @property
+    def lr(self):
+        return self.optimizer.lr
+
+    @lr.setter
+    def lr(self, value):
+        self.optimizer.lr = value
+
+    def state_dict(self):
+        return self.optimizer.state_dict()
+
+    def load_state_dict(self, state_dict):
+        self.optimizer.load_state_dict(state_dict)
+
+    # accumulation-aware ops ---------------------------------------------------
+    def zero_grad(self, set_to_none: bool = True) -> None:
+        if self.gradient_state.sync_gradients:
+            self.optimizer.zero_grad(set_to_none)
+
+    def step(self, closure=None) -> None:
+        if not self.gradient_state.sync_gradients:
+            return  # mid-accumulation micro-step: skip (reference optimizer.py:161)
+        self._accelerate_step_called = True
+        if self.scaler is not None:
+            import jax
+
+            # single fused finite-check over all grads
+            grads = [
+                p.grad for p in self.optimizer.param_list if p.grad is not None
+            ]
+            finite = all(bool(jnp.isfinite(g).all()) for g in grads)
+            if finite:
+                self.optimizer.step(closure, grad_scale=self.scaler.unscale_())
+                self._is_overflow = False
+            else:
+                self._is_overflow = True
+            self.scaler.update(found_inf=not finite)
+        else:
+            self.optimizer.step(closure)
+
+    @property
+    def step_was_skipped(self) -> bool:
+        """True when the last ``step`` was dropped due to fp16 overflow."""
+        return self._is_overflow
+
+    def train(self):
+        if hasattr(self.optimizer, "train"):
+            self.optimizer.train()
+
+    def eval(self):
+        if hasattr(self.optimizer, "eval"):
+            self.optimizer.eval()
+
+    def __repr__(self):
+        return f"AcceleratedOptimizer({self.optimizer})"

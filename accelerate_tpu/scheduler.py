@@ -1,0 +1,78 @@
+"""AcceleratedScheduler — LR stepping synced to real optimizer steps.
+
+Counterpart of ``/root/reference/src/accelerate/scheduler.py`` (98 LoC),
+same contract: without ``split_batches`` the scheduler steps
+``step_with_optimizer × num_shards`` times per call so the LR curve written
+for a single-process loop lands on the same schedule when the global batch is
+N× larger; steps are skipped while gradients accumulate or when the fp16
+scaler dropped the optimizer step (scheduler.py:54-82).
+"""
+
+from __future__ import annotations
+
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        scheduler,
+        optimizers,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        self.scheduler = scheduler
+        self.optimizers = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+        self.split_batches = split_batches
+        self.step_with_optimizer = step_with_optimizer
+        self.gradient_state = GradientState()
+
+    def step(self, *args, _from_capture_replay: bool = False, **kwargs) -> None:
+        if not _from_capture_replay:
+            from .capture import current_capture
+
+            ctx = current_capture()
+            if ctx is not None:
+                # under step capture: LR math is python-side; defer to after
+                # the compiled call (LR flows into the program as data via
+                # opt_state.hyperparams)
+                ctx.defer_scheduler(self, args, kwargs)
+                return
+        if not self.step_with_optimizer:
+            self.scheduler.step(*args, **kwargs)
+            return
+        if not self.gradient_state.sync_gradients:
+            # mid-accumulation micro-step: never advance the LR (reference
+            # scheduler.py:61-64 returns here regardless of adjust_scheduler)
+            return
+        # only advance when at least one wrapped optimizer really stepped
+        for opt in self.optimizers:
+            if getattr(opt, "step_was_skipped", False):
+                return
+        if self.split_batches:
+            self.scheduler.step(*args, **kwargs)
+        else:
+            num_shards = 1
+            if AcceleratorState._shared_state:
+                num_shards = AcceleratorState().num_batch_shards
+            for _ in range(num_shards):
+                self.scheduler.step(*args, **kwargs)
+
+    def get_last_lr(self):
+        return self.scheduler.get_last_lr()
+
+    def state_dict(self):
+        return self.scheduler.state_dict()
+
+    def load_state_dict(self, state_dict):
+        self.scheduler.load_state_dict(state_dict)
+
+    def get_lr(self):
+        return self.scheduler.get_lr()
+
+    def print_lr(self, *args, **kwargs):
+        if hasattr(self.scheduler, "print_lr"):
+            return self.scheduler.print_lr(*args, **kwargs)
+
+    def __repr__(self):
+        return f"AcceleratedScheduler({self.scheduler})"
