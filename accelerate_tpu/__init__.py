@@ -9,8 +9,10 @@ wrapper modules; collectives are compiled into the step by XLA and ride ICI.
 
 __version__ = "0.1.0"
 
+from .accelerator import Accelerator
 from .state import AcceleratorState, GradientState, PartialState
 from .logging import get_logger
+from .data_loader import prepare_data_loader, skip_first_batches
 from .utils.dataclasses import (
     DataLoaderConfiguration,
     DistributedType,

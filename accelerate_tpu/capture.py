@@ -1,0 +1,187 @@
+"""Step capture: trace the imperative loop body into one jitted XLA program.
+
+This is the resolution of SURVEY.md §7 hard-part #2 ("eager-shaped API over
+lazy compiled execution"): the user's Python step — forward through tape
+Modules, ``accelerator.backward``, ``optimizer.step()`` — executes inside a
+``jax.jit`` trace exactly once per (shapes, sync_gradients, training-mode)
+variant.  The tape's per-op ``jax.vjp`` closures compose into the backward
+graph; optimizer math and GSPMD collectives land in the same program; state
+(params, grads, optax state, fp32 masters, RNG key) is threaded through as
+donated arguments so replays are a single device launch with zero host work
+beyond argument assembly.
+
+Scheduler steps are recorded at trace time and replayed python-side after
+every call: their LR lands in ``opt_state.hyperparams`` which is *data* to the
+compiled program, so LR schedules work across replays without recompiles.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .nn import random as nn_random
+from .nn.tape import Tensor
+
+
+class _CaptureState(threading.local):
+    def __init__(self):
+        self.active: Optional["CaptureContext"] = None
+
+
+_capture_state = _CaptureState()
+
+
+def current_capture() -> Optional["CaptureContext"]:
+    return _capture_state.active
+
+
+class CaptureContext:
+    """Book-keeping for one trace: deferred scheduler steps."""
+
+    def __init__(self):
+        self.deferred_scheduler_steps: list[tuple[Any, tuple, dict]] = []
+
+    def defer_scheduler(self, scheduler, args, kwargs) -> None:
+        self.deferred_scheduler_steps.append((scheduler, args, kwargs))
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x.data if isinstance(x, Tensor) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, Tensor),
+    )
+
+
+class CapturedStep:
+    """Callable produced by ``accelerator.compile_step``."""
+
+    def __init__(self, accelerator, fn: Callable):
+        self.accelerator = accelerator
+        self.fn = fn
+        self._cache: dict = {}
+
+    # -- state threading -----------------------------------------------------
+    def _collect_state(self) -> dict:
+        acc = self.accelerator
+        models = acc._models
+        optimizers = acc._optimizers
+        state = {
+            "params": [m.param_pytree() for m in models],
+            "buffers": [m.buffer_pytree() for m in models],
+            "grads": [
+                {
+                    name: (p.grad if p.grad is not None else jnp.zeros_like(p.data))
+                    for name, p in m.named_parameters()
+                }
+                for m in models
+            ],
+            "opt": [o.optimizer.capture_state() for o in optimizers],
+            "rng": nn_random.next_key(),
+        }
+        return state
+
+    def _bind_state(self, state: dict) -> None:
+        acc = self.accelerator
+        for m, params, buffers, grads in zip(
+            acc._models, state["params"], state["buffers"], state["grads"]
+        ):
+            m.bind_params(params)
+            m.bind_buffers(buffers)
+            named = dict(m.named_parameters())
+            for name, g in grads.items():
+                named[name].grad = g
+        for o, s in zip(acc._optimizers, state["opt"]):
+            o.optimizer.bind_capture_state(s)
+
+    def _snapshot_state(self) -> dict:
+        acc = self.accelerator
+        return {
+            "params": [m.param_pytree() for m in acc._models],
+            "buffers": [m.buffer_pytree() for m in acc._models],
+            "grads": [
+                {
+                    name: (p.grad if p.grad is not None else jnp.zeros_like(p.data))
+                    for name, p in m.named_parameters()
+                }
+                for m in acc._models
+            ],
+            "opt": [o.optimizer.capture_state() for o in acc._optimizers],
+        }
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args):
+        acc = self.accelerator
+        if acc.scaler is not None:
+            raise NotImplementedError(
+                "compile_step with fp16 dynamic loss scaling is not yet "
+                "supported; use mixed_precision='bf16' (the TPU-native choice)."
+            )
+        args = _unwrap_tree(args)
+        flat_args, args_treedef = jax.tree_util.tree_flatten(args)
+        key = (
+            args_treedef,
+            tuple((tuple(a.shape), str(a.dtype)) for a in map(jnp.asarray, flat_args)),
+            acc.gradient_state.sync_gradients,
+            tuple(m.training for m in acc._models),
+        )
+        entry = self._cache.get(key)
+        state = self._collect_state()
+        if entry is None:
+            entry = self._build(key, state, args)
+        jitted, sched_steps, out_is_tensor = entry
+        new_state, out = jitted(state, *flat_args)
+        self._writeback(new_state)
+        # deferred scheduler steps run for real, python-side, every replay
+        for scheduler, s_args, s_kwargs in sched_steps:
+            scheduler.step(*s_args, _from_capture_replay=True, **s_kwargs)
+        return out
+
+    def _build(self, key, state_template, args_template):
+        acc = self.accelerator
+        _, args_treedef = jax.tree_util.tree_flatten(args_template)
+        captured_ctx = CaptureContext()
+
+        def traced(state, *flat_args):
+            call_args = jax.tree_util.tree_unflatten(args_treedef, flat_args)
+            prev_rng_state = nn_random.default_rng.get_state()
+            prev_capture = _capture_state.active
+            prev_acc_ctx = acc._capture_ctx
+            _capture_state.active = captured_ctx
+            acc._capture_ctx = captured_ctx
+            # re-traces (e.g. after an input-layout change) must not double-
+            # count python side effects recorded during a previous trace
+            captured_ctx.deferred_scheduler_steps.clear()
+            try:
+                self._bind_state(state)
+                nn_random.default_rng.set_key(state["rng"])
+                out = self.fn(*call_args)
+                out = _unwrap_tree(out)
+                new_state = self._snapshot_state()
+                return new_state, out
+            finally:
+                _capture_state.active = prev_capture
+                acc._capture_ctx = prev_acc_ctx
+                nn_random.default_rng.set_state(prev_rng_state)
+
+        jitted = jax.jit(traced, donate_argnums=(0,))
+        entry = (jitted, captured_ctx.deferred_scheduler_steps, None)
+        self._cache[key] = entry
+        return entry
+
+    def _writeback(self, new_state: dict) -> None:
+        acc = self.accelerator
+        for m, params, buffers, grads in zip(
+            acc._models, new_state["params"], new_state["buffers"], new_state["grads"]
+        ):
+            m.bind_params(params)
+            m.bind_buffers(buffers)
+            named = dict(m.named_parameters())
+            for name, g in grads.items():
+                named[name].grad = g
+        for o, s in zip(acc._optimizers, new_state["opt"]):
+            o.optimizer.bind_capture_state(s)

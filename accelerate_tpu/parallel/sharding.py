@@ -1,0 +1,90 @@
+"""Parameter sharding rules — FSDP/ZeRO and TP as GSPMD layouts.
+
+The reference implements FSDP by wrapping modules (accelerator.py:1555-1679)
+and TP via torch device meshes (:1545); here both are *data layout* decisions:
+each parameter gets a ``NamedSharding`` over the global mesh and XLA inserts
+the all-gathers / reduce-scatters (ZeRO) or keeps the matmuls local (TP).
+
+Rules:
+* TP plan entries map parameter-path regexes to partition-spec templates, e.g.
+  ``{".*q_proj.weight": ("tp", None)}`` (shard output features).  Models can
+  carry a default plan in ``Module.tp_plan``.
+* FSDP shards the largest remaining axis over the ``fsdp`` mesh axis when
+  divisible (ZeRO-3 param sharding; optimizer state follows params because
+  optax states mirror param shapes and jit propagates shardings).
+* Everything else is replicated.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.dataclasses import FullyShardedDataParallelPlugin, TensorParallelPlugin
+
+
+def plan_param_spec(
+    name: str,
+    shape: tuple,
+    mesh: Mesh,
+    fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
+    tp_plan: Optional[dict] = None,
+) -> P:
+    """Decide the PartitionSpec for one parameter."""
+    tp_size = mesh.shape.get("tp", 1)
+    fsdp_size = mesh.shape.get("fsdp", 1)
+    spec = [None] * len(shape)
+
+    if tp_plan and tp_size > 1:
+        for pattern, template in tp_plan.items():
+            if re.fullmatch(pattern, name) or re.search(pattern, name):
+                template = list(template) + [None] * (len(shape) - len(template))
+                spec = list(template[: len(shape)])
+                break
+
+    if fsdp_plugin is not None and fsdp_size > 1 and fsdp_plugin.sharding_strategy in (
+        "FULL_SHARD",
+        "HYBRID_SHARD",
+    ):
+        # shard the largest axis not already taken by tp and divisible by fsdp
+        order = sorted(range(len(shape)), key=lambda i: -shape[i])
+        for axis in order:
+            if spec[axis] is None and shape[axis] % fsdp_size == 0 and shape[axis] >= fsdp_size:
+                spec[axis] = "fsdp"
+                break
+    return P(*spec)
+
+
+def shard_module_params(
+    model,
+    mesh: Mesh,
+    fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
+    tp_plugin: Optional[TensorParallelPlugin] = None,
+) -> dict[str, P]:
+    """device_put every param/buffer with its planned sharding.
+
+    Returns the {name: spec} plan (used by checkpointing and tests).
+    """
+    tp_plan = None
+    if tp_plugin is not None and tp_plugin.tp_plan is not None:
+        tp_plan = tp_plugin.tp_plan
+    elif getattr(model, "tp_plan", None):
+        tp_plan = model.tp_plan
+
+    plan: dict[str, P] = {}
+    for name, p in model.named_parameters():
+        spec = plan_param_spec(name, tuple(p.shape), mesh, fsdp_plugin, tp_plan)
+        plan[name] = spec
+        p.data = jax.device_put(p.data, NamedSharding(mesh, spec))
+    for name, b in model.named_buffers():
+        b.data = jax.device_put(b.data, NamedSharding(mesh, P()))
+    return plan
+
+
+def replicate_module_params(model, mesh: Mesh) -> None:
+    for t in list(model.parameters()) + list(model.buffers()):
+        t.data = jax.device_put(t.data, NamedSharding(mesh, P()))

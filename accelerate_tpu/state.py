@@ -406,6 +406,14 @@ class AcceleratorState:
                 parallelism_config.pp_size = pp_plugin.pp_size
             if ep_plugin is not None:
                 parallelism_config.ep_size = ep_plugin.ep_size
+        if parallelism_config.fsdp_size > 1 and self.fsdp_plugin is None:
+            # an fsdp mesh axis without a plugin would silently replicate
+            # params over it (no memory saving); default to ZeRO-3 semantics
+            from .utils.dataclasses import FullyShardedDataParallelPlugin
+
+            self.fsdp_plugin = FullyShardedDataParallelPlugin(
+                fsdp_size=parallelism_config.fsdp_size
+            )
         self.parallelism_config = parallelism_config
         axis_sizes = parallelism_config.axis_sizes(self._partial.num_devices)
         self.mesh = make_mesh(axis_sizes)

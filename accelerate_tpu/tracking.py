@@ -1,0 +1,321 @@
+"""Experiment trackers behind one interface.
+
+Counterpart of ``/root/reference/src/accelerate/tracking.py`` (1076 LoC, 8
+backends).  Same shape: a ``GeneralTracker`` protocol, concrete adapters that
+are only importable when their library is installed, `filter_trackers`
+resolving the ``log_with`` argument.  A dependency-free ``JSONLTracker`` is
+the always-available default so training logs land on disk even on a bare
+TPU VM image.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional, Union
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.dataclasses import LoggerType
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_dvclive_available,
+    is_mlflow_available,
+    is_swanlab_available,
+    is_tensorboard_available,
+    is_wandb_available,
+)
+
+logger = get_logger(__name__)
+
+
+def on_main_process(function):
+    """Gate any tracker method to the main process (reference tracking.py:67)."""
+
+    def execute_on_main_process(self, *args, **kwargs):
+        if PartialState().is_main_process:
+            return function(self, *args, **kwargs)
+
+    return execute_on_main_process
+
+
+class GeneralTracker:
+    """Base tracker protocol (reference tracking.py:91)."""
+
+    main_process_only = True
+
+    def __init__(self, _blank: bool = False):
+        self._started = not _blank
+
+    @property
+    def name(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def requires_logging_directory(self) -> bool:
+        return False
+
+    @property
+    def tracker(self):
+        return None
+
+    def store_init_configuration(self, values: dict) -> None:
+        pass
+
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+
+class JSONLTracker(GeneralTracker):
+    """Native tracker: one JSON object per log call, appended to
+    ``<logging_dir>/<run_name>/metrics.jsonl``. Zero dependencies."""
+
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        super().__init__()
+        self.run_name = run_name
+        self.run_dir = os.path.join(logging_dir, run_name)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self._path = os.path.join(self.run_dir, "metrics.jsonl")
+
+    @property
+    def name(self) -> str:
+        return "jsonl"
+
+    @property
+    def tracker(self):
+        return self._path
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        with open(os.path.join(self.run_dir, "config.json"), "w") as f:
+            json.dump(values, f, indent=2, default=str)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        record = {"_time": time.time()}
+        if step is not None:
+            record["_step"] = step
+        record.update(values)
+        with open(self._path, "a") as f:
+            f.write(json.dumps(record, default=float) + "\n")
+
+
+class TensorBoardTracker(GeneralTracker):
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        super().__init__()
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+
+    @property
+    def name(self) -> str:
+        return "tensorboard"
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer.add_hparams(
+            {k: v for k, v in values.items() if isinstance(v, (str, float, int, bool))},
+            metric_dict={},
+        )
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.writer.add_scalar(k, v, global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    main_process_only = True
+
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import wandb
+
+        self.run_name = run_name
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @property
+    def name(self) -> str:
+        return "wandb"
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.run.finish()
+
+
+class MLflowTracker(GeneralTracker):
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__()
+        import mlflow
+
+        self.run_name = run_name
+        mlflow.start_run(run_name=run_name)
+        self._mlflow = mlflow
+
+    @property
+    def name(self) -> str:
+        return "mlflow"
+
+    @property
+    def tracker(self):
+        return self._mlflow.active_run()
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        for k, v in values.items():
+            self._mlflow.log_param(k, v)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        metrics = {k: v for k, v in values.items() if isinstance(v, (int, float))}
+        self._mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self) -> None:
+        self._mlflow.end_run()
+
+
+class CometMLTracker(GeneralTracker):
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        from comet_ml import Experiment
+
+        self.run_name = run_name
+        self.writer = Experiment(project_name=run_name, **kwargs)
+
+    @property
+    def name(self) -> str:
+        return "comet_ml"
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        if step is not None:
+            self.writer.set_step(step)
+        self.writer.log_metrics(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.end()
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "jsonl": JSONLTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "mlflow": MLflowTracker,
+    "comet_ml": CometMLTracker,
+}
+
+_AVAILABILITY = {
+    "jsonl": lambda: True,
+    "tensorboard": is_tensorboard_available,
+    "wandb": is_wandb_available,
+    "mlflow": is_mlflow_available,
+    "comet_ml": is_comet_ml_available,
+    "aim": is_aim_available,
+    "clearml": is_clearml_available,
+    "dvclive": is_dvclive_available,
+    "swanlab": is_swanlab_available,
+}
+
+
+def filter_trackers(
+    log_with, logging_dir: Optional[str] = None
+) -> list[str]:
+    """Resolve the ``log_with`` argument to available tracker names
+    (reference tracking.py:1024)."""
+    if log_with is None:
+        return []
+    if not isinstance(log_with, (list, tuple)):
+        log_with = [log_with]
+    names: list[str] = []
+    if "all" in [str(x) for x in log_with] or LoggerType.ALL in log_with:
+        names = [name for name, avail in _AVAILABILITY.items() if avail()]
+    else:
+        for item in log_with:
+            if isinstance(item, GeneralTracker):
+                names.append(item)  # pre-built tracker passed through
+                continue
+            name = str(item)
+            if name not in _AVAILABILITY:
+                raise ValueError(
+                    f"unknown tracker {name!r}; choose from {sorted(_AVAILABILITY)}"
+                )
+            if not _AVAILABILITY[name]():
+                logger.warning(f"tracker {name} requested but not installed; skipping")
+                continue
+            names.append(name)
+    needs_dir = [n for n in names if isinstance(n, str) and LOGGER_TYPE_TO_CLASS.get(n, GeneralTracker).requires_logging_directory]
+    if needs_dir and logging_dir is None:
+        raise ValueError(
+            f"trackers {needs_dir} need a logging_dir; pass project_dir/logging_dir "
+            "to Accelerator"
+        )
+    return names
+
+
+def resolve_trackers(names, project_name: str, logging_dir, init_kwargs: dict) -> list[GeneralTracker]:
+    trackers: list[GeneralTracker] = []
+    for name in names:
+        if isinstance(name, GeneralTracker):
+            trackers.append(name)
+            continue
+        cls = LOGGER_TYPE_TO_CLASS.get(name)
+        if cls is None:
+            logger.warning(f"tracker {name} has no adapter yet; skipping")
+            continue
+        kwargs = dict(init_kwargs.get(name, {}))
+        if cls.requires_logging_directory:
+            trackers.append(cls(project_name, logging_dir, **kwargs))
+        else:
+            trackers.append(cls(project_name, **kwargs))
+    return trackers

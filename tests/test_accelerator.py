@@ -1,0 +1,253 @@
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator
+from accelerate_tpu.nn import F, Tensor
+from accelerate_tpu.optimizer import AcceleratedOptimizer
+from accelerate_tpu.scheduler import AcceleratedScheduler
+from accelerate_tpu.data_loader import DataLoaderShard
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    nn.manual_seed(0)
+    yield
+    Accelerator._reset_state()
+
+
+def make_regression_data(n=64, in_dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(in_dim,))
+    x = rng.normal(size=(n, in_dim)).astype(np.float32)
+    y = (x @ w + 0.1 * rng.normal(size=n)).astype(np.float32)
+    return [{"x": x[i], "y": y[i]} for i in range(n)]
+
+
+def test_prepare_returns_wrapped_objects():
+    acc = Accelerator()
+    model = nn.Linear(4, 1)
+    opt = optim.AdamW(model.parameters(), lr=1e-2)
+    sched = optim.LambdaLR(opt, lambda s: 1.0)
+    data = make_regression_data()
+    model, opt, dl, sched = acc.prepare(model, opt, data and acc.prepare_data_loader(
+        __import__("accelerate_tpu").prepare_data_loader(dataset=data, batch_size=2)
+    ), sched)
+    assert isinstance(opt, AcceleratedOptimizer)
+    assert isinstance(sched, AcceleratedScheduler)
+    assert isinstance(dl, DataLoaderShard)
+    # params now replicated global arrays on the mesh
+    assert isinstance(model.weight.data, jax.Array)
+    assert len(model.weight.data.sharding.device_set) == 8
+
+
+def test_end_to_end_training_eager_converges():
+    acc = Accelerator()
+    model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = optim.AdamW(model.parameters(), lr=1e-2)
+    dl = acc.prepare_data_loader(
+        __import__("accelerate_tpu").prepare_data_loader(
+            dataset=make_regression_data(), batch_size=2, shuffle=True
+        )
+    )
+    model, opt = acc.prepare(model, opt)
+    losses = []
+    for epoch in range(10):
+        for batch in dl:
+            opt.zero_grad()
+            pred = model(Tensor(batch["x"])).squeeze(-1)
+            loss = F.mse_loss(pred, Tensor(batch["y"]))
+            acc.backward(loss)
+            opt.step()
+            losses.append(float(loss.item()))
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_gradient_accumulation_semantics():
+    """Accumulated micro-steps must produce the same update as one big batch."""
+    data_x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    data_y = np.random.default_rng(1).normal(size=(8,)).astype(np.float32)
+
+    def run(accum_steps, micro):
+        Accelerator._reset_state()
+        nn.manual_seed(0)
+        acc = Accelerator(gradient_accumulation_steps=accum_steps)
+        model = nn.Linear(4, 1)
+        opt = acc.prepare(optim.SGD(model.parameters(), lr=0.1))
+        acc.prepare_model(model)
+        n = len(data_x) // micro
+        for i in range(n):
+            with acc.accumulate(model):
+                xb = data_x[i * micro : (i + 1) * micro]
+                yb = data_y[i * micro : (i + 1) * micro]
+                pred = model(Tensor(jnp.asarray(xb))).squeeze(-1)
+                loss = F.mse_loss(pred, Tensor(jnp.asarray(yb)))
+                acc.backward(loss)
+                opt.step()
+                opt.zero_grad()
+        return np.asarray(model.weight.data)
+
+    w_accum = run(4, 2)  # 4 micro-batches of 2
+    w_big = run(1, 8)  # one batch of 8
+    np.testing.assert_allclose(w_accum, w_big, rtol=1e-5, atol=1e-6)
+
+
+def test_no_sync_context():
+    acc = Accelerator()
+    model = nn.Linear(2, 1)
+    opt = acc.prepare(optim.SGD(model.parameters(), lr=0.1))
+    before = np.asarray(model.weight.data).copy()
+    with acc.no_sync(model):
+        pred = model(Tensor(jnp.ones((2, 2))))
+        acc.backward(pred.sum())
+        opt.step()
+    np.testing.assert_array_equal(model.weight.data, before)
+
+
+def test_clip_grad_norm():
+    acc = Accelerator()
+    model = nn.Linear(2, 1)
+    acc.prepare_model(model)
+    model.weight.grad = jnp.full((1, 2), 30.0)
+    model.bias.grad = jnp.full((1,), 40.0)
+    norm = acc.clip_grad_norm_(model.parameters(), max_norm=1.0)
+    assert float(norm) == pytest.approx(np.sqrt(30**2 * 2 + 40**2), rel=1e-4)
+    new_norm = np.sqrt(
+        (np.asarray(model.weight.grad) ** 2).sum() + (np.asarray(model.bias.grad) ** 2).sum()
+    )
+    assert new_norm == pytest.approx(1.0, rel=1e-3)
+
+
+def test_mixed_precision_bf16_params_and_master():
+    acc = Accelerator(mixed_precision="bf16")
+    model = nn.Linear(4, 4)
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+    assert model.weight.dtype == jnp.bfloat16
+    pred = model(Tensor(jnp.ones((2, 4), dtype=jnp.bfloat16)))
+    acc.backward(pred.sum())
+    opt.step()
+    # master weights stay fp32 inside the optimizer
+    assert opt.optimizer.master_params[0].dtype == jnp.float32
+    assert model.weight.dtype == jnp.bfloat16
+
+
+def test_compile_step_matches_eager():
+    data = make_regression_data(n=16)
+    x = np.stack([d["x"] for d in data])
+    y = np.stack([d["y"] for d in data])
+
+    def run(use_capture):
+        Accelerator._reset_state()
+        nn.manual_seed(0)
+        acc = Accelerator()
+        model = nn.Linear(4, 1)
+        opt = optim.SGD(model.parameters(), lr=0.05)
+        model, opt = acc.prepare(model, opt)
+
+        def step_fn(xb, yb):
+            opt.zero_grad()
+            pred = model(Tensor(xb)).squeeze(-1)
+            loss = F.mse_loss(pred, Tensor(yb))
+            acc.backward(loss)
+            opt.step()
+            return loss
+
+        step = acc.compile_step(step_fn) if use_capture else step_fn
+        losses = []
+        for i in range(8):
+            loss = step(jnp.asarray(x), jnp.asarray(y))
+            losses.append(float(loss.item() if hasattr(loss, "item") else loss))
+        return losses, np.asarray(model.weight.data)
+
+    eager_losses, eager_w = run(False)
+    cap_losses, cap_w = run(True)
+    np.testing.assert_allclose(cap_losses, eager_losses, rtol=1e-4)
+    np.testing.assert_allclose(cap_w, eager_w, rtol=1e-4)
+
+
+def test_compile_step_with_scheduler():
+    Accelerator._reset_state()
+    acc = Accelerator()
+    model = nn.Linear(2, 1)
+    opt = optim.SGD(model.parameters(), lr=1.0)
+    sched = optim.LambdaLR(opt, lambda s: 1.0 / (s + 1))
+    model, opt, sched = acc.prepare(model, opt, sched)
+
+    def step_fn(xb):
+        opt.zero_grad()
+        loss = model(Tensor(xb)).sum()
+        acc.backward(loss)
+        opt.step()
+        sched.step()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    step(jnp.ones((2, 2)))
+    lr_after_1 = float(opt.optimizer.lr)
+    step(jnp.ones((2, 2)))
+    lr_after_2 = float(opt.optimizer.lr)
+    # scheduler stepped 8× per call (8 shards): lr = 1/(8k+1)
+    assert lr_after_1 == pytest.approx(1.0 / 9)
+    assert lr_after_2 == pytest.approx(1.0 / 17)
+
+
+def test_gather_for_metrics_truncates_remainder():
+    import accelerate_tpu
+
+    acc = Accelerator()
+    data = [{"x": np.array([float(i)])} for i in range(20)]
+    dl = acc.prepare_data_loader(
+        accelerate_tpu.prepare_data_loader(dataset=data, batch_size=2)
+    )
+    seen = []
+    for batch in dl:
+        gathered = acc.gather_for_metrics(batch["x"])
+        seen.extend(np.asarray(gathered)[:, 0].tolist())
+    assert sorted(seen) == [float(i) for i in range(20)]
+
+
+def test_trigger_single_process():
+    acc = Accelerator()
+    assert not acc.check_trigger()
+    acc.set_trigger()
+    assert acc.check_trigger()
+    assert not acc.check_trigger()
+
+
+def test_jsonl_tracker(tmp_path):
+    acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
+    acc.init_trackers("run1", config={"lr": 0.1})
+    acc.log({"loss": 1.5}, step=0)
+    acc.log({"loss": jnp.asarray(0.5)}, step=1)
+    acc.end_training()
+    import json
+
+    lines = (tmp_path / "run1" / "metrics.jsonl").read_text().strip().split("\n")
+    assert len(lines) == 2
+    assert json.loads(lines[1])["loss"] == 0.5
+    assert json.loads((tmp_path / "run1" / "config.json").read_text())["lr"] == 0.1
+
+
+def test_save_and_load_state_roundtrip(tmp_path):
+    acc = Accelerator()
+    model = nn.Linear(4, 2)
+    opt = optim.AdamW(model.parameters(), lr=1e-2)
+    model, opt = acc.prepare(model, opt)
+    # train a step so optimizer state is nontrivial
+    loss = model(Tensor(jnp.ones((2, 4)))).sum()
+    acc.backward(loss)
+    opt.step()
+    w_before = np.asarray(model.weight.data).copy()
+    acc.save_state(str(tmp_path / "ckpt"))
+    # perturb
+    model.weight.data = jnp.zeros_like(model.weight.data)
+    acc.load_state(str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(np.asarray(model.weight.data), w_before, rtol=1e-6)
+    # sharding preserved after load
+    assert len(model.weight.data.sharding.device_set) == 8
