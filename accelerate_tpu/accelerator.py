@@ -468,14 +468,15 @@ class Accelerator:
             data = self.gather(
                 input_data.data if isinstance(input_data, Tensor) else input_data
             )
-        try:
-            if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
-                def _truncate(t):
-                    return t[: t.shape[0] - self.gradient_state.remainder]
+        if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
+            remainder = self.gradient_state.remainder
 
-                return ops.recursively_apply(_truncate, data)
-        except Exception:
-            pass
+            def _truncate(t):
+                if getattr(t, "ndim", 0) == 0:
+                    return t  # scalars carry no batch dim to truncate
+                return t[: t.shape[0] - remainder]
+
+            return ops.recursively_apply(_truncate, data)
         return data
 
     def reduce(self, tensor, reduction: str = "sum", scale: float = 1.0):
@@ -635,9 +636,14 @@ class Accelerator:
     def log(self, values: dict, step: Optional[int] = None, log_kwargs: dict = {}) -> None:
         if not self.is_main_process:
             return
-        clean = {
-            k: (float(v.item()) if hasattr(v, "item") else v) for k, v in values.items()
-        }
+        def _clean(v):
+            if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+                return float(v.item())
+            if hasattr(v, "tolist"):
+                return v.tolist()
+            return v
+
+        clean = {k: _clean(v) for k, v in values.items()}
         for tracker in self.trackers:
             tracker.log(clean, step=step, **log_kwargs.get(tracker.name, {}))
 

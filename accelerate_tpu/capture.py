@@ -123,9 +123,14 @@ class CapturedStep:
             )
         args = _unwrap_tree(args)
         flat_args, args_treedef = jax.tree_util.tree_flatten(args)
+        import numpy as _np
+
         key = (
             args_treedef,
-            tuple((tuple(a.shape), str(a.dtype)) for a in map(jnp.asarray, flat_args)),
+            tuple(
+                (tuple(_np.shape(a)), str(getattr(a, "dtype", _np.result_type(a))))
+                for a in flat_args
+            ),
             acc.gradient_state.sync_gradients,
             tuple(m.training for m in acc._models),
         )

@@ -11,10 +11,9 @@ at host scope via the ops layer.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
-from .state import GradientState, PartialState
+from .state import PartialState
 from .utils import operations as ops
 
 

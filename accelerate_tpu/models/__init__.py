@@ -1,0 +1,2 @@
+from .bert import BertConfig, BertForSequenceClassification, BertModel
+from .gpt import GPTConfig, GPTLMHeadModel

@@ -1,0 +1,177 @@
+"""BERT-family encoder on accelerate_tpu.nn.
+
+The flagship fine-tuning workload (BASELINE.json: BERT-base MRPC via
+examples/nlp_example.py).  Written TPU-first: bf16-friendly, SDPA routed to
+the Pallas flash kernel when shapes allow, weights carrying a TP plan so the
+same model runs replicated, ZeRO-sharded, or tensor-parallel purely by mesh
+layout.  Reference model source for parity: HF transformers BERT (the
+reference repo itself ships no models — SURVEY.md §2; models are part of this
+framework's larger scope).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import F, Tensor
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def small(cls) -> "BertConfig":
+        return cls(hidden_size=256, num_hidden_layers=4, num_attention_heads=4, intermediate_size=1024)
+
+
+def _bert_init(model: nn.Module, initializer_range: float = 0.02) -> None:
+    """HF BERT init: N(0, 0.02) for all weight matrices, zero biases."""
+    import jax
+
+    from ..nn import random as nn_random
+
+    for name, p in model.named_parameters():
+        if name.endswith("bias"):
+            p.data = jnp.zeros_like(p.data)
+        elif p.ndim >= 2:
+            p.data = initializer_range * jax.random.normal(
+                nn_random.next_key(), p.shape, dtype=p.dtype
+            )
+
+
+class BertEmbeddings(nn.Module):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size, config.hidden_size)
+        self.LayerNorm = nn.LayerNorm(config.hidden_size, eps=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        seq_len = input_ids.shape[-1]
+        if position_ids is None:
+            position_ids = jnp.arange(seq_len)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(jnp.asarray(input_ids))
+        emb = (
+            self.word_embeddings(input_ids)
+            + self.position_embeddings(position_ids)
+            + self.token_type_embeddings(token_type_ids)
+        )
+        return self.dropout(self.LayerNorm(emb))
+
+
+class BertSelfAttention(nn.Module):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        self.query = nn.Linear(config.hidden_size, config.hidden_size)
+        self.key = nn.Linear(config.hidden_size, config.hidden_size)
+        self.value = nn.Linear(config.hidden_size, config.hidden_size)
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, hidden, attention_mask=None):
+        b, s, _ = hidden.shape
+
+        def split(x):
+            return x.reshape(b, s, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = split(self.query(hidden)), split(self.key(hidden)), split(self.value(hidden))
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask,
+            dropout_p=self.dropout_p if self.training else 0.0,
+        )
+        return out.transpose(0, 2, 1, 3).reshape(b, s, self.num_heads * self.head_dim)
+
+
+class BertLayer(nn.Module):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(config)
+        self.attention_output = nn.Linear(config.hidden_size, config.hidden_size)
+        self.attention_norm = nn.LayerNorm(config.hidden_size, eps=config.layer_norm_eps)
+        self.intermediate = nn.Linear(config.hidden_size, config.intermediate_size)
+        self.output = nn.Linear(config.intermediate_size, config.hidden_size)
+        self.output_norm = nn.LayerNorm(config.hidden_size, eps=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, hidden, attention_mask=None):
+        attn = self.attention(hidden, attention_mask)
+        hidden = self.attention_norm(hidden + self.dropout(self.attention_output(attn)))
+        ff = self.output(F.gelu(self.intermediate(hidden)))
+        return self.output_norm(hidden + self.dropout(ff))
+
+
+class BertModel(nn.Module):
+    # tensor-parallel plan: attention projections split on output features,
+    # FFN split on the intermediate axis
+    tp_plan = {
+        r".*\.(query|key|value)\.weight": ("tp", None),
+        r".*\.(query|key|value)\.bias": ("tp",),
+        r".*\.intermediate\.weight": ("tp", None),
+        r".*\.intermediate\.bias": ("tp",),
+        r".*\.attention_output\.weight": (None, "tp"),
+        r".*layer\.\d+\.output\.weight": (None, "tp"),
+    }
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.layer = nn.ModuleList([BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+        _bert_init(self)
+
+    def forward(self, input_ids, attention_mask=None, token_type_ids=None):
+        if attention_mask is not None:
+            mask = jnp.asarray(
+                attention_mask.data if isinstance(attention_mask, Tensor) else attention_mask
+            )
+            # (b, s) padding mask → (b, 1, 1, s) additive-compatible bool
+            attention_mask = (mask[:, None, None, :] > 0)
+        hidden = self.embeddings(input_ids, token_type_ids)
+        for layer in self.layer:
+            hidden = layer(hidden, attention_mask)
+        pooled = F.tanh(self.pooler(hidden[:, 0]))
+        return hidden, pooled
+
+
+class BertForSequenceClassification(nn.Module):
+    tp_plan = BertModel.tp_plan
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, config.num_labels)
+
+    def forward(self, input_ids, attention_mask=None, token_type_ids=None, labels=None):
+        _, pooled = self.bert(input_ids, attention_mask, token_type_ids)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return {"loss": loss, "logits": logits}
+        return {"logits": logits}

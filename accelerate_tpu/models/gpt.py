@@ -1,0 +1,154 @@
+"""GPT-2-family causal LM on accelerate_tpu.nn — the throughput flagship.
+
+Decoder-only transformer with pre-norm blocks, learned positions, weight-tied
+LM head, causal SDPA routed to the Pallas flash kernel.  Carries the TP plan
+(qkv/ffn column-parallel, proj row-parallel) so pjit lays it out on any mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import F, Tensor
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304  # padded to a 128 multiple for the MXU
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+
+    @classmethod
+    def small(cls) -> "GPTConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "GPTConfig":
+        return cls(vocab_size=1024, n_positions=256, n_embd=128, n_layer=2, n_head=4)
+
+    @classmethod
+    def medium(cls) -> "GPTConfig":
+        return cls(n_embd=1024, n_layer=24, n_head=16)
+
+
+def _gpt2_init(model: nn.Module, config: GPTConfig) -> None:
+    """GPT-2 init: N(0, 0.02) weights, zero biases, residual-proj scaling."""
+    import jax
+
+    from ..nn import random as nn_random
+
+    scale = 0.02
+    resid_scale = scale / math.sqrt(2 * config.n_layer)
+    for name, p in model.named_parameters():
+        if name.endswith(".bias") or ".ln" in name or "ln_" in name:
+            if p.ndim == 1 and name.endswith("weight"):
+                continue  # LN weight stays ones
+            if name.endswith("bias"):
+                p.data = jnp.zeros_like(p.data)
+            continue
+        if p.ndim >= 2:
+            std = resid_scale if "c_proj" in name else scale
+            p.data = std * jax.random.normal(
+                nn_random.next_key(), p.shape, dtype=p.dtype
+            )
+
+
+class CausalSelfAttention(nn.Module):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.n_head = config.n_head
+        self.head_dim = config.n_embd // config.n_head
+        self.c_attn = nn.Linear(config.n_embd, 3 * config.n_embd)
+        self.c_proj = nn.Linear(config.n_embd, config.n_embd)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        b, s, c = x.shape
+        qkv = self.c_attn(x).reshape(b, s, 3, self.n_head, self.head_dim)
+        qkv = qkv.transpose(2, 0, 3, 1, 4)  # (3, b, h, s, d)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, c)
+        return self.dropout(self.c_proj(out))
+
+
+class MLP(nn.Module):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.c_fc = nn.Linear(config.n_embd, 4 * config.n_embd)
+        self.c_proj = nn.Linear(4 * config.n_embd, config.n_embd)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.c_proj(F.gelu(self.c_fc(x))))
+
+
+class Block(nn.Module):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.n_embd, eps=config.layer_norm_eps)
+        self.attn = CausalSelfAttention(config)
+        self.ln_2 = nn.LayerNorm(config.n_embd, eps=config.layer_norm_eps)
+        self.mlp = MLP(config)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        return x + self.mlp(self.ln_2(x))
+
+
+class GPTLMHeadModel(nn.Module):
+    tp_plan = {
+        r".*\.c_attn\.weight": ("tp", None),
+        r".*\.c_attn\.bias": ("tp",),
+        r".*\.c_fc\.weight": ("tp", None),
+        r".*\.c_fc\.bias": ("tp",),
+        r".*\.c_proj\.weight": (None, "tp"),
+        r"wte\.weight": ("tp", None),
+    }
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = nn.Embedding(config.vocab_size, config.n_embd)
+        self.wpe = nn.Embedding(config.n_positions, config.n_embd)
+        self.drop = nn.Dropout(config.dropout)
+        self.h = nn.ModuleList([Block(config) for _ in range(config.n_layer)])
+        self.ln_f = nn.LayerNorm(config.n_embd, eps=config.layer_norm_eps)
+        # LM head weight-tied to wte (reference find_tied_parameters semantics,
+        # utils/modeling.py:559 — ties survive state_dict round trips here by
+        # construction since the head reuses wte.weight directly)
+        _gpt2_init(self, config)
+
+    def forward(self, input_ids, labels=None):
+        ids = jnp.asarray(input_ids.data if isinstance(input_ids, Tensor) else input_ids)
+        b, s = ids.shape
+        pos = jnp.arange(s)[None, :]
+        x = self.drop(self.wte(ids) + self.wpe(pos))
+        for block in self.h:
+            x = block(x)
+        x = self.ln_f(x)
+        logits = F.linear(x, self.wte.weight)  # tied head: x @ wte^T
+        if labels is not None:
+            lab = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
+            shift_logits = logits[:, :-1, :].reshape(-1, self.config.vocab_size)
+            shift_labels = lab[:, 1:].reshape(-1)
+            loss = F.cross_entropy(shift_logits, shift_labels)
+            return {"loss": loss, "logits": logits}
+        return {"logits": logits}
+
+    @property
+    def num_flops_per_token(self) -> float:
+        """Approximate training FLOPs/token (6N + attention term)."""
+        n = self.num_parameters
+        c = self.config
+        attn = 12 * c.n_layer * c.n_embd * c.n_positions
+        return 6 * n + attn

@@ -189,6 +189,9 @@ class Conv2d(Module):
         from .tape import tape_op
 
         def _conv(v, w, *b):
+            # mixed precision: compute in the weight dtype (lax.conv requires
+            # matching dtypes; down-casting the input is the bf16-policy move)
+            v = v.astype(w.dtype)
             out = jax.lax.conv_general_dilated(
                 v,
                 w,

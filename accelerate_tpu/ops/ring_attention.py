@@ -1,0 +1,141 @@
+"""Ring attention — sequence/context parallelism over the ``sp`` mesh axis.
+
+NEW capability relative to the reference: HF Accelerate has no native
+sequence parallelism at all (SURVEY.md §2.2 — grep-verified; only Megatron
+pass-through flags).  Here it is first-class and TPU-native:
+
+* the sequence dimension is sharded over the ``sp`` mesh axis;
+* each device holds one q-chunk permanently and streams k/v chunks around the
+  ring with ``lax.ppermute`` over ICI — communication overlaps the blockwise
+  attention compute of the previous chunk (XLA schedules the permute
+  concurrently with the einsums);
+* softmax is computed online (running max/denominator, the flash-attention
+  recurrence) so the full (S × S) score matrix never exists anywhere and the
+  per-device memory is O(S/n · S/n) per block pair;
+* causal masking skips fully-masked chunk pairs via ``lax.cond`` so the
+  causal ring does ~half the FLOPs.
+
+Design follows the blockwise/ring attention literature (see PAPERS.md);
+no reference code exists for this path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _block_update(q, k, v, m, l, acc, q_offset, k_offset, scale, is_causal):
+    """One online-softmax accumulation of q against a k/v chunk."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, is_causal: bool, scale: float):
+    """Per-device body under shard_map: q stays, k/v ride the ring."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    chunk = sq  # local chunk length (== global_seq / n)
+    q32 = q.astype(jnp.float32)
+
+    m0 = jnp.full((b, h, sq, 1), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, h, sq, d), dtype=jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        k_cur, v_cur, m, l, acc = carry
+        # after `step` rotations this device holds the chunk that started at
+        # ring position (my_idx - step) mod n
+        k_idx = jax.lax.rem(my_idx - step + n, n)
+        q_offset = my_idx * chunk
+        k_offset = k_idx * chunk
+
+        def do_update(args):
+            m_, l_, acc_ = args
+            return _block_update(
+                q32, k_cur.astype(jnp.float32), v_cur, m_, l_, acc_,
+                q_offset, k_offset, scale, is_causal,
+            )
+
+        if is_causal:
+            # whole chunk strictly in the future → nothing to accumulate
+            m, l, acc = jax.lax.cond(
+                k_offset > q_offset + chunk - 1,
+                lambda args: args,
+                do_update,
+                (m, l, acc),
+            )
+        else:
+            m, l, acc = do_update((m, l, acc))
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_next, v_next, m, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    is_causal: bool = False,
+    scale: Optional[float] = None,
+    axis_name: str = "sp",
+    batch_axes: tuple = ("dp", "fsdp"),
+) -> jax.Array:
+    """Sequence-parallel attention over (batch, heads, seq, head_dim) arrays
+    whose seq dimension is sharded on the ``axis_name`` mesh axis.
+
+    Differentiable (pure jnp + collectives inside shard_map — JAX transposes
+    ppermute automatically), jit-compatible, composes with dp/fsdp batch
+    sharding.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        mesh = AcceleratorState().mesh
+    if mesh.shape.get(axis_name, 1) == 1:
+        from .attention import sdpa_tpu
+
+        return sdpa_tpu(q, k, v, is_causal=is_causal, scale=scale)
+
+    batch_spec = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    spec = P(batch_spec, None, axis_name, None)
+    from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, is_causal=is_causal, scale=scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)

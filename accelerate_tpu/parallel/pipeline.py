@@ -1,0 +1,143 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+Counterpart of the reference's PiPPy integration (inference.py:124
+``prepare_pippy`` — trace, split at layer boundaries, ScheduleGPipe) rebuilt
+as SPMD: stage parameters carry a leading stage axis sharded over ``pp``;
+under ``shard_map`` each device runs its own stage and activations hop to the
+next stage with ``lax.ppermute`` each tick.  ``T = num_microbatches +
+num_stages - 1`` ticks fill and drain the pipeline; everything is pure jnp so
+JAX transposes it for training as well as inference.
+
+On TPU slices GSPMD tensor/data sharding usually beats PP (ICI is fast and
+XLA overlaps collectives); PP earns its keep across slices (DCN) — which is
+why it is a mesh axis here and composes with dp/fsdp/tp rather than being a
+separate engine.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _gpipe_local(stage_params, x_mb, *, stage_fn, axis_name: str, num_microbatches: int):
+    """Per-device GPipe schedule under shard_map.
+
+    stage_params: this stage's params (leading stage axis already split away).
+    x_mb: (M, mb, ...) microbatched input (only stage 0 reads it).
+    Returns (M, mb, ...) outputs (only the last stage's are meaningful).
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage_idx = jax.lax.axis_index(axis_name)
+    M = num_microbatches
+    T = M + n_stages - 1
+
+    # activation probe to get output shape/dtype of one stage
+    sample_out = jax.eval_shape(lambda p, x: stage_fn(p, x), stage_params, x_mb[0])
+    act0 = jnp.zeros(sample_out.shape, sample_out.dtype)
+    outputs0 = jnp.zeros((M,) + sample_out.shape, sample_out.dtype)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(t, carry):
+        incoming, outputs = carry
+        mb_idx = t - stage_idx
+        active = jnp.logical_and(mb_idx >= 0, mb_idx < M)
+        # stage 0 reads its microbatch; later stages use the ring input
+        x_idx = jnp.clip(mb_idx, 0, M - 1)
+        my_input = jnp.where(
+            stage_idx == 0,
+            jax.lax.dynamic_index_in_dim(x_mb, x_idx, keepdims=False).astype(incoming.dtype)
+            if x_mb.shape[1:] == incoming.shape
+            else incoming,
+            incoming,
+        )
+        out = stage_fn(stage_params, my_input)
+        out = jnp.where(active, out, jnp.zeros_like(out))
+        # last stage records its finished microbatch
+        outputs = jax.lax.cond(
+            jnp.logical_and(active, stage_idx == n_stages - 1),
+            lambda o: jax.lax.dynamic_update_index_in_dim(o, out, x_idx, 0),
+            lambda o: o,
+            outputs,
+        )
+        # all stages forward their activation to the next stage
+        nxt = jax.lax.ppermute(out, axis_name, perm)
+        return nxt, outputs
+
+    _, outputs = jax.lax.fori_loop(0, T, tick, (act0, outputs0))
+    # only the last stage holds real outputs; broadcast them around the ring
+    # so the result is replicated over pp (callers slice/psum as needed)
+    outputs = jax.lax.psum(
+        jnp.where(stage_idx == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name,
+    )
+    return outputs
+
+
+def gpipe(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "pp",
+    batch_axes: tuple = ("dp", "fsdp"),
+):
+    """Run ``stage_fn(params_i, x)`` as a pipeline over the ``pp`` axis.
+
+    ``stacked_params``: pytree whose leaves have a leading ``num_stages`` axis
+    (stage i's slice feeds device i).  ``x``: (batch, ...) global input —
+    reshaped to (num_microbatches, batch/M, ...).
+
+    Constraint (GPipe classic): every stage must map activations to the same
+    shape/dtype.  Embedding/head layers live outside the pipelined trunk.
+    """
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        mesh = AcceleratorState().mesh
+    n_stages = mesh.shape.get(axis_name, 1)
+    if n_stages == 1:
+        # degenerate: sequential scan over stages on one device group
+        def body(h, p):
+            return stage_fn(p, h), None
+
+        out, _ = jax.lax.scan(body, x, stacked_params)
+        return out
+
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches {num_microbatches}"
+        )
+    x_mb = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+    from jax.experimental.shard_map import shard_map
+
+    batch_spec = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stacked_params
+    )
+    x_spec = P(None, batch_spec)
+    out_spec = P(None, batch_spec)
+
+    fn = shard_map(
+        functools.partial(
+            _gpipe_local,
+            stage_fn=lambda p, h: stage_fn(
+                jax.tree_util.tree_map(lambda a: a[0], p), h
+            ),
+            axis_name=axis_name,
+            num_microbatches=num_microbatches,
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=out_spec,
+        check_rep=False,
+    )
+    out_mb = fn(stacked_params, x_mb)
+    return out_mb.reshape(b, *out_mb.shape[2:])

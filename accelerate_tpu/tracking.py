@@ -46,6 +46,10 @@ class GeneralTracker:
     """Base tracker protocol (reference tracking.py:91)."""
 
     main_process_only = True
+    # plain class attribute (NOT a property): it is read off the class in
+    # filter_trackers/resolve_trackers, where a property object would be
+    # always-truthy
+    requires_logging_directory = False
 
     def __init__(self, _blank: bool = False):
         self._started = not _blank
@@ -53,10 +57,6 @@ class GeneralTracker:
     @property
     def name(self) -> str:
         raise NotImplementedError
-
-    @property
-    def requires_logging_directory(self) -> bool:
-        return False
 
     @property
     def tracker(self):

@@ -1,0 +1,92 @@
+"""Benchmark: GPT-2-small causal-LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The flagship workload (BASELINE.md): transformer training throughput,
+bf16, full captured step (fwd+bwd+AdamW fused into one XLA program).
+``vs_baseline`` compares per-chip tokens/sec against an 8×A100 NCCL DDP
+baseline estimate for GPT-2-small of 150k tokens/s/GPU (A100 312 TFLOP/s
+bf16 at ~40% MFU over ~6N FLOPs/token; BASELINE.json publishes no number,
+so the denominator is this documented estimate).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+A100_BASELINE_TOKENS_PER_SEC = 150_000.0
+
+BATCH = int(os.environ.get("BENCH_BATCH", 8))
+SEQ = int(os.environ.get("BENCH_SEQ", 1024))
+STEPS = int(os.environ.get("BENCH_STEPS", 20))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 5))
+
+
+def main() -> None:
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    nn.manual_seed(0)
+    acc = Accelerator(mixed_precision="bf16")
+    cfg = GPTConfig.small()
+    model = GPTLMHeadModel(cfg)
+    opt = optim.AdamW(model.parameters(), lr=3e-4, weight_decay=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    rng = np.random.default_rng(0)
+    from accelerate_tpu.data_loader import batch_to_global_array
+
+    def make_batch(i):
+        ids = rng.integers(0, cfg.vocab_size, size=(BATCH, SEQ), dtype=np.int32)
+        return batch_to_global_array(jnp.asarray(ids), mesh=acc.mesh)
+
+    batches = [make_batch(i) for i in range(4)]
+    for i in range(WARMUP):
+        loss = step(batches[i % len(batches)])
+    float(loss)  # force full sync before timing
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        loss = step(batches[i % len(batches)])
+    final_loss = float(loss)  # device sync: everything above has completed
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = BATCH * SEQ * STEPS / dt
+    n_params = model.num_parameters
+    flops_per_token = 6 * n_params
+    mfu_denom = 197e12 if acc.state.backend in ("tpu", "axon") else None
+    result = {
+        "metric": "gpt2_small_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / A100_BASELINE_TOKENS_PER_SEC, 4),
+    }
+    print(json.dumps(result))
+    print(
+        f"# params={n_params/1e6:.1f}M batch={BATCH}x{SEQ} steps={STEPS} "
+        f"time={dt:.2f}s loss={final_loss:.3f} "
+        f"model_flops={tokens_per_sec * flops_per_token / 1e12:.1f} TFLOP/s"
+        + (f" (~{tokens_per_sec * flops_per_token / mfu_denom * 100:.0f}% MFU)" if mfu_denom else ""),
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

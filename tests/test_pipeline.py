@@ -1,0 +1,69 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.parallel.pipeline import gpipe
+from accelerate_tpu.state import AcceleratorState
+from accelerate_tpu.utils.dataclasses import ParallelismConfig
+
+
+def stage_fn(params, h):
+    return jnp.tanh(h @ params["w"] + params["b"])
+
+
+def make_stages(n_stages, dim, key=0):
+    ks = jax.random.split(jax.random.key(key), n_stages)
+    return {
+        "w": jnp.stack([jax.random.normal(k, (dim, dim)) * 0.5 for k in ks]),
+        "b": jnp.zeros((n_stages, dim)),
+    }
+
+
+def sequential(params, x):
+    h = x
+    for i in range(params["w"].shape[0]):
+        h = stage_fn({"w": params["w"][i], "b": params["b"][i]}, h)
+    return h
+
+
+def test_gpipe_matches_sequential():
+    state = AcceleratorState(parallelism_config=ParallelismConfig(pp_size=4, dp_size=2))
+    params = make_stages(4, 16)
+    x = jax.random.normal(jax.random.key(1), (8, 16))
+    expected = sequential(params, x)
+    out = jax.jit(
+        lambda p, x_: gpipe(stage_fn, p, x_, num_microbatches=4, mesh=state.mesh)
+    )(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-6)
+
+
+def test_gpipe_differentiable():
+    state = AcceleratorState(parallelism_config=ParallelismConfig(pp_size=4, dp_size=2))
+    params = make_stages(4, 8)
+    x = jax.random.normal(jax.random.key(2), (4, 8))
+
+    def loss_pp(p):
+        return gpipe(stage_fn, p, x, num_microbatches=2, mesh=state.mesh).sum()
+
+    def loss_seq(p):
+        return sequential(p, x).sum()
+
+    g_pp = jax.grad(loss_pp)(params)
+    g_seq = jax.grad(loss_seq)(params)
+    np.testing.assert_allclose(np.asarray(g_pp["w"]), np.asarray(g_seq["w"]), rtol=1e-4, atol=1e-6)
+
+
+def test_gpipe_pp1_fallback():
+    state = AcceleratorState()  # pp == 1
+    params = make_stages(3, 8)
+    x = jax.random.normal(jax.random.key(3), (4, 8))
+    out = gpipe(stage_fn, params, x, num_microbatches=2, mesh=state.mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(sequential(params, x)), rtol=1e-5)
+
+
+def test_gpipe_bad_microbatch():
+    state = AcceleratorState(parallelism_config=ParallelismConfig(pp_size=4, dp_size=2))
+    params = make_stages(4, 8)
+    with pytest.raises(ValueError):
+        gpipe(stage_fn, params, jnp.ones((6, 8)), num_microbatches=4, mesh=state.mesh)
