@@ -13,6 +13,8 @@ from .accelerator import Accelerator
 from .state import AcceleratorState, GradientState, PartialState
 from .logging import get_logger
 from .data_loader import prepare_data_loader, skip_first_batches
+from .utils.memory import find_executable_batch_size
+from .utils.random import set_seed, synchronize_rng_states
 from .utils.dataclasses import (
     DataLoaderConfiguration,
     DistributedType,

@@ -54,6 +54,7 @@ class RNGType(BaseEnum):
     JAX = "jax"
     NUMPY = "numpy"
     PYTHON = "python"
+    TORCH = "torch"
     GENERATOR = "generator"
 
 

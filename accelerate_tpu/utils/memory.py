@@ -1,0 +1,134 @@
+"""Memory utilities — OOM-retry and device-memory bookkeeping.
+
+Counterpart of ``/root/reference/src/accelerate/utils/memory.py`` (200 LoC):
+``find_executable_batch_size`` (memory.py:120) halves the batch size on OOM
+and retries; ``release_memory`` (memory.py:70) drops references and clears
+caches; ``clear_device_cache`` (memory.py:43).
+
+TPU-native differences: XLA raises ``XlaRuntimeError`` with a
+RESOURCE_EXHAUSTED status instead of torch's ``cuda OOM`` RuntimeError, and
+"clearing the cache" means deleting live buffers + dropping jit compilation
+caches — there is no CUDA caching allocator. Live-array accounting comes from
+``jax.live_arrays()`` and per-device memory stats from
+``Device.memory_stats()`` (PJRT).
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable, Optional
+
+import jax
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """True when ``exception`` is an out-of-memory condition worth retrying
+    at a smaller batch size (reference memory.py:95 checks CUDA/CPU/XPU OOM
+    strings; here: XLA RESOURCE_EXHAUSTED / allocation failures)."""
+    statuses = (
+        "RESOURCE_EXHAUSTED",
+        "Out of memory",
+        "out of memory",
+        "OOM",
+        "Attempting to allocate",
+        "exceeds the maximum",
+    )
+    msg = str(exception)
+    if isinstance(exception, MemoryError):
+        return True
+    return any(s in msg for s in statuses)
+
+
+def clear_device_cache(garbage_collection: bool = False) -> None:
+    """Free what can be freed: python garbage, then XLA compilation caches.
+
+    Reference clear_device_cache (memory.py:43) calls per-backend
+    ``empty_cache``; PJRT has no caching allocator, so the analog is GC (drops
+    unreferenced device buffers immediately) plus clearing jit caches so
+    stale executables don't pin donated buffers.
+    """
+    if garbage_collection:
+        gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:  # pragma: no cover - defensive, clear_caches is stable
+        pass
+
+
+def release_memory(*objects):
+    """Set references to None and clear the cache (reference memory.py:70).
+
+    Usage: ``a, b = release_memory(a, b)``.
+    """
+    if not isinstance(objects, list):
+        objects = list(objects)
+    for i in range(len(objects)):
+        objects[i] = None
+    gc.collect()
+    clear_device_cache()
+    return objects
+
+
+def get_device_memory_stats(device: Optional[jax.Device] = None) -> dict:
+    """Per-device memory stats from PJRT (bytes_in_use, peak_bytes_in_use,
+    bytes_limit where the platform reports them)."""
+    device = device or jax.devices()[0]
+    stats = {}
+    try:
+        stats = dict(device.memory_stats() or {})
+    except Exception:
+        pass
+    return stats
+
+
+def find_executable_batch_size(
+    function: Optional[Callable] = None,
+    starting_batch_size: int = 128,
+    reduce_batch_size_fn: Optional[Callable[[int], int]] = None,
+):
+    """Decorator: retry ``function(batch_size, *a, **kw)`` halving
+    ``batch_size`` whenever an OOM is raised, until it succeeds or reaches 0.
+
+    Mirrors reference find_executable_batch_size (memory.py:120): the
+    decorated function MUST take ``batch_size`` as its first argument; each
+    retry clears device caches first. On TPU an OOM surfaces at compile- or
+    run-time as RESOURCE_EXHAUSTED — both are caught.
+    """
+    if function is None:
+        return functools.partial(
+            find_executable_batch_size,
+            starting_batch_size=starting_batch_size,
+            reduce_batch_size_fn=reduce_batch_size_fn,
+        )
+
+    reduce_fn = reduce_batch_size_fn or (lambda b: b // 2)
+    batch_size_box = [starting_batch_size]
+
+    @functools.wraps(function)
+    def decorator(*args, **kwargs):
+        batch_size_box[0] = starting_batch_size
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < 1 or params[0] != "batch_size":
+            arg_str = ", ".join(params)
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the "
+                f"first argument when called.\nRemove this as the decorator "
+                f"already does so: `{function.__name__}({arg_str})`"
+            )
+        while True:
+            if batch_size_box[0] == 0:
+                raise RuntimeError(
+                    "No executable batch size found, reached zero."
+                )
+            try:
+                return function(batch_size_box[0], *args, **kwargs)
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    clear_device_cache(garbage_collection=True)
+                    batch_size_box[0] = reduce_fn(batch_size_box[0])
+                else:
+                    raise
+
+    return decorator

@@ -1,0 +1,155 @@
+"""Serialization + model-unwrap helpers.
+
+Counterpart of ``/root/reference/src/accelerate/utils/other.py`` (352 LoC):
+``save``/``load`` with safetensors-or-pickle (other.py:62-170),
+``clean_state_dict_for_safetensors``, ``extract_model_from_parallel``
+(other.py:62-266), ``wait_for_everyone``, ``write_basic_config`` lives in
+commands/config.
+
+TPU-native notes: there are no DDP/FSDP wrapper modules to peel off —
+"parallel" is a sharding property of arrays, not a wrapper class — so
+``extract_model_from_parallel`` only unwraps the fp32-output forward wrapper
+and step-capture binding, mirroring the reference's `keep_fp32_wrapper`
+handling (other.py:77-107).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def is_main_process_gate() -> bool:
+    from ..state import PartialState
+
+    return PartialState().is_main_process
+
+
+def clean_state_dict_for_safetensors(state_dict: dict) -> dict:
+    """Deduplicate aliased tensors and force contiguous numpy buffers —
+    safetensors refuses shared/non-contiguous storage (reference
+    other.py:137-154)."""
+    seen: dict[int, str] = {}
+    out: dict[str, np.ndarray] = {}
+    for k, v in state_dict.items():
+        arr = np.ascontiguousarray(np.asarray(jax.device_get(v)))
+        ident = id(v)
+        if ident in seen:
+            arr = arr.copy()
+        seen[ident] = k
+        out[k] = arr
+    return out
+
+
+def save(obj: Any, f, save_on_each_node: bool = False, safe_serialization: bool = True) -> None:
+    """Save ``obj`` to file ``f`` — safetensors for flat tensor dicts,
+    pickle otherwise (reference other.py:62: `accelerator.save`). Gated to
+    the main process unless ``save_on_each_node``."""
+    if not save_on_each_node and not is_main_process_gate():
+        return
+    f = os.fspath(f)
+    os.makedirs(os.path.dirname(f) or ".", exist_ok=True)
+    tensor_dict = (
+        isinstance(obj, dict)
+        and len(obj) > 0
+        and all(isinstance(v, (jax.Array, np.ndarray)) for v in obj.values())
+    )
+    if safe_serialization and tensor_dict:
+        from safetensors.numpy import save_file
+
+        save_file(clean_state_dict_for_safetensors(obj), f)
+    else:
+        obj = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x,
+            obj,
+        )
+        with open(f, "wb") as fh:
+            pickle.dump(obj, fh)
+
+
+def load(f, map_location=None) -> Any:
+    """Load a file written by :func:`save` (reference other.py:155)."""
+    f = os.fspath(f)
+    if f.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(f)
+    with open(f, "rb") as fh:
+        head = fh.read(9)
+    # safetensors layout: u64 LE header length, then the JSON header ("{...")
+    if len(head) == 9 and head[8:9] == b"{":
+        from safetensors.numpy import load_file
+
+        try:
+            return load_file(f)
+        except Exception:
+            pass
+    with open(f, "rb") as fh:
+        return pickle.load(fh)
+
+
+def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True, recursive: bool = False):
+    """Return the underlying user model (reference other.py:62-107).
+
+    On TPU parallelism never wraps the module — sharding lives on the
+    arrays — so only the autocast fp32-output forward patch is removable.
+    """
+    if not keep_fp32_wrapper:
+        forward = getattr(model, "__wrapped_forward__", None)
+        if forward is not None:
+            model.forward = forward
+            try:
+                delattr(model, "__wrapped_forward__")
+            except AttributeError:
+                pass
+    return model
+
+
+def wait_for_everyone() -> None:
+    """Module-level barrier (reference other.py:58)."""
+    from ..state import PartialState
+
+    PartialState().wait_for_everyone()
+
+
+def convert_bytes(size: float) -> str:
+    """Human-readable byte size, e.g. 1253656678 → '1.17 GB'
+    (reference utils/modeling.py:42)."""
+    for unit in ["B", "KB", "MB", "GB", "TB", "PB"]:
+        if abs(size) < 1024.0:
+            return f"{size:.2f} {unit}"
+        size /= 1024.0
+    return f"{size:.2f} EB"
+
+
+def check_os_kernel() -> None:
+    """Warn on Linux kernels with known multiprocessing perf bugs
+    (reference other.py:299 warns on <5.5)."""
+    import platform
+    import warnings
+
+    if platform.system() != "Linux":
+        return
+    try:
+        release = platform.release().split("-")[0]
+        parts = release.split(".")
+        version = (int(parts[0]), int(parts[1]))
+    except (ValueError, IndexError):
+        return
+    if version < (5, 5):
+        warnings.warn(
+            f"Detected kernel version {release}, which is below the recommended "
+            "minimum of 5.5.0; this can cause the process to hang.",
+            stacklevel=2,
+        )
+
+
+def recursive_getattr(obj, attr: str):
+    """`recursive_getattr(model, "h.0.attn")` (reference other.py:339)."""
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj

@@ -1,0 +1,93 @@
+"""Seeding + cross-process RNG synchronization.
+
+Counterpart of ``/root/reference/src/accelerate/utils/random.py`` (156 LoC):
+``set_seed`` (random.py:39) seeds every RNG in the process;
+``synchronize_rng_states`` (random.py:154) makes all processes agree by
+broadcasting rank 0's state.
+
+TPU-native design: the framework RNG is a counter-based JAX PRNG key
+(``nn.random.GlobalRNG``), which is *deterministic given the seed* — so
+cross-process sync broadcasts the (seed, counter) pair, a few bytes, instead
+of a full Mersenne-Twister state vector. Python/NumPy/torch generators are
+synced the reference way for user-side data augmentation code.
+"""
+
+from __future__ import annotations
+
+import random as _py_random
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..nn import random as nn_random
+from .dataclasses import RNGType
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False) -> None:
+    """Seed python/numpy/framework (and torch when importable) RNGs.
+
+    ``device_specific``: offset the seed by process index so hosts draw
+    different streams (reference random.py:57-58). ``deterministic`` is a
+    no-op on TPU — XLA executables are deterministic by construction (no
+    cudnn benchmark autotuning nondeterminism to disable).
+    """
+    if device_specific:
+        from ..state import PartialState
+
+        seed += PartialState().process_index
+    _py_random.seed(seed)
+    np.random.seed(seed % (2**32))
+    nn_random.manual_seed(seed)
+    try:  # torch is optional; user datasets often use its generators
+        import torch
+
+        torch.manual_seed(seed)
+    except ImportError:
+        pass
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None) -> None:
+    """Broadcast rank-0's RNG state of one kind to all processes
+    (reference random.py:78)."""
+    from ..state import PartialState
+    from .operations import broadcast_object_list
+
+    state = PartialState()
+    if state.num_processes <= 1:
+        return
+    rng_type = RNGType(rng_type) if rng_type is not None else RNGType.GENERATOR
+
+    if rng_type == RNGType.GENERATOR and generator is not None:
+        payload = [generator.get_state() if hasattr(generator, "get_state") else None]
+        payload = broadcast_object_list(payload, from_process=0)
+        if payload[0] is not None and hasattr(generator, "set_state"):
+            generator.set_state(payload[0])
+        return
+
+    if rng_type in (RNGType.JAX, RNGType.GENERATOR):
+        payload = [nn_random.default_rng.get_state()]
+        payload = broadcast_object_list(payload, from_process=0)
+        nn_random.default_rng.set_state(payload[0])
+    elif rng_type == RNGType.NUMPY:
+        payload = [np.random.get_state()]
+        payload = broadcast_object_list(payload, from_process=0)
+        np.random.set_state(payload[0])
+    elif rng_type == RNGType.PYTHON:
+        payload = [_py_random.getstate()]
+        payload = broadcast_object_list(payload, from_process=0)
+        _py_random.setstate(payload[0])
+    elif rng_type == RNGType.TORCH:
+        try:
+            import torch
+
+            payload = [torch.get_rng_state().numpy()]
+            payload = broadcast_object_list(payload, from_process=0)
+            torch.set_rng_state(torch.from_numpy(np.asarray(payload[0])))
+        except ImportError:
+            pass
+
+
+def synchronize_rng_states(rng_types: Iterable, generator=None) -> None:
+    """Reference random.py:154 — sync a list of RNG kinds each epoch."""
+    for rng_type in rng_types:
+        synchronize_rng_state(rng_type=rng_type, generator=generator)

@@ -1,0 +1,10 @@
+"""Rich traceback install (reference /root/reference/src/accelerate/utils/rich.py)."""
+
+from .imports import is_rich_available
+
+
+def install_rich_tracebacks() -> None:
+    if is_rich_available():
+        from rich.traceback import install
+
+        install(show_locals=False)

@@ -1,0 +1,56 @@
+"""Rank-gated tqdm (reference /root/reference/src/accelerate/utils/tqdm.py):
+only the main (or local-main) process renders a bar; other ranks get a
+transparent pass-through iterator."""
+
+from __future__ import annotations
+
+
+class _PassthroughTqdm:
+    """Iterator wrapper exposing the tqdm surface as no-ops."""
+
+    def __init__(self, iterable=None, **kwargs):
+        self.iterable = iterable
+
+    def __iter__(self):
+        return iter(self.iterable if self.iterable is not None else ())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def update(self, n: int = 1):
+        pass
+
+    def set_description(self, *a, **k):
+        pass
+
+    def set_postfix(self, *a, **k):
+        pass
+
+    def write(self, *a, **k):
+        pass
+
+    def close(self):
+        pass
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """Drop-in ``tqdm`` that renders only on the main process.
+
+    Matches the reference signature (utils/tqdm.py:23): first positional arg
+    may be the iterable, or legacy ``tqdm(main_process_only, iterable)``.
+    """
+    from ..state import PartialState
+
+    if args and isinstance(args[0], bool):  # legacy positional form
+        main_process_only, *args = args
+    should_render = PartialState().is_main_process or not main_process_only
+    if not should_render:
+        return _PassthroughTqdm(args[0] if args else kwargs.get("iterable"))
+    try:
+        from tqdm.auto import tqdm as _tqdm
+    except ImportError:
+        return _PassthroughTqdm(args[0] if args else kwargs.get("iterable"))
+    return _tqdm(*args, **kwargs)

@@ -1,0 +1,143 @@
+"""Tests for memory / random / serialization utils (reference
+tests/test_memory_utils.py + tests/test_utils.py patterns)."""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils import (
+    clean_state_dict_for_safetensors,
+    convert_bytes,
+    find_executable_batch_size,
+    load,
+    release_memory,
+    save,
+    set_seed,
+    should_reduce_batch_size,
+    synchronize_rng_states,
+)
+from accelerate_tpu.utils.dataclasses import RNGType
+
+
+class TestFindExecutableBatchSize:
+    def test_shrinks_on_oom(self):
+        sizes = []
+
+        @find_executable_batch_size(starting_batch_size=128)
+        def fn(batch_size):
+            sizes.append(batch_size)
+            if batch_size > 16:
+                raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory on TPU")
+            return batch_size
+
+        assert fn() == 16
+        assert sizes == [128, 64, 32, 16]
+
+    def test_non_oom_propagates(self):
+        @find_executable_batch_size(starting_batch_size=8)
+        def fn(batch_size):
+            raise ValueError("unrelated")
+
+        with pytest.raises(ValueError, match="unrelated"):
+            fn()
+
+    def test_zero_raises(self):
+        @find_executable_batch_size(starting_batch_size=2)
+        def fn(batch_size):
+            raise MemoryError("oom")
+
+        with pytest.raises(RuntimeError, match="No executable batch size"):
+            fn()
+
+    def test_signature_enforced(self):
+        @find_executable_batch_size(starting_batch_size=4)
+        def fn(foo):
+            return foo
+
+        with pytest.raises(TypeError, match="first argument"):
+            fn()
+
+    def test_extra_args_forwarded(self):
+        @find_executable_batch_size(starting_batch_size=4)
+        def fn(batch_size, a, b=1):
+            return batch_size + a + b
+
+        assert fn(10, b=2) == 16
+
+
+def test_should_reduce_batch_size():
+    assert should_reduce_batch_size(RuntimeError("RESOURCE_EXHAUSTED: ..."))
+    assert should_reduce_batch_size(MemoryError())
+    assert not should_reduce_batch_size(ValueError("nope"))
+
+
+def test_release_memory():
+    a, b = jnp.ones(4), jnp.ones(4)
+    a, b = release_memory(a, b)
+    assert a is None and b is None
+
+
+class TestSetSeed:
+    def test_reproducible(self):
+        import accelerate_tpu.nn as nn
+
+        set_seed(42)
+        k1 = nn.random.next_key()
+        n1 = np.random.rand(3)
+        set_seed(42)
+        k2 = nn.random.next_key()
+        n2 = np.random.rand(3)
+        assert jnp.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+        np.testing.assert_array_equal(n1, n2)
+
+    def test_sync_noop_single_host(self):
+        # single process: must be a no-op, not a hang
+        synchronize_rng_states([RNGType.JAX, RNGType.NUMPY, RNGType.PYTHON])
+
+
+class TestSaveLoad:
+    def test_tensor_dict_safetensors(self, tmp_path):
+        sd = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.zeros(3)}
+        f = os.path.join(tmp_path, "model.safetensors")
+        save(sd, f)
+        out = load(f)
+        np.testing.assert_array_equal(out["w"], np.asarray(sd["w"]))
+
+    def test_safetensors_content_sniff_without_extension(self, tmp_path):
+        # save() picks safetensors by content; load() must sniff it even
+        # when the path lacks the .safetensors extension
+        sd = {"w": jnp.ones((2, 2))}
+        f = os.path.join(tmp_path, "ckpt.bin")
+        save(sd, f)
+        out = load(f)
+        np.testing.assert_array_equal(out["w"], np.ones((2, 2)))
+
+    def test_pickle_fallback(self, tmp_path):
+        obj = {"step": 3, "arr": jnp.ones(2), "name": "x"}
+        f = os.path.join(tmp_path, "state.bin")
+        save(obj, f, safe_serialization=False)
+        out = load(f)
+        assert out["step"] == 3 and out["name"] == "x"
+        np.testing.assert_array_equal(out["arr"], np.ones(2))
+
+    def test_clean_state_dict_dedups_aliases(self):
+        w = jnp.ones((2, 2))
+        cleaned = clean_state_dict_for_safetensors({"a": w, "b": w})
+        assert cleaned["a"] is not cleaned["b"]
+        for v in cleaned.values():
+            assert v.flags["C_CONTIGUOUS"]
+
+
+def test_convert_bytes():
+    assert convert_bytes(1024) == "1.00 KB"
+    assert convert_bytes(1253656678) == "1.17 GB"
+
+
+def test_tqdm_passthrough():
+    from accelerate_tpu.utils import tqdm
+
+    assert list(tqdm(range(5))) == list(range(5))
