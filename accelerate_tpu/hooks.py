@@ -1,0 +1,488 @@
+"""Forward-hook engine for layer-streaming execution.
+
+Capability parity with the reference's ``hooks.py`` (``ModelHook`` :43,
+``add_hook_to_module`` :130, ``AlignDevicesHook`` :226,
+``attach_align_device_hook_on_blocks`` :557, ``CpuOffload`` :691), rebuilt on
+this framework's own Module system: hooking is an instance-attribute swap of
+``forward`` (our ``Module.__call__`` dispatches through ``self.forward``, so
+no class surgery is needed).
+
+TPU framing: on a slice where the model fits, prefer GSPMD sharded inference
+(``big_modeling.shard_for_inference``) — XLA pipelines the collectives and
+every chip computes. Hooks are the *overflow* path: weights parked in host
+RAM (JAX CPU backend) or disk memmaps stream into HBM one block at a time,
+compute happens on-chip eagerly, and the block's HBM is released when the
+post-forward drops the reference. That is the same "naive pipeline" the
+reference ships for models bigger than device memory.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .nn.meta import MetaArray, is_meta
+from .nn.module import Module
+from .nn.tape import Tensor
+from .utils.modeling import (
+    _resolve_device,
+    named_module_tensors,
+    set_module_tensor_to_device,
+)
+from .utils.offload import PrefixedDataset
+
+
+class ModelHook:
+    """Pre/post-forward protocol (reference: hooks.py:43)."""
+
+    no_grad = False
+
+    def init_hook(self, module: Module) -> Module:
+        return module
+
+    def pre_forward(self, module: Module, *args, **kwargs):
+        return args, kwargs
+
+    def post_forward(self, module: Module, output):
+        return output
+
+    def detach_hook(self, module: Module) -> Module:
+        return module
+
+
+class SequentialHook(ModelHook):
+    """Compose several hooks in order (reference: hooks.py:100)."""
+
+    def __init__(self, *hooks: ModelHook):
+        self.hooks = hooks
+
+    def init_hook(self, module):
+        for hook in self.hooks:
+            module = hook.init_hook(module)
+        return module
+
+    def pre_forward(self, module, *args, **kwargs):
+        for hook in self.hooks:
+            args, kwargs = hook.pre_forward(module, *args, **kwargs)
+        return args, kwargs
+
+    def post_forward(self, module, output):
+        for hook in self.hooks:
+            output = hook.post_forward(module, output)
+        return output
+
+    def detach_hook(self, module):
+        for hook in self.hooks:
+            module = hook.detach_hook(module)
+        return module
+
+
+def add_hook_to_module(module: Module, hook: ModelHook, append: bool = False) -> Module:
+    """Wrap ``module.forward`` with the hook (reference: hooks.py:130)."""
+    if append and getattr(module, "_atpu_hook", None) is not None:
+        old = module._atpu_hook
+        remove_hook_from_module(module)
+        hook = SequentialHook(old, hook)
+
+    if getattr(module, "_old_forward", None) is None:
+        object.__setattr__(module, "_old_forward", module.forward)
+    old_forward = module._old_forward
+    object.__setattr__(module, "_atpu_hook", hook)
+    module = hook.init_hook(module)
+
+    def new_forward(*args, **kwargs):
+        args, kwargs = module._atpu_hook.pre_forward(module, *args, **kwargs)
+        if module._atpu_hook.no_grad:
+            from .nn.tape import no_grad as _ng
+
+            with _ng():
+                output = old_forward(*args, **kwargs)
+        else:
+            output = old_forward(*args, **kwargs)
+        return module._atpu_hook.post_forward(module, output)
+
+    object.__setattr__(module, "forward", new_forward)
+    return module
+
+
+def remove_hook_from_module(module: Module, recurse: bool = False) -> Module:
+    if getattr(module, "_atpu_hook", None) is not None:
+        module._atpu_hook.detach_hook(module)
+        object.__setattr__(module, "_atpu_hook", None)
+    if getattr(module, "_old_forward", None) is not None:
+        object.__setattr__(module, "forward", module._old_forward)
+        object.__setattr__(module, "_old_forward", None)
+    if recurse:
+        for child in module.children():
+            remove_hook_from_module(child, recurse=True)
+    return module
+
+
+def remove_hook_from_submodules(module: Module) -> None:
+    remove_hook_from_module(module, recurse=True)
+
+
+# ---------------------------------------------------------------------------
+# device movement helpers
+# ---------------------------------------------------------------------------
+
+def _move_leaf(x, device):
+    if isinstance(x, Tensor):
+        if is_meta(x.data):
+            return x
+        return Tensor(jax.device_put(x.data, device), requires_grad=x.requires_grad)
+    if isinstance(x, (jax.Array, np.ndarray)):
+        return jax.device_put(jnp.asarray(x), device)
+    return x
+
+
+def send_to_device(obj, device):
+    """Recursive device move over tuples/lists/dicts/Tensors/arrays."""
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(send_to_device(o, device) for o in obj)
+    if isinstance(obj, dict):
+        return {k: send_to_device(v, device) for k, v in obj.items()}
+    return _move_leaf(obj, device)
+
+
+def _first_device(obj):
+    if isinstance(obj, (list, tuple)):
+        for o in obj:
+            d = _first_device(o)
+            if d is not None:
+                return d
+        return None
+    if isinstance(obj, dict):
+        for v in obj.values():
+            d = _first_device(v)
+            if d is not None:
+                return d
+        return None
+    if isinstance(obj, Tensor) and isinstance(obj.data, jax.Array):
+        return list(obj.data.devices())[0]
+    if isinstance(obj, jax.Array):
+        return list(obj.devices())[0]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# AlignDevicesHook
+# ---------------------------------------------------------------------------
+
+class AlignDevicesHook(ModelHook):
+    """Materialise a module's weights on its execution device around forward
+    (reference: hooks.py:226).
+
+    offload=False: weights are moved once at init and stay.
+    offload=True: weights live in ``weights_map`` (host arrays or disk
+    memmaps); pre_forward streams them to the chip, post_forward resets them
+    to meta so HBM frees as soon as XLA drops the last reference.
+    """
+
+    def __init__(
+        self,
+        execution_device=None,
+        offload: bool = False,
+        io_same_device: bool = False,
+        weights_map: Optional[Mapping] = None,
+        offload_buffers: bool = False,
+        place_submodules: bool = False,
+        tied_params_map: Optional[dict] = None,
+    ):
+        self.execution_device = execution_device
+        self.offload = offload
+        self.io_same_device = io_same_device
+        self.weights_map = weights_map
+        self.offload_buffers = offload_buffers
+        self.place_submodules = place_submodules
+        self.tied_params_map = tied_params_map if tied_params_map is not None else {}
+        self.input_device = None
+        self.tied_pointers_to_remove: set = set()
+
+    def __repr__(self):
+        return (
+            f"AlignDevicesHook(execution_device={self.execution_device}, "
+            f"offload={self.offload}, io_same_device={self.io_same_device}, "
+            f"offload_buffers={self.offload_buffers}, "
+            f"place_submodules={self.place_submodules})"
+        )
+
+    def _tensors(self, module):
+        yield from named_module_tensors(
+            module, include_buffers=self.offload_buffers or not self.offload,
+            recurse=self.place_submodules,
+        )
+
+    def init_hook(self, module):
+        if not self.offload and self.execution_device is not None:
+            device = _resolve_device(self.execution_device)
+            for name, _ in named_module_tensors(module, recurse=self.place_submodules):
+                set_module_tensor_to_device(module, name, device)
+        elif self.offload:
+            for name, t in self._tensors(module):
+                if id(t) in self.tied_params_map and self.tied_params_map[id(t)] is None:
+                    continue  # tied twin stays resident on its own chip
+                if not is_meta(t.data):
+                    t.data = MetaArray(t.shape, t.dtype)
+        return module
+
+    def pre_forward(self, module, *args, **kwargs):
+        if self.execution_device is None:
+            return args, kwargs
+        device = _resolve_device(self.execution_device)
+        if self.io_same_device:
+            self.input_device = _first_device((args, kwargs))
+        if self.offload:
+            for name, t in self._tensors(module):
+                if self.weights_map is None or name not in self.weights_map:
+                    continue
+                value = self.weights_map[name]
+                # tied weights: reuse the already-on-chip copy (None = the
+                # twin is permanently resident, leave t.data alone)
+                key = id(t)
+                if key in self.tied_params_map:
+                    mapped = self.tied_params_map[key]
+                    if mapped is None:
+                        continue
+                    if not is_meta(mapped):
+                        t.data = mapped
+                        continue
+                if isinstance(value, jax.Array):
+                    arr = jax.device_put(value, device)  # host→HBM DMA
+                else:
+                    arr = jax.device_put(jnp.asarray(np.asarray(value)), device)
+                t.data = arr
+                self.tied_params_map[key] = arr
+                self.tied_pointers_to_remove.add(key)
+        return send_to_device(args, device), send_to_device(kwargs, device)
+
+    def post_forward(self, module, output):
+        if self.offload:
+            for name, t in self._tensors(module):
+                if self.weights_map is not None and name in self.weights_map:
+                    if (
+                        id(t) in self.tied_params_map
+                        and id(t) not in self.tied_pointers_to_remove
+                    ):
+                        continue  # resident tied twin: never park
+                    t.data = MetaArray(t.shape, t.dtype)
+            for key in self.tied_pointers_to_remove:
+                self.tied_params_map.pop(key, None)
+            self.tied_pointers_to_remove = set()
+        if self.io_same_device and self.input_device is not None:
+            output = send_to_device(output, self.input_device)
+        return output
+
+    def detach_hook(self, module):
+        if self.offload and self.weights_map is not None:
+            cpu = _resolve_device("cpu")
+            for name, t in self._tensors(module):
+                if name in self.weights_map:
+                    t.data = jax.device_put(
+                        jnp.asarray(np.asarray(self.weights_map[name])), cpu
+                    )
+        return module
+
+
+# ---------------------------------------------------------------------------
+# attachment strategies
+# ---------------------------------------------------------------------------
+
+def attach_execution_device_hook(
+    module: Module,
+    execution_device,
+    skip_keys=None,
+    preload_module_classes: Optional[list] = None,
+    tied_params_map: Optional[dict] = None,
+    _name: str = "",
+) -> None:
+    """Every submodule with direct tensors gets an exec-device hook
+    (reference: hooks.py:448)."""
+    if getattr(module, "_atpu_hook", None) is None and (
+        module._parameters or module._buffers
+    ):
+        add_hook_to_module(
+            module,
+            AlignDevicesHook(execution_device, tied_params_map=tied_params_map),
+        )
+    if preload_module_classes and type(module).__name__ in preload_module_classes:
+        return
+    for cname, child in module._modules.items():
+        attach_execution_device_hook(
+            child, execution_device, skip_keys, preload_module_classes,
+            tied_params_map, f"{_name}.{cname}" if _name else cname,
+        )
+
+
+def attach_align_device_hook(
+    module: Module,
+    execution_device=None,
+    offload: bool = False,
+    weights_map: Optional[Mapping] = None,
+    offload_buffers: bool = False,
+    module_name: str = "",
+    skip_keys=None,
+    preload_module_classes: Optional[list] = None,
+    tied_params_map: Optional[dict] = None,
+) -> None:
+    """Hook every submodule that has direct weights (reference: hooks.py:478)."""
+    directs = list(named_module_tensors(module, include_buffers=offload_buffers))
+    full_offload = (
+        offload
+        and preload_module_classes is not None
+        and type(module).__name__ in preload_module_classes
+    )
+    if (directs or full_offload) and execution_device is not None:
+        prefixed = (
+            PrefixedDataset(weights_map, f"{module_name}." if module_name else "")
+            if weights_map is not None
+            else None
+        )
+        hook = AlignDevicesHook(
+            execution_device=execution_device,
+            offload=offload,
+            weights_map=prefixed,
+            offload_buffers=offload_buffers,
+            place_submodules=full_offload,
+            tied_params_map=tied_params_map,
+        )
+        add_hook_to_module(module, hook, append=True)
+    if full_offload:
+        return
+    for cname, child in module._modules.items():
+        child_name = f"{module_name}.{cname}" if module_name else cname
+        attach_align_device_hook(
+            child, execution_device, offload, weights_map, offload_buffers,
+            child_name, skip_keys, preload_module_classes, tied_params_map,
+        )
+
+
+def attach_align_device_hook_on_blocks(
+    module: Module,
+    execution_device=None,
+    offload=None,
+    weights_map: Optional[Mapping] = None,
+    offload_buffers: bool = False,
+    module_name: str = "",
+    skip_keys=None,
+    preload_module_classes: Optional[list] = None,
+    tied_params_map: Optional[dict] = None,
+) -> None:
+    """Per-block placement from a device_map (reference: hooks.py:557).
+
+    ``execution_device``/``offload`` are either scalars or {module_name: ...}
+    dicts keyed like a device_map.
+    """
+    if not isinstance(execution_device, Mapping) and not isinstance(offload, dict):
+        if not offload:
+            hook = AlignDevicesHook(
+                execution_device=execution_device,
+                io_same_device=True,
+                place_submodules=True,
+                tied_params_map=tied_params_map,
+            )
+            add_hook_to_module(module, hook)
+        else:
+            attach_align_device_hook(
+                module, execution_device, offload=True, weights_map=weights_map,
+                offload_buffers=offload_buffers, module_name=module_name,
+                tied_params_map=tied_params_map,
+            )
+        return
+
+    if not isinstance(execution_device, Mapping):
+        execution_device = {key: execution_device for key in offload}
+    if not isinstance(offload, Mapping):
+        offload = {key: offload for key in execution_device}
+
+    if module_name in execution_device and module_name in offload and not offload[module_name]:
+        hook = AlignDevicesHook(
+            execution_device=execution_device[module_name],
+            offload_buffers=offload_buffers,
+            io_same_device=(module_name == ""),
+            place_submodules=True,
+            tied_params_map=tied_params_map,
+        )
+        add_hook_to_module(module, hook)
+        attach_execution_device_hook(
+            module, execution_device[module_name],
+            preload_module_classes=preload_module_classes,
+            tied_params_map=tied_params_map,
+        )
+    elif module_name in execution_device and module_name in offload:
+        attach_align_device_hook(
+            module, execution_device[module_name], offload=True,
+            weights_map=weights_map, offload_buffers=offload_buffers,
+            module_name=module_name, skip_keys=skip_keys,
+            preload_module_classes=preload_module_classes,
+            tied_params_map=tied_params_map,
+        )
+        if getattr(module, "_atpu_hook", None) is None:
+            hook = AlignDevicesHook(
+                execution_device=execution_device[module_name],
+                io_same_device=(module_name == ""),
+                tied_params_map=tied_params_map,
+            )
+            add_hook_to_module(module, hook)
+        attach_execution_device_hook(
+            module, execution_device[module_name],
+            preload_module_classes=preload_module_classes,
+            tied_params_map=tied_params_map,
+        )
+    elif module_name == "":
+        hook = AlignDevicesHook(
+            execution_device=execution_device.get(""),
+            io_same_device=True,
+            tied_params_map=tied_params_map,
+        )
+        add_hook_to_module(module, hook)
+
+    for cname, child in module._modules.items():
+        child_name = f"{module_name}.{cname}" if module_name else cname
+        attach_align_device_hook_on_blocks(
+            child, execution_device, offload, weights_map, offload_buffers,
+            child_name, skip_keys, preload_module_classes, tied_params_map,
+        )
+
+
+# ---------------------------------------------------------------------------
+# CPU offload hooks (sequential pipelines, e.g. diffusion UNet/VAE swapping)
+# ---------------------------------------------------------------------------
+
+class CpuOffload(ModelHook):
+    """Keep the model on host; move to chip at forward, optionally kicking the
+    previous model back to host first (reference: hooks.py:691)."""
+
+    def __init__(self, execution_device=None, prev_module_hook: Optional["UserCpuOffloadHook"] = None):
+        self.execution_device = (
+            execution_device if execution_device is not None else 0
+        )
+        self.prev_module_hook = prev_module_hook
+
+    def init_hook(self, module):
+        return module.to(_resolve_device("cpu"))
+
+    def pre_forward(self, module, *args, **kwargs):
+        if self.prev_module_hook is not None:
+            self.prev_module_hook.offload()
+        device = _resolve_device(self.execution_device)
+        module.to(device)
+        return send_to_device(args, device), send_to_device(kwargs, device)
+
+
+class UserCpuOffloadHook:
+    """User-facing handle pairing a model and its CpuOffload hook
+    (reference: hooks.py:726)."""
+
+    def __init__(self, model: Module, hook: CpuOffload):
+        self.model = model
+        self.hook = hook
+
+    def offload(self):
+        self.hook.init_hook(self.model)
+
+    def remove(self):
+        remove_hook_from_module(self.model)

@@ -50,7 +50,11 @@ def _bert_init(model: nn.Module, initializer_range: float = 0.02) -> None:
 
     from ..nn import random as nn_random
 
+    from ..nn.meta import is_meta
+
     for name, p in model.named_parameters():
+        if is_meta(p.data):
+            continue  # init_empty_weights: nothing to initialise
         if name.endswith("bias"):
             p.data = jnp.zeros_like(p.data)
         elif p.ndim >= 2:
@@ -125,6 +129,7 @@ class BertLayer(nn.Module):
 
 
 class BertModel(nn.Module):
+    _no_split_modules = ["BertLayer", "BertEmbeddings"]
     # tensor-parallel plan: attention projections split on output features,
     # FFN split on the intermediate axis
     tp_plan = {
@@ -159,6 +164,7 @@ class BertModel(nn.Module):
 
 
 class BertForSequenceClassification(nn.Module):
+    _no_split_modules = BertModel._no_split_modules
     tp_plan = BertModel.tp_plan
 
     def __init__(self, config: BertConfig):

@@ -48,7 +48,11 @@ def _gpt2_init(model: nn.Module, config: GPTConfig) -> None:
 
     scale = 0.02
     resid_scale = scale / math.sqrt(2 * config.n_layer)
+    from ..nn.meta import is_meta
+
     for name, p in model.named_parameters():
+        if is_meta(p.data):
+            continue  # init_empty_weights: nothing to initialise
         if name.endswith(".bias") or ".ln" in name or "ln_" in name:
             if p.ndim == 1 and name.endswith("weight"):
                 continue  # LN weight stays ones
@@ -106,6 +110,7 @@ class Block(nn.Module):
 
 
 class GPTLMHeadModel(nn.Module):
+    _no_split_modules = ["Block"]  # device_map units must keep residual adds intact
     tp_plan = {
         r".*\.c_attn\.weight": ("tp", None),
         r".*\.c_attn\.bias": ("tp",),
@@ -123,9 +128,15 @@ class GPTLMHeadModel(nn.Module):
         self.drop = nn.Dropout(config.dropout)
         self.h = nn.ModuleList([Block(config) for _ in range(config.n_layer)])
         self.ln_f = nn.LayerNorm(config.n_embd, eps=config.layer_norm_eps)
-        # LM head weight-tied to wte (reference find_tied_parameters semantics,
-        # utils/modeling.py:559 — ties survive state_dict round trips here by
-        # construction since the head reuses wte.weight directly)
+        # LM head weight-tied to wte by Parameter-object sharing (reference
+        # find_tied_parameters semantics, utils/modeling.py:559); a real
+        # module (not an inline matmul) so device_map hooks cover it; built
+        # under meta so the discarded weight never allocates or consumes RNG
+        from ..nn.meta import meta_init
+
+        with meta_init():
+            self.lm_head = nn.Linear(config.n_embd, config.vocab_size, bias=False)
+        self.lm_head.weight = self.wte.weight
         _gpt2_init(self, config)
 
     def forward(self, input_ids, labels=None):
@@ -136,7 +147,7 @@ class GPTLMHeadModel(nn.Module):
         for block in self.h:
             x = block(x)
         x = self.ln_f(x)
-        logits = F.linear(x, self.wte.weight)  # tied head: x @ wte^T
+        logits = self.lm_head(x)  # tied head: x @ wte^T
         if labels is not None:
             lab = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
             shift_logits = logits[:, :-1, :].reshape(-1, self.config.vocab_size)

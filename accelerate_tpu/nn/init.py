@@ -1,0 +1,63 @@
+"""Parameter initialisers, meta-mode aware.
+
+All layer/model weight creation routes through these helpers so that inside
+``init_empty_weights`` (big_modeling) nothing is allocated and no RNG is
+consumed: each call returns a :class:`~accelerate_tpu.nn.meta.MetaArray`
+instead of running the initializer. Outside meta mode they are thin wrappers
+over ``jax.random`` / ``jnp`` with torch-default semantics (kaiming-uniform
+Linear bounds are computed by the callers).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import random as nn_random
+from .meta import MetaArray, meta_mode_active
+
+
+def _meta_or(fn, shape, dtype):
+    from .meta import meta_include_buffers
+
+    # include_buffers=False mode computes for real: Buffers must keep their
+    # true values (position ids, rotary caches); Parameter.__init__ converts
+    # its (transient) array back to meta in that mode.
+    if meta_mode_active() and meta_include_buffers():
+        return MetaArray(shape, dtype)
+    return fn()
+
+
+def uniform(shape, bound: float, dtype=jnp.float32):
+    """U(-bound, bound) — torch Linear/Conv default (kaiming-uniform)."""
+    return _meta_or(
+        lambda: jax.random.uniform(
+            nn_random.next_key(), shape, minval=-bound, maxval=bound, dtype=dtype
+        ),
+        shape,
+        dtype,
+    )
+
+
+def normal(shape, std: float = 1.0, mean: float = 0.0, dtype=jnp.float32):
+    return _meta_or(
+        lambda: mean + std * jax.random.normal(nn_random.next_key(), shape, dtype),
+        shape,
+        dtype,
+    )
+
+
+def zeros(shape, dtype=jnp.float32):
+    return _meta_or(lambda: jnp.zeros(shape, dtype), shape, dtype)
+
+
+def ones(shape, dtype=jnp.float32):
+    return _meta_or(lambda: jnp.ones(shape, dtype), shape, dtype)
+
+
+def full(shape, fill_value, dtype=jnp.float32):
+    return _meta_or(lambda: jnp.full(shape, fill_value, dtype), shape, dtype)
+
+
+def arange(n: int, dtype=jnp.int32):
+    return _meta_or(lambda: jnp.arange(n, dtype=dtype), (n,), dtype)

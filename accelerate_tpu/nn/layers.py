@@ -14,13 +14,9 @@ import jax
 import jax.numpy as jnp
 
 from . import functional as F
-from . import random as nn_random
+from . import init
 from .module import Buffer, Module, Parameter
 from .tape import Tensor
-
-
-def _uniform(key, shape, bound, dtype=jnp.float32):
-    return jax.random.uniform(key, shape, minval=-bound, maxval=bound, dtype=dtype)
 
 
 class Linear(Module):
@@ -29,13 +25,9 @@ class Linear(Module):
         self.in_features = in_features
         self.out_features = out_features
         bound = 1.0 / math.sqrt(in_features)
-        self.weight = Parameter(
-            _uniform(nn_random.next_key(), (out_features, in_features), bound, dtype)
-        )
+        self.weight = Parameter(init.uniform((out_features, in_features), bound, dtype))
         if bias:
-            self.bias = Parameter(
-                _uniform(nn_random.next_key(), (out_features,), bound, dtype)
-            )
+            self.bias = Parameter(init.uniform((out_features,), bound, dtype))
         else:
             self.register_parameter("bias", None)
 
@@ -51,11 +43,7 @@ class Embedding(Module):
         super().__init__()
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
-        self.weight = Parameter(
-            jax.random.normal(
-                nn_random.next_key(), (num_embeddings, embedding_dim), dtype
-            )
-        )
+        self.weight = Parameter(init.normal((num_embeddings, embedding_dim), dtype=dtype))
 
     def forward(self, ids):
         return F.embedding(ids, self.weight)
@@ -72,8 +60,8 @@ class LayerNorm(Module):
         self.normalized_shape = tuple(normalized_shape)
         self.eps = eps
         if elementwise_affine:
-            self.weight = Parameter(jnp.ones(self.normalized_shape, dtype))
-            self.bias = Parameter(jnp.zeros(self.normalized_shape, dtype))
+            self.weight = Parameter(init.ones(self.normalized_shape, dtype))
+            self.bias = Parameter(init.zeros(self.normalized_shape, dtype))
         else:
             self.register_parameter("weight", None)
             self.register_parameter("bias", None)
@@ -86,7 +74,7 @@ class RMSNorm(Module):
     def __init__(self, dim: int, eps: float = 1e-6, dtype=jnp.float32):
         super().__init__()
         self.eps = eps
-        self.weight = Parameter(jnp.ones((dim,), dtype))
+        self.weight = Parameter(init.ones((dim,), dtype))
 
     def forward(self, x):
         return F.rms_norm(x, self.weight, self.eps)
@@ -171,17 +159,10 @@ class Conv2d(Module):
         fan_in = in_channels * kernel_size[0] * kernel_size[1]
         bound = 1.0 / math.sqrt(fan_in)
         self.weight = Parameter(
-            _uniform(
-                nn_random.next_key(),
-                (out_channels, in_channels, *kernel_size),
-                bound,
-                dtype,
-            )
+            init.uniform((out_channels, in_channels, *kernel_size), bound, dtype)
         )
         if bias:
-            self.bias = Parameter(
-                _uniform(nn_random.next_key(), (out_channels,), bound, dtype)
-            )
+            self.bias = Parameter(init.uniform((out_channels,), bound, dtype))
         else:
             self.register_parameter("bias", None)
 

@@ -1,0 +1,93 @@
+"""Meta ("empty") tensors — zero-memory model instantiation.
+
+Capability parity with the reference's ``init_empty_weights`` /
+``init_on_device`` (reference: big_modeling.py:58,94), rebuilt for JAX: the
+reference re-targets torch's meta device; here a :class:`MetaArray` carries
+only (shape, dtype) — the shape/dtype algebra that sizing and placement
+planners need — and materialisation happens later via checkpoint loading or
+explicit init, placed straight onto its final TPU/host device so peak host
+memory never sees the full model.
+
+Creation helpers in :mod:`accelerate_tpu.nn.init` consult the thread-local
+meta mode set up here, so ``with init_empty_weights(): model = GPT(cfg)``
+allocates nothing and runs no RNG.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class MetaArray:
+    """Shape+dtype stand-in for an unmaterialised array (torch meta tensor)."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype=jnp.float32):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = jnp.dtype(dtype)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * self.dtype.itemsize
+
+    def astype(self, dtype) -> "MetaArray":
+        return MetaArray(self.shape, dtype)
+
+    def __repr__(self):
+        return f"MetaArray(shape={self.shape}, dtype={self.dtype})"
+
+
+def is_meta(x) -> bool:
+    return isinstance(x, MetaArray)
+
+
+class _MetaState(threading.local):
+    def __init__(self):
+        self.active: bool = False
+        self.include_buffers: bool = True
+
+
+_meta_state = _MetaState()
+
+
+def meta_mode_active() -> bool:
+    return _meta_state.active
+
+
+def meta_include_buffers() -> bool:
+    return _meta_state.include_buffers
+
+
+class meta_init:
+    """Context manager: array creation through ``nn.init`` yields MetaArrays.
+
+    ``include_buffers=False`` materialises buffers (rotary caches, position
+    ids) for real while parameters stay meta — matching the reference's
+    ``init_empty_weights(include_buffers=False)`` behavior.
+    """
+
+    def __init__(self, include_buffers: bool = True):
+        self.include_buffers = include_buffers
+
+    def __enter__(self):
+        self._prev = (_meta_state.active, _meta_state.include_buffers)
+        _meta_state.active = True
+        _meta_state.include_buffers = self.include_buffers
+        return self
+
+    def __exit__(self, *exc):
+        _meta_state.active, _meta_state.include_buffers = self._prev
+        return False

@@ -28,7 +28,13 @@ class Parameter(Tensor):
     """A Tensor registered as a learnable leaf of a Module."""
 
     def __init__(self, data, requires_grad: bool = True):
+        from .meta import MetaArray, is_meta, meta_mode_active
+
         super().__init__(data, requires_grad=requires_grad)
+        # init_empty_weights(include_buffers=False): initializers ran for real
+        # (buffers need true values); params still come out meta
+        if meta_mode_active() and not is_meta(self.data):
+            self.data = MetaArray(self.data.shape, self.data.dtype)
 
     def __repr__(self):
         return f"Parameter(shape={tuple(self.shape)}, dtype={self.dtype})"
@@ -96,18 +102,35 @@ class Module:
     def named_children(self) -> Iterator[tuple[str, "Module"]]:
         yield from self._modules.items()
 
-    def named_parameters(self, prefix: str = "") -> Iterator[tuple[str, Parameter]]:
+    def named_parameters(
+        self, prefix: str = "", remove_duplicate: bool = True
+    ) -> Iterator[tuple[str, Parameter]]:
+        """Tied parameters (one object, several paths) are yielded once by
+        default (torch semantics) — critical under step capture: duplicate
+        pytree entries would split the tied gradient across two leaves."""
+        seen: set[int] = set()
         for mod_name, module in self.named_modules(prefix):
             for name, param in module._parameters.items():
+                if remove_duplicate:
+                    if id(param) in seen:
+                        continue
+                    seen.add(id(param))
                 yield (f"{mod_name}.{name}" if mod_name else name), param
 
     def parameters(self) -> Iterator[Parameter]:
         for _, p in self.named_parameters():
             yield p
 
-    def named_buffers(self, prefix: str = "") -> Iterator[tuple[str, Buffer]]:
+    def named_buffers(
+        self, prefix: str = "", remove_duplicate: bool = True
+    ) -> Iterator[tuple[str, Buffer]]:
+        seen: set[int] = set()
         for mod_name, module in self.named_modules(prefix):
             for name, buf in module._buffers.items():
+                if remove_duplicate:
+                    if id(buf) in seen:
+                        continue
+                    seen.add(id(buf))
                 yield (f"{mod_name}.{name}" if mod_name else name), buf
 
     def buffers(self) -> Iterator[Buffer]:
@@ -116,16 +139,17 @@ class Module:
 
     # -- state dict ---------------------------------------------------------
     def state_dict(self) -> "OrderedDict[str, jax.Array]":
+        # tied weights appear under every name (torch state_dict semantics)
         out: OrderedDict[str, jax.Array] = OrderedDict()
-        for name, p in self.named_parameters():
+        for name, p in self.named_parameters(remove_duplicate=False):
             out[name] = p.data
-        for name, b in self.named_buffers():
+        for name, b in self.named_buffers(remove_duplicate=False):
             out[name] = b.data
         return out
 
     def load_state_dict(self, state_dict, strict: bool = True):
-        own = dict(self.named_parameters())
-        own.update(dict(self.named_buffers()))
+        own = dict(self.named_parameters(remove_duplicate=False))
+        own.update(dict(self.named_buffers(remove_duplicate=False)))
         missing = [k for k in own if k not in state_dict]
         unexpected = [k for k in state_dict if k not in own]
         if strict and (missing or unexpected):
@@ -166,6 +190,8 @@ class Module:
         """Move/cast all params+buffers. Accepts a dtype, Device, or Sharding."""
         import numpy as _np
 
+        from .meta import is_meta
+
         if device_or_dtype is None:
             return self
         if isinstance(device_or_dtype, (jnp.dtype, _np.dtype, type)) or (
@@ -176,6 +202,8 @@ class Module:
                 t.data = t.data.astype(dtype)
         else:
             for t in list(self.parameters()) + list(self.buffers()):
+                if is_meta(t.data):
+                    continue
                 t.data = jax.device_put(t.data, device_or_dtype)
         return self
 

@@ -89,9 +89,13 @@ class Tensor:
     __slots__ = ("data", "requires_grad", "grad", "_node")
 
     def __init__(self, data, requires_grad: bool = False, _node: Optional[Node] = None):
+        from .meta import MetaArray
+
         if isinstance(data, Tensor):
             data = data.data
-        self.data = data if isinstance(data, jax.Array) else jnp.asarray(data)
+        self.data = (
+            data if isinstance(data, (jax.Array, MetaArray)) else jnp.asarray(data)
+        )
         self.requires_grad = requires_grad
         self.grad: Optional[jax.Array] = None
         self._node = _node

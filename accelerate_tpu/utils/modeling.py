@@ -1,0 +1,598 @@
+"""Model sizing, auto placement, tied weights, and checkpoint loading.
+
+Capability parity with the reference's big-model toolbox
+(reference: utils/modeling.py — ``compute_module_sizes`` :656,
+``get_max_memory`` :749, ``get_balanced_memory`` :923,
+``infer_auto_device_map`` :1281, ``find_tied_parameters`` :559,
+``set_module_tensor_to_device`` :217, ``load_checkpoint_in_model`` :1787),
+rebuilt for the JAX/TPU world:
+
+* "devices" in a device_map are TPU chip ordinals (ints into
+  ``jax.devices()``), ``"cpu"`` (host memory via JAX's CPU backend — arrays
+  stay addressable without a host→device copy), ``"disk"`` (numpy-memmap
+  offload store, :mod:`.offload`) and ``"meta"`` (unmaterialised).
+* sizing runs on :class:`~accelerate_tpu.nn.meta.MetaArray` shapes, so the
+  whole plan can be computed under ``init_empty_weights`` with zero memory;
+* on a TPU slice the *preferred* layout is GSPMD sharding
+  (``big_modeling.shard_for_inference``) — per-layer placement exists for the
+  model-bigger-than-HBM streaming case, same role it plays in the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import re
+from collections import defaultdict
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.meta import MetaArray, is_meta
+
+Device = Union[int, str, jax.Device]
+
+
+# ---------------------------------------------------------------------------
+# sizing
+# ---------------------------------------------------------------------------
+
+def dtype_byte_size(dtype) -> float:
+    """Bytes per element (fractional for sub-byte dtypes like int4/fp4)."""
+    dtype = str(jnp.dtype(dtype)) if not isinstance(dtype, str) else dtype
+    if dtype in ("bool",):
+        return 1 / 8
+    m = re.search(r"(\d+)$", dtype)
+    if m is None:
+        raise ValueError(f"`dtype` is not a valid dtype: {dtype}")
+    return int(m.group(1)) / 8
+
+
+def _tensor_nbytes(data, dtype=None) -> int:
+    d = jnp.dtype(dtype) if dtype is not None else data.dtype
+    size = int(np.prod(data.shape)) if len(data.shape) else 1
+    return int(size * dtype_byte_size(d))
+
+
+def named_module_tensors(module, include_buffers: bool = True, recurse: bool = False):
+    """Yield (name, Tensor) for direct (or all, if recurse) params/buffers."""
+    if recurse:
+        yield from module.named_parameters(remove_duplicate=False)
+        if include_buffers:
+            yield from module.named_buffers(remove_duplicate=False)
+    else:
+        yield from module._parameters.items()
+        if include_buffers:
+            yield from module._buffers.items()
+
+
+def compute_module_sizes(
+    model,
+    dtype=None,
+    special_dtypes: Optional[dict] = None,
+    buffers_only: bool = False,
+) -> dict[str, int]:
+    """Byte size of every dotted module prefix; ``""`` is the total.
+
+    Tied parameters (one Parameter object reachable under several names) are
+    counted once, at their first name — mirrors the reference's tied-weight
+    sizing so a device_map never double-budgets shared embeddings.
+    """
+    sizes: dict[str, int] = defaultdict(int)
+    seen_ids: set[int] = set()
+    tensors = []
+    if not buffers_only:
+        tensors.extend(model.named_parameters(remove_duplicate=False))
+    tensors.extend(model.named_buffers(remove_duplicate=False))
+    for name, t in tensors:
+        if id(t) in seen_ids:
+            continue
+        seen_ids.add(id(t))
+        use_dtype = None
+        if special_dtypes and name in special_dtypes:
+            use_dtype = special_dtypes[name]
+        elif dtype is not None and jnp.issubdtype(t.dtype, jnp.floating):
+            use_dtype = dtype
+        nbytes = _tensor_nbytes(t.data, use_dtype)
+        parts = name.split(".")
+        for i in range(len(parts) + 1):
+            sizes[".".join(parts[:i])] += nbytes
+    return dict(sizes)
+
+
+def calculate_maximum_sizes(model):
+    """(total_size, largest_layer) — used by ``estimate-memory`` and the
+    balanced-memory planner (reference: utils/modeling.py:888)."""
+    sizes = compute_module_sizes(model)
+    total = sizes.get("", 0)
+    no_split = getattr(model, "_no_split_modules", None) or []
+    largest, largest_name = 0, ""
+    for name, module in model.named_modules():
+        if name == "":
+            continue
+        leaf = not module._modules or type(module).__name__ in no_split
+        if leaf and sizes.get(name, 0) > largest:
+            largest, largest_name = sizes[name], name
+    return total, (largest, largest_name)
+
+
+# ---------------------------------------------------------------------------
+# memory budgets
+# ---------------------------------------------------------------------------
+
+_DEFAULT_HBM_BYTES = 16 * 1024**3  # v5e chip HBM when PJRT exposes no stats
+
+
+def _host_available_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 16 * 1024**3
+
+
+def get_max_memory(max_memory: Optional[dict] = None) -> dict:
+    """Normalise/complete a ``{device: budget}`` dict.
+
+    Defaults: every addressable chip's HBM limit (PJRT ``memory_stats``
+    ``bytes_limit`` when available) and the host's available RAM for "cpu".
+    String budgets like ``"10GiB"``/``"300MB"`` are parsed.
+    """
+    if max_memory is None:
+        max_memory = {}
+    out: dict = {}
+    devices = jax.local_devices()
+    for i, d in enumerate(devices):
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            pass
+        limit = (stats or {}).get("bytes_limit", _DEFAULT_HBM_BYTES)
+        in_use = (stats or {}).get("bytes_in_use", 0)
+        out[i] = max(limit - in_use, 0)
+    out["cpu"] = _host_available_bytes()
+    for key, value in max_memory.items():
+        if isinstance(key, int) and key >= len(devices):
+            raise ValueError(
+                f"max_memory names chip {key} but only {len(devices)} local "
+                f"devices exist"
+            )
+        out[key] = convert_file_size_to_int(value) if isinstance(value, str) else value
+    # user-specified dict restricts the device set (reference semantics:
+    # only devices named in max_memory participate)
+    if max_memory:
+        keep = set(max_memory.keys())
+        out = {k: v for k, v in out.items() if k in keep}
+    return out
+
+
+def convert_file_size_to_int(size: Union[int, str]) -> int:
+    """'10GiB' / '300MB' / '1.5GB' → bytes (reference: utils/modeling.py:97)."""
+    if isinstance(size, int):
+        return size
+    mem_size = str(size).strip().upper()
+    units = {
+        "GIB": 2**30, "MIB": 2**20, "KIB": 2**10,
+        "GB": 10**9, "MB": 10**6, "KB": 10**3,
+    }
+    for suffix, mult in units.items():
+        if mem_size.endswith(suffix):
+            return int(float(mem_size[: -len(suffix)]) * mult)
+    if mem_size.isdigit():
+        return int(mem_size)
+    raise ValueError(f"size {size!r} is not in a valid format (e.g. '10GiB')")
+
+
+def get_balanced_memory(
+    model,
+    max_memory: Optional[dict] = None,
+    no_split_module_classes: Optional[list] = None,
+    dtype=None,
+    special_dtypes: Optional[dict] = None,
+    low_zero: bool = False,
+) -> dict:
+    """Per-chip budgets that spread layers evenly instead of filling chip 0
+    (reference: utils/modeling.py:923). ``low_zero`` keeps chip 0 light for
+    generation-time KV caches / host feeding."""
+    max_memory = get_max_memory(max_memory)
+    chips = [k for k in max_memory if isinstance(k, int) and max_memory[k] > 0]
+    if len(chips) <= 1:
+        return max_memory
+    total, (largest_layer, _) = calculate_maximum_sizes(model)
+    if dtype is not None:
+        sizes = compute_module_sizes(model, dtype=dtype, special_dtypes=special_dtypes)
+        total = sizes.get("", total)
+    num = len(chips) - 1 if low_zero else len(chips)
+    per_chip = total // num + int(0.1 * total // num) + largest_layer
+    out = dict(max_memory)
+    for i, c in enumerate(sorted(chips)):
+        if low_zero and i == 0:
+            out[c] = min(out[c], largest_layer)
+        elif i < len(chips) - 1:  # last chip keeps its full budget (catch-all)
+            out[c] = min(out[c], per_chip)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# tied parameters
+# ---------------------------------------------------------------------------
+
+def find_tied_parameters(model) -> list[list[str]]:
+    """Groups of dotted names that resolve to the same Parameter object.
+
+    In this framework tying *is* object sharing (no ``weight.data_ptr`` games
+    needed — reference: utils/modeling.py:559): two modules holding the same
+    ``Parameter`` are tied by construction.
+    """
+    by_id: dict[int, list[str]] = defaultdict(list)
+    for name, p in model.named_parameters(remove_duplicate=False):
+        by_id[id(p)].append(name)
+    return sorted([sorted(names) for names in by_id.values() if len(names) > 1])
+
+
+def retie_parameters(model, tied_params: list[list[str]]) -> None:
+    """Re-share the Parameter object across each tied group (after a load or
+    materialisation broke identity)."""
+    for group in tied_params:
+        params = dict(model.named_parameters(remove_duplicate=False))
+        source = None
+        for name in group:
+            p = params.get(name)
+            if p is not None and not is_meta(p.data):
+                source = p
+                break
+        if source is None:
+            continue
+        for name in group:
+            mod, attr = _get_owner(model, name)
+            setattr(mod, attr, source)
+
+
+def _get_owner(model, dotted: str):
+    """(owning module, attribute name) for a dotted tensor path."""
+    parts = dotted.split(".")
+    mod = model
+    for part in parts[:-1]:
+        mod = mod._modules.get(part) or getattr(mod, part)
+    return mod, parts[-1]
+
+
+def get_module_from_name(model, dotted: str):
+    mod, attr = _get_owner(model, dotted)
+    return mod, attr
+
+
+# ---------------------------------------------------------------------------
+# tensor placement
+# ---------------------------------------------------------------------------
+
+def _cpu_device() -> jax.Device:
+    return jax.local_devices(backend="cpu")[0]
+
+
+def _resolve_device(device: Device) -> Union[jax.Device, str]:
+    if isinstance(device, jax.Device):
+        return device
+    if isinstance(device, int):
+        return jax.local_devices()[device]
+    if device in ("cpu", "host"):
+        return _cpu_device()
+    if device in ("meta", "disk"):
+        return device
+    raise ValueError(f"unknown device {device!r}")
+
+
+def set_module_tensor_to_device(
+    model,
+    tensor_name: str,
+    device: Device,
+    value=None,
+    dtype=None,
+) -> None:
+    """Materialise/move one named param/buffer (reference:
+    utils/modeling.py:217). ``value=None`` moves the existing array; a meta
+    tensor requires a value unless the target is "meta"."""
+    mod, attr = _get_owner(model, tensor_name)
+    store = mod._parameters if attr in mod._parameters else mod._buffers
+    if attr not in store:
+        raise KeyError(f"{tensor_name} is not a parameter or buffer of the model")
+    tensor = store[attr]
+    target = _resolve_device(device)
+    if target == "meta":
+        tensor.data = MetaArray(tensor.shape, dtype or tensor.dtype)
+        return
+    if value is None:
+        if is_meta(tensor.data):
+            raise ValueError(
+                f"{tensor_name} is on meta, `value` is required to materialise it"
+            )
+        value = tensor.data
+    if hasattr(value, "data") and not isinstance(value, (np.ndarray, jax.Array)):
+        value = value.data  # unwrap Tensor
+    arr = jnp.asarray(value) if not isinstance(value, jax.Array) else value
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    elif jnp.issubdtype(arr.dtype, jnp.floating) and jnp.issubdtype(
+        tensor.dtype, jnp.floating
+    ):
+        arr = arr.astype(tensor.dtype)
+    tensor.data = jax.device_put(arr, target)
+
+
+# ---------------------------------------------------------------------------
+# auto device map
+# ---------------------------------------------------------------------------
+
+def infer_auto_device_map(
+    model,
+    max_memory: Optional[dict] = None,
+    no_split_module_classes: Optional[list] = None,
+    dtype=None,
+    special_dtypes: Optional[dict] = None,
+    clean_result: bool = True,
+    offload_buffers: bool = False,
+    fallback_allocation: bool = False,
+    verbose: bool = False,
+) -> dict[str, Device]:
+    """Greedy per-module placement over ``{chip ordinals → "cpu" → "disk"}``
+    budgets (reference: utils/modeling.py:1281).
+
+    Walks the module tree in definition order; a block goes to the first
+    device with room, splitting non-atomic blocks when they overflow; tied
+    groups land with their first-placed member. The result feeds
+    ``dispatch_model`` (streaming) or, preferably on TPU, is translated into
+    mesh shardings by ``big_modeling.shard_for_inference``.
+    """
+    no_split = list(no_split_module_classes or getattr(model, "_no_split_modules", None) or [])
+    max_memory = get_max_memory(max_memory)
+    devices: list[Device] = sorted(
+        [k for k in max_memory if isinstance(k, int)]
+    ) + [k for k in ("cpu", "disk") if k in max_memory or k == "disk"]
+    remaining = {d: max_memory.get(d, float("inf")) for d in devices}
+    remaining["disk"] = float("inf")
+
+    sizes = compute_module_sizes(model, dtype=dtype, special_dtypes=special_dtypes)
+    tied_groups = find_tied_parameters(model)
+    tied_of: dict[str, list[str]] = {}
+    for group in tied_groups:
+        for name in group:
+            tied_of[name] = group
+
+    device_map: dict[str, Device] = {}
+    placed_tied: dict[int, Device] = {}  # id(param) -> device
+
+    # work queue of (name, module) units; leaves (direct tensors of modules
+    # that also have children) are handled via their owning module entry
+    queue: list[tuple[str, object]] = []
+
+    def push_children(prefix, module):
+        for name, child in module._modules.items():
+            queue.append((f"{prefix}.{name}" if prefix else name, child))
+
+    # root-level direct tensors are placed with the root's first device
+    queue = []
+    push_children("", model)
+    root_direct = [n for n, _ in named_module_tensors(model, recurse=False)]
+
+    dev_idx = 0
+    while queue:
+        name, module = queue.pop(0)
+        size = sizes.get(name, 0)
+        # tied pull: if any param inside is already placed, prefer that device
+        preferred = None
+        for pname, p in module.named_parameters(name):
+            if id(p) in placed_tied:
+                preferred = placed_tied[id(p)]
+                break
+        placed = False
+        while dev_idx < len(devices):
+            device = preferred if preferred is not None else devices[dev_idx]
+            budget = remaining[device]
+            if size <= budget:
+                device_map[name] = device
+                remaining[device] = budget - size
+                for pname, p in module.named_parameters(name):
+                    placed_tied.setdefault(id(p), device)
+                placed = True
+                break
+            preferred = None  # tied device is full: fall through normally
+            splittable = module._modules and type(module).__name__ not in no_split
+            if splittable:
+                # split: place direct tensors individually, recurse on children
+                insert_at = 0
+                for tname, t in named_module_tensors(module, recurse=False):
+                    tsize = _tensor_nbytes(t.data, dtype if jnp.issubdtype(t.dtype, jnp.floating) else None)
+                    tdev = devices[dev_idx]
+                    if tsize <= remaining[tdev]:
+                        device_map[f"{name}.{tname}"] = tdev
+                        remaining[tdev] -= tsize
+                    else:
+                        device_map[f"{name}.{tname}"] = "disk"
+                for cname, child in module._modules.items():
+                    queue.insert(insert_at, (f"{name}.{cname}", child))
+                    insert_at += 1
+                placed = True
+                break
+            dev_idx += 1
+        if not placed:
+            device_map[name] = "disk"
+
+    # root-level direct tensors (e.g. a top-level LayerNorm) ride device 0
+    for tname in root_direct:
+        if not any(tname == k or tname.startswith(k + ".") for k in device_map):
+            device_map[tname] = devices[0] if devices else "cpu"
+
+    if clean_result:
+        device_map = clean_device_map(device_map)
+    return device_map
+
+
+def clean_device_map(device_map: dict, module_name: str = "") -> dict:
+    """Collapse children that all share one device into their parent
+    (reference: utils/modeling.py:1239)."""
+
+    def under(k: str) -> bool:
+        if module_name == "":
+            return True
+        return k == module_name or k.startswith(module_name + ".")
+
+    keys = [k for k in device_map if under(k)]
+    values = [device_map[k] for k in keys]
+    if len(values) > 1 and len(set(map(str, values))) == 1:
+        for k in keys:
+            del device_map[k]
+        device_map[module_name] = values[0]
+        return device_map
+    prefix = f"{module_name}." if module_name else ""
+    children = sorted(
+        {
+            prefix + k[len(prefix):].split(".")[0]
+            for k in keys
+            if k != module_name and len(k) > len(prefix)
+        }
+    )
+    for child in children:
+        clean_device_map(device_map, child)
+    return device_map
+
+
+def check_device_map(model, device_map: dict) -> None:
+    """Every tensor must be covered by some device_map prefix
+    (reference: utils/modeling.py:1747)."""
+    all_names = [n for n, _ in model.named_parameters(remove_duplicate=False)] + [
+        n for n, _ in model.named_buffers(remove_duplicate=False)
+    ]
+    uncovered = []
+    for name in all_names:
+        covered = "" in device_map or any(
+            name == k or name.startswith(k + ".") for k in device_map if k
+        )
+        if not covered:
+            uncovered.append(name)
+    if uncovered:
+        raise ValueError(
+            f"device_map does not cover: {uncovered[:5]}{'...' if len(uncovered) > 5 else ''}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# checkpoint loading
+# ---------------------------------------------------------------------------
+
+def _load_state_dict_file(path: str) -> dict:
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(path)
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def load_state_dict(checkpoint_file: str, device_map: Optional[dict] = None) -> dict:
+    return _load_state_dict_file(checkpoint_file)
+
+
+def _device_for(name: str, device_map: dict) -> Device:
+    best, best_len = None, -1
+    for prefix, dev in device_map.items():
+        if prefix == "" or name == prefix or name.startswith(prefix + "."):
+            if len(prefix) > best_len:
+                best, best_len = dev, len(prefix)
+    if best is None:
+        raise ValueError(f"{name} not covered by device_map")
+    return best
+
+
+def load_checkpoint_in_model(
+    model,
+    checkpoint: str,
+    device_map: Optional[dict] = None,
+    offload_folder: Optional[str] = None,
+    dtype=None,
+    offload_buffers: bool = False,
+    strict: bool = False,
+) -> list[str]:
+    """Shard-by-shard load straight to mapped devices
+    (reference: utils/modeling.py:1787): each weight goes from disk to its
+    final chip/host/offload location — host peak memory is one shard, not the
+    model. Accepts a single file (.safetensors/.npz/pickle), a sharded
+    directory with ``*.index.json``, or a directory of shards.
+    """
+    from .offload import offload_weight, save_offload_index
+
+    files: list[str] = []
+    if os.path.isdir(checkpoint):
+        index_files = [f for f in os.listdir(checkpoint) if f.endswith("index.json")]
+        if index_files:
+            with open(os.path.join(checkpoint, index_files[0])) as f:
+                index = json.load(f)
+            weight_map = index.get("weight_map", index)
+            files = sorted({os.path.join(checkpoint, v) for v in weight_map.values()})
+        else:
+            files = sorted(
+                os.path.join(checkpoint, f)
+                for f in os.listdir(checkpoint)
+                if f.endswith((".safetensors", ".npz", ".bin", ".pkl"))
+            )
+    else:
+        files = [checkpoint]
+
+    if device_map is not None:
+        check_device_map(model, device_map)
+    own = {n for n, _ in model.named_parameters(remove_duplicate=False)} | {
+        n for n, _ in model.named_buffers(remove_duplicate=False)
+    }
+    buffer_names = {n for n, _ in model.named_buffers(remove_duplicate=False)}
+    # a tied name mapped to disk whose twin is resident must not park the
+    # shared object on meta — load it at the twin's device instead
+    tied_resident: dict[str, Device] = {}
+    if device_map is not None:
+        for group in find_tied_parameters(model):
+            devices_of = {n: _device_for(n, device_map) for n in group}
+            resident = [d for d in devices_of.values() if d != "disk"]
+            if resident:
+                for n in group:
+                    if devices_of[n] == "disk":
+                        tied_resident[n] = resident[0]
+    offload_index: dict = {}
+    unexpected: list[str] = []
+    loaded: set[str] = set()
+    for file in files:
+        shard = _load_state_dict_file(file)
+        for name, value in shard.items():
+            if name not in own:
+                unexpected.append(name)
+                continue
+            loaded.add(name)
+            device = _device_for(name, device_map) if device_map else 0
+            if device == "disk" and name in tied_resident:
+                device = tied_resident[name]
+            if device == "disk" and (name not in buffer_names or offload_buffers):
+                if offload_folder is None:
+                    raise ValueError(
+                        "device_map contains 'disk' entries: pass offload_folder"
+                    )
+                offload_weight(np.asarray(value), name, offload_folder, offload_index)
+                set_module_tensor_to_device(model, name, "meta", dtype=dtype)
+            else:
+                device = "cpu" if device == "disk" else device
+                set_module_tensor_to_device(model, name, device, value, dtype=dtype)
+    if offload_index:
+        save_offload_index(offload_index, offload_folder)
+    missing = sorted(own - loaded)
+    if strict and (missing or unexpected):
+        raise RuntimeError(
+            f"load_checkpoint_in_model mismatch: missing={missing[:5]}, "
+            f"unexpected={unexpected[:5]}"
+        )
+    return missing

@@ -1,0 +1,202 @@
+"""Big-model machinery end-to-end tests (mirrors reference tests/test_big_modeling.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+from accelerate_tpu.big_modeling import (
+    cpu_offload,
+    cpu_offload_with_hook,
+    disk_offload,
+    dispatch_model,
+    init_empty_weights,
+    init_on_device,
+    load_checkpoint_and_dispatch,
+    materialize_meta_module,
+    shard_for_inference,
+)
+from accelerate_tpu.nn.meta import is_meta
+from accelerate_tpu.utils.modeling import find_tied_parameters
+
+
+class ModelForTest(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.linear1 = nn.Linear(3, 4)
+        self.batchnorm = nn.LayerNorm(4)
+        self.linear2 = nn.Linear(4, 5)
+
+    def forward(self, x):
+        return self.linear2(self.batchnorm(self.linear1(x)))
+
+
+class BiggerModelForTest(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.linear1 = nn.Linear(3, 4)
+        self.linear2 = nn.Linear(4, 5)
+        self.batchnorm = nn.LayerNorm(5)
+        self.linear3 = nn.Linear(5, 6)
+        self.linear4 = nn.Linear(6, 5)
+
+    def forward(self, x):
+        return self.linear4(self.linear3(self.batchnorm(self.linear2(self.linear1(x)))))
+
+
+def test_init_empty_weights():
+    with init_empty_weights():
+        model = ModelForTest()
+    assert all(is_meta(p.data) for p in model.parameters())
+    # sizing works, forward obviously can't run
+    assert model.num_parameters == 3 * 4 + 4 + 4 + 4 + 4 * 5 + 5
+
+
+def test_init_empty_weights_without_buffers():
+    class WithBuffer(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.linear = nn.Linear(2, 2)
+            from accelerate_tpu.nn import init as nn_init
+
+            self.register_buffer("pos", nn_init.arange(8))
+
+    with init_empty_weights(include_buffers=False):
+        model = WithBuffer()
+    assert is_meta(model.linear.weight.data)
+    # buffers keep their TRUE values (not zeros) in this mode
+    np.testing.assert_array_equal(np.asarray(model.pos.data), np.arange(8))
+
+
+def test_init_on_device():
+    cpu = jax.local_devices(backend="cpu")[0]
+    with init_on_device(cpu):
+        model = ModelForTest()
+    assert list(model.linear1.weight.data.devices())[0].platform == "cpu"
+
+
+def test_materialize_meta_module():
+    with init_empty_weights():
+        model = ModelForTest()
+    materialize_meta_module(model, device=0)
+    assert not any(is_meta(p.data) for p in model.parameters())
+    out = model(nn.Tensor(jnp.ones((2, 3))))
+    assert out.shape == (2, 5)
+
+
+def test_cpu_offload():
+    model = ModelForTest()
+    x = nn.Tensor(jnp.ones((2, 3)))
+    base = model(x).numpy()
+    cpu_offload(model, execution_device=0)
+    np.testing.assert_allclose(model(x).numpy(), base, rtol=1e-5)
+    # params parked again after forward
+    assert is_meta(model.linear1.weight.data)
+
+
+def test_cpu_offload_with_hook():
+    model1 = ModelForTest()
+    model2 = ModelForTest()
+    x = nn.Tensor(jnp.ones((2, 3)))
+    e1 = model1(x).numpy()
+    model1, hook1 = cpu_offload_with_hook(model1, execution_device=0)
+    model2, hook2 = cpu_offload_with_hook(model2, execution_device=0, prev_module_hook=hook1)
+    np.testing.assert_allclose(model1(x).numpy(), e1, rtol=1e-5)
+    model2(x)  # offloads model1 first
+    dev = list(model1.linear1.weight.data.devices())[0]
+    assert dev.platform == "cpu"
+    hook2.remove()
+
+
+def test_disk_offload(tmp_path):
+    model = ModelForTest()
+    x = nn.Tensor(jnp.ones((2, 3)))
+    base = model(x).numpy()
+    disk_offload(model, str(tmp_path / "offload"), execution_device=0)
+    np.testing.assert_allclose(model(x).numpy(), base, rtol=1e-5)
+    assert (tmp_path / "offload" / "index.json").exists()
+
+
+def test_dispatch_model_multichip():
+    model = BiggerModelForTest()
+    x = nn.Tensor(jnp.ones((2, 3)))
+    base = model(x).numpy()
+    device_map = {"linear1": 0, "linear2": 1, "batchnorm": 1, "linear3": 2, "linear4": 3}
+    dispatch_model(model, device_map)
+    np.testing.assert_allclose(model(x).numpy(), base, rtol=1e-5)
+    # weights actually live on their mapped chips
+    assert list(model.linear1.weight.data.devices())[0] == jax.devices()[0]
+    assert list(model.linear3.weight.data.devices())[0] == jax.devices()[2]
+
+
+def test_dispatch_model_cpu_offload(tmp_path):
+    model = BiggerModelForTest()
+    x = nn.Tensor(jnp.ones((2, 3)))
+    base = model(x).numpy()
+    device_map = {"linear1": 0, "linear2": 0, "batchnorm": 0, "linear3": "cpu", "linear4": "disk"}
+    dispatch_model(model, device_map, offload_dir=str(tmp_path / "off"))
+    np.testing.assert_allclose(model(x).numpy(), base, rtol=1e-5)
+    # offloaded blocks are parked outside forward
+    assert is_meta(model.linear4.weight.data)
+
+
+def test_dispatch_model_tied_weights():
+    class Tied(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 4, bias=False)
+            self.b = nn.Linear(4, 4, bias=False)
+            self.b.weight = self.a.weight
+
+        def forward(self, x):
+            return self.b(self.a(x))
+
+    model = Tied()
+    x = nn.Tensor(jnp.ones((2, 4)))
+    base = model(x).numpy()
+    dispatch_model(model, {"a": 0, "b": "cpu"})
+    np.testing.assert_allclose(model(x).numpy(), base, rtol=1e-5)
+    assert find_tied_parameters(model) == [["a.weight", "b.weight"]]
+
+
+def test_load_checkpoint_and_dispatch_auto(tmp_path):
+    from safetensors.numpy import save_file
+
+    src = BiggerModelForTest()
+    x = nn.Tensor(jnp.ones((2, 3)))
+    base = src(x).numpy()
+    sd = {k: np.asarray(v) for k, v in src.state_dict().items()}
+    save_file(sd, str(tmp_path / "model.safetensors"))
+
+    with init_empty_weights():
+        model = BiggerModelForTest()
+    model = load_checkpoint_and_dispatch(
+        model, str(tmp_path / "model.safetensors"), device_map="auto",
+        max_memory={0: 200, 1: 200, "cpu": 10_000},
+    )
+    assert hasattr(model, "atpu_device_map")
+    np.testing.assert_allclose(model(x).numpy(), base, rtol=1e-5)
+
+
+def test_shard_for_inference_matches():
+    model = ModelForTest()
+    x = nn.Tensor(jnp.ones((2, 3)))
+    base = model(x).numpy()
+    from accelerate_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    shard_for_inference(
+        model, mesh, tp_plan={r".*linear1\.weight": ("tp", None), r".*linear2\.weight": (None, "tp")}
+    )
+    np.testing.assert_allclose(model(x).numpy(), base, rtol=1e-5)
+    # linear1 weight is actually sharded over 2 chips
+    shards = model.linear1.weight.data.sharding.device_set
+    assert len(shards) == 2
+
+
+def test_shard_for_inference_rejects_meta():
+    with init_empty_weights():
+        model = ModelForTest()
+    with pytest.raises(ValueError):
+        shard_for_inference(model)

@@ -1,0 +1,112 @@
+"""Hook engine tests (mirrors reference tests/test_hooks.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import accelerate_tpu.nn as nn
+from accelerate_tpu.hooks import (
+    AlignDevicesHook,
+    ModelHook,
+    SequentialHook,
+    add_hook_to_module,
+    attach_align_device_hook,
+    remove_hook_from_module,
+    remove_hook_from_submodules,
+    send_to_device,
+)
+from accelerate_tpu.nn.meta import is_meta
+
+
+class ModelForTest(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.linear1 = nn.Linear(3, 4)
+        self.batchnorm = nn.LayerNorm(4)
+        self.linear2 = nn.Linear(4, 5)
+
+    def forward(self, x):
+        return self.linear2(self.batchnorm(self.linear1(x)))
+
+
+class PreForwardHook(ModelHook):
+    def pre_forward(self, module, *args, **kwargs):
+        return (args[0] + 1,) + args[1:], kwargs
+
+
+class PostForwardHook(ModelHook):
+    def post_forward(self, module, output):
+        return output + 1
+
+
+def test_add_and_remove_hooks():
+    model = ModelForTest()
+    x = nn.Tensor(jnp.ones((2, 3)))
+    base = model(x).numpy()
+
+    add_hook_to_module(model, PostForwardHook())
+    plus_one = model(x).numpy()
+    np.testing.assert_allclose(plus_one, base + 1, rtol=1e-6)
+
+    # append composes
+    add_hook_to_module(model, PostForwardHook(), append=True)
+    plus_two = model(x).numpy()
+    np.testing.assert_allclose(plus_two, base + 2, rtol=1e-6)
+    assert isinstance(model._atpu_hook, SequentialHook)
+
+    remove_hook_from_module(model)
+    np.testing.assert_allclose(model(x).numpy(), base, rtol=1e-6)
+    assert model._atpu_hook is None
+
+
+def test_pre_forward_hook():
+    model = ModelForTest()
+    x = nn.Tensor(jnp.ones((2, 3)))
+    expected = model(x + 1).numpy()
+    add_hook_to_module(model, PreForwardHook())
+    np.testing.assert_allclose(model(x).numpy(), expected, rtol=1e-6)
+
+
+def test_no_grad_hook():
+    model = ModelForTest()
+
+    class NG(ModelHook):
+        no_grad = True
+
+    add_hook_to_module(model, NG())
+    out = model(nn.Tensor(jnp.ones((2, 3)), requires_grad=True))
+    assert out._node is None  # tape did not record
+
+
+def test_align_devices_hook_offload():
+    model = ModelForTest()
+    x = nn.Tensor(jnp.ones((2, 3)))
+    base = model(x).numpy()
+
+    cpu = jax.local_devices(backend="cpu")[0]
+    weights_map = {
+        name: jax.device_put(t.data, cpu) for name, t in model.named_parameters()
+    }
+    attach_align_device_hook(
+        model, execution_device=0, offload=True, weights_map=weights_map,
+        tied_params_map={},
+    )
+    # weights are parked (meta) outside forward
+    assert is_meta(model.linear1.weight.data)
+    out = model(x).numpy()
+    np.testing.assert_allclose(out, base, rtol=1e-5)
+    # back to meta after forward
+    assert is_meta(model.linear1.weight.data)
+
+    # detach restores real weights
+    remove_hook_from_submodules(model)
+    assert not is_meta(model.linear1.weight.data)
+    np.testing.assert_allclose(model(x).numpy(), base, rtol=1e-5)
+
+
+def test_send_to_device_nested():
+    tree = {"a": jnp.ones((2,)), "b": [jnp.zeros((3,)), nn.Tensor(jnp.ones((1,)))]}
+    dev = jax.devices()[0]
+    moved = send_to_device(tree, dev)
+    assert list(moved["a"].devices())[0] == dev
+    assert isinstance(moved["b"][1], nn.Tensor)

@@ -1,0 +1,231 @@
+"""Sizing / placement planner tests (mirrors reference tests/test_modeling_utils.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+from accelerate_tpu.big_modeling import init_empty_weights
+from accelerate_tpu.utils.modeling import (
+    calculate_maximum_sizes,
+    check_device_map,
+    clean_device_map,
+    compute_module_sizes,
+    convert_file_size_to_int,
+    dtype_byte_size,
+    find_tied_parameters,
+    get_balanced_memory,
+    infer_auto_device_map,
+    load_checkpoint_in_model,
+    retie_parameters,
+    set_module_tensor_to_device,
+)
+
+
+class SubNet(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.linear1 = nn.Linear(4, 4)
+        self.linear2 = nn.Linear(4, 4)
+
+    def forward(self, x):
+        return self.linear2(self.linear1(x))
+
+
+class BiggerModel(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.block1 = SubNet()
+        self.block2 = SubNet()
+        self.head = nn.Linear(4, 2)
+
+    def forward(self, x):
+        return self.head(self.block2(self.block1(x)))
+
+
+def test_dtype_byte_size():
+    assert dtype_byte_size(jnp.float32) == 4
+    assert dtype_byte_size(jnp.bfloat16) == 2
+    assert dtype_byte_size(jnp.int8) == 1
+    assert dtype_byte_size("bool") == 1 / 8
+
+
+def test_convert_file_size():
+    assert convert_file_size_to_int("1KB") == 1000
+    assert convert_file_size_to_int("1KiB") == 1024
+    assert convert_file_size_to_int("2GB") == 2 * 10**9
+    assert convert_file_size_to_int(77) == 77
+    with pytest.raises(ValueError):
+        convert_file_size_to_int("1 potato")
+
+
+def test_compute_module_sizes():
+    model = BiggerModel()
+    sizes = compute_module_sizes(model)
+    # linear(4,4): 4*4+4 = 20 floats = 80 bytes
+    assert sizes["block1.linear1"] == 80
+    assert sizes["block1"] == 160
+    # head: 4*2+2 = 10 floats
+    assert sizes["head"] == 40
+    assert sizes[""] == 160 + 160 + 40
+    # half-precision sizing
+    sizes16 = compute_module_sizes(model, dtype=jnp.bfloat16)
+    assert sizes16[""] == sizes[""] // 2
+
+
+def test_compute_module_sizes_on_meta():
+    with init_empty_weights():
+        model = BiggerModel()
+    sizes = compute_module_sizes(model)
+    assert sizes[""] == 360
+
+
+def test_calculate_maximum_sizes():
+    model = BiggerModel()
+    total, (largest, name) = calculate_maximum_sizes(model)
+    assert total == 360
+    assert largest == 80  # a single Linear leaf
+    assert name.startswith("block")
+
+
+def test_find_and_retie_tied_parameters():
+    model = BiggerModel()
+    assert find_tied_parameters(model) == []
+    # tie head weight to block2.linear2 weight (object sharing = tying)
+    model.head.weight = model.block2.linear2.weight
+    tied = find_tied_parameters(model)
+    assert tied == [["block2.linear2.weight", "head.weight"]]
+    # tied params counted once in sizes
+    sizes = compute_module_sizes(model)
+    assert sizes[""] == 360 - 40 + 8  # head.weight (32B) deduped; bias stays
+
+    # break tying, then retie
+    model.head.weight = nn.Parameter(jnp.zeros((4, 4)))
+    assert find_tied_parameters(model) == []
+    retie_parameters(model, tied)
+    assert find_tied_parameters(model) == tied
+
+
+def test_set_module_tensor_to_device():
+    import jax
+
+    model = SubNet()
+    set_module_tensor_to_device(model, "linear1.weight", "cpu")
+    dev = list(model.linear1.weight.data.devices())[0]
+    assert dev.platform == "cpu"
+    set_module_tensor_to_device(
+        model, "linear1.weight", 0, value=np.ones((4, 4), np.float32)
+    )
+    assert model.linear1.weight.data[0, 0] == 1.0
+    set_module_tensor_to_device(model, "linear1.weight", "meta")
+    from accelerate_tpu.nn.meta import is_meta
+
+    assert is_meta(model.linear1.weight.data)
+    with pytest.raises(ValueError):
+        set_module_tensor_to_device(model, "linear1.weight", 0)  # meta, no value
+
+
+def test_infer_auto_device_map_all_fit():
+    model = BiggerModel()
+    device_map = infer_auto_device_map(model, max_memory={0: 10_000})
+    check_device_map(model, device_map)
+    assert set(device_map.values()) == {0}
+
+
+def test_infer_auto_device_map_splits():
+    model = BiggerModel()
+    # 200 bytes on chip0: block1 (160) fits, block2 (160) must split/spill
+    device_map = infer_auto_device_map(model, max_memory={0: 200, 1: 200})
+    check_device_map(model, device_map)
+    assert device_map["block1"] == 0
+    assert all(v in (0, 1) for v in device_map.values())
+    # with no_split, block2 moves wholesale to chip 1
+    device_map = infer_auto_device_map(
+        model, max_memory={0: 200, 1: 200}, no_split_module_classes=["SubNet"]
+    )
+    assert device_map["block1"] == 0
+    assert device_map["block2"] == 1
+
+
+def test_infer_auto_device_map_spills_to_cpu_and_disk():
+    model = BiggerModel()
+    device_map = infer_auto_device_map(
+        model,
+        max_memory={0: 170, "cpu": 170},
+        no_split_module_classes=["SubNet"],
+    )
+    check_device_map(model, device_map)
+    assert device_map["block1"] == 0
+    assert device_map["block2"] == "cpu"
+    assert device_map["head"] == "disk"
+
+
+def test_infer_auto_device_map_tied_weights_colocate():
+    model = BiggerModel()
+    model.head.weight = model.block1.linear1.weight
+    # chip0 fits block1 (160B) with 10B slack; block2 overflows to chip1; head
+    # (8B after tied dedup) would normally follow onto chip1 — the tied pull
+    # brings it back to chip0 where its shared weight lives
+    device_map = infer_auto_device_map(
+        model, max_memory={0: 170, 1: 400}, no_split_module_classes=["SubNet"]
+    )
+    check_device_map(model, device_map)
+    assert device_map["block1"] == 0
+    assert device_map["block2"] == 1
+    assert device_map["head"] == 0
+
+
+def test_clean_device_map():
+    dm = {"a.0": 0, "a.1": 0, "b": 1}
+    assert clean_device_map(dict(dm)) == {"a": 0, "b": 1}
+    dm = {"a.0": 0, "a.1": 1}
+    assert clean_device_map(dict(dm)) == dm
+    assert clean_device_map({"a": 0, "b": 0}) == {"": 0}
+
+
+def test_get_balanced_memory():
+    model = BiggerModel()
+    balanced = get_balanced_memory(model, max_memory={0: 10_000, 1: 10_000})
+    # chip 0 capped below full budget, last chip keeps its budget
+    assert balanced[0] < 10_000
+    assert balanced[1] == 10_000
+
+
+def test_load_checkpoint_in_model(tmp_path):
+    from safetensors.numpy import save_file
+
+    model = SubNet()
+    sd = {k: np.asarray(v) for k, v in model.state_dict().items()}
+    path = str(tmp_path / "model.safetensors")
+    save_file(sd, path)
+
+    with init_empty_weights():
+        fresh = SubNet()
+    missing = load_checkpoint_in_model(
+        fresh, path, device_map={"": 0}, strict=True
+    )
+    assert missing == []
+    np.testing.assert_array_equal(
+        np.asarray(fresh.linear1.weight.data), sd["linear1.weight"]
+    )
+
+
+def test_load_checkpoint_in_model_disk_offload(tmp_path):
+    from safetensors.numpy import save_file
+
+    model = SubNet()
+    sd = {k: np.asarray(v) for k, v in model.state_dict().items()}
+    save_file(sd, str(tmp_path / "model.safetensors"))
+
+    with init_empty_weights():
+        fresh = SubNet()
+    load_checkpoint_in_model(
+        fresh,
+        str(tmp_path / "model.safetensors"),
+        device_map={"linear1": 0, "linear2": "disk"},
+        offload_folder=str(tmp_path / "offload"),
+    )
+    from accelerate_tpu.nn.meta import is_meta
+
+    assert is_meta(fresh.linear2.weight.data)
+    assert (tmp_path / "offload" / "index.json").exists()

@@ -55,7 +55,10 @@ def test_gpt_forward_loss_and_tied_head():
     g = np.asarray(model.wte.weight.grad)
     assert np.abs(g).sum() > 0
     names = [n for n, _ in model.named_parameters()]
-    assert "wte.weight" in names and not any("lm_head" in n for n in names)
+    # tied head: lm_head.weight IS wte.weight (one object, deduped by default)
+    assert "wte.weight" in names and "lm_head.weight" not in names
+    assert "lm_head.weight" in dict(model.named_parameters(remove_duplicate=False))
+    assert model.lm_head.weight is model.wte.weight
 
 
 def test_gpt_trains_to_memorize():

@@ -1,0 +1,55 @@
+"""Offload store tests (mirrors reference tests/test_offload.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from accelerate_tpu.utils.offload import (
+    OffloadedWeightsLoader,
+    extract_submodules_state_dict,
+    load_offloaded_weight,
+    offload_state_dict,
+    offload_weight,
+    save_offload_index,
+)
+
+
+def test_offload_weight_roundtrip(tmp_path):
+    index = {}
+    w = np.random.randn(3, 4).astype(np.float32)
+    offload_weight(w, "layer.weight", str(tmp_path), index)
+    loaded = load_offloaded_weight(
+        str(tmp_path / "layer.weight.dat"), index["layer.weight"]
+    )
+    np.testing.assert_array_equal(np.asarray(loaded), w)
+
+
+def test_offload_weight_bfloat16(tmp_path):
+    index = {}
+    w = jnp.asarray(np.random.randn(4, 2), dtype=jnp.bfloat16)
+    offload_weight(np.asarray(w), "w", str(tmp_path), index)
+    assert index["w"]["dtype"] == "bfloat16"
+    loaded = load_offloaded_weight(str(tmp_path / "w.dat"), index["w"])
+    np.testing.assert_array_equal(np.asarray(loaded), np.asarray(w))
+
+
+def test_offload_weight_scalar(tmp_path):
+    index = {}
+    offload_weight(np.float32(3.5), "s", str(tmp_path), index)
+    loaded = load_offloaded_weight(str(tmp_path / "s.dat"), index["s"])
+    assert float(loaded) == 3.5
+
+
+def test_offloaded_weights_loader(tmp_path):
+    disk = {"a": np.ones((2, 2), np.float32)}
+    offload_state_dict(str(tmp_path), disk)
+    mem = {"b": np.zeros((3,), np.float32)}
+    loader = OffloadedWeightsLoader(state_dict=mem, save_folder=str(tmp_path))
+    assert sorted(loader.keys()) == ["a", "b"]
+    np.testing.assert_array_equal(np.asarray(loader["a"]), disk["a"])
+    np.testing.assert_array_equal(loader["b"], mem["b"])
+
+
+def test_extract_submodules_state_dict():
+    sd = {"block.linear.weight": 1, "block.linear.bias": 2, "head.weight": 3}
+    sub = extract_submodules_state_dict(sd, ["block.linear"])
+    assert sub == {"block.linear.weight": 1, "block.linear.bias": 2}
