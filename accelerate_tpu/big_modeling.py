@@ -214,11 +214,13 @@ def dispatch_model(
                     state_dict[full] = jax.device_put(t.data, cpu)
 
     disk_modules = [n for n, d in device_map.items() if d == "disk"]
+    if disk_modules and offload_dir is None:
+        # with or without a prebuilt offload_index, disk weights are read
+        # from offload_dir at forward time — fail here, not inside a hook
+        raise ValueError(
+            f"device_map sends {disk_modules} to disk: an offload_dir is required"
+        )
     if disk_modules and offload_index is None:
-        if offload_dir is None:
-            raise ValueError(
-                f"device_map sends {disk_modules} to disk: an offload_dir is required"
-            )
         existing = os.path.isfile(os.path.join(offload_dir, "index.json"))
         if not existing:
             disk_state = {}

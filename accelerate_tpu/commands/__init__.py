@@ -1,0 +1,1 @@
+"""CLI subcommand package (reference: src/accelerate/commands/)."""

@@ -1,0 +1,44 @@
+"""The ``accelerate-tpu`` CLI entry point.
+
+Counterpart of ``/root/reference/src/accelerate/commands/accelerate_cli.py:27-48``
+— subcommand mux: config, env, launch, estimate-memory, merge-weights,
+tpu-config, test.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .config import get_config_parser
+from .env import env_command_parser
+from .estimate import estimate_command_parser
+from .launch import launch_command_parser
+from .merge import merge_command_parser
+from .test import test_command_parser
+from .tpu import tpu_command_parser
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu",
+        usage="accelerate-tpu <command> [<args>]",
+        allow_abbrev=False,
+    )
+    subparsers = parser.add_subparsers(dest="command", required=True)
+
+    get_config_parser(subparsers)
+    estimate_command_parser(subparsers)
+    env_command_parser(subparsers)
+    launch_command_parser(subparsers)
+    merge_command_parser(subparsers)
+    tpu_command_parser(subparsers)
+    test_command_parser(subparsers)
+
+    args = parser.parse_args()
+    if not hasattr(args, "func"):
+        raise ValueError("A subcommand must be given")
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

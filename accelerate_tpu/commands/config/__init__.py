@@ -1,0 +1,34 @@
+"""Config subcommand package (reference: src/accelerate/commands/config/)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+from .config import config_command, config_command_parser
+from .config_args import Config, default_config_file, load_config_from_file
+from .default import default_command_parser, write_basic_config
+from .update import update_command_parser
+
+__all__ = [
+    "Config",
+    "default_config_file",
+    "load_config_from_file",
+    "write_basic_config",
+    "get_config_parser",
+]
+
+
+def get_config_parser(subparsers: Optional[argparse._SubParsersAction] = None):
+    """``config`` with nested ``default``/``update`` subcommands
+    (reference commands/config/__init__.py:30)."""
+    if subparsers is not None:
+        parser = subparsers.add_parser("config", help="Launch configuration")
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu config")
+    parser.add_argument("--config_file", default=None)
+    inner = parser.add_subparsers(dest="config_subcommand")
+    default_command_parser(inner)
+    update_command_parser(inner)
+    parser.set_defaults(func=config_command)
+    return parser

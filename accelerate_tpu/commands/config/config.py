@@ -1,0 +1,130 @@
+"""Interactive questionnaire for ``accelerate-tpu config``.
+
+Counterpart of ``/root/reference/src/accelerate/commands/config/cluster.py:55``
++ ``commands/config/config.py``.  The reference's 800-line questionnaire
+mostly disambiguates ten process backends; here the questions collapse to:
+where do you run (local host / TPU pod / CPU simulation), how many hosts, the
+mesh layout, and precision.  Plain ``input()`` prompts instead of the arrow-key
+menu TUI (commands/menu/) so the flow works over any terminal (incl. ssh'd pod
+workers); every question accepts an empty answer for its default.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Callable, Optional
+
+from .config_args import Config, default_config_file
+
+
+def _ask_field(
+    prompt: str,
+    convert: Callable = str,
+    default=None,
+    error_message: str = "invalid input",
+):
+    """Reference: _ask_field commands/config/config_utils.py:33."""
+    while True:
+        raw = input(prompt)
+        if not raw.strip():
+            return default
+        try:
+            return convert(raw.strip())
+        except ValueError:
+            print(error_message)
+
+
+def _ask_choice(prompt: str, choices: list[str], default: str) -> str:
+    labels = "/".join(c if c != default else c.upper() for c in choices)
+    while True:
+        raw = input(f"{prompt} [{labels}]: ").strip().lower()
+        if not raw:
+            return default
+        if raw in choices:
+            return raw
+        print(f"please answer one of: {', '.join(choices)}")
+
+
+def _yes_no(prompt: str, default: bool = False) -> bool:
+    answer = _ask_choice(prompt, ["yes", "no"], "yes" if default else "no")
+    return answer == "yes"
+
+
+def get_user_input() -> Config:
+    """Run the questionnaire and return the resulting Config."""
+    env = _ask_choice(
+        "In which compute environment are you running?",
+        ["local_machine", "tpu_pod", "cpu_simulation"],
+        "local_machine",
+    )
+    config = Config()
+    if env == "cpu_simulation":
+        config.use_cpu = True
+        config.distributed_type = "NO"
+        config.num_virtual_devices = _ask_field(
+            "How many virtual devices should XLA create? [8]: ", int, 8
+        )
+    else:
+        config.compute_environment = (
+            "TPU_POD" if env == "tpu_pod" else "LOCAL_MACHINE"
+        )
+        config.num_processes = _ask_field(
+            "How many host processes (one per TPU VM worker)? [1]: ", int, 1
+        )
+        config.distributed_type = "MULTI_HOST" if config.num_processes > 1 else "TPU"
+        if config.num_processes > 1:
+            config.main_process_ip = _ask_field(
+                "What is the coordinator (worker 0) IP address? ", str, None
+            )
+            config.main_process_port = _ask_field(
+                "What is the coordinator port? [29500]: ", int, 29500
+            )
+        if env == "tpu_pod":
+            config.tpu_name = _ask_field("What is the TPU name? ", str, None)
+            config.tpu_zone = _ask_field("What is the GCP zone? ", str, None)
+            config.tpu_use_cluster = True
+    config.fsdp_size = _ask_field(
+        "FSDP (parameter-sharding) axis size? [1 = off]: ", int, 1
+    )
+    config.tp_size = _ask_field("Tensor-parallel axis size? [1 = off]: ", int, 1)
+    config.sp_size = _ask_field(
+        "Sequence-parallel (ring attention) axis size? [1 = off]: ", int, 1
+    )
+    config.gradient_accumulation_steps = _ask_field(
+        "Gradient accumulation steps? [1]: ", int, 1
+    )
+    config.mixed_precision = _ask_choice(
+        "Mixed precision?", ["no", "bf16", "fp16", "fp8"], "bf16"
+    )
+    return config
+
+
+def config_command_parser(subparsers: Optional[argparse._SubParsersAction] = None):
+    description = "Launch configuration questionnaire"
+    if subparsers is not None:
+        parser = subparsers.add_parser("config", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu config", description=description)
+    parser.add_argument(
+        "--config_file",
+        default=None,
+        help=f"Where to save the config (default {default_config_file})",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=config_command)
+    return parser
+
+
+def config_command(args) -> None:
+    config = get_user_input()
+    path = config.save(args.config_file)
+    print(f"accelerate-tpu configuration saved at {path}")
+
+
+def main():
+    args = config_command_parser().parse_args()
+    config_command(args)
+
+
+if __name__ == "__main__":
+    main()

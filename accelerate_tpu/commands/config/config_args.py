@@ -1,0 +1,137 @@
+"""Config-file schema + IO for ``accelerate-tpu config`` / ``launch``.
+
+Counterpart of ``/root/reference/src/accelerate/commands/config/config_args.py``
+(ClusterConfig :179, load_config_from_file :43-76).  One schema instead of the
+reference's Cluster/SageMaker split: on TPU the only cluster shape is
+"N host processes over a device mesh", so the mesh-axis sizes replace the
+reference's distributed_type-specific argument blocks (fsdp_config,
+deepspeed_config, megatron_lm_config, ...).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import yaml
+
+default_json_config_file = os.path.expanduser(
+    "~/.cache/accelerate_tpu/default_config.json"
+)
+default_yaml_config_file = os.path.expanduser(
+    "~/.cache/accelerate_tpu/default_config.yaml"
+)
+default_config_file = (
+    default_json_config_file
+    if os.path.isfile(default_json_config_file)
+    and not os.path.isfile(default_yaml_config_file)
+    else default_yaml_config_file
+)
+
+
+def load_config_from_file(config_file: Optional[str] = None) -> "Config":
+    """Reference: load_config_from_file config_args.py:43."""
+    if config_file is None:
+        config_file = os.environ.get("ACCELERATE_CONFIG_FILE", default_config_file)
+        if not os.path.isfile(config_file):
+            raise FileNotFoundError(
+                f"no config file at {config_file}; run `accelerate-tpu config` "
+                "first or pass --config_file"
+            )
+    elif not os.path.isfile(config_file):
+        raise FileNotFoundError(f"config file {config_file} does not exist")
+    if config_file.endswith(".json"):
+        return Config.from_json_file(config_file)
+    return Config.from_yaml_file(config_file)
+
+
+@dataclass
+class Config:
+    """The launch configuration (reference ClusterConfig config_args.py:179)."""
+
+    compute_environment: str = "LOCAL_MACHINE"  # or TPU_POD
+    distributed_type: str = "TPU"  # TPU | MULTI_HOST | NO
+    mixed_precision: str = "no"  # no | bf16 | fp16 | fp8
+    use_cpu: bool = False
+    debug: bool = False
+
+    # host topology (one process per host; rendezvous = jax.distributed)
+    num_processes: int = 1
+    machine_rank: int = 0
+    main_process_ip: Optional[str] = None
+    main_process_port: Optional[int] = None
+
+    # mesh layout
+    dp_size: int = 0  # 0 → inferred from device count / other axes
+    fsdp_size: int = 1
+    tp_size: int = 1
+    sp_size: int = 1
+    ep_size: int = 1
+    pp_size: int = 1
+
+    gradient_accumulation_steps: int = 1
+    num_virtual_devices: int = 0  # CPU simulation; 0 → off
+
+    # FSDP details (reference fsdp_config dict)
+    fsdp_config: dict[str, Any] = field(default_factory=dict)
+    # TPU pod details (reference tpu_name/tpu_zone in ClusterConfig)
+    tpu_name: Optional[str] = None
+    tpu_zone: Optional[str] = None
+    tpu_use_cluster: bool = False
+
+    def __post_init__(self):
+        valid = ("TPU", "MULTI_HOST", "NO")
+        if self.distributed_type not in valid:
+            raise ValueError(
+                f"distributed_type must be one of {valid}, got {self.distributed_type!r}"
+            )
+
+    def to_dict(self) -> dict:
+        result = {
+            k: v for k, v in self.__dict__.items() if v is not None
+        }
+        return result
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Config":
+        known = {f for f in cls.__dataclass_fields__}
+        extra = {k: v for k, v in data.items() if k not in known}
+        if extra:
+            raise ValueError(
+                f"unknown config keys {sorted(extra)}; valid keys: {sorted(known)}"
+            )
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+    # -- IO -----------------------------------------------------------------
+    @classmethod
+    def from_json_file(cls, json_file: Optional[str] = None) -> "Config":
+        json_file = json_file or default_json_config_file
+        with open(json_file, encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+    def to_json_file(self, json_file: str) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(json_file)), exist_ok=True)
+        with open(json_file, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    @classmethod
+    def from_yaml_file(cls, yaml_file: Optional[str] = None) -> "Config":
+        yaml_file = yaml_file or default_yaml_config_file
+        with open(yaml_file, encoding="utf-8") as f:
+            return cls.from_dict(yaml.safe_load(f) or {})
+
+    def to_yaml_file(self, yaml_file: str) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(yaml_file)), exist_ok=True)
+        with open(yaml_file, "w", encoding="utf-8") as f:
+            yaml.safe_dump(self.to_dict(), f, sort_keys=True)
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or default_config_file
+        if path.endswith(".json"):
+            self.to_json_file(path)
+        else:
+            self.to_yaml_file(path)
+        return path

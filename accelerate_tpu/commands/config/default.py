@@ -1,0 +1,61 @@
+"""Non-interactive default config writer.
+
+Counterpart of ``write_basic_config``
+(``/root/reference/src/accelerate/commands/config/default.py``), used by the
+``config default`` subcommand and by downstream libraries' first-run setup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+from .config_args import Config, default_config_file
+
+
+def write_basic_config(
+    mixed_precision: str = "no",
+    save_location: str = default_config_file,
+) -> str:
+    """Probe the local topology and write a single-host config."""
+    if os.path.isfile(save_location):
+        print(
+            f"Config file already exists at {save_location}; delete it or pass a "
+            "different --config_file; not overwriting."
+        )
+        return save_location
+    config = Config(mixed_precision=mixed_precision)
+    try:
+        import jax
+
+        platform = jax.local_devices()[0].platform
+        config.use_cpu = platform == "cpu"
+        config.distributed_type = "NO" if platform == "cpu" else "TPU"
+    except Exception:  # backend unavailable (no TPU attached, CI sandbox)
+        config.use_cpu = True
+        config.distributed_type = "NO"
+    config.save(save_location)
+    return save_location
+
+
+def default_command_parser(subparsers: Optional[argparse._SubParsersAction] = None):
+    description = "Write a basic config without a questionnaire"
+    if subparsers is not None:
+        parser = subparsers.add_parser("default", description=description)
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu config default", description=description
+        )
+    parser.add_argument("--config_file", default=default_config_file)
+    parser.add_argument(
+        "--mixed_precision", default="no", choices=["no", "bf16", "fp16", "fp8"]
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=default_config_command)
+    return parser
+
+
+def default_config_command(args) -> None:
+    path = write_basic_config(args.mixed_precision, args.config_file)
+    print(f"accelerate-tpu configuration saved at {path}")

@@ -1,0 +1,51 @@
+"""``accelerate-tpu config update`` — rewrite an existing config file with the
+current schema (drops unknown keys, fills new defaults).
+
+Counterpart of ``/root/reference/src/accelerate/commands/config/update.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import yaml
+
+from .config_args import Config, default_config_file
+
+
+def update_config(args) -> str:
+    config_file = args.config_file or default_config_file
+    with open(config_file, encoding="utf-8") as f:
+        if config_file.endswith(".json"):
+            import json
+
+            data = json.load(f)
+        else:
+            data = yaml.safe_load(f) or {}
+    known = set(Config.__dataclass_fields__)
+    dropped = sorted(set(data) - known)
+    config = Config(**{k: v for k, v in data.items() if k in known})
+    config.save(config_file)
+    if dropped:
+        print(f"dropped legacy keys: {', '.join(dropped)}")
+    return config_file
+
+
+def update_command_parser(subparsers: Optional[argparse._SubParsersAction] = None):
+    description = "Update an existing config file to the current schema"
+    if subparsers is not None:
+        parser = subparsers.add_parser("update", description=description)
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu config update", description=description
+        )
+    parser.add_argument("--config_file", default=None)
+    if subparsers is not None:
+        parser.set_defaults(func=update_config_command)
+    return parser
+
+
+def update_config_command(args) -> None:
+    path = update_config(args)
+    print(f"configuration at {path} updated")

@@ -1,0 +1,90 @@
+"""``accelerate-tpu env`` — environment report for bug reports.
+
+Counterpart of ``/root/reference/src/accelerate/commands/env.py:47``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+from typing import Optional
+
+__all__ = ["env_command", "env_command_parser"]
+
+
+def env_command_parser(subparsers: Optional[argparse._SubParsersAction] = None):
+    description = "Print the accelerate-tpu environment report"
+    if subparsers is not None:
+        parser = subparsers.add_parser("env", help=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu env", description=description)
+    parser.add_argument("--config_file", default=None)
+    if subparsers is not None:
+        parser.set_defaults(func=env_command)
+    return parser
+
+
+def env_command(args) -> None:
+    import numpy as np
+
+    import accelerate_tpu
+
+    info = {
+        "`accelerate_tpu` version": accelerate_tpu.__version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "Numpy version": np.__version__,
+    }
+    try:
+        import jax
+        import jaxlib
+
+        info["JAX version"] = jax.__version__
+        info["jaxlib version"] = jaxlib.__version__
+        try:
+            devices = jax.devices()
+            info["JAX backend"] = devices[0].platform
+            info["JAX device count"] = str(len(devices))
+            info["JAX process count"] = str(jax.process_count())
+        except Exception as e:  # no backend attachable from this shell
+            info["JAX backend"] = f"unavailable ({e})"
+    except ImportError:
+        info["JAX version"] = "not installed"
+
+    from .config.config_args import default_config_file, load_config_from_file
+
+    config_file = args.config_file or default_config_file
+    if os.path.isfile(config_file):
+        config = load_config_from_file(config_file)
+        info["Default config"] = ""
+        print_config = {f"\t{k}": v for k, v in config.to_dict().items()}
+    else:
+        info["Default config"] = "not found"
+        print_config = {}
+
+    relevant_env = {
+        k: v
+        for k, v in os.environ.items()
+        if k.startswith(("ACCELERATE_", "JAX_", "XLA_", "TPU_", "LIBTPU"))
+        or k.endswith("_SIZE")
+    }
+
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    for key, value in info.items():
+        print(f"- {key}: {value}")
+    for key, value in print_config.items():
+        print(f"{key}: {value}")
+    if relevant_env:
+        print("- Environment variables:")
+        for key in sorted(relevant_env):
+            print(f"\t{key}={relevant_env[key]}")
+
+
+def main():
+    args = env_command_parser().parse_args()
+    env_command(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,175 @@
+"""``accelerate-tpu estimate-memory`` — dtype-wise model memory table.
+
+Counterpart of ``/root/reference/src/accelerate/commands/estimate.py:183-305``.
+The reference pulls the model from the Hub onto the meta device; here the
+size comes from zero-memory shape evaluation: built-in model families
+(``gpt-small``, ``bert-base``, ...) are constructed under
+``init_empty_weights`` (meta device, big_modeling.py), and any HuggingFace
+model id/path with a local ``config.json`` is sized via ``transformers``'
+meta-device init when the package is importable (no downloads — zero-egress
+friendly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional
+
+__all__ = ["estimate_command", "estimate_command_parser", "gather_data", "estimate_training_usage"]
+
+_DTYPE_BYTES = {"float32": 4, "float16": 2, "bfloat16": 2, "int8": 1, "int4": 0.5}
+
+
+def _builtin_model(name: str):
+    from ..big_modeling import init_empty_weights
+    from ..models import MODEL_REGISTRY
+
+    if name not in MODEL_REGISTRY:
+        return None
+    builder = MODEL_REGISTRY[name]
+    with init_empty_weights(include_buffers=False):
+        model = builder()
+    return model
+
+
+def _num_params_builtin(model) -> tuple[int, int]:
+    total = 0
+    largest_layer = 0
+    for module in model.children():
+        size = sum(p.numel() for p in module.parameters())
+        largest_layer = max(largest_layer, size)
+    total = sum(p.numel() for p in model.parameters())
+    return total, largest_layer
+
+
+def _num_params_hf(model_id: str) -> Optional[tuple[int, int, str]]:
+    """Size a HF model from a local path / cached config via transformers."""
+    try:
+        import torch
+        from transformers import AutoConfig, AutoModel
+    except ImportError:
+        return None
+    try:
+        config = AutoConfig.from_pretrained(model_id, local_files_only=True)
+        with torch.device("meta"):
+            model = AutoModel.from_config(config)
+    except Exception as e:
+        raise ValueError(
+            f"{model_id!r} is not a built-in model "
+            f"(see `accelerate-tpu estimate-memory --list`) and could not be "
+            f"loaded through transformers offline: {e}"
+        )
+    largest = 0
+    for child in model.children():
+        largest = max(largest, sum(p.numel() for p in child.parameters()))
+    return model.num_parameters(), largest, config.model_type
+
+
+def estimate_training_usage(bytes_params: float) -> float:
+    """Peak training memory ≈ params + grads + Adam m/v + fp32 master copy
+    (reference estimate.py:239: 4× model size heuristic for Adam)."""
+    return 4 * bytes_params
+
+
+def _fmt(num_bytes: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(num_bytes) < 1024:
+            return f"{num_bytes:.2f} {unit}"
+        num_bytes /= 1024
+    return f"{num_bytes:.2f} PB"
+
+
+def gather_data(args) -> list[list]:
+    """Rows: [dtype, largest_layer, total_size, training_size]."""
+    model = _builtin_model(args.model_name)
+    if model is not None:
+        total, largest = _num_params_builtin(model)
+    else:
+        sized = _num_params_hf(args.model_name)
+        total, largest, _ = sized
+    rows = []
+    for dtype in args.dtypes:
+        per_param = _DTYPE_BYTES[dtype]
+        total_bytes = total * per_param
+        rows.append(
+            [
+                dtype,
+                largest * per_param,
+                total_bytes,
+                estimate_training_usage(total_bytes),
+            ]
+        )
+    return rows
+
+
+def estimate_command_parser(subparsers: Optional[argparse._SubParsersAction] = None):
+    description = "Estimate model memory per dtype (load + Adam training)"
+    if subparsers is not None:
+        parser = subparsers.add_parser("estimate-memory", help=description)
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu estimate-memory", description=description
+        )
+    parser.add_argument(
+        "model_name",
+        nargs="?",
+        default=None,
+        help="Built-in name (gpt-small, bert-base, ...) or a local HF model path",
+    )
+    parser.add_argument(
+        "--dtypes",
+        nargs="+",
+        default=["float32", "bfloat16", "int8", "int4"],
+        choices=list(_DTYPE_BYTES),
+    )
+    parser.add_argument("--list", action="store_true", help="List built-in models")
+    parser.add_argument("--json", action="store_true", help="Machine-readable output")
+    if subparsers is not None:
+        parser.set_defaults(func=estimate_command)
+    return parser
+
+
+def estimate_command(args) -> None:
+    if args.list or args.model_name is None:
+        from ..models import MODEL_REGISTRY
+
+        print("Built-in models:")
+        for name in sorted(MODEL_REGISTRY):
+            print(f"  {name}")
+        return
+    rows = gather_data(args)
+    if args.json:
+        print(
+            json.dumps(
+                [
+                    {
+                        "dtype": r[0],
+                        "largest_layer_bytes": r[1],
+                        "total_bytes": r[2],
+                        "training_bytes": r[3],
+                    }
+                    for r in rows
+                ]
+            )
+        )
+        return
+    header = ["dtype", "Largest Layer", "Total Size", "Training (Adam)"]
+    widths = [10, 16, 16, 16]
+    line = "".join(h.ljust(w) for h, w in zip(header, widths))
+    print(f"Memory usage for `{args.model_name}`:\n{line}\n{'-' * len(line)}")
+    for dtype, largest, total, training in rows:
+        print(
+            f"{dtype.ljust(widths[0])}{_fmt(largest).ljust(widths[1])}"
+            f"{_fmt(total).ljust(widths[2])}{_fmt(training).ljust(widths[3])}"
+        )
+
+
+def main():
+    args = estimate_command_parser().parse_args()
+    estimate_command(args)
+
+
+if __name__ == "__main__":
+    main()

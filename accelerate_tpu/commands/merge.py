@@ -1,0 +1,58 @@
+"""``accelerate-tpu merge-weights`` — merge a sharded checkpoint offline.
+
+Counterpart of ``/root/reference/src/accelerate/commands/merge.py:26``
+(merge_fsdp_weights).  Operates on the GSPMD sharded layout written by
+``utils/fsdp_utils.save_sharded_model_state``.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+from ..utils.constants import MODEL_NAME
+from ..utils.fsdp_utils import merge_sharded_weights
+
+__all__ = ["merge_command", "merge_command_parser"]
+
+
+def merge_command_parser(subparsers: Optional[argparse._SubParsersAction] = None):
+    description = "Merge sharded checkpoint shards into one weights file"
+    if subparsers is not None:
+        parser = subparsers.add_parser("merge-weights", help=description)
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu merge-weights", description=description
+        )
+    parser.add_argument("checkpoint_dir", help="Directory holding *.shard-*.safetensors")
+    parser.add_argument(
+        "output_path", nargs="?", default=None, help="Merged file destination"
+    )
+    parser.add_argument("--name", default=MODEL_NAME, help="Checkpoint base name")
+    parser.add_argument(
+        "--unsafe_serialization",
+        action="store_true",
+        help="Write .npz instead of safetensors",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=merge_command)
+    return parser
+
+
+def merge_command(args) -> None:
+    path = merge_sharded_weights(
+        args.checkpoint_dir,
+        args.output_path,
+        name=args.name,
+        safe_serialization=not args.unsafe_serialization,
+    )
+    print(f"merged weights written to {path}")
+
+
+def main():
+    args = merge_command_parser().parse_args()
+    merge_command(args)
+
+
+if __name__ == "__main__":
+    main()

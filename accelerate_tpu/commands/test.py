@@ -1,0 +1,58 @@
+"""``accelerate-tpu test`` — run the bundled correctness suite through the
+launcher (reference: src/accelerate/commands/test.py:44, which launches
+test_utils/scripts/test_script.py for end users to validate their setup).
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+from typing import Optional
+
+from ..utils.launch import launch_command_to_argv
+
+__all__ = ["test_command", "test_command_parser"]
+
+
+def test_command_parser(subparsers: Optional[argparse._SubParsersAction] = None):
+    description = "Validate the environment by running the bundled test script"
+    if subparsers is not None:
+        parser = subparsers.add_parser("test", help=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu test", description=description)
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument(
+        "--num_virtual_devices",
+        type=int,
+        default=None,
+        help="Run on N virtual CPU devices instead of the attached accelerator",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=test_command)
+    return parser
+
+
+def test_command(args) -> None:
+    import accelerate_tpu.test_utils.scripts.test_script as test_script
+
+    extra = []
+    if args.config_file:
+        extra += ["--config_file", args.config_file]
+    argv = launch_command_to_argv(
+        test_script.__file__,
+        num_virtual_devices=args.num_virtual_devices,
+        extra=extra,
+    )
+    result = subprocess.run(argv)
+    if result.returncode == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    raise SystemExit(result.returncode)
+
+
+def main():
+    args = test_command_parser().parse_args()
+    test_command(args)
+
+
+if __name__ == "__main__":
+    main()

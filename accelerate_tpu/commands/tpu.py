@@ -1,0 +1,98 @@
+"""``accelerate-tpu tpu-config`` — run setup commands on every pod worker.
+
+Counterpart of ``/root/reference/src/accelerate/commands/tpu.py:29-157``
+(gcloud alpha compute tpus tpu-vm ssh --worker all).
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+from typing import Optional
+
+__all__ = ["tpu_command_parser", "tpu_command_launcher"]
+
+_DEFAULT_INSTALL = "pip install -U accelerate_tpu"
+
+
+def tpu_command_parser(subparsers: Optional[argparse._SubParsersAction] = None):
+    description = "Run commands on all workers of a TPU pod (setup/install)"
+    if subparsers is not None:
+        parser = subparsers.add_parser("tpu-config", help=description)
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu tpu-config", description=description
+        )
+    config_args = parser.add_argument_group("Config Arguments")
+    config_args.add_argument("--config_file", default=None)
+    config_args.add_argument("--tpu_name", default=None)
+    config_args.add_argument("--tpu_zone", default=None)
+    pod_args = parser.add_argument_group("TPU Arguments")
+    pod_args.add_argument(
+        "--command",
+        action="append",
+        help="Command to run on each worker (repeatable)",
+    )
+    pod_args.add_argument(
+        "--command_file", default=None, help="File with one command per line"
+    )
+    pod_args.add_argument(
+        "--install_accelerate",
+        action="store_true",
+        help=f"Prepend `{_DEFAULT_INSTALL}`",
+    )
+    pod_args.add_argument("--debug", action="store_true", help="Print, don't run")
+    if subparsers is not None:
+        parser.set_defaults(func=tpu_command_launcher)
+    return parser
+
+
+def tpu_command_launcher(args) -> None:
+    if args.config_file or (args.tpu_name is None or args.tpu_zone is None):
+        from .config.config_args import default_config_file, load_config_from_file
+        import os
+
+        path = args.config_file or default_config_file
+        if os.path.isfile(path):
+            config = load_config_from_file(path)
+            args.tpu_name = args.tpu_name or config.tpu_name
+            args.tpu_zone = args.tpu_zone or config.tpu_zone
+    if not args.tpu_name or not args.tpu_zone:
+        raise ValueError("tpu-config needs --tpu_name and --tpu_zone (or a config file)")
+
+    commands = []
+    if args.install_accelerate:
+        commands.append(_DEFAULT_INSTALL)
+    if args.command_file:
+        with open(args.command_file) as f:
+            commands.extend(line.strip() for line in f if line.strip())
+    commands.extend(args.command or [])
+    if not commands:
+        raise ValueError("no commands given (--command / --command_file)")
+
+    command = "; ".join(commands)
+    gcloud_cmd = [
+        "gcloud",
+        "compute",
+        "tpus",
+        "tpu-vm",
+        "ssh",
+        args.tpu_name,
+        f"--zone={args.tpu_zone}",
+        f"--command={command}",
+        "--worker=all",
+    ]
+    if args.debug:
+        print(f"Running {' '.join(gcloud_cmd)}")
+        return
+    subprocess.run(gcloud_cmd, check=True)
+    print("Successfully setup pod.")
+
+
+def main():
+    args = tpu_command_parser().parse_args()
+    tpu_command_launcher(args)
+
+
+if __name__ == "__main__":
+    main()

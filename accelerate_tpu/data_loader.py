@@ -212,8 +212,11 @@ class GlobalBatchSampler:
                 yield group
             return
         if not self.even_batches:
-            # ragged tail: emit what exists (host-level iteration only)
-            yield group
+            # SPMD requires every shard to run the same program on the same
+            # shapes; a ragged tail group has no uniform global batch, so it
+            # is dropped — the TPU-native reading of the reference's
+            # "shards without a full batch stop iterating" semantics
+            # (reference data_loader.py:195-262).
             return
         # loop back to the start of the epoch's sample stream to even out
     # (reference semantics: indices restart from the first samples)
@@ -231,7 +234,23 @@ class GlobalBatchSampler:
         n = len(self.batch_sampler)
         if self.even_batches:
             return math.ceil(n / self.num_shards)
-        return math.ceil(n / self.num_shards)
+        # ragged tail groups are dropped (see __iter__): only groups made of
+        # num_shards FULL batches count, and a trailing short batch poisons
+        # the group it lands in
+        n_full = n
+        sampler = getattr(self.batch_sampler, "sampler", None)
+        if (
+            self.batch_size
+            and sampler is not None
+            and not getattr(self.batch_sampler, "drop_last", False)
+        ):
+            try:
+                total = len(sampler)
+            except TypeError:
+                total = None
+            if total is not None:
+                n_full = total // self.batch_size
+        return n_full // self.num_shards
 
     @property
     def total_batch_size(self) -> int:

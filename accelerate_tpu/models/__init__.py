@@ -1,2 +1,12 @@
 from .bert import BertConfig, BertForSequenceClassification, BertModel
 from .gpt import GPTConfig, GPTLMHeadModel
+
+# name → zero-arg builder; used by `accelerate-tpu estimate-memory` and tests
+MODEL_REGISTRY = {
+    "bert-base": lambda: BertModel(BertConfig.base()),
+    "bert-small": lambda: BertModel(BertConfig.small()),
+    "bert-base-classifier": lambda: BertForSequenceClassification(BertConfig.base()),
+    "gpt-tiny": lambda: GPTLMHeadModel(GPTConfig.tiny()),
+    "gpt-small": lambda: GPTLMHeadModel(GPTConfig.small()),
+    "gpt-medium": lambda: GPTLMHeadModel(GPTConfig.medium()),
+}

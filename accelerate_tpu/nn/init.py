@@ -18,12 +18,12 @@ from .meta import MetaArray, meta_mode_active
 
 
 def _meta_or(fn, shape, dtype):
-    from .meta import meta_include_buffers
-
-    # include_buffers=False mode computes for real: Buffers must keep their
-    # true values (position ids, rotary caches); Parameter.__init__ converts
-    # its (transient) array back to meta in that mode.
-    if meta_mode_active() and meta_include_buffers():
+    # These helpers only ever create Parameter data (Buffers build their
+    # true values directly — arange caches etc. — so include_buffers=False
+    # never needs a real draw here): under meta mode, skip the initializer
+    # entirely so no RNG is consumed and later materialisation stays
+    # deterministic.
+    if meta_mode_active():
         return MetaArray(shape, dtype)
     return fn()
 
@@ -60,4 +60,11 @@ def full(shape, fill_value, dtype=jnp.float32):
 
 
 def arange(n: int, dtype=jnp.int32):
-    return _meta_or(lambda: jnp.arange(n, dtype=dtype), (n,), dtype)
+    """Buffer-value helper: unlike the parameter initializers above, in
+    ``init_empty_weights(include_buffers=False)`` mode the TRUE values are
+    produced (position ids / caches must survive meta init)."""
+    from .meta import meta_include_buffers
+
+    if meta_mode_active() and meta_include_buffers():
+        return MetaArray((n,), dtype)
+    return jnp.arange(n, dtype=dtype)

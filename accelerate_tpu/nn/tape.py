@@ -128,6 +128,9 @@ class Tensor:
         grad_str = ", requires_grad=True" if self.requires_grad else ""
         return f"Tensor({self.data!r}{grad_str})"
 
+    def numel(self) -> int:
+        return int(self.data.size)
+
     def numpy(self):
         return np.asarray(jax.device_get(self.data))
 

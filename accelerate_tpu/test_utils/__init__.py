@@ -1,0 +1,19 @@
+"""Importable test harness (reference: src/accelerate/test_utils/)."""
+
+from .testing import (
+    TempDirTestCase,
+    default_launch_command,
+    device_count,
+    execute_subprocess,
+    launch_test_script,
+    require_cpu,
+    require_multi_device,
+    require_non_cpu,
+    require_single_device,
+    require_tpu,
+    require_transformers,
+    run_command,
+    skip,
+    slow,
+)
+from .training import RegressionDataset, RegressionModel, mocked_dataloaders

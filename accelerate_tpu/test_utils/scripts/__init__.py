@@ -1,0 +1,1 @@
+"""Distributed test scripts meant to run under the launcher."""

@@ -1,0 +1,53 @@
+"""Collective-ops correctness under the launcher (reference
+test_utils/scripts/test_ops.py): gather/reduce/broadcast/pad over pytrees."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.operations import (
+    broadcast,
+    gather,
+    gather_object,
+    pad_across_processes,
+    reduce,
+)
+
+
+def main():
+    acc = Accelerator()
+    state = acc.state
+    shards = max(1, state.num_devices)
+
+    # gather: each shard contributes its slice; global result is the full batch
+    local = np.arange(4, dtype=np.float32) + 1
+    gathered = np.asarray(gather({"t": local})["t"]).ravel()
+    assert gathered.size >= local.size
+
+    # reduce(sum): pytree of per-shard values sums across shards
+    summed = reduce({"v": np.ones(3, dtype=np.float32)}, reduction="sum")
+    total = np.asarray(summed["v"])
+    assert np.allclose(total, total[0]), "reduce must be replicated"
+
+    # broadcast from main: all shards end with main's value
+    value = np.full((2,), float(state.process_index), dtype=np.float32)
+    out = np.asarray(broadcast(value))
+    assert np.allclose(out, 0.0), f"broadcast failed: {out}"
+
+    # gather_object returns one entry per process
+    objs = gather_object([state.process_index])
+    assert [0] in objs and len(objs) == state.num_processes
+
+    # pad_across_processes makes ragged dims uniform
+    ragged = np.ones((2 + state.process_index % 2, 3), dtype=np.float32)
+    padded = pad_across_processes(ragged, dim=0)
+    assert np.asarray(padded).shape[0] >= ragged.shape[0]
+
+    state.wait_for_everyone()
+    if state.is_main_process:
+        print("All ops checks passed")
+
+
+if __name__ == "__main__":
+    main()

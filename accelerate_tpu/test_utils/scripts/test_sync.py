@@ -1,0 +1,48 @@
+"""Gradient-accumulation semantics under the launcher (reference
+test_utils/scripts/test_sync.py): grads only apply on sync steps, and the
+accumulated update equals one big-batch update."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator, set_seed
+from accelerate_tpu.nn import Tensor
+from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+
+def _train(accum_steps: int, micro_bs: int, n_batches: int, lr=0.1):
+    set_seed(0)
+    acc = Accelerator(gradient_accumulation_steps=accum_steps)
+    model = RegressionModel()
+    opt = optim.SGD(model.parameters(), lr=lr)
+    model, opt = acc.prepare(model, opt)
+    data = RegressionDataset(length=micro_bs * n_batches, seed=7)
+    for i in range(n_batches):
+        sl = slice(i * micro_bs, (i + 1) * micro_bs)
+        with acc.accumulate(model):
+            pred = model(Tensor(data.x[sl]))
+            loss = nn.F.mse_loss(pred, Tensor(data.y[sl]))
+            acc.backward(loss)
+            opt.step()
+            opt.zero_grad()  # canonical order: both are no-ops mid-window
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    a, b = float(np.asarray(model.a.data)), float(np.asarray(model.b.data))
+    PartialState._reset_state()
+    return a, b
+
+
+def main():
+    # 4 micro-batches at accumulation 4 == one batch 4× the size at accumulation 1
+    a_accum, b_accum = _train(accum_steps=4, micro_bs=4, n_batches=4)
+    a_big, b_big = _train(accum_steps=1, micro_bs=16, n_batches=1)
+    assert abs(a_accum - a_big) < 1e-5, f"{a_accum} vs {a_big}"
+    assert abs(b_accum - b_big) < 1e-5, f"{b_accum} vs {b_big}"
+    print("All sync checks passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Toy models/data for numerically-checkable training.
+
+Counterpart of ``/root/reference/src/accelerate/test_utils/training.py``
+(RegressionModel/RegressionDataset :1-162): y = a·x + b with scalar learnable
+a, b, so trained weights can be asserted against a closed-form/single-process
+baseline exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import accelerate_tpu.nn as nn
+from accelerate_tpu.nn import Tensor
+
+__all__ = ["RegressionDataset", "RegressionModel", "mocked_dataloaders"]
+
+
+class RegressionDataset:
+    """List-like dataset of {'x': float, 'y': 2x+1+noise} samples."""
+
+    def __init__(self, a=2, b=3, length=64, seed=96):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.a, self.b = a, b
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + 0.1 * rng.normal(size=(length,))).astype(np.float32)
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+class RegressionModel(nn.Module):
+    """y_hat = a*x + b (reference training.py RegressionModel)."""
+
+    def __init__(self, a=0.0, b=0.0):
+        super().__init__()
+        self.a = nn.Parameter(np.array(float(a), dtype=np.float32))
+        self.b = nn.Parameter(np.array(float(b), dtype=np.float32))
+
+    def forward(self, x):
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        return x * self.a + self.b
+
+
+def mocked_dataloaders(accelerator, batch_size: int = 8, length: int = 64):
+    """Tiny deterministic train/val loaders (reference
+    tests/test_examples.py mocked_dataloaders)."""
+    from accelerate_tpu import prepare_data_loader
+
+    train = RegressionDataset(length=length, seed=42)
+    val = RegressionDataset(length=length // 2, seed=43)
+    train_dl = prepare_data_loader(
+        dataset=[train[i] for i in range(len(train))],
+        batch_size=batch_size,
+        shuffle=True,
+        data_seed=42,
+    )
+    val_dl = prepare_data_loader(
+        dataset=[val[i] for i in range(len(val))], batch_size=batch_size
+    )
+    return train_dl, val_dl

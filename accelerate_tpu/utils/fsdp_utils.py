@@ -1,0 +1,273 @@
+"""Sharded (FSDP/GSPMD) checkpoint save/load + offline merge.
+
+Counterpart of ``/root/reference/src/accelerate/utils/fsdp_utils.py``
+(save_fsdp_model :66, save_fsdp_optimizer :175, merge_fsdp_weights :275).
+The reference delegates to ``torch.distributed.checkpoint`` with per-rank
+``__{rank}_0.distcp`` files; here the unit of sharding is the GSPMD layout of
+each ``jax.Array``: every host writes the *unique addressable shards* it owns,
+with the global slice bounds encoded in each entry's key, and the offline
+merge pastes slices back into full arrays — valid for ANY NamedSharding, not
+just axis-0 sharding.
+
+Layout of a sharded checkpoint directory::
+
+    <dir>/<name>.shard-00000-of-00004.safetensors   # rank 0's unique slices
+    <dir>/<name>.shard-00001-of-00004.safetensors
+    ...
+    <dir>/<name>.index.json   # tensor → global shape/dtype + shard count
+
+Entry keys inside a shard file are ``<tensor>|<start>:<stop>,...`` (one
+``start:stop`` pair per dimension), so any subset of shard files is
+self-describing and the merge tool needs no per-rank metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from .constants import MODEL_NAME
+
+__all__ = [
+    "save_sharded_model_state",
+    "load_sharded_model_state",
+    "merge_sharded_weights",
+    "sharded_index_path",
+]
+
+
+def _shard_file(name: str, rank: int, world: int) -> str:
+    return f"{name}.shard-{rank:05d}-of-{world:05d}.safetensors"
+
+
+def sharded_index_path(directory: str, name: str = MODEL_NAME) -> str:
+    return os.path.join(directory, f"{name}.index.json")
+
+
+def _bf16_np():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _bf16_to_view(arr: np.ndarray) -> np.ndarray:
+    # safetensors.numpy rejects ml_dtypes.bfloat16; store as a raw uint16 view
+    if arr.dtype == _bf16_np():
+        return arr.view(np.uint16)
+    return arr
+
+
+def _maybe_bf16_from_view(arr: np.ndarray, dtype: str) -> np.ndarray:
+    if dtype == "bfloat16" and arr.dtype == np.uint16:
+        return arr.view(_bf16_np())
+    return arr
+
+
+def _dtype_str(dtype) -> str:
+    if dtype == _bf16_np():
+        return "bfloat16"
+    return str(np.dtype(dtype))
+
+
+def _slice_key(tensor_name: str, bounds: list[tuple[int, int]]) -> str:
+    spec = ",".join(f"{a}:{b}" for a, b in bounds) or "scalar"
+    return f"{tensor_name}|{spec}"
+
+
+def _parse_slice_key(key: str) -> tuple[str, list[tuple[int, int]]]:
+    tensor_name, _, spec = key.rpartition("|")
+    if not tensor_name:
+        return key, []
+    if spec == "scalar":
+        return tensor_name, []
+    bounds = []
+    for pair in spec.split(","):
+        a, b = pair.split(":")
+        bounds.append((int(a), int(b)))
+    return tensor_name, bounds
+
+
+def _unique_shard_bounds(arr) -> list:
+    """(bounds, numpy_data) per unique addressable shard.
+
+    Under dp/tp replication several local devices hold the same slice; one
+    copy is enough for the checkpoint.
+    """
+    seen: set = set()
+    out = []
+    for shard in arr.addressable_shards:
+        bounds = tuple(
+            (int(s.start or 0), int(s.stop if s.stop is not None else dim))
+            for s, dim in zip(shard.index, arr.shape)
+        )
+        if bounds not in seen:
+            seen.add(bounds)
+            out.append((list(bounds), np.asarray(shard.data)))
+    return out
+
+
+def save_sharded_model_state(
+    state_dict: dict[str, Any],
+    output_dir: str,
+    name: str = MODEL_NAME,
+    process_index: Optional[int] = None,
+    num_processes: Optional[int] = None,
+) -> str:
+    """Write this host's unique shards of every array + (rank0) the index.
+
+    Reference: save_fsdp_model with SHARDED_STATE_DICT
+    (fsdp_utils.py:121-143).  Unlike the gather-to-rank0 path in
+    ``checkpointing.save_model_weights`` this never materialises a full array
+    in host memory, so it scales to models larger than one host's RAM.
+    """
+    import jax
+    from safetensors.numpy import save_file
+
+    rank = jax.process_index() if process_index is None else process_index
+    world = jax.process_count() if num_processes is None else num_processes
+    os.makedirs(output_dir, exist_ok=True)
+
+    local_arrays: dict[str, np.ndarray] = {}
+    index: dict[str, Any] = {"metadata": {"num_shards": world}, "tensors": {}}
+    for tensor_name, value in state_dict.items():
+        if isinstance(value, jax.Array) and hasattr(value, "addressable_shards"):
+            shards = _unique_shard_bounds(value)
+            shape = [int(d) for d in value.shape]
+            dtype = _dtype_str(np.asarray(shards[0][1]).dtype)
+        else:
+            arr = np.asarray(value)
+            shards = [([(0, int(d)) for d in arr.shape], arr)]
+            shape = list(arr.shape)
+            dtype = _dtype_str(arr.dtype)
+        for bounds, data in shards:
+            local_arrays[_slice_key(tensor_name, bounds)] = data
+        index["tensors"][tensor_name] = {"shape": shape, "dtype": dtype}
+
+    save_file(
+        {k: _bf16_to_view(v) for k, v in local_arrays.items()},
+        os.path.join(output_dir, _shard_file(name, rank, world)),
+        metadata={"format": "accelerate_tpu-sharded"},
+    )
+    if rank == 0:
+        with open(sharded_index_path(output_dir, name), "w") as f:
+            json.dump(index, f, indent=1)
+    return output_dir
+
+
+def _load_all_shard_files(directory: str, name: str) -> dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+
+    out: dict[str, np.ndarray] = {}
+    found = False
+    for fname in sorted(os.listdir(directory)):
+        if fname.startswith(f"{name}.shard-") and fname.endswith(".safetensors"):
+            out.update(load_file(os.path.join(directory, fname)))
+            found = True
+    if not found:
+        raise FileNotFoundError(
+            f"no {name}.shard-*.safetensors files under {directory}"
+        )
+    return out
+
+
+def merge_sharded_weights(
+    input_dir: str,
+    output_path: Optional[str] = None,
+    name: str = MODEL_NAME,
+    safe_serialization: bool = True,
+) -> str:
+    """Offline merge of a sharded checkpoint into one full-weights file.
+
+    Reference: merge_fsdp_weights fsdp_utils.py:275 / ``accelerate
+    merge-weights`` CLI (commands/merge.py:26).  Pure host-side numpy — runs
+    with no accelerator attached.
+    """
+    index_file = sharded_index_path(input_dir, name)
+    if not os.path.exists(index_file):
+        raise FileNotFoundError(
+            f"{index_file} not found — not a sharded checkpoint directory"
+        )
+    with open(index_file) as f:
+        index = json.load(f)
+    flat = _load_all_shard_files(input_dir, name)
+
+    by_tensor: dict[str, list[tuple[list, np.ndarray]]] = {}
+    for key, data in flat.items():
+        tensor_name, bounds = _parse_slice_key(key)
+        by_tensor.setdefault(tensor_name, []).append((bounds, data))
+
+    merged: dict[str, np.ndarray] = {}
+    for tensor_name, entry in index["tensors"].items():
+        shape = tuple(entry["shape"])
+        pieces = by_tensor.get(tensor_name)
+        if not pieces:
+            raise ValueError(f"no shards found for tensor {tensor_name!r}")
+        pieces = [
+            (bounds, _maybe_bf16_from_view(data, entry["dtype"]))
+            for bounds, data in pieces
+        ]
+        full = np.zeros(shape, dtype=pieces[0][1].dtype)
+        filled = np.zeros(shape, dtype=bool) if shape else None
+        for bounds, data in pieces:
+            sl = tuple(slice(a, b) for a, b in bounds)
+            full[sl] = data.reshape(full[sl].shape)
+            if filled is not None:
+                filled[sl] = True
+        if filled is not None and not filled.all():
+            raise ValueError(
+                f"tensor {tensor_name!r} has uncovered regions after merge; "
+                "checkpoint is incomplete (were all ranks' shard files copied?)"
+            )
+        merged[tensor_name] = full
+
+    if output_path is None:
+        output_path = os.path.join(
+            input_dir, f"{name}.safetensors" if safe_serialization else f"{name}.npz"
+        )
+    if safe_serialization:
+        from safetensors.numpy import save_file
+
+        bf16 = _bf16_np()
+        meta = {
+            "format": "accelerate_tpu",
+            "bf16_keys": json.dumps([k for k, v in merged.items() if v.dtype == bf16]),
+        }
+        save_file(
+            {k: _bf16_to_view(v) for k, v in merged.items()}, output_path, metadata=meta
+        )
+    else:
+        np.savez(output_path, **merged)
+    return output_path
+
+
+def load_sharded_model_state(
+    input_dir: str, name: str = MODEL_NAME
+) -> dict[str, np.ndarray]:
+    """Load a sharded checkpoint fully into host memory (merge in RAM)."""
+    index_file = sharded_index_path(input_dir, name)
+    with open(index_file) as f:
+        index = json.load(f)
+    flat = _load_all_shard_files(input_dir, name)
+    by_tensor: dict[str, list[tuple[list, np.ndarray]]] = {}
+    for key, data in flat.items():
+        tensor_name, bounds = _parse_slice_key(key)
+        by_tensor.setdefault(tensor_name, []).append((bounds, data))
+    out: dict[str, np.ndarray] = {}
+    for tensor_name, entry in index["tensors"].items():
+        shape = tuple(entry["shape"])
+        pieces = [
+            (bounds, _maybe_bf16_from_view(data, entry["dtype"]))
+            for bounds, data in by_tensor.get(tensor_name, [])
+        ]
+        if not pieces:
+            raise ValueError(f"no shards found for tensor {tensor_name!r}")
+        full = np.zeros(shape, dtype=pieces[0][1].dtype)
+        for bounds, data in pieces:
+            sl = tuple(slice(a, b) for a, b in bounds)
+            full[sl] = data.reshape(full[sl].shape)
+        out[tensor_name] = full
+    return out

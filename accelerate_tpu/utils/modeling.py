@@ -45,6 +45,12 @@ def dtype_byte_size(dtype) -> float:
     dtype = str(jnp.dtype(dtype)) if not isinstance(dtype, str) else dtype
     if dtype in ("bool",):
         return 1 / 8
+    if dtype.startswith(("float8", "int8", "uint8")):
+        # fp8 variant names embed exponent/mantissa digits (e.g.
+        # float8_e4m3fn) that the trailing-digit parse would misread
+        return 1
+    if dtype.startswith(("float4", "int4", "uint4")):
+        return 0.5
     m = re.search(r"(\d+)$", dtype)
     if m is None:
         raise ValueError(f"`dtype` is not a valid dtype: {dtype}")
@@ -393,7 +399,20 @@ def infer_auto_device_map(
                 break
         placed = False
         while dev_idx < len(devices):
-            device = preferred if preferred is not None else devices[dev_idx]
+            if preferred is not None:
+                # tied pull first; if that device is full, retry this same
+                # iteration with the regular fill device (dev_idx untouched)
+                if size <= remaining[preferred]:
+                    device = preferred
+                    device_map[name] = device
+                    remaining[device] -= size
+                    for pname, p in module.named_parameters(name):
+                        placed_tied.setdefault(id(p), device)
+                    placed = True
+                    break
+                preferred = None
+                continue
+            device = devices[dev_idx]
             budget = remaining[device]
             if size <= budget:
                 device_map[name] = device
@@ -402,19 +421,19 @@ def infer_auto_device_map(
                     placed_tied.setdefault(id(p), device)
                 placed = True
                 break
-            preferred = None  # tied device is full: fall through normally
             splittable = module._modules and type(module).__name__ not in no_split
             if splittable:
-                # split: place direct tensors individually, recurse on children
+                # split: place direct tensors individually (first device from
+                # the current fill point with room; "disk" has ∞ budget so the
+                # scan always terminates), recurse on children
                 insert_at = 0
                 for tname, t in named_module_tensors(module, recurse=False):
                     tsize = _tensor_nbytes(t.data, dtype if jnp.issubdtype(t.dtype, jnp.floating) else None)
-                    tdev = devices[dev_idx]
-                    if tsize <= remaining[tdev]:
-                        device_map[f"{name}.{tname}"] = tdev
-                        remaining[tdev] -= tsize
-                    else:
-                        device_map[f"{name}.{tname}"] = "disk"
+                    for tdev in devices[dev_idx:]:
+                        if tsize <= remaining[tdev]:
+                            device_map[f"{name}.{tname}"] = tdev
+                            remaining[tdev] -= tsize
+                            break
                 for cname, child in module._modules.items():
                     queue.insert(insert_at, (f"{name}.{cname}", child))
                     insert_at += 1

@@ -110,7 +110,6 @@ def training_function(args):
         accelerator.init_trackers("nlp_example", config=vars(args))
 
     def train_step(batch):
-        optimizer.zero_grad()
         out = model(
             batch["input_ids"],
             attention_mask=batch["attention_mask"],
@@ -120,6 +119,9 @@ def training_function(args):
         accelerator.backward(out["loss"])
         optimizer.step()
         scheduler.step()
+        # after step, inside accumulate(): no-ops mid-window, so accumulated
+        # grads survive until the sync step (reference by_feature pattern)
+        optimizer.zero_grad()
         return out["loss"]
 
     def eval_step(batch):

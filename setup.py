@@ -1,0 +1,22 @@
+from setuptools import find_packages, setup
+
+setup(
+    name="accelerate_tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native training & inference framework: the capability surface of "
+        "HuggingFace Accelerate rebuilt on JAX/XLA/Pallas SPMD"
+    ),
+    packages=find_packages(include=["accelerate_tpu", "accelerate_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["jax", "numpy", "pyyaml", "safetensors", "ml_dtypes"],
+    entry_points={
+        "console_scripts": [
+            "accelerate-tpu=accelerate_tpu.commands.accelerate_cli:main",
+            "accelerate-tpu-launch=accelerate_tpu.commands.launch:main",
+            "accelerate-tpu-config=accelerate_tpu.commands.config.config:main",
+            "accelerate-tpu-estimate=accelerate_tpu.commands.estimate:main",
+            "accelerate-tpu-merge=accelerate_tpu.commands.merge:main",
+        ]
+    },
+)

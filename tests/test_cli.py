@@ -1,0 +1,164 @@
+"""CLI layer tests (reference: tests/test_cli.py, 545 LoC — config/launch/env
+round-trips against checked-in YAMLs)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.commands.accelerate_cli import main as cli_main
+from accelerate_tpu.commands.config.config_args import Config, load_config_from_file
+from accelerate_tpu.commands.estimate import estimate_command_parser, gather_data
+from accelerate_tpu.commands.launch import launch_command_parser
+
+
+def test_config_yaml_roundtrip(tmp_path):
+    config = Config(
+        num_processes=4,
+        distributed_type="MULTI_HOST",
+        mixed_precision="bf16",
+        main_process_ip="10.0.0.2",
+        main_process_port=29501,
+        fsdp_size=2,
+        tp_size=2,
+    )
+    path = str(tmp_path / "cfg.yaml")
+    config.save(path)
+    loaded = load_config_from_file(path)
+    assert loaded.to_dict() == config.to_dict()
+
+
+def test_config_json_roundtrip(tmp_path):
+    config = Config(mixed_precision="fp16", sp_size=4)
+    path = str(tmp_path / "cfg.json")
+    config.save(path)
+    loaded = load_config_from_file(path)
+    assert loaded.mixed_precision == "fp16"
+    assert loaded.sp_size == 4
+
+
+def test_config_rejects_unknown_keys(tmp_path):
+    path = tmp_path / "bad.yaml"
+    path.write_text("mixed_precision: bf16\nnum_gpus: 4\n")
+    with pytest.raises(ValueError, match="num_gpus"):
+        load_config_from_file(str(path))
+
+
+def test_config_rejects_bad_distributed_type():
+    with pytest.raises(ValueError, match="distributed_type"):
+        Config(distributed_type="MULTI_GPU")
+
+
+def test_launch_parser_mesh_args():
+    parser = launch_command_parser()
+    args = parser.parse_args(
+        ["--fsdp_size", "2", "--tp_size", "4", "--mixed_precision", "bf16",
+         "script.py", "--foo", "bar"]
+    )
+    assert args.fsdp_size == 2 and args.tp_size == 4
+    assert args.training_script == "script.py"
+    assert args.training_script_args == ["--foo", "bar"]
+
+
+def test_launch_env_protocol():
+    from accelerate_tpu.utils.launch import prepare_launch_environment
+
+    parser = launch_command_parser()
+    args = parser.parse_args(
+        ["--num_processes", "4", "--machine_rank", "1",
+         "--main_process_ip", "10.0.0.2", "--main_process_port", "29501",
+         "--tp_size", "2", "--mixed_precision", "bf16",
+         "--gradient_accumulation_steps", "8", "--seed", "7", "script.py"]
+    )
+    env = prepare_launch_environment(args)
+    assert env["ACCELERATE_NUM_PROCESSES"] == "4"
+    assert env["ACCELERATE_PROCESS_INDEX"] == "1"
+    assert env["ACCELERATE_COORDINATOR_ADDRESS"] == "10.0.0.2:29501"
+    assert env["TP_SIZE"] == "2"
+    assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"
+    assert env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] == "8"
+    assert env["ACCELERATE_SEED"] == "7"
+
+
+def test_launch_config_defaults_merge(tmp_path):
+    Config(mixed_precision="bf16", tp_size=2, gradient_accumulation_steps=4).save(
+        str(tmp_path / "cfg.yaml")
+    )
+    parser = launch_command_parser()
+    args = parser.parse_args(
+        ["--config_file", str(tmp_path / "cfg.yaml"), "--tp_size", "4", "s.py"]
+    )
+    from accelerate_tpu.commands.launch import _merge_config_defaults
+
+    _merge_config_defaults(args)
+    assert args.tp_size == 4  # CLI wins
+    assert args.mixed_precision == "bf16"  # config fills the gap
+    assert args.gradient_accumulation_steps == 4
+
+
+def test_estimate_builtin_models():
+    parser = estimate_command_parser()
+    args = parser.parse_args(["gpt-tiny", "--dtypes", "float32", "bfloat16"])
+    rows = gather_data(args)
+    assert len(rows) == 2
+    fp32, bf16 = rows
+    assert fp32[0] == "float32" and bf16[0] == "bfloat16"
+    assert fp32[2] == 2 * bf16[2]  # fp32 is exactly twice bf16
+    assert fp32[3] == 4 * fp32[2]  # Adam training ≈ 4× weights
+
+
+def test_estimate_unknown_model_raises():
+    parser = estimate_command_parser()
+    args = parser.parse_args(["no-such-model-xyz"])
+    with pytest.raises(ValueError):
+        gather_data(args)
+
+
+def test_cli_env_command(capsys, monkeypatch):
+    monkeypatch.setattr(sys, "argv", ["accelerate-tpu", "env"])
+    cli_main()
+    out = capsys.readouterr().out
+    assert "`accelerate_tpu` version" in out
+    assert "JAX version" in out
+
+
+def test_write_basic_config(tmp_path):
+    from accelerate_tpu.commands.config.default import write_basic_config
+
+    path = str(tmp_path / "default.yaml")
+    write_basic_config(mixed_precision="bf16", save_location=path)
+    config = load_config_from_file(path)
+    assert config.mixed_precision == "bf16"
+    # second call must refuse to overwrite
+    config2 = write_basic_config(mixed_precision="no", save_location=path)
+    assert load_config_from_file(path).mixed_precision == "bf16"
+
+
+def test_config_update_drops_legacy_keys(tmp_path):
+    path = tmp_path / "old.yaml"
+    path.write_text("mixed_precision: bf16\ndeepspeed_config: {stage: 3}\n")
+    from accelerate_tpu.commands.config.update import update_config
+
+    class Args:
+        config_file = str(path)
+
+    update_config(Args())
+    loaded = load_config_from_file(str(path))
+    assert loaded.mixed_precision == "bf16"
+
+
+def test_tpu_config_debug_mode(capsys):
+    from accelerate_tpu.commands.tpu import tpu_command_parser, tpu_command_launcher
+
+    parser = tpu_command_parser()
+    args = parser.parse_args(
+        ["--tpu_name", "pod-1", "--tpu_zone", "us-central2-b",
+         "--command", "echo hi", "--debug"]
+    )
+    tpu_command_launcher(args)
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm ssh pod-1" in out
+    assert "--worker=all" in out

@@ -73,10 +73,13 @@ def test_global_batch_sampler_drop_last():
 
 
 def test_global_batch_sampler_even_false_ragged():
+    # SPMD: a ragged tail group (one shard would get [8, 9], the other
+    # nothing) has no uniform global batch, so it is dropped entirely
     gs = make_global(10, 2, 2, even_batches=False)
     groups = list(gs)
-    assert groups[-1] == [[8, 9]]  # ragged tail kept
+    assert groups == [[[0, 1], [2, 3]], [[4, 5], [6, 7]]]
     assert gs.remainder == 0
+    assert len(gs) == len(groups)
 
 
 def test_global_batch_sampler_split_batches():
@@ -219,3 +222,11 @@ def test_dataloader_len():
     dl = prepare_data_loader(dataset=dataset, batch_size=2)
     assert len(dl) == 2
     assert dl.total_batch_size == 16
+
+
+def test_global_batch_sampler_even_false_len_matches_iter():
+    """__len__ must count only yielded groups (code-review regression):
+    a trailing short batch poisons its whole group."""
+    for n, bs, shards in [(10, 3, 2), (12, 3, 2), (9, 3, 2), (22, 4, 8), (10, 2, 2)]:
+        gs = make_global(n, bs, shards, even_batches=False)
+        assert len(list(gs)) == len(gs), (n, bs, shards)

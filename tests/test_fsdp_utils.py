@@ -1,0 +1,117 @@
+"""Sharded checkpoint save/merge tests (reference: tests/test_merge_weights
+via test_utils/scripts/test_merge_weights.py)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.utils.fsdp_utils import (
+    load_sharded_model_state,
+    merge_sharded_weights,
+    save_sharded_model_state,
+    sharded_index_path,
+)
+
+
+def _mesh():
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devices, ("fsdp", "tp"))
+
+
+def _sharded(arr, mesh, spec):
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+
+def test_sharded_save_and_merge_roundtrip(tmp_path):
+    mesh = _mesh()
+    w1 = np.arange(64, dtype=np.float32).reshape(8, 8)
+    w2 = np.arange(32, dtype=np.float32).reshape(8, 4) * 0.5
+    bias = np.arange(8, dtype=np.float32)
+    state_dict = {
+        "layer.w1": _sharded(w1, mesh, P("fsdp", "tp")),
+        "layer.w2": _sharded(w2, mesh, P("fsdp", None)),
+        "layer.bias": _sharded(bias, mesh, P(None)),
+        "host_value": np.float32(3.5),
+    }
+    out = str(tmp_path / "ckpt")
+    save_sharded_model_state(state_dict, out)
+    assert os.path.exists(sharded_index_path(out))
+
+    merged_file = merge_sharded_weights(out, str(tmp_path / "merged.safetensors"))
+    from safetensors.numpy import load_file
+
+    merged = load_file(merged_file)
+    np.testing.assert_array_equal(merged["layer.w1"], w1)
+    np.testing.assert_array_equal(merged["layer.w2"], w2)
+    np.testing.assert_array_equal(merged["layer.bias"], bias)
+    assert merged["host_value"] == np.float32(3.5)
+
+
+def test_sharded_load_in_memory(tmp_path):
+    mesh = _mesh()
+    w = np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32)
+    out = str(tmp_path / "ckpt")
+    save_sharded_model_state({"w": _sharded(w, mesh, P("fsdp", "tp"))}, out)
+    loaded = load_sharded_model_state(out)
+    np.testing.assert_array_equal(loaded["w"], w)
+
+
+def test_sharded_bf16_roundtrip(tmp_path):
+    mesh = _mesh()
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(8, 8)), dtype=jnp.bfloat16)
+    out = str(tmp_path / "ckpt")
+    save_sharded_model_state(
+        {"w": jax.device_put(w, NamedSharding(mesh, P("fsdp", None)))}, out
+    )
+    loaded = load_sharded_model_state(out)
+    assert str(loaded["w"].dtype) == "bfloat16"
+    np.testing.assert_array_equal(
+        np.asarray(loaded["w"], dtype=np.float32), np.asarray(w, dtype=np.float32)
+    )
+
+
+def test_merge_detects_missing_shards(tmp_path):
+    """Simulate a multi-host checkpoint with one rank's file missing."""
+    mesh = _mesh()
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    out = str(tmp_path / "ckpt")
+    # pretend we are rank 0 of 2: only rank 0's addressable slice set is
+    # written, and the index records 2 shards
+    save_sharded_model_state(
+        {"w": _sharded(w, mesh, P("fsdp", None))}, out, process_index=0, num_processes=2
+    )
+    # drop half the entries from the single written file to fake a partial copy
+    from safetensors.numpy import load_file, save_file
+
+    shard = [f for f in os.listdir(out) if f.endswith(".safetensors")][0]
+    data = load_file(os.path.join(out, shard))
+    partial = dict(list(data.items())[: len(data) // 2])
+    save_file(partial, os.path.join(out, shard))
+    with pytest.raises(ValueError, match="uncovered|no shards"):
+        merge_sharded_weights(out, str(tmp_path / "m.safetensors"))
+
+
+def test_merge_cli(tmp_path, capsys):
+    mesh = _mesh()
+    w = np.ones((8, 8), dtype=np.float32)
+    out = str(tmp_path / "ckpt")
+    save_sharded_model_state({"w": _sharded(w, mesh, P("fsdp", "tp"))}, out)
+    import sys
+
+    from accelerate_tpu.commands.accelerate_cli import main as cli_main
+
+    target = str(tmp_path / "full.safetensors")
+    sys_argv = sys.argv
+    try:
+        sys.argv = ["accelerate-tpu", "merge-weights", out, target]
+        cli_main()
+    finally:
+        sys.argv = sys_argv
+    from safetensors.numpy import load_file
+
+    np.testing.assert_array_equal(load_file(target)["w"], w)

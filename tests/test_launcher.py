@@ -1,0 +1,59 @@
+"""Launcher integration tests (reference Pattern 2/3, SURVEY.md §4).
+
+One true subprocess launch exercises the CLI + env protocol end-to-end; the
+other in-package scripts run in-process on the warm 8-device mesh (this CI
+box has a single CPU core — every cold subprocess pays full XLA recompiles,
+so subprocess fan-out is kept minimal).
+"""
+
+import os
+
+import pytest
+
+import accelerate_tpu.test_utils.scripts.test_ops as test_ops_script
+import accelerate_tpu.test_utils.scripts.test_script as test_script
+import accelerate_tpu.test_utils.scripts.test_sync as test_sync_script
+from accelerate_tpu.test_utils.testing import launch_test_script
+
+
+def test_launch_test_script_via_cli():
+    """Full round trip: accelerate-tpu launch → env protocol → child SPMD."""
+    env = os.environ.copy()
+    env.pop("ACCELERATE_MIXED_PRECISION", None)
+    out = launch_test_script(
+        test_script.__file__, num_virtual_devices=2, env=env
+    )
+    assert "All checks passed" in out
+
+
+def test_ops_script_in_process():
+    test_ops_script.main()
+
+
+def test_sync_script_in_process():
+    test_sync_script.main()
+
+
+def test_script_in_process():
+    test_script.main()
+
+
+def test_debug_launcher_multiprocess():
+    """Two real OS processes rendezvous through jax.distributed on CPU
+    (reference debug_launcher, launchers.py:268)."""
+    from accelerate_tpu.launchers import debug_launcher
+
+    debug_launcher(_check_world, num_processes=2, timeout=240)
+
+
+def _check_world():
+    # PartialState() performs the jax.distributed rendezvous from the env
+    # protocol — it must come before any process_count() query
+    from accelerate_tpu import PartialState
+
+    state = PartialState()
+    assert state.num_processes == 2, f"got {state.num_processes} processes"
+    import jax
+
+    assert jax.process_count() == 2
+    state.wait_for_everyone()

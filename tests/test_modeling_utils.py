@@ -229,3 +229,41 @@ def test_load_checkpoint_in_model_disk_offload(tmp_path):
 
     assert is_meta(fresh.linear2.weight.data)
     assert (tmp_path / "offload" / "index.json").exists()
+
+
+def test_dtype_byte_size_fp8_variants():
+    # fp8 names embed digits that must not be parsed as bit-widths
+    assert dtype_byte_size(jnp.float8_e4m3fn) == 1
+    assert dtype_byte_size(jnp.float8_e5m2) == 1
+    assert dtype_byte_size("int4") == 0.5
+
+
+def test_infer_auto_device_map_tied_full_falls_back_to_open_chip():
+    """When the tied-preferred chip is full, the CURRENT fill chip must be
+    tried before spilling to cpu/disk (code-review regression)."""
+    model = BiggerModel()
+    model.head.weight = model.block1.linear1.weight  # tie head to block1
+    # chip0 fits block1 (160B) with 5B slack — too small even for head's
+    # bias (8B), so the tied pull to chip0 must fail and fall back to the
+    # regular fill device (chip1), NOT skip past it to cpu/disk
+    device_map = infer_auto_device_map(
+        model,
+        max_memory={0: 165, 1: 10_000, "cpu": 10_000},
+        no_split_module_classes=["SubNet", "Linear"],
+    )
+    check_device_map(model, device_map)
+    assert device_map["block1"] == 0
+    assert device_map["head"] == 1
+    assert "disk" not in device_map.values()
+    assert "cpu" not in device_map.values()
+
+
+def test_split_direct_tensors_try_all_devices():
+    """Direct tensors of a split module must scan remaining devices before
+    hitting disk (code-review regression)."""
+    model = BiggerModel()
+    device_map = infer_auto_device_map(
+        model, max_memory={0: 100, 1: 10_000}
+    )
+    check_device_map(model, device_map)
+    assert "disk" not in device_map.values()

@@ -209,3 +209,17 @@ def test_tape_under_jit_capture():
     expected_grads = jax.grad(pure_loss)(params)
     np.testing.assert_allclose(grads["weight"], expected_grads["weight"], rtol=1e-4)
     np.testing.assert_allclose(grads["bias"], expected_grads["bias"], rtol=1e-4)
+
+
+def test_meta_init_consumes_no_rng():
+    """init_empty_weights must not advance the RNG stream or allocate
+    (code-review regression), in both include_buffers modes."""
+    import accelerate_tpu.nn.random as nn_random
+    from accelerate_tpu.big_modeling import init_empty_weights
+
+    for include_buffers in (True, False):
+        nn.manual_seed(123)
+        before = nn_random.default_rng._counter
+        with init_empty_weights(include_buffers=include_buffers):
+            nn.Linear(64, 64)
+        assert nn_random.default_rng._counter == before, include_buffers
