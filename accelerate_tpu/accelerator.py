@@ -89,6 +89,9 @@ class Accelerator:
         self.init_handler = None
         self.profile_handler = None
         self.autocast_handler = None
+        self.fp8_recipe_handler = None
+        from .utils.dataclasses import FP8RecipeKwargs
+
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
@@ -96,6 +99,8 @@ class Accelerator:
                 self.init_handler = handler
             elif isinstance(handler, ProfileKwargs):
                 self.profile_handler = handler
+            elif isinstance(handler, FP8RecipeKwargs):
+                self.fp8_recipe_handler = handler
 
         if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "false").lower() in ("1", "true"):
             fsdp_plugin = FullyShardedDataParallelPlugin()
@@ -232,7 +237,8 @@ class Accelerator:
 
     @property
     def compute_dtype(self):
-        return jnp.bfloat16 if self.state.mixed_precision == "bf16" else (
+        # fp8 keeps bf16 activations/params; only the matmuls drop to fp8
+        return jnp.bfloat16 if self.state.mixed_precision in ("bf16", "fp8") else (
             jnp.float16 if self.state.mixed_precision == "fp16" else jnp.float32
         )
 
@@ -311,6 +317,13 @@ class Accelerator:
         # precision policy: params in compute dtype, master fp32 kept by optim
         if self.state.mixed_precision in ("bf16", "fp16"):
             model.to(self.compute_dtype)
+        elif self.state.mixed_precision == "fp8":
+            # swap Linears for fp8-matmul layers, activations/params bf16
+            # (reference fp8 backends convert + autocast, SURVEY.md §2.4)
+            from .utils.fp8 import convert_to_float8_training
+
+            convert_to_float8_training(model, self.fp8_recipe_handler)
+            model.to(jnp.bfloat16)
         if device_placement:
             shard_module_params(
                 model,

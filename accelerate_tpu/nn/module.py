@@ -96,6 +96,17 @@ class Module:
         for _, m in self.named_modules():
             yield m
 
+    def get_submodule(self, target: str) -> "Module":
+        """Resolve a dotted path like ``encoder.layer.3.attn`` (torch parity)."""
+        module = self
+        if not target:
+            return module
+        for part in target.split("."):
+            if part not in module._modules:
+                raise AttributeError(f"{module!r} has no submodule {part!r}")
+            module = module._modules[part]
+        return module
+
     def children(self) -> Iterator["Module"]:
         yield from self._modules.values()
 

@@ -34,6 +34,18 @@ from .dataclasses import (
     SequenceParallelPlugin,
     TensorParallelPlugin,
 )
+from .fp8 import FP8Linear, convert_to_float8_training
+from .quantization import (
+    QuantizationConfig,
+    QuantizedLinear,
+    load_and_quantize_model,
+    replace_with_quantized_layers,
+)
+from .fsdp_utils import (
+    load_sharded_model_state,
+    merge_sharded_weights,
+    save_sharded_model_state,
+)
 from .environment import (
     are_libraries_initialized,
     get_int_from_env,

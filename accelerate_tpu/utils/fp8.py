@@ -1,0 +1,240 @@
+"""FP8 mixed-precision training, XLA-native.
+
+Counterpart of the reference's three fp8 backends (SURVEY.md §2.4:
+TransformerEngine ``utils/transformer_engine.py:26-160``, torchao
+``utils/ao.py:103``, MS-AMP ``accelerator.py:2164``).  On TPU none of those
+engines exist — XLA itself understands ``float8_e4m3fn``/``float8_e5m2`` and
+lowers scaled fp8 matmuls onto the MXU — so the rebuild is one module swap:
+``convert_to_float8_training`` replaces ``nn.Linear`` with :class:`FP8Linear`.
+
+The matmul is a ``jax.custom_vjp`` implementing the full HYBRID recipe:
+
+* forward:  y  = dot(quant_e4m3(x), quant_e4m3(w)) / (sx·sw)
+* backward: dx = dot(quant_e5m2(g), quant_e4m3(w)ᵀ) / (sg·sw)
+            dw = dot(quant_e4m3(x)ᵀ, quant_e5m2(g)) / (sx·sg)
+
+with per-tensor current scaling (amax computed in-step: stateless,
+jit-capture safe, numerically tightest).  A TE-style delayed-scaling mode
+keeps a weight-amax history in a lazily-created Buffer for eager use.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.module import Buffer, Module, Parameter
+from ..nn.tape import Tensor, tape_op
+from .dataclasses import FP8RecipeKwargs
+
+__all__ = [
+    "FP8Linear",
+    "convert_to_float8_training",
+    "fp8_dtype_forward",
+    "fp8_dtype_backward",
+]
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+_FP8_MAX = {"e4m3": E4M3_MAX, "e5m2": E5M2_MAX}
+_FP8_DTYPE = {"e4m3": None, "e5m2": None}  # filled lazily (jnp attributes)
+
+
+def _dtype_of(kind: str):
+    return jnp.float8_e4m3fn if kind == "e4m3" else jnp.float8_e5m2
+
+
+def fp8_dtype_forward(fmt: str):
+    return _dtype_of("e4m3" if fmt.upper() in ("HYBRID", "E4M3") else "e5m2")
+
+
+def fp8_dtype_backward(fmt: str):
+    return _dtype_of("e5m2" if fmt.upper() in ("HYBRID", "E5M2") else "e4m3")
+
+
+def _kind_forward(fmt: str) -> str:
+    return "e4m3" if fmt.upper() in ("HYBRID", "E4M3") else "e5m2"
+
+
+def _kind_backward(fmt: str) -> str:
+    return "e5m2" if fmt.upper() in ("HYBRID", "E5M2") else "e4m3"
+
+
+def _quant(t, kind: str, margin: int, amax=None):
+    """(quantized, scale): scale maps amax to the top of the fp8 range.
+
+    A zero/invalid amax (e.g. an unseeded delayed-scaling history) falls back
+    to the tensor's live amax so the cast can never overflow to NaN.
+    """
+    fp8_max = _FP8_MAX[kind]
+    live = jnp.max(jnp.abs(t))
+    amax = live if amax is None else jnp.where(amax > 0, amax, live)
+    amax = jnp.maximum(amax, 1e-12)
+    scale = (fp8_max / amax) * (2.0 ** -margin)
+    q = (t.astype(jnp.float32) * scale).astype(_dtype_of(kind))
+    return q, scale
+
+
+@lru_cache(maxsize=None)
+def _make_fp8_matmul(fwd_kind: str, bwd_kind: str, margin: int):
+    """custom_vjp fp8 matmul for (x:[n,k]) @ (w_t:[k,m]), HYBRID recipe."""
+
+    @jax.custom_vjp
+    def fp8_matmul(x, w_t):
+        x8, sx = _quant(x, fwd_kind, margin)
+        w8, sw = _quant(w_t, fwd_kind, margin)
+        y = jnp.dot(x8, w8, preferred_element_type=jnp.float32)
+        return (y / (sx * sw)).astype(x.dtype)
+
+    def fwd(x, w_t):
+        return fp8_matmul(x, w_t), (x, w_t)
+
+    def bwd(res, g):
+        x, w_t = res
+        g8, sg = _quant(g, bwd_kind, margin)
+        x8, sx = _quant(x, fwd_kind, margin)
+        w8, sw = _quant(w_t, fwd_kind, margin)
+        dx = jnp.dot(g8, w8.T, preferred_element_type=jnp.float32) / (sg * sw)
+        dw_t = jnp.dot(x8.T, g8, preferred_element_type=jnp.float32) / (sx * sg)
+        return dx.astype(x.dtype), dw_t.astype(w_t.dtype)
+
+    fp8_matmul.defvjp(fwd, bwd)
+    return fp8_matmul
+
+
+class FP8Linear(Module):
+    """Linear with fp8 matmul + high-precision master weight.
+
+    Mirrors the role of TE's ``te.Linear`` swap (reference
+    transformer_engine.py:40-61): the Parameter stays bf16/fp32 (so the
+    optimizer and checkpoints are unchanged), only the dot runs in fp8.
+    """
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        bias: bool = True,
+        recipe: Optional[FP8RecipeKwargs] = None,
+    ):
+        super().__init__()
+        import math
+
+        from ..nn import init
+
+        self.in_features = in_features
+        self.out_features = out_features
+        self.recipe = recipe or FP8RecipeKwargs()
+        bound = 1.0 / math.sqrt(in_features)
+        self.weight = Parameter(init.uniform((out_features, in_features), bound))
+        if bias:
+            self.bias = Parameter(init.uniform((out_features,), bound))
+        else:
+            self.register_parameter("bias", None)
+        self._delayed = False  # current scaling by default; see set_delayed()
+
+    @classmethod
+    def from_linear(cls, linear, recipe: Optional[FP8RecipeKwargs] = None) -> "FP8Linear":
+        new = cls.__new__(cls)
+        Module.__init__(new)
+        new.in_features = linear.in_features
+        new.out_features = linear.out_features
+        new.recipe = recipe or FP8RecipeKwargs()
+        new.weight = linear.weight
+        if getattr(linear, "bias", None) is not None:
+            new.bias = linear.bias
+        else:
+            new.register_parameter("bias", None)
+        new._delayed = False
+        return new
+
+    def set_delayed(self, delayed: bool = True) -> None:
+        """Switch to TE-style delayed weight scaling (eager mode only: Buffer
+        mutation does not thread through step capture).  The amax-history
+        Buffer is created on first use so current-scaling layers — the
+        default — carry no extra state in checkpoints."""
+        self._delayed = delayed
+        if delayed and "amax_history" not in self._buffers:
+            length = max(1, self.recipe.amax_history_len)
+            self.amax_history = Buffer(jnp.zeros((length,)))
+
+    def forward(self, x):
+        margin = self.recipe.margin
+        matmul = _make_fp8_matmul(
+            _kind_forward(self.recipe.fp8_format),
+            _kind_backward(self.recipe.fp8_format),
+            margin,
+        )
+        w_amax = None
+        if self._delayed:
+            hist = self.amax_history.data
+            w_amax = jnp.max(hist)  # _quant falls back to live amax while 0
+            w = self.weight.data if isinstance(self.weight, Tensor) else self.weight
+            self.amax_history.data = jnp.concatenate(
+                [hist[1:], jnp.max(jnp.abs(w)).reshape(1)]
+            )
+        fwd_kind = _kind_forward(self.recipe.fp8_format)
+
+        def _fwd(v, w, *rest):
+            orig_shape = v.shape
+            v2 = v.reshape(-1, orig_shape[-1])
+            if w_amax is not None:
+                # delayed: pre-scale the weight by the history amax outside
+                # the custom_vjp (its internal quant then sees amax≈fp8_max)
+                w8, sw = _quant(w.T, fwd_kind, margin, amax=w_amax)
+                y = jnp.dot(
+                    (v2.astype(jnp.float32) * 1.0).astype(v2.dtype), w8.astype(v2.dtype)
+                )
+                y = jnp.asarray(y, jnp.float32) / sw
+            else:
+                y = matmul(v2, w.T)
+            y = y.reshape(*orig_shape[:-1], w.shape[0])
+            if rest:
+                y = y + rest[0]
+            return y.astype(v.dtype)
+
+        if self.bias is None:
+            return tape_op(_fwd, x, self.weight)
+        return tape_op(_fwd, x, self.weight, self.bias)
+
+    def __repr__(self):
+        return (
+            f"FP8Linear(in={self.in_features}, out={self.out_features}, "
+            f"bias={self.bias is not None}, fmt={self.recipe.fp8_format})"
+        )
+
+
+def convert_to_float8_training(
+    model: Module,
+    recipe: Optional[FP8RecipeKwargs] = None,
+    module_filter=None,
+) -> Module:
+    """Swap every eligible ``nn.Linear`` for :class:`FP8Linear` in place.
+
+    Reference: torchao ``convert_to_float8_training`` with first/last-layer
+    filtering (utils/ao.py:103-139) and TE's ``convert_model``
+    (transformer_engine.py:26).  ``module_filter(name, module) -> bool``
+    keeps a layer in high precision when it returns False; by default the
+    first and last Linear are kept (standard fp8 practice — embedding-adjacent
+    layers are precision-critical).
+    """
+    from ..nn.layers import Linear
+
+    linear_names = [name for name, m in model.named_modules() if type(m) is Linear]
+    if module_filter is None:
+        skip = {linear_names[0], linear_names[-1]} if len(linear_names) > 2 else set()
+        module_filter = lambda name, m: name not in skip  # noqa: E731
+
+    for name in linear_names:
+        parent, _, leaf = name.rpartition(".")
+        parent_mod = model.get_submodule(parent) if parent else model
+        child = parent_mod._modules[leaf]
+        if not module_filter(name, child):
+            continue
+        # setattr (not a bare _modules write) keeps the instance attribute
+        # and registry in sync
+        setattr(parent_mod, leaf, FP8Linear.from_linear(child, recipe))
+    return model

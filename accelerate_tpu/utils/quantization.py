@@ -1,0 +1,249 @@
+"""Weight-only int8/int4 quantized loading.
+
+Counterpart of the reference's bitsandbytes integration
+(``/root/reference/src/accelerate/utils/bnb.py:44-470`` —
+``load_and_quantize_model``, ``replace_with_bnb_layers``,
+``BnbQuantizationConfig`` ``dataclasses.py:2450``).  bitsandbytes is
+CUDA-only; the TPU-native design quantizes to plain integer arrays that XLA
+dequantizes inside the matmul fusion:
+
+* int8: per-output-channel symmetric scale, one int8 per weight;
+* int4: per-output-channel scale, two weights packed per uint8 byte
+  (unpacked with shifts inside the forward — stays fused, never
+  materialised at full precision in HBM beyond the running tile).
+
+The swap happens layer-by-layer at load time so the full-precision model is
+never resident (mirrors bnb's meta→quantized load path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.module import Buffer, Module, Parameter
+from ..nn.tape import Tensor, tape_op
+
+__all__ = [
+    "QuantizationConfig",
+    "QuantizedLinear",
+    "quantize_weight",
+    "dequantize_weight",
+    "replace_with_quantized_layers",
+    "load_and_quantize_model",
+]
+
+
+@dataclass
+class QuantizationConfig:
+    """Reference: BnbQuantizationConfig dataclasses.py:2450."""
+
+    load_in_8bit: bool = False
+    load_in_4bit: bool = False
+    compute_dtype: Any = jnp.bfloat16
+    skip_modules: Optional[list[str]] = None  # names kept in high precision
+    keep_in_fp32_modules: list[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.load_in_8bit and self.load_in_4bit:
+            raise ValueError("load_in_8bit and load_in_4bit are mutually exclusive")
+        if not (self.load_in_8bit or self.load_in_4bit):
+            raise ValueError("pass load_in_8bit=True or load_in_4bit=True")
+
+    @property
+    def bits(self) -> int:
+        return 8 if self.load_in_8bit else 4
+
+
+def quantize_weight(w, bits: int = 8):
+    """(q, scale): per-output-channel symmetric quantisation of a (out, in)
+    weight; int4 packs two values per byte along the input dim."""
+    w = np.asarray(w, dtype=np.float32)
+    qmax = 127.0 if bits == 8 else 7.0
+    amax = np.maximum(np.abs(w).max(axis=1, keepdims=True), 1e-12)
+    scale = (amax / qmax).astype(np.float32)
+    q = np.clip(np.round(w / scale), -qmax - 1, qmax).astype(np.int8)
+    if bits == 4:
+        if q.shape[1] % 2:
+            raise ValueError("int4 packing needs an even input dimension")
+        nibbles = (q + 8).astype(np.uint8)  # [-8,7] → [0,15]
+        q = (nibbles[:, 0::2] << 4 | nibbles[:, 1::2]).astype(np.uint8)
+    return q, scale[:, 0]
+
+
+def dequantize_weight(q, scale, bits: int = 8, dtype=jnp.float32):
+    """Inverse of :func:`quantize_weight` (jnp; fusable inside jit)."""
+    if bits == 4:
+        hi = (q >> 4).astype(jnp.int8) - 8
+        lo = (q & 0xF).astype(jnp.int8) - 8
+        out_dim, half = q.shape
+        w = jnp.stack([hi, lo], axis=2).reshape(out_dim, half * 2)
+    else:
+        w = q
+    return w.astype(dtype) * scale[:, None].astype(dtype)
+
+
+class QuantizedLinear(Module):
+    """Linear whose weight lives as int8/packed-int4 + per-channel scales.
+
+    The dequant happens inside the tape lambda, so XLA fuses it into the
+    matmul (weights stream from HBM at 1 or 0.5 bytes/param).
+    """
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        bias: bool = True,
+        bits: int = 8,
+        compute_dtype=jnp.bfloat16,
+    ):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.bits = bits
+        self.compute_dtype = compute_dtype
+        packed_in = in_features // 2 if bits == 4 else in_features
+        qdtype = jnp.uint8 if bits == 4 else jnp.int8
+        self.qweight = Buffer(jnp.zeros((out_features, packed_in), dtype=qdtype))
+        self.scales = Buffer(jnp.ones((out_features,), dtype=jnp.float32))
+        if bias:
+            self.bias = Parameter(jnp.zeros((out_features,), dtype=jnp.float32))
+        else:
+            self.register_parameter("bias", None)
+
+    @classmethod
+    def from_weight(
+        cls, weight, bias=None, bits: int = 8, compute_dtype=jnp.bfloat16
+    ) -> "QuantizedLinear":
+        w = np.asarray(weight.data if isinstance(weight, Tensor) else weight)
+        out_features, in_features = w.shape
+        new = cls(
+            in_features,
+            out_features,
+            bias=bias is not None,
+            bits=bits,
+            compute_dtype=compute_dtype,
+        )
+        q, scale = quantize_weight(w, bits)
+        new.qweight.data = jnp.asarray(q)
+        new.scales.data = jnp.asarray(scale)
+        if bias is not None:
+            b = bias.data if isinstance(bias, Tensor) else bias
+            new.bias.data = jnp.asarray(b, dtype=jnp.float32)
+        return new
+
+    def forward(self, x):
+        bits, cdtype = self.bits, self.compute_dtype
+        q, s = self.qweight.data, self.scales.data
+
+        def _fwd(v, *rest):
+            w = dequantize_weight(q, s, bits, cdtype)
+            y = jnp.dot(v.astype(cdtype), w.T, preferred_element_type=jnp.float32)
+            if rest:
+                y = y + rest[0]
+            return y.astype(v.dtype)
+
+        if self.bias is None:
+            return tape_op(_fwd, x)
+        return tape_op(_fwd, x, self.bias)
+
+    def __repr__(self):
+        return (
+            f"QuantizedLinear(in={self.in_features}, out={self.out_features}, "
+            f"bits={self.bits}, bias={self.bias is not None})"
+        )
+
+
+def replace_with_quantized_layers(
+    model: Module,
+    config: QuantizationConfig,
+    state_dict: Optional[dict] = None,
+    prefix: str = "",
+) -> Module:
+    """Swap eligible ``nn.Linear``s for :class:`QuantizedLinear`, pulling
+    values from ``state_dict`` when given (meta-init load path) or from the
+    live weights otherwise.  Reference: replace_with_bnb_layers bnb.py:211.
+    """
+    from ..nn.layers import Linear
+    from ..nn.meta import is_meta
+
+    skip = set(config.skip_modules or [])
+    for name, module in list(model.named_modules()):
+        if type(module) is not Linear or name in skip:
+            continue
+        if any(name.endswith(k) or k in name for k in config.keep_in_fp32_modules):
+            continue
+        parent, _, leaf = name.rpartition(".")
+        parent_mod = model.get_submodule(parent) if parent else model
+        if state_dict is not None:
+            w = state_dict.get(f"{name}.weight")
+            b = state_dict.get(f"{name}.bias")
+            if w is None:
+                continue
+        else:
+            if is_meta(module.weight.data):
+                raise ValueError(
+                    f"{name} is on meta with no state_dict value; pass the "
+                    "checkpoint to load_and_quantize_model"
+                )
+            w = module.weight
+            b = module.bias
+        # setattr keeps the instance attribute and registry in sync
+        setattr(
+            parent_mod,
+            leaf,
+            QuantizedLinear.from_weight(
+                w, b, bits=config.bits, compute_dtype=config.compute_dtype
+            ),
+        )
+    return model
+
+
+def load_and_quantize_model(
+    model: Module,
+    quantization_config: QuantizationConfig,
+    weights_location: Optional[str] = None,
+    state_dict: Optional[dict] = None,
+    device_map: Optional[dict] = None,
+) -> Module:
+    """Load a checkpoint into ``model`` with eligible Linears quantized on
+    the way in (reference: load_and_quantize_model bnb.py:44).
+
+    ``model`` may be meta-initialised (``init_empty_weights``): quantized
+    layers take their values straight from the checkpoint, remaining modules
+    are materialised normally via ``load_checkpoint_in_model``.
+    """
+    from ..checkpointing import load_model_weights
+
+    if state_dict is None:
+        if weights_location is None:
+            raise ValueError("pass weights_location or state_dict")
+        state_dict = load_model_weights(weights_location)
+
+    replace_with_quantized_layers(model, quantization_config, state_dict=state_dict)
+
+    # materialise everything that is still high-precision from the same dict
+    remaining = {
+        k: v
+        for k, v in state_dict.items()
+        if _owner_is_not_quantized(model, k)
+    }
+    model.load_state_dict(remaining, strict=False)
+    return model
+
+
+def _owner_is_not_quantized(model: Module, key: str) -> bool:
+    mod_path, _, leaf = key.rpartition(".")
+    try:
+        owner = model.get_submodule(mod_path) if mod_path else model
+    except AttributeError:
+        return True
+    if isinstance(owner, QuantizedLinear):
+        # bias is a live Parameter on the quantized layer; weight is consumed
+        return leaf != "weight"
+    return True

@@ -1,0 +1,180 @@
+"""FP8 + quantization tests (reference: SURVEY.md §2.4 precision backends;
+fp8 benchmark scripts assert convergence parity vs bf16)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator
+from accelerate_tpu.nn import Tensor
+from accelerate_tpu.utils.dataclasses import FP8RecipeKwargs
+from accelerate_tpu.utils.fp8 import FP8Linear, convert_to_float8_training
+from accelerate_tpu.utils.quantization import (
+    QuantizationConfig,
+    QuantizedLinear,
+    dequantize_weight,
+    load_and_quantize_model,
+    quantize_weight,
+    replace_with_quantized_layers,
+)
+
+
+class TinyMLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc_in = nn.Linear(8, 16)
+        self.mid = nn.Linear(16, 16)
+        self.fc_out = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc_out(nn.F.gelu(self.mid(nn.F.gelu(self.fc_in(x)))))
+
+
+# --------------------------------------------------------------------- fp8
+def test_fp8_linear_matches_fp32_within_tolerance():
+    nn.manual_seed(0)
+    lin = nn.Linear(16, 8)
+    fp8 = FP8Linear.from_linear(lin)
+    x = Tensor(np.random.default_rng(0).normal(size=(4, 16)).astype(np.float32))
+    with nn.no_grad():
+        ref = lin(x).data
+        out = fp8(x).data
+    # e4m3 has ~2 decimal digits; relative error on a dot of 16 terms
+    assert np.allclose(np.asarray(out), np.asarray(ref), rtol=0.1, atol=0.1)
+
+
+def test_fp8_linear_backward_flows():
+    nn.manual_seed(1)
+    fp8 = FP8Linear(8, 4)
+    x = Tensor(np.random.default_rng(1).normal(size=(2, 8)).astype(np.float32))
+    loss = fp8(x).sum()
+    loss.backward()
+    assert fp8.weight.grad is not None
+    assert np.isfinite(np.asarray(fp8.weight.grad)).all()
+
+
+def test_convert_to_float8_skips_first_and_last():
+    nn.manual_seed(0)
+    model = TinyMLP()
+    convert_to_float8_training(model)
+    assert type(model.fc_in).__name__ == "Linear"  # first kept
+    assert isinstance(model.mid, FP8Linear)
+    assert type(model.fc_out).__name__ == "Linear"  # last kept
+
+
+def test_fp8_conversion_preserves_weights_and_state_dict_keys():
+    nn.manual_seed(0)
+    model = TinyMLP()
+    before = {k: np.asarray(v) for k, v in model.state_dict().items()}
+    convert_to_float8_training(model)
+    after = model.state_dict()
+    for key, value in before.items():
+        assert key in after
+        np.testing.assert_array_equal(value, np.asarray(after[key]))
+
+
+def test_accelerator_fp8_prepare_and_train_step():
+    nn.manual_seed(0)
+    acc = Accelerator(mixed_precision="fp8")
+    model = TinyMLP()
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+    assert isinstance(model.mid, FP8Linear)
+    assert model.mid.weight.dtype == jnp.bfloat16
+
+    x = Tensor(np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32))
+    y = Tensor(np.zeros((4, 4), dtype=np.float32))
+    losses = []
+    for _ in range(5):
+        out = model(x)
+        loss = nn.F.mse_loss(out, y)
+        acc.backward(loss)
+        opt.step()
+        opt.zero_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]  # training must make progress in fp8
+
+
+def test_fp8_delayed_scaling_mode():
+    nn.manual_seed(0)
+    fp8 = FP8Linear(8, 8, recipe=FP8RecipeKwargs(amax_history_len=4))
+    fp8.set_delayed(True)
+    x = Tensor(np.random.default_rng(2).normal(size=(2, 8)).astype(np.float32))
+    with nn.no_grad():
+        fp8(x)
+        fp8(x)
+    hist = np.asarray(fp8.amax_history.data)
+    assert (hist[-2:] > 0).all()  # history rolled twice
+
+
+# ----------------------------------------------------------- quantization
+@pytest.mark.parametrize("bits", [8, 4])
+def test_quantize_dequantize_roundtrip(bits):
+    w = np.random.default_rng(0).normal(size=(16, 32)).astype(np.float32)
+    q, scale = quantize_weight(w, bits)
+    back = np.asarray(dequantize_weight(jnp.asarray(q), jnp.asarray(scale), bits))
+    qmax = 127 if bits == 8 else 7
+    # max error is half a quantisation step per channel
+    step = np.abs(w).max(axis=1, keepdims=True) / qmax
+    assert (np.abs(back - w) <= step * 0.5 + 1e-6).all()
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_quantized_linear_forward(bits):
+    nn.manual_seed(0)
+    lin = nn.Linear(32, 8)
+    qlin = QuantizedLinear.from_weight(lin.weight, lin.bias, bits=bits)
+    x = Tensor(np.random.default_rng(1).normal(size=(4, 32)).astype(np.float32))
+    with nn.no_grad():
+        ref = np.asarray(lin(x).data)
+        out = np.asarray(qlin(x).data)
+    tol = 0.05 if bits == 8 else 0.3
+    assert np.abs(out - ref).max() < tol
+
+
+def test_int4_memory_is_halved():
+    lin_w = np.zeros((16, 32), dtype=np.float32)
+    q8, _ = quantize_weight(lin_w, 8)
+    q4, _ = quantize_weight(lin_w, 4)
+    assert q4.nbytes == q8.nbytes // 2
+
+
+def test_replace_with_quantized_layers_respects_skip():
+    nn.manual_seed(0)
+    model = TinyMLP()
+    config = QuantizationConfig(load_in_8bit=True, skip_modules=["fc_out"])
+    replace_with_quantized_layers(model, config)
+    assert isinstance(model.fc_in, QuantizedLinear)
+    assert isinstance(model.mid, QuantizedLinear)
+    assert type(model.fc_out).__name__ == "Linear"
+
+
+def test_load_and_quantize_model_from_meta(tmp_path):
+    """bnb-style path: meta init → quantize straight from the checkpoint."""
+    from accelerate_tpu.big_modeling import init_empty_weights
+    from accelerate_tpu.checkpointing import save_model_weights
+
+    nn.manual_seed(0)
+    source = TinyMLP()
+    save_model_weights(source.state_dict(), str(tmp_path))
+
+    with init_empty_weights():
+        empty = TinyMLP()
+    config = QuantizationConfig(load_in_8bit=True)
+    load_and_quantize_model(empty, config, weights_location=str(tmp_path))
+
+    x = Tensor(np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32))
+    with nn.no_grad():
+        ref = np.asarray(source(x).data)
+        out = np.asarray(empty(x).data)
+    assert np.abs(out - ref).max() < 0.1
+
+
+def test_quantization_config_validation():
+    with pytest.raises(ValueError):
+        QuantizationConfig(load_in_8bit=True, load_in_4bit=True)
+    with pytest.raises(ValueError):
+        QuantizationConfig()
