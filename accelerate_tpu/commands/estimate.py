@@ -88,6 +88,12 @@ def gather_data(args) -> list[list]:
         total, largest = _num_params_builtin(model)
     else:
         sized = _num_params_hf(args.model_name)
+        if sized is None:
+            raise ValueError(
+                f"`{args.model_name}` is not a built-in model, and sizing it from "
+                "the Hugging Face Hub requires `transformers` and `torch` to be "
+                "importable. Install them or pass one of the built-in model names."
+            )
         total, largest, _ = sized
     rows = []
     for dtype in args.dtypes:

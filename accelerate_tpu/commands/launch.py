@@ -160,7 +160,9 @@ def _merge_config_defaults(args) -> None:
         "tpu_zone": config.tpu_zone,
     }
     for key, value in mapping.items():
-        if getattr(args, key, None) in (None, False):
+        # value-typed keys: only None means "unset" — 0 is a legitimate
+        # explicit value (e.g. --machine_rank 0 must beat the config file)
+        if getattr(args, key, None) is None:
             setattr(args, key, value)
     if config.use_cpu:
         args.cpu = True
@@ -172,7 +174,9 @@ def _merge_config_defaults(args) -> None:
         args.use_fsdp = True
         for k, v in config.fsdp_config.items():
             attr = k if k.startswith("fsdp_") else f"fsdp_{k}"
-            if getattr(args, attr, None) in (None, False):
+            cur = getattr(args, attr, None)
+            # store_true flags default to False; value-typed args default None
+            if cur is None or (cur is False and isinstance(v, bool)):
                 setattr(args, attr, v)
 
 

@@ -168,6 +168,8 @@ class GlobalBatchSampler:
                 f"round multiple of num_shards ({num_shards})."
             )
         self.remainder = 0  # duplicated samples in the final step (set per epoch)
+        self.dropped = 0  # samples lost to a ragged tail under even_batches=False
+        self._warned_ragged_drop = False
 
     def set_epoch(self, epoch: int) -> None:
         if hasattr(self.batch_sampler, "set_epoch"):
@@ -175,6 +177,7 @@ class GlobalBatchSampler:
 
     def __iter__(self) -> Iterator[list[list[int]]]:
         self.remainder = 0
+        self.dropped = 0
         if self.split_batches:
             for batch in self.batch_sampler:
                 if len(batch) % self.num_shards != 0:
@@ -216,7 +219,21 @@ class GlobalBatchSampler:
             # shapes; a ragged tail group has no uniform global batch, so it
             # is dropped — the TPU-native reading of the reference's
             # "shards without a full batch stop iterating" semantics
-            # (reference data_loader.py:195-262).
+            # (reference data_loader.py:195-262).  The reference still feeds
+            # the ragged tail to the shards that have data; we diverge, so
+            # warn (once) with the number of samples the epoch loses.
+            dropped = sum(len(b) for b in group)
+            self.dropped = dropped
+            if not self._warned_ragged_drop:
+                self._warned_ragged_drop = True
+                logger.warning(
+                    "even_batches=False: dropping the ragged tail group "
+                    f"({dropped} samples) — under SPMD every shard must run an "
+                    "identical program, so unlike the reference the short tail "
+                    "is not delivered to a subset of shards. Metrics computed "
+                    "through this loader omit these samples; use "
+                    "even_batches=True with gather_for_metrics to dedup instead."
+                )
             return
         # loop back to the start of the epoch's sample stream to even out
     # (reference semantics: indices restart from the first samples)

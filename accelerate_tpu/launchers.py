@@ -51,12 +51,14 @@ def notebook_launcher(
         try:
             import jax
 
-            backend = jax.local_devices()[0].platform
+            local = jax.local_devices()
+            backend = local[0].platform
+            n_chips = len(local)
         except Exception:
-            backend = "cpu"
+            backend, n_chips = "cpu", 0
         if backend == "cpu" and num_processes and num_processes > 1:
             return debug_launcher(function, args, num_processes, use_port=use_port)
-        print(f"Launching training on {backend} ({len(jax.local_devices())} chips).")
+        print(f"Launching training on {backend} ({n_chips} chips).")
         return function(*args)
 
 

@@ -225,7 +225,20 @@ def convert_to_float8_training(
 
     linear_names = [name for name, m in model.named_modules() if type(m) is Linear]
     if module_filter is None:
-        skip = {linear_names[0], linear_names[-1]} if len(linear_names) > 2 else set()
+        if len(linear_names) <= 2:
+            # every Linear is first or last — converting any would put an
+            # embedding-adjacent, precision-critical layer in fp8
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "convert_to_float8_training: model has only %d Linear layer(s),"
+                " all of which are first/last (precision-critical); NOTHING was"
+                " converted to fp8. Pass module_filter to force conversion.",
+                len(linear_names),
+            )
+            skip = set(linear_names)
+        else:
+            skip = {linear_names[0], linear_names[-1]}
         module_filter = lambda name, m: name not in skip  # noqa: E731
 
     for name in linear_names:
