@@ -156,9 +156,12 @@ class BertModel(nn.Module):
             )
             # (b, s) padding mask → (b, 1, 1, s) additive-compatible bool
             attention_mask = (mask[:, None, None, :] > 0)
-        hidden = self.embeddings(input_ids, token_type_ids)
+        from ..parallel.sharding import constrain_activation
+
+        hidden = constrain_activation(self.embeddings(input_ids, token_type_ids))
         for layer in self.layer:
-            hidden = layer(hidden, attention_mask)
+            # pin batch to (dp, fsdp) at every layer boundary (see models/gpt.py)
+            hidden = constrain_activation(layer(hidden, attention_mask))
         pooled = F.tanh(self.pooler(hidden[:, 0]))
         return hidden, pooled
 

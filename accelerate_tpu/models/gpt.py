@@ -140,12 +140,18 @@ class GPTLMHeadModel(nn.Module):
         _gpt2_init(self, config)
 
     def forward(self, input_ids, labels=None):
+        from ..parallel.sharding import constrain_activation
+
         ids = jnp.asarray(input_ids.data if isinstance(input_ids, Tensor) else input_ids)
         b, s = ids.shape
         pos = jnp.arange(s)[None, :]
         x = self.drop(self.wte(ids) + self.wpe(pos))
+        # pin the activation layout at every layer boundary: batch stays on
+        # (dp, fsdp) exactly as the loader placed it, so GSPMD never reshards
+        # the residual stream (round-1 dryrun hit involuntary full remats)
+        x = constrain_activation(x)
         for block in self.h:
-            x = block(x)
+            x = constrain_activation(block(x))
         x = self.ln_f(x)
         logits = self.lm_head(x)  # tied head: x @ wte^T
         if labels is not None:

@@ -15,6 +15,7 @@ from .layers import (
     MSELoss,
     ReLU,
     RMSNorm,
+    Sigmoid,
     SiLU,
     Softmax,
     Tanh,

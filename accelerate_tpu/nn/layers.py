@@ -44,6 +44,11 @@ class Embedding(Module):
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
         self.weight = Parameter(init.normal((num_embeddings, embedding_dim), dtype=dtype))
+        # gather tables must not be ZeRO-sharded on the feature axis: an
+        # fsdp-sharded embedding makes every lookup emit its output sharded
+        # on embd, which GSPMD then full-rematerializes back to the batch
+        # layout (Megatron layout: vocab-over-tp only)
+        self.weight.fsdp_exempt = True
 
     def forward(self, ids):
         return F.embedding(ids, self.weight)
@@ -119,6 +124,11 @@ class SiLU(Module):
 class Tanh(Module):
     def forward(self, x):
         return F.tanh(x)
+
+
+class Sigmoid(Module):
+    def forward(self, x):
+        return F.sigmoid(x)
 
 
 class Softmax(Module):

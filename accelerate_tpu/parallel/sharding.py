@@ -33,6 +33,7 @@ def plan_param_spec(
     mesh: Mesh,
     fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
     tp_plan: Optional[dict] = None,
+    fsdp_exempt: bool = False,
 ) -> P:
     """Decide the PartitionSpec for one parameter."""
     tp_size = mesh.shape.get("tp", 1)
@@ -46,7 +47,7 @@ def plan_param_spec(
                 spec = list(template[: len(shape)])
                 break
 
-    if fsdp_plugin is not None and fsdp_size > 1 and fsdp_plugin.sharding_strategy in (
+    if not fsdp_exempt and fsdp_plugin is not None and fsdp_size > 1 and fsdp_plugin.sharding_strategy in (
         "FULL_SHARD",
         "HYBRID_SHARD",
     ):
@@ -77,7 +78,14 @@ def shard_module_params(
 
     plan: dict[str, P] = {}
     for name, p in model.named_parameters():
-        spec = plan_param_spec(name, tuple(p.shape), mesh, fsdp_plugin, tp_plan)
+        spec = plan_param_spec(
+            name,
+            tuple(p.shape),
+            mesh,
+            fsdp_plugin,
+            tp_plan,
+            fsdp_exempt=getattr(p, "fsdp_exempt", False),
+        )
         plan[name] = spec
         p.data = jax.device_put(p.data, NamedSharding(mesh, spec))
     for name, b in model.named_buffers():
@@ -88,3 +96,46 @@ def shard_module_params(
 def replicate_module_params(model, mesh: Mesh) -> None:
     for t in list(model.parameters()) + list(model.buffers()):
         t.data = jax.device_put(t.data, NamedSharding(mesh, P()))
+
+
+def activation_spec(ndim: int, mesh: Mesh) -> P:
+    """Canonical activation layout: batch over (dp, fsdp), rest unsharded.
+
+    Matches the data loader's batch placement (``data_axes``), so constraining
+    intermediate activations to this spec pins XLA's layout search at layer
+    boundaries and prevents the "involuntary full rematerialization" reshards
+    the round-1 multichip dryrun hit (batch layout drifting between the
+    loader's P(('dp','fsdp')) and per-op inferred layouts).
+    """
+    from .mesh import data_axes
+
+    batch_axes = data_axes(mesh)
+    return P(batch_axes, *([None] * (ndim - 1)))
+
+
+def constrain_activation(x, mesh: Optional[Mesh] = None):
+    """``with_sharding_constraint`` to the canonical activation layout.
+
+    Accepts tape Tensors or raw arrays; no-op without a multi-device mesh
+    (single chip, or outside an Accelerator context).  Differentiable: the
+    constraint is linear, JAX transposes it to itself.
+    """
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        if not AcceleratorState._shared_state:
+            return x
+        mesh = AcceleratorState().mesh
+    if mesh is None or np.prod(list(mesh.shape.values())) <= 1:
+        return x
+
+    from ..nn.tape import Tensor, tape_op
+
+    def _constrain(v):
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, activation_spec(v.ndim, mesh))
+        )
+
+    if isinstance(x, Tensor):
+        return tape_op(_constrain, x)
+    return _constrain(x)

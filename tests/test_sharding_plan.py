@@ -1,0 +1,92 @@
+"""Sharding-plan regression tests for the round-1 involuntary-full-remat bug.
+
+The multichip dryrun (dp2×fsdp2×tp2) hit XLA "involuntary full
+rematerialization" because (a) the embedding table got doubly sharded
+(vocab→tp from the tp_plan, embd→fsdp from the ZeRO rule) so every lookup
+emitted an embd-sharded activation, and (b) nothing pinned activations to the
+loader's batch layout.  The fix: gather tables are fsdp-exempt (Megatron
+layout: vocab-over-tp only) and models constrain the residual stream at layer
+boundaries.  MULTICHIP_r02's clean tail is the end-to-end proof; these unit
+tests pin the plan-level invariants.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import accelerate_tpu.nn as nn
+from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+from accelerate_tpu.parallel.sharding import (
+    activation_spec,
+    constrain_activation,
+    plan_param_spec,
+    shard_module_params,
+)
+from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+
+def _mesh():
+    devices = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    return Mesh(devices, ("dp", "fsdp", "tp"))
+
+
+def test_embedding_weight_is_fsdp_exempt():
+    nn.manual_seed(0)
+    emb = nn.Embedding(64, 32)
+    assert getattr(emb.weight, "fsdp_exempt", False)
+
+
+def test_plan_skips_fsdp_for_exempt_params():
+    mesh = _mesh()
+    plugin = FullyShardedDataParallelPlugin(sharding_strategy="FULL_SHARD")
+    spec = plan_param_spec(
+        "wte.weight", (1024, 128), mesh, plugin,
+        tp_plan={r"wte\.weight": ("tp", None)}, fsdp_exempt=True,
+    )
+    assert spec == P("tp", None), f"embedding table must not be fsdp-sharded, got {spec}"
+    # non-exempt params still get ZeRO sharding
+    spec2 = plan_param_spec("h.0.mlp.c_fc.weight", (512, 128), mesh, plugin)
+    assert "fsdp" in [a for a in spec2 if a is not None]
+
+
+def test_gpt_plan_has_no_fsdp_on_embeddings():
+    nn.manual_seed(0)
+    mesh = _mesh()
+    model = GPTLMHeadModel(GPTConfig.tiny())
+    plugin = FullyShardedDataParallelPlugin(sharding_strategy="FULL_SHARD")
+    plan = shard_module_params(model, mesh, fsdp_plugin=plugin)
+    for name in ("wte.weight", "wpe.weight"):
+        assert "fsdp" not in [a for a in plan[name] if a is not None], (
+            f"{name} sharded {plan[name]}: gather tables must stay off the fsdp axis"
+        )
+
+
+def test_activation_spec_matches_loader_layout():
+    mesh = _mesh()
+    assert activation_spec(3, mesh) == P(("dp", "fsdp"), None, None)
+    assert activation_spec(2, mesh) == P(("dp", "fsdp"), None)
+
+
+def test_constrain_activation_applies_batch_sharding():
+    import jax.numpy as jnp
+
+    mesh = _mesh()
+    x = jnp.ones((16, 8, 32))
+    out = jax.jit(lambda v: constrain_activation(v, mesh=mesh))(x)
+    from jax.sharding import NamedSharding
+
+    want = NamedSharding(mesh, activation_spec(3, mesh))
+    assert out.sharding.is_equivalent_to(want, 3), out.sharding
+
+
+def test_constrain_activation_is_differentiable():
+    import jax.numpy as jnp
+
+    mesh = _mesh()
+    from accelerate_tpu.nn import Tensor
+
+    t = Tensor(jnp.ones((4, 4)), requires_grad=True)
+    y = constrain_activation(t, mesh=mesh)
+    (y * y).sum().backward()
+    np.testing.assert_allclose(np.asarray(t.grad), 2 * np.ones((4, 4)))
