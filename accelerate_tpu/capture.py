@@ -82,6 +82,7 @@ class CapturedStep:
             ],
             "opt": [o.optimizer.capture_state() for o in optimizers],
             "rng": nn_random.next_key(),
+            "scaler": acc.scaler.capture_state() if acc.scaler is not None else None,
         }
         return state
 
@@ -97,6 +98,8 @@ class CapturedStep:
                 named[name].grad = g
         for o, s in zip(acc._optimizers, state["opt"]):
             o.optimizer.bind_capture_state(s)
+        if state.get("scaler") is not None and acc.scaler is not None:
+            acc.scaler.bind_capture_state(state["scaler"])
 
     def _snapshot_state(self) -> dict:
         acc = self.accelerator
@@ -111,16 +114,12 @@ class CapturedStep:
                 for m in acc._models
             ],
             "opt": [o.optimizer.capture_state() for o in acc._optimizers],
+            "scaler": acc.scaler.capture_state() if acc.scaler is not None else None,
         }
 
     # -- call ----------------------------------------------------------------
     def __call__(self, *args):
         acc = self.accelerator
-        if acc.scaler is not None:
-            raise NotImplementedError(
-                "compile_step with fp16 dynamic loss scaling is not yet "
-                "supported; use mixed_precision='bf16' (the TPU-native choice)."
-            )
         args = _unwrap_tree(args)
         flat_args, args_treedef = jax.tree_util.tree_flatten(args)
         import numpy as _np
@@ -190,3 +189,5 @@ class CapturedStep:
                 named[name].grad = g
         for o, s in zip(acc._optimizers, new_state["opt"]):
             o.optimizer.bind_capture_state(s)
+        if new_state.get("scaler") is not None and acc.scaler is not None:
+            acc.scaler.bind_capture_state(new_state["scaler"])

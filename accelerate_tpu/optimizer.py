@@ -20,45 +20,76 @@ from .utils.dataclasses import GradScalerKwargs
 
 
 class DynamicLossScaler:
-    """Dynamic fp16 loss scaling (GradScaler parity, reference via torch).
+    """Dynamic fp16 loss scaling (GradScaler parity, reference via torch,
+    accelerator.py:2384 + optimizer.py:161-178).
 
     bf16 — the TPU default — never needs this; it exists for
     ``mixed_precision='fp16'`` parity and numerics experiments.
+
+    State (scale, growth tracker, last-overflow flag) lives in jnp arrays so
+    the whole scaler — overflow detection, step skip, scale backoff/growth —
+    traces into the captured XLA step program: ``update_traced`` is pure
+    ``jnp.where`` math on that state, no host branching.  The overflow flag
+    needs no explicit all-reduce: under GSPMD every device computes the same
+    global isfinite() over the (sharded) grads, XLA inserts the collective.
     """
 
     def __init__(self, kwargs: Optional[GradScalerKwargs] = None):
         kwargs = kwargs or GradScalerKwargs()
-        self.scale = float(kwargs.init_scale)
+        self.scale = jnp.asarray(float(kwargs.init_scale), dtype=jnp.float32)
         self.growth_factor = kwargs.growth_factor
         self.backoff_factor = kwargs.backoff_factor
         self.growth_interval = kwargs.growth_interval
         self.enabled = kwargs.enabled
-        self._growth_tracker = 0
+        self._growth_tracker = jnp.asarray(0, dtype=jnp.int32)
+        self.last_overflow = jnp.asarray(False)
 
     def scale_loss(self, loss):
         return loss * self.scale if self.enabled else loss
 
-    def unscale_(self) -> float:
+    def unscale_(self):
         return 1.0 / self.scale if self.enabled else 1.0
 
-    def update(self, found_inf: bool) -> None:
+    def update_traced(self, finite) -> None:
+        """Pure-jnp scale update: works traced (capture) and eager alike."""
         if not self.enabled:
             return
-        if found_inf:
-            self.scale = max(self.scale * self.backoff_factor, 1.0)
-            self._growth_tracker = 0
-        else:
-            self._growth_tracker += 1
-            if self._growth_tracker >= self.growth_interval:
-                self.scale *= self.growth_factor
-                self._growth_tracker = 0
+        finite = jnp.asarray(finite)
+        tracker = self._growth_tracker + 1
+        grow = tracker >= self.growth_interval
+        scale_ok = jnp.where(grow, self.scale * self.growth_factor, self.scale)
+        tracker_ok = jnp.where(grow, 0, tracker).astype(jnp.int32)
+        self.scale = jnp.where(
+            finite, scale_ok, jnp.maximum(self.scale * self.backoff_factor, 1.0)
+        )
+        self._growth_tracker = jnp.where(finite, tracker_ok, 0).astype(jnp.int32)
+        self.last_overflow = ~finite
 
-    def state_dict(self) -> dict:
-        return {"scale": self.scale, "growth_tracker": self._growth_tracker}
+    def update(self, found_inf: bool) -> None:
+        self.update_traced(jnp.asarray(not found_inf))
 
-    def load_state_dict(self, state: dict) -> None:
+    # -- capture threading ----------------------------------------------------
+    def capture_state(self) -> dict:
+        return {
+            "scale": self.scale,
+            "growth_tracker": self._growth_tracker,
+            "last_overflow": self.last_overflow,
+        }
+
+    def bind_capture_state(self, state: dict) -> None:
         self.scale = state["scale"]
         self._growth_tracker = state["growth_tracker"]
+        self.last_overflow = state["last_overflow"]
+
+    def state_dict(self) -> dict:
+        return {
+            "scale": float(self.scale),
+            "growth_tracker": int(self._growth_tracker),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.scale = jnp.asarray(float(state["scale"]), dtype=jnp.float32)
+        self._growth_tracker = jnp.asarray(int(state["growth_tracker"]), dtype=jnp.int32)
 
 
 class AcceleratedOptimizer:
@@ -107,26 +138,61 @@ class AcceleratedOptimizer:
             return  # mid-accumulation micro-step: skip (reference optimizer.py:161)
         self._accelerate_step_called = True
         if self.scaler is not None:
-            import jax
-
-            # single fused finite-check over all grads
-            grads = [
-                p.grad for p in self.optimizer.param_list if p.grad is not None
-            ]
-            finite = all(bool(jnp.isfinite(g).all()) for g in grads)
-            if finite:
-                self.optimizer.step(closure, grad_scale=self.scaler.unscale_())
-                self._is_overflow = False
-            else:
-                self._is_overflow = True
-            self.scaler.update(found_inf=not finite)
+            self._step_with_scaler(closure)
         else:
             self.optimizer.step(closure)
+
+    def _step_with_scaler(self, closure) -> None:
+        """fp16 step: finite-check, unscale, conditionally apply, update scale.
+
+        Fully traceable: instead of a host-side branch (reference
+        optimizer.py:161-178 via torch GradScaler), the update always runs on
+        overflow-sanitized grads and a ``jnp.where`` select keeps the old
+        params/opt-state when any grad was non-finite — so the same code path
+        works eagerly and inside ``compile_step`` (one XLA program, the skip
+        compiled in as a select).
+        """
+        import jax
+
+        opt = self.optimizer
+        grads = [p.grad for p in opt.param_list if p.grad is not None]
+        finite = jnp.asarray(True)
+        for g in grads:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+
+        opt._ensure_master()
+        params_before = [p.data for p in opt.param_list]
+        masters_before = list(opt.master_params)
+        opt_state_before = opt.opt_state
+        # sanitize so the speculative update never poisons Adam moments
+        for p in opt.param_list:
+            if p.grad is not None:
+                p.grad = jnp.where(jnp.isfinite(p.grad), p.grad, 0.0).astype(p.grad.dtype)
+        opt.step(closure, grad_scale=self.scaler.unscale_())
+
+        def _sel(new, old):
+            return jnp.where(finite, new, old) if hasattr(old, "dtype") else new
+
+        for i, p in enumerate(opt.param_list):
+            p.data = _sel(p.data, params_before[i])
+            if opt.master_params[i] is not None and masters_before[i] is not None:
+                opt.master_params[i] = _sel(opt.master_params[i], masters_before[i])
+        opt.opt_state = jax.tree_util.tree_map(_sel, opt.opt_state, opt_state_before)
+        self.scaler.update_traced(finite)
+        try:
+            self._is_overflow = bool(~finite)  # eager: concrete immediately
+        except jax.errors.TracerBoolConversionError:
+            self._is_overflow = None  # captured: read scaler.last_overflow
 
     @property
     def step_was_skipped(self) -> bool:
         """True when the last ``step`` was dropped due to fp16 overflow."""
-        return self._is_overflow
+        if self._is_overflow is None and self.scaler is not None:
+            # captured step: the flag was threaded through the compiled
+            # program; by the time anyone asks (scheduler replay, user code)
+            # the state has been written back as a concrete array
+            return bool(self.scaler.last_overflow)
+        return bool(self._is_overflow)
 
     def train(self):
         if hasattr(self.optimizer, "train"):

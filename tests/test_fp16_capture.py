@@ -1,0 +1,114 @@
+"""fp16 dynamic loss scaling inside the captured step (VERDICT round-1 #6).
+
+Reference semantics: torch GradScaler skips optimizer.step on overflow and
+halves the scale (accelerator.py:2384, optimizer.py:161-178).  Here the whole
+scaler traces into the XLA program: overflow detection is a jnp.all(isfinite)
+select, the skip is a jnp.where on params/opt-state, and the scale update is
+pure state threading — verified below by inducing a real overflow.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator
+from accelerate_tpu.nn import F, Tensor
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    nn.manual_seed(0)
+    yield
+    Accelerator._reset_state()
+
+
+def _setup(init_scale=2.0**8):
+    from accelerate_tpu.utils.dataclasses import GradScalerKwargs
+
+    acc = Accelerator(
+        mixed_precision="fp16",
+        kwargs_handlers=[GradScalerKwargs(init_scale=init_scale, growth_interval=2000)],
+    )
+    model = nn.Linear(4, 4)
+    opt = optim.AdamW(model.parameters(), lr=1e-2)
+    model, opt = acc.prepare(model, opt)
+    return acc, model, opt
+
+
+def test_captured_fp16_normal_step_updates_params():
+    acc, model, opt = _setup()
+
+    def step_fn(x, y):
+        opt.zero_grad()
+        loss = F.mse_loss(model(x), y)
+        acc.backward(loss)
+        opt.step()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    x = Tensor(jnp.ones((2, 4), jnp.float16))
+    y = Tensor(jnp.zeros((2, 4), jnp.float16))
+    before = np.asarray(model.weight.data, dtype=np.float32).copy()
+    loss = step(x, y)
+    after = np.asarray(model.weight.data, dtype=np.float32)
+    assert np.isfinite(float(loss))
+    assert not np.allclose(before, after), "normal fp16 step must update params"
+    assert not opt.step_was_skipped
+
+
+def test_captured_fp16_overflow_skips_step_and_halves_scale():
+    acc, model, opt = _setup(init_scale=2.0**8)
+
+    def step_fn(x, y, poison):
+        opt.zero_grad()
+        loss = F.mse_loss(model(x), y)
+        acc.backward(loss)
+        # poison one grad with the traced value (inf when poison=1):
+        # emulates an fp16 overflow inside the backward
+        p0 = opt.optimizer.param_list[0]
+        p0.grad = p0.grad + jnp.asarray(poison, dtype=p0.grad.dtype)
+        opt.step()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    x = Tensor(jnp.ones((2, 4), jnp.float16))
+    y = Tensor(jnp.zeros((2, 4), jnp.float16))
+
+    inf = jnp.asarray(np.inf, jnp.float32)
+    zero = jnp.asarray(0.0, jnp.float32)
+
+    # step 1: overflow — params frozen, scale halved, step marked skipped
+    before = np.asarray(model.weight.data, dtype=np.float32).copy()
+    scale_before = float(acc.scaler.scale)
+    step(x, y, inf)
+    after = np.asarray(model.weight.data, dtype=np.float32)
+    np.testing.assert_array_equal(before, after)
+    assert float(acc.scaler.scale) == scale_before * 0.5
+    assert opt.step_was_skipped
+
+    # step 2 (same compiled program, clean grads): params move, scale stable
+    step(x, y, zero)
+    after2 = np.asarray(model.weight.data, dtype=np.float32)
+    assert not np.allclose(after, after2)
+    assert float(acc.scaler.scale) == scale_before * 0.5
+    assert not opt.step_was_skipped
+
+
+def test_eager_fp16_overflow_parity():
+    """The same semantics hold without capture (eager loop)."""
+    acc, model, opt = _setup(init_scale=2.0**4)
+    x = Tensor(jnp.ones((2, 4), jnp.float16))
+    y = Tensor(jnp.zeros((2, 4), jnp.float16))
+    opt.zero_grad()
+    loss = F.mse_loss(model(x), y)
+    acc.backward(loss)
+    p0 = opt.optimizer.param_list[0]
+    p0.grad = p0.grad * jnp.asarray(np.inf, dtype=p0.grad.dtype)
+    before = np.asarray(model.weight.data, dtype=np.float32).copy()
+    opt.step()
+    np.testing.assert_array_equal(before, np.asarray(model.weight.data, dtype=np.float32))
+    assert opt.step_was_skipped
+    assert float(acc.scaler.scale) == 2.0**3
