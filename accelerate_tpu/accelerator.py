@@ -143,6 +143,8 @@ class Accelerator:
             self.scaler = DynamicLossScaler(self.scaler_handler)
 
         self._models: list[Module] = []
+        self._converted_models: list[Module] = []  # torch→native conversions
+        self._converted_optimizers: list[tuple] = []  # (torch_opt, native_opt)
         self._optimizers: list[AcceleratedOptimizer] = []
         self._schedulers: list[AcceleratedScheduler] = []
         self._dataloaders: list[DataLoaderShard] = []
@@ -287,10 +289,44 @@ class Accelerator:
         result = []
         for obj in args:
             result.append(self._prepare_one(obj))
-        # re-point optimizer master state at possibly re-laid-out params
+        # re-lay-out optimizer state (Adam moments, fp32 masters) onto the
+        # params' mesh shardings: tx.init ran before prepare() sharded the
+        # params, so without this the opt state stays on the old layout and
+        # ZeRO saves no memory (reference FSDP shards optimizer state too,
+        # accelerator.py:1555-1679)
+        for opt in self._optimizers:
+            opt.optimizer.relayout_for_sharded_params()
         return result[0] if len(result) == 1 else tuple(result)
 
     def _prepare_one(self, obj):
+        from .utils.torch_bridge import (
+            convert_torch_module,
+            convert_torch_optimizer,
+            convert_torch_scheduler,
+            is_torch_lr_scheduler,
+            is_torch_module,
+            is_torch_optimizer,
+        )
+
+        if is_torch_module(obj):
+            # reference prepare_model takes any torch.nn.Module
+            # (accelerator.py:1421); convert supported architectures to the
+            # native nn with weights copied, then prepare as usual
+            obj = convert_torch_module(obj)
+            self._converted_models.append(obj)
+        elif is_torch_optimizer(obj):
+            # param identity can't cross the torch→JAX boundary: rebuild over
+            # the converted models' params (reference's XLA param remap,
+            # accelerator.py:1376-1410, same problem one framework harder)
+            torch_opt = obj
+            obj = convert_torch_optimizer(
+                torch_opt, self._converted_models or self._models
+            )
+            self._converted_optimizers.append((torch_opt, obj))
+        elif is_torch_lr_scheduler(obj):
+            # the scheduler must drive the CONVERTED optimizer, not the
+            # discarded torch one (silent frozen-LR bug otherwise)
+            obj = convert_torch_scheduler(obj, self._converted_optimizers)
         if isinstance(obj, Module):
             return self.prepare_model(obj)
         if isinstance(obj, AcceleratedOptimizer):

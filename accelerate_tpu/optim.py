@@ -99,6 +99,47 @@ class Optimizer:
                 p.data = new
         self._step_count += 1
 
+    def relayout_for_sharded_params(self) -> None:
+        """Move optimizer state + fp32 masters onto the params' shardings.
+
+        ``tx.init`` runs at construction time, *before* ``Accelerator.prepare``
+        lays params out on the mesh — so the Adam moments (and any master
+        copies already created) are committed to the pre-sharding layout.  For
+        ZeRO semantics (reference FSDP optimizer-state sharding,
+        accelerator.py:1555-1679) every per-param state leaf must live on the
+        same ``fsdp``/``tp`` shards as its parameter.  Optax states keep
+        per-param leaves in the same container the params were passed in (a
+        list here), so each leaf's tree path carries a ``SequenceKey`` whose
+        index identifies the owning parameter — we match on that plus an exact
+        shape check (factored states like Adafactor's keep their own layout).
+        """
+        self._ensure_master()
+        shardings = [p.data.sharding for p in self.param_list]
+        shapes = [tuple(p.shape) for p in self.param_list]
+        for i, m in enumerate(self.master_params):
+            if m is not None:
+                self.master_params[i] = jax.device_put(m, shardings[i])
+
+        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(self.opt_state)
+        new_leaves = []
+        for path, leaf in leaves_with_path:
+            idx = None
+            for key in reversed(path):
+                if isinstance(key, jax.tree_util.SequenceKey):
+                    idx = key.idx
+                    break
+            if (
+                idx is not None
+                and idx < len(shapes)
+                and hasattr(leaf, "shape")
+                and tuple(leaf.shape) == shapes[idx]
+            ):
+                leaf = jax.device_put(leaf, shardings[idx])
+            new_leaves.append(leaf)
+        self.opt_state = jax.tree_util.tree_unflatten(
+            treedef, new_leaves
+        )
+
     # -- functional bridge (used by Accelerator's step capture) --------------
     def capture_state(self) -> dict:
         self._ensure_master()

@@ -1,0 +1,258 @@
+"""HF-checkpoint ingestion: name-mapped loading into the native models.
+
+The reference's core value proposition is wrapping *existing* torch/HF models
+(reference accelerator.py:1421 ``prepare_model`` takes any ``torch.nn.Module``;
+README.md:50-82).  This module is the checkpoint half of that bridge: weights
+from a Hugging Face BERT / GPT-2 checkpoint (safetensors or torch .bin, local
+path or already-loaded state dict) land in ``models/bert.py`` /
+``models/gpt.py`` via explicit name maps — so fine-tuning starts from real
+pretrained weights, matching the reference's `examples/nlp_example.py`
+workload.  The module half (live ``torch.nn.Module`` conversion) is
+``utils/torch_bridge.py``.
+
+No network access is assumed anywhere: ``path`` is a local directory/file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# state-dict reading (safetensors preferred, torch pickle fallback)
+# ---------------------------------------------------------------------------
+def load_hf_state_dict(path: str) -> dict[str, np.ndarray]:
+    """Load all weights from a HF checkpoint directory or single file."""
+    files: list[str] = []
+    if os.path.isdir(path):
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                files = sorted(
+                    {os.path.join(path, v) for v in json.load(f)["weight_map"].values()}
+                )
+        elif os.path.exists(os.path.join(path, "model.safetensors")):
+            files = [os.path.join(path, "model.safetensors")]
+        elif os.path.exists(os.path.join(path, "pytorch_model.bin")):
+            files = [os.path.join(path, "pytorch_model.bin")]
+        else:
+            raise FileNotFoundError(
+                f"no model.safetensors(.index.json) or pytorch_model.bin in {path}"
+            )
+    else:
+        files = [path]
+
+    state: dict[str, np.ndarray] = {}
+    for f in files:
+        if f.endswith(".safetensors"):
+            from safetensors.numpy import load_file
+
+            state.update(load_file(f))
+        else:
+            import torch
+
+            sd = torch.load(f, map_location="cpu", weights_only=True)
+            state.update({k: v.numpy() for k, v in sd.items()})
+    return state
+
+
+def load_hf_config(path: str) -> Optional[dict]:
+    cfg = os.path.join(path, "config.json") if os.path.isdir(path) else None
+    if cfg and os.path.exists(cfg):
+        with open(cfg) as f:
+            return json.load(f)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# name maps
+# ---------------------------------------------------------------------------
+_BERT_RULES: list[tuple[str, str]] = [
+    # (HF pattern, our replacement) — applied with re.sub, first match wins
+    (r"^bert\.embeddings\.", "bert.embeddings."),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.self\.(query|key|value)\.", r"bert.layer.\1.attention.\2."),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.output\.dense\.", r"bert.layer.\1.attention_output."),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.output\.LayerNorm\.", r"bert.layer.\1.attention_norm."),
+    (r"^bert\.encoder\.layer\.(\d+)\.intermediate\.dense\.", r"bert.layer.\1.intermediate."),
+    (r"^bert\.encoder\.layer\.(\d+)\.output\.dense\.", r"bert.layer.\1.output."),
+    (r"^bert\.encoder\.layer\.(\d+)\.output\.LayerNorm\.", r"bert.layer.\1.output_norm."),
+    (r"^bert\.pooler\.dense\.", "bert.pooler."),
+    (r"^classifier\.", "classifier."),
+]
+
+_BERT_SKIP = (
+    # decoder/MLM heads and relative-position tables we don't model
+    r"^cls\.",
+    r"position_ids$",  # HF buffer, not a weight
+)
+
+
+def map_bert_key(hf_key: str) -> Optional[str]:
+    """HF BertForSequenceClassification key → models/bert.py key (or None)."""
+    for pat in _BERT_SKIP:
+        if re.search(pat, hf_key):
+            return None
+    key = hf_key
+    if not key.startswith(("bert.", "classifier.")):
+        key = "bert." + key  # bare BertModel checkpoints
+    for pattern, repl in _BERT_RULES:
+        if re.match(pattern, key):
+            return re.sub(pattern, repl, key)
+    return None
+
+
+# HF GPT-2 uses Conv1D: weight stored (in, out) — transposed vs nn.Linear
+_GPT2_TRANSPOSE = re.compile(r"\.(c_attn|c_proj|c_fc)\.weight$")
+_GPT2_SKIP = (r"\.attn\.bias$", r"\.attn\.masked_bias$", r"^lm_head\.weight$")
+
+
+def map_gpt2_key(hf_key: str) -> Optional[tuple[str, bool]]:
+    """HF GPT2LMHeadModel key → (models/gpt.py key, needs_transpose)."""
+    key = hf_key
+    if key.startswith("transformer."):
+        key = key[len("transformer."):]
+    for pat in _GPT2_SKIP:
+        if re.search(pat, hf_key):
+            return None  # causal-mask buffers; lm_head is weight-tied to wte
+    return key, bool(_GPT2_TRANSPOSE.search(key))
+
+
+# ---------------------------------------------------------------------------
+# generic application
+# ---------------------------------------------------------------------------
+def load_mapped_state_dict(
+    model,
+    hf_state: dict[str, np.ndarray],
+    key_map: Callable,
+    strict: bool = False,
+    pad_vocab_to: Optional[int] = None,
+) -> tuple[list[str], list[str]]:
+    """Copy HF weights into ``model`` through ``key_map``.
+
+    ``key_map(hf_key)`` returns our key, ``(our_key, transpose)``, or None to
+    skip.  ``pad_vocab_to``: zero-pad embedding rows (MXU-friendly padded
+    vocab, e.g. GPT-2 50257 → 50304).  Returns (missing_ours, unexpected_hf).
+    """
+    params = dict(model.named_parameters())
+    loaded: set[str] = set()
+    unexpected: list[str] = []
+    for hf_key, value in hf_state.items():
+        mapped = key_map(hf_key)
+        if mapped is None:
+            continue
+        transpose = False
+        if isinstance(mapped, tuple):
+            mapped, transpose = mapped
+        if mapped not in params:
+            unexpected.append(hf_key)
+            continue
+        arr = np.asarray(value)
+        if transpose:
+            arr = arr.T
+        p = params[mapped]
+        if arr.shape != tuple(p.shape):
+            if (
+                pad_vocab_to
+                and arr.ndim == 2
+                and tuple(p.shape) == (pad_vocab_to, arr.shape[1])
+            ):
+                pad = np.zeros((pad_vocab_to - arr.shape[0], arr.shape[1]), arr.dtype)
+                arr = np.concatenate([arr, pad], axis=0)
+            else:
+                raise ValueError(
+                    f"shape mismatch for {hf_key} -> {mapped}: "
+                    f"checkpoint {arr.shape} vs model {tuple(p.shape)}"
+                )
+        p.data = jnp.asarray(arr, dtype=p.dtype)
+        loaded.add(mapped)
+    missing = [k for k in params if k not in loaded]
+    if strict and (missing or unexpected):
+        raise ValueError(
+            f"strict load failed: missing={missing[:8]}... unexpected={unexpected[:8]}..."
+        )
+    return missing, unexpected
+
+
+# ---------------------------------------------------------------------------
+# model-level entry points
+# ---------------------------------------------------------------------------
+def bert_config_from_hf(cfg: dict, num_labels: int = 2):
+    from ..models.bert import BertConfig
+
+    return BertConfig(
+        vocab_size=cfg.get("vocab_size", 30522),
+        hidden_size=cfg.get("hidden_size", 768),
+        num_hidden_layers=cfg.get("num_hidden_layers", 12),
+        num_attention_heads=cfg.get("num_attention_heads", 12),
+        intermediate_size=cfg.get("intermediate_size", 3072),
+        max_position_embeddings=cfg.get("max_position_embeddings", 512),
+        type_vocab_size=cfg.get("type_vocab_size", 2),
+        hidden_dropout_prob=cfg.get("hidden_dropout_prob", 0.1),
+        attention_probs_dropout_prob=cfg.get("attention_probs_dropout_prob", 0.1),
+        layer_norm_eps=cfg.get("layer_norm_eps", 1e-12),
+        num_labels=num_labels,
+    )
+
+
+def gpt2_config_from_hf(cfg: dict):
+    from ..models.gpt import GPTConfig
+
+    vocab = cfg.get("vocab_size", 50257)
+    return GPTConfig(
+        vocab_size=((vocab + 127) // 128) * 128,  # MXU-pad; extra rows zero
+        n_positions=cfg.get("n_positions", 1024),
+        n_embd=cfg.get("n_embd", 768),
+        n_layer=cfg.get("n_layer", 12),
+        n_head=cfg.get("n_head", 12),
+        dropout=cfg.get("resid_pdrop", 0.0) or 0.0,
+        layer_norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def from_pretrained(path: str, architecture: Optional[str] = None, num_labels: int = 2):
+    """Build + load a native model from a local HF checkpoint directory.
+
+    ``architecture``: "bert" | "gpt2" | None (inferred from config.json).
+    """
+    cfg = load_hf_config(path) or {}
+    if architecture is None:
+        model_type = cfg.get("model_type", "")
+        archs = " ".join(cfg.get("architectures", []) or [])
+        if model_type == "bert" or "Bert" in archs:
+            architecture = "bert"
+        elif model_type == "gpt2" or "GPT2" in archs:
+            architecture = "gpt2"
+        else:
+            raise ValueError(
+                f"cannot infer architecture from {path}; pass architecture='bert'|'gpt2'"
+            )
+    state = load_hf_state_dict(path)
+    if architecture == "bert":
+        from ..models.bert import BertForSequenceClassification
+
+        model = BertForSequenceClassification(bert_config_from_hf(cfg, num_labels))
+        missing, unexpected = load_mapped_state_dict(model, state, map_bert_key)
+        # the classifier head is fresh for fine-tuning: missing is expected
+        core_missing = [m for m in missing if not m.startswith("classifier.")]
+        if core_missing:
+            raise ValueError(f"BERT load left core weights uninitialised: {core_missing[:8]}")
+        return model
+    if architecture == "gpt2":
+        from ..models.gpt import GPTLMHeadModel
+
+        config = gpt2_config_from_hf(cfg)
+        model = GPTLMHeadModel(config)
+        missing, _ = load_mapped_state_dict(
+            model, state, map_gpt2_key, pad_vocab_to=config.vocab_size
+        )
+        missing = [m for m in missing if "lm_head" not in m]
+        if missing:
+            raise ValueError(f"GPT-2 load left weights uninitialised: {missing[:8]}")
+        return model
+    raise ValueError(f"unsupported architecture {architecture!r}")

@@ -1,0 +1,192 @@
+"""torch→JAX bridge parity tests (VERDICT round-1 #2: HF/torch ingestion).
+
+Covers both halves of the bridge: live ``torch.nn.Module`` conversion
+(utils/torch_bridge.py — reference prepare_model accepts any torch module,
+accelerator.py:1421) and HF-checkpoint name mapping (utils/hf.py).  Parity is
+asserted numerically: the converted native model must reproduce the torch
+forward on the same inputs.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp
+
+import accelerate_tpu.nn as nn
+from accelerate_tpu.nn import Tensor
+from accelerate_tpu.utils.torch_bridge import (
+    convert_torch_module,
+    convert_torch_optimizer,
+    is_torch_module,
+)
+
+
+def test_sequential_conversion_parity():
+    torch.manual_seed(0)
+    tm = torch.nn.Sequential(
+        torch.nn.Linear(8, 16),
+        torch.nn.ReLU(),
+        torch.nn.LayerNorm(16),
+        torch.nn.Linear(16, 4),
+        torch.nn.Tanh(),
+    ).eval()
+    ours = convert_torch_module(tm)
+    x = np.random.default_rng(0).normal(size=(3, 8)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x)).numpy()
+    got = np.asarray(ours(Tensor(jnp.asarray(x))).data)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_unsupported_module_raises_helpfully():
+    class Custom(torch.nn.Module):
+        def forward(self, x):
+            return x * 2
+
+    with pytest.raises(TypeError, match="accelerate_tpu.nn"):
+        convert_torch_module(Custom())
+
+
+def test_torch_optimizer_conversion():
+    torch.manual_seed(0)
+    tm = torch.nn.Sequential(torch.nn.Linear(4, 4)).eval()
+    ours = convert_torch_module(tm)
+    topt = torch.optim.AdamW(tm.parameters(), lr=3e-4, weight_decay=0.05)
+    opt = convert_torch_optimizer(topt, [ours])
+    assert abs(opt.defaults["lr"] - 3e-4) < 1e-12
+    assert abs(opt.defaults["weight_decay"] - 0.05) < 1e-12
+    assert len(opt.param_list) == 2  # weight + bias
+
+
+@pytest.mark.parametrize("arch", ["bert", "gpt2"])
+def test_transformers_conversion_parity(arch):
+    transformers = pytest.importorskip("transformers")
+    torch.manual_seed(0)
+    rng = np.random.default_rng(0)
+
+    if arch == "bert":
+        cfg = transformers.BertConfig(
+            vocab_size=128,
+            hidden_size=32,
+            num_hidden_layers=2,
+            num_attention_heads=2,
+            intermediate_size=64,
+            max_position_embeddings=64,
+            hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0,
+            num_labels=2,
+        )
+        tm = transformers.BertForSequenceClassification(cfg).eval()
+        ours = convert_torch_module(tm)
+        ids = rng.integers(0, 128, size=(2, 16))
+        with torch.no_grad():
+            want = tm(torch.from_numpy(ids)).logits.numpy()
+        got = np.asarray(ours(jnp.asarray(ids, dtype=jnp.int32))["logits"].data)
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+    else:
+        cfg = transformers.GPT2Config(
+            vocab_size=128,
+            n_positions=64,
+            n_embd=32,
+            n_layer=2,
+            n_head=2,
+            resid_pdrop=0.0,
+            embd_pdrop=0.0,
+            attn_pdrop=0.0,
+        )
+        tm = transformers.GPT2LMHeadModel(cfg).eval()
+        ours = convert_torch_module(tm)
+        ids = rng.integers(0, 128, size=(2, 16))
+        with torch.no_grad():
+            want = tm(torch.from_numpy(ids)).logits.numpy()
+        logits = np.asarray(ours(jnp.asarray(ids, dtype=jnp.int32))["logits"].data)
+        # our vocab is MXU-padded to a 128 multiple; compare the real rows
+        np.testing.assert_allclose(
+            logits[..., : want.shape[-1]], want, atol=2e-4, rtol=2e-4
+        )
+
+
+def test_prepare_accepts_torch_module():
+    from accelerate_tpu import Accelerator
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    try:
+        acc = Accelerator()
+        torch.manual_seed(0)
+        tm = torch.nn.Sequential(torch.nn.Linear(4, 4), torch.nn.ReLU())
+        topt = torch.optim.SGD(tm.parameters(), lr=0.1)
+        model, opt = acc.prepare(tm, topt)
+        assert isinstance(model, nn.Module) and not is_torch_module(model)
+        x = Tensor(jnp.ones((2, 4)))
+        y = model(x)
+        loss = (y * y).sum()
+        acc.backward(loss)
+        opt.step()
+    finally:
+        Accelerator._reset_state()
+
+
+def test_torch_scheduler_drives_converted_optimizer():
+    """A torch LR scheduler through prepare() must step the NATIVE optimizer
+    (stepping the discarded torch optimizer = silent frozen-LR training)."""
+    from accelerate_tpu import Accelerator
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    try:
+        acc = Accelerator()
+        tm = torch.nn.Sequential(torch.nn.Linear(4, 4))
+        topt = torch.optim.SGD(tm.parameters(), lr=1.0)
+        tsched = torch.optim.lr_scheduler.LambdaLR(topt, lambda s: 1.0 / (s + 1))
+        model, opt, sched = acc.prepare(tm, topt, tsched)
+        lr0 = float(opt.lr)
+        x = Tensor(jnp.ones((2, 4)))
+        for _ in range(3):
+            opt.zero_grad()
+            loss = (model(x) ** 2).sum()
+            acc.backward(loss)
+            opt.step()
+            sched.step()
+        lr3 = float(opt.lr)
+        assert lr0 == pytest.approx(1.0)
+        assert lr3 < lr0, f"native optimizer LR frozen at {lr3} — scheduler not remapped"
+    finally:
+        Accelerator._reset_state()
+
+
+def test_hf_checkpoint_roundtrip(tmp_path):
+    """Save a torch BERT state dict → load through utils/hf name mapping."""
+    transformers = pytest.importorskip("transformers")
+    torch.manual_seed(0)
+    cfg = transformers.BertConfig(
+        vocab_size=64,
+        hidden_size=16,
+        num_hidden_layers=1,
+        num_attention_heads=2,
+        intermediate_size=32,
+        max_position_embeddings=32,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    tm = transformers.BertForSequenceClassification(cfg).eval()
+    ckpt = tmp_path / "bert"
+    ckpt.mkdir()
+    from safetensors.numpy import save_file
+
+    save_file(
+        {k: v.numpy() for k, v in tm.state_dict().items()},
+        str(ckpt / "model.safetensors"),
+    )
+    (ckpt / "config.json").write_text(cfg.to_json_string())
+
+    from accelerate_tpu.utils.hf import from_pretrained
+
+    ours = from_pretrained(str(ckpt))
+    ids = np.random.default_rng(0).integers(0, 64, size=(2, 8))
+    with torch.no_grad():
+        want = tm.bert(torch.from_numpy(ids)).pooler_output.numpy()
+    _, pooled = ours.bert(jnp.asarray(ids, dtype=jnp.int32))
+    np.testing.assert_allclose(np.asarray(pooled.data), want, atol=2e-4, rtol=2e-4)

