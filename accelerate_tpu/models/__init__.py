@@ -1,5 +1,5 @@
 from .bert import BertConfig, BertForSequenceClassification, BertModel
-from .gpt import GPTConfig, GPTLMHeadModel
+from .gpt import GPTConfig, GPTLMHeadModel, PipelinedGPTLMHeadModel
 
 # name → zero-arg builder; used by `accelerate-tpu estimate-memory` and tests
 MODEL_REGISTRY = {

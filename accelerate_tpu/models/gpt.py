@@ -11,6 +11,7 @@ import dataclasses
 import math
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -169,3 +170,162 @@ class GPTLMHeadModel(nn.Module):
         c = self.config
         attn = 12 * c.n_layer * c.n_embd * c.n_positions
         return 6 * n + attn
+
+
+# ---------------------------------------------------------------------------
+# Pipelined variant: stacked per-layer params + GPipe over pp + ring over sp
+# ---------------------------------------------------------------------------
+def _pure_layernorm(x, w, b, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return ((x - mu) * jax.lax.rsqrt(var + eps)) * w + b
+
+
+def _pipelined_block(p, h, *, n_head: int, eps: float, seq_axis: str):
+    """One pre-norm GPT block as pure jnp, runnable inside shard_map.
+
+    Attention goes through the ring-attention per-device body over
+    ``seq_axis`` — with sp=1 the ring has one hop and reduces to plain causal
+    SDPA, so pp-only and pp×sp use the same code.
+    """
+    from ..ops.ring_attention import _ring_attention_local
+
+    b, s, c = h.shape
+    hd = c // n_head
+    h1 = _pure_layernorm(h, p["ln1_w"], p["ln1_b"], eps)
+    qkv = h1 @ p["qkv_w"].T + p["qkv_b"]
+    qkv = qkv.reshape(b, s, 3, n_head, hd).transpose(2, 0, 3, 1, 4)
+    att = _ring_attention_local(
+        qkv[0], qkv[1], qkv[2], axis_name=seq_axis, is_causal=True, scale=hd**-0.5
+    )
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, c)
+    h = h + att @ p["proj_w"].T + p["proj_b"]
+    h2 = _pure_layernorm(h, p["ln2_w"], p["ln2_b"], eps)
+    ff = jax.nn.gelu(h2 @ p["fc_w"].T + p["fc_b"], approximate=True)
+    return h + ff @ p["fcproj_w"].T + p["fcproj_b"]
+
+
+class _StackedBlocks(nn.Module):
+    """Per-layer GPT block weights stacked on a leading layer axis.
+
+    The layer axis is sharded over ``pp`` (see tp_plan on the parent): each
+    pipeline stage holds a contiguous span of layers, the TPU-native reading
+    of the reference's PiPPy split-at-layer-boundaries
+    (reference inference.py:124).
+    """
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        import jax as _jax
+
+        from ..nn import random as nn_random
+
+        L, E = config.n_layer, config.n_embd
+        scale = 0.02
+        resid = scale / math.sqrt(2 * L)
+
+        def norm(shape, std):
+            return nn.Parameter(
+                std * _jax.random.normal(nn_random.next_key(), shape, jnp.float32)
+            )
+
+        self.ln1_w = nn.Parameter(jnp.ones((L, E)))
+        self.ln1_b = nn.Parameter(jnp.zeros((L, E)))
+        self.qkv_w = norm((L, 3 * E, E), scale)
+        self.qkv_b = nn.Parameter(jnp.zeros((L, 3 * E)))
+        self.proj_w = norm((L, E, E), resid)
+        self.proj_b = nn.Parameter(jnp.zeros((L, E)))
+        self.ln2_w = nn.Parameter(jnp.ones((L, E)))
+        self.ln2_b = nn.Parameter(jnp.zeros((L, E)))
+        self.fc_w = norm((L, 4 * E, E), scale)
+        self.fc_b = nn.Parameter(jnp.zeros((L, 4 * E)))
+        self.fcproj_w = norm((L, E, 4 * E), resid)
+        self.fcproj_b = nn.Parameter(jnp.zeros((L, E)))
+
+    _ORDER = (
+        "ln1_w", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
+        "ln2_w", "ln2_b", "fc_w", "fc_b", "fcproj_w", "fcproj_b",
+    )
+
+    def param_tensors(self):
+        return [getattr(self, n) for n in self._ORDER]
+
+
+class PipelinedGPTLMHeadModel(nn.Module):
+    """GPT-2 whose trunk runs as a GPipe pipeline over the ``pp`` mesh axis
+    with ring attention over ``sp`` — pp × sp × dp/fsdp in ONE shard_map.
+
+    Embeddings and the (tied) head stay outside the pipeline (GPipe classic:
+    every pipelined layer must be shape-preserving).  TP inside the pipeline
+    body is intentionally out of scope — on-slice, GSPMD tp on the unrolled
+    ``GPTLMHeadModel`` is the faster layout; pp/sp earn their keep across
+    slices and long sequences (SURVEY.md §2.2 rows PP/SP).
+    """
+
+    tp_plan = {
+        r"blocks\..*": ("pp",),  # leading layer axis → pipeline stages
+        r"wte\.weight": ("tp", None),
+    }
+
+    def __init__(self, config: GPTConfig, num_microbatches: int = 2):
+        super().__init__()
+        self.config = config
+        self.num_microbatches = num_microbatches
+        self.wte = nn.Embedding(config.vocab_size, config.n_embd)
+        self.wpe = nn.Embedding(config.n_positions, config.n_embd)
+        self.blocks = _StackedBlocks(config)
+        self.ln_f = nn.LayerNorm(config.n_embd, eps=config.layer_norm_eps)
+        from ..nn.meta import is_meta, meta_init
+
+        with meta_init():
+            self.lm_head = nn.Linear(config.n_embd, config.vocab_size, bias=False)
+        self.lm_head.weight = self.wte.weight
+        # GPT-2 embedding init (the stacked blocks init themselves)
+        for emb in (self.wte, self.wpe):
+            if not is_meta(emb.weight.data):
+                emb.weight.data = emb.weight.data * 0.02
+
+    def forward(self, input_ids, labels=None):
+        from ..parallel.pipeline import gpipe
+        from ..parallel.sharding import constrain_activation
+        from ..state import AcceleratorState
+
+        mesh = AcceleratorState().mesh if AcceleratorState._shared_state else None
+
+        ids = jnp.asarray(input_ids.data if isinstance(input_ids, Tensor) else input_ids)
+        b, s = ids.shape
+        pos = jnp.arange(s)[None, :]
+        x = self.wte(ids) + self.wpe(pos)
+        x = constrain_activation(x)
+
+        cfg = self.config
+        names = _StackedBlocks._ORDER
+
+        def trunk(xv, *flat_params):
+            stacked = dict(zip(names, flat_params))
+
+            def stage_fn(layer_params, h):
+                return _pipelined_block(
+                    layer_params, h,
+                    n_head=cfg.n_head, eps=cfg.layer_norm_eps, seq_axis="sp",
+                )
+
+            return gpipe(
+                stage_fn,
+                stacked,
+                xv,
+                num_microbatches=self.num_microbatches,
+                mesh=mesh,
+                seq_axis="sp",
+            )
+
+        x = nn.tape_op(trunk, x, *self.blocks.param_tensors())
+        x = self.ln_f(x)
+        logits = self.lm_head(x)
+        if labels is not None:
+            lab = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
+            shift_logits = logits[:, :-1, :].reshape(-1, cfg.vocab_size)
+            shift_labels = lab[:, 1:].reshape(-1)
+            loss = F.cross_entropy(shift_logits, shift_labels)
+            return {"loss": loss, "logits": logits}
+        return {"logits": logits}

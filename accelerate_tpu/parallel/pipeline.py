@@ -2,15 +2,21 @@
 
 Counterpart of the reference's PiPPy integration (inference.py:124
 ``prepare_pippy`` — trace, split at layer boundaries, ScheduleGPipe) rebuilt
-as SPMD: stage parameters carry a leading stage axis sharded over ``pp``;
-under ``shard_map`` each device runs its own stage and activations hop to the
-next stage with ``lax.ppermute`` each tick.  ``T = num_microbatches +
-num_stages - 1`` ticks fill and drain the pipeline; everything is pure jnp so
-JAX transposes it for training as well as inference.
+as SPMD: stage parameters carry a leading layer axis sharded over ``pp``;
+under ``shard_map`` each device runs its own contiguous span of layers and
+activations hop to the next stage with ``lax.ppermute`` each tick.
+``T = num_microbatches + num_stages - 1`` ticks fill and drain the pipeline;
+everything is pure jnp with static trip counts, so JAX transposes it for
+training as well as inference.
+
+Composition: the shard_map covers the whole mesh, so the stage body may use
+other named axes manually — ``seq_axis`` shards the activations' sequence
+dimension over ``sp`` and the body can run ring attention with ``ppermute``
+over that axis (models/gpt.py PipelinedGPTLMHeadModel does exactly this).
 
 On TPU slices GSPMD tensor/data sharding usually beats PP (ICI is fast and
 XLA overlaps collectives); PP earns its keep across slices (DCN) — which is
-why it is a mesh axis here and composes with dp/fsdp/tp rather than being a
+why it is a mesh axis here and composes with dp/fsdp/sp rather than being a
 separate engine.
 """
 
@@ -24,23 +30,51 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _gpipe_local(stage_params, x_mb, *, stage_fn, axis_name: str, num_microbatches: int):
+def _apply_local_layers(stage_fn, local_params, h):
+    """Apply this stage's span of layers (leading local axis) sequentially."""
+
+    def body(carry, layer_params):
+        return stage_fn(layer_params, carry), None
+
+    out, _ = jax.lax.scan(body, h, local_params)
+    return out
+
+
+def _gpipe_local(
+    stage_params,
+    x,
+    *,
+    stage_fn,
+    axis_name: str,
+    num_microbatches: int,
+    num_stages: int,
+):
     """Per-device GPipe schedule under shard_map.
 
-    stage_params: this stage's params (leading stage axis already split away).
-    x_mb: (M, mb, ...) microbatched input (only stage 0 reads it).
-    Returns (M, mb, ...) outputs (only the last stage's are meaningful).
+    stage_params: this stage's layer span (leading local-layer axis).
+    x: (local_batch, ...) input — microbatched HERE, per device, so the split
+    never reshards the dp/fsdp batch layout (a global (b,...)→(M, b/M, ...)
+    reshape would interleave the sharded batch dim and force a full reshard).
+    Returns (local_batch, ...) outputs (only the last stage's are real; psum
+    over the pp ring replicates them).  ``num_stages`` is static so the tick
+    loop has a static trip count (reverse-mode AD requires it).
     """
-    n_stages = jax.lax.psum(1, axis_name)
     stage_idx = jax.lax.axis_index(axis_name)
     M = num_microbatches
-    T = M + n_stages - 1
+    if x.shape[0] % M != 0:
+        raise ValueError(
+            f"per-device batch {x.shape[0]} not divisible by num_microbatches {M}"
+        )
+    x_mb = x.reshape(M, x.shape[0] // M, *x.shape[1:])
+    T = M + num_stages - 1
 
     # activation probe to get output shape/dtype of one stage
-    sample_out = jax.eval_shape(lambda p, x: stage_fn(p, x), stage_params, x_mb[0])
+    sample_out = jax.eval_shape(
+        lambda p, x: _apply_local_layers(stage_fn, p, x), stage_params, x_mb[0]
+    )
     act0 = jnp.zeros(sample_out.shape, sample_out.dtype)
     outputs0 = jnp.zeros((M,) + sample_out.shape, sample_out.dtype)
-    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
     def tick(t, carry):
         incoming, outputs = carry
@@ -55,11 +89,11 @@ def _gpipe_local(stage_params, x_mb, *, stage_fn, axis_name: str, num_microbatch
             else incoming,
             incoming,
         )
-        out = stage_fn(stage_params, my_input)
+        out = _apply_local_layers(stage_fn, stage_params, my_input)
         out = jnp.where(active, out, jnp.zeros_like(out))
         # last stage records its finished microbatch
         outputs = jax.lax.cond(
-            jnp.logical_and(active, stage_idx == n_stages - 1),
+            jnp.logical_and(active, stage_idx == num_stages - 1),
             lambda o: jax.lax.dynamic_update_index_in_dim(o, out, x_idx, 0),
             lambda o: o,
             outputs,
@@ -72,10 +106,10 @@ def _gpipe_local(stage_params, x_mb, *, stage_fn, axis_name: str, num_microbatch
     # only the last stage holds real outputs; broadcast them around the ring
     # so the result is replicated over pp (callers slice/psum as needed)
     outputs = jax.lax.psum(
-        jnp.where(stage_idx == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        jnp.where(stage_idx == num_stages - 1, outputs, jnp.zeros_like(outputs)),
         axis_name,
     )
-    return outputs
+    return outputs.reshape(x.shape[0], *outputs.shape[2:])
 
 
 def gpipe(
@@ -86,35 +120,50 @@ def gpipe(
     mesh: Optional[Mesh] = None,
     axis_name: str = "pp",
     batch_axes: tuple = ("dp", "fsdp"),
+    seq_axis: Optional[str] = None,
 ):
-    """Run ``stage_fn(params_i, x)`` as a pipeline over the ``pp`` axis.
+    """Run ``stage_fn(layer_params_i, x)`` as a pipeline over the ``pp`` axis.
 
-    ``stacked_params``: pytree whose leaves have a leading ``num_stages`` axis
-    (stage i's slice feeds device i).  ``x``: (batch, ...) global input —
-    reshaped to (num_microbatches, batch/M, ...).
+    ``stacked_params``: pytree whose leaves have a leading ``num_layers`` axis
+    (``num_layers`` divisible by the pp size; each stage scans its contiguous
+    span).  ``x``: (batch, ...) global input — reshaped to
+    (num_microbatches, batch/M, ...).  ``seq_axis``: optionally shard x's
+    second data dimension (seq) over that mesh axis; the stage body may then
+    use it manually (ring attention).
 
-    Constraint (GPipe classic): every stage must map activations to the same
+    Constraint (GPipe classic): every layer must map activations to the same
     shape/dtype.  Embedding/head layers live outside the pipelined trunk.
     """
     if mesh is None:
         from ..state import AcceleratorState
 
-        mesh = AcceleratorState().mesh
+        if AcceleratorState._shared_state:
+            mesh = AcceleratorState().mesh
+    if mesh is None:
+        # no Accelerator context: trivial one-device full-axes mesh so stage
+        # bodies that use named axes (ring attention) still have axis context
+        import numpy as np
+
+        from ..utils.constants import ALL_MESH_AXES
+
+        mesh = Mesh(
+            np.asarray(jax.devices()[:1]).reshape((1,) * len(ALL_MESH_AXES)),
+            ALL_MESH_AXES,
+        )
     n_stages = mesh.shape.get(axis_name, 1)
-    if n_stages == 1:
-        # degenerate: sequential scan over stages on one device group
+    num_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if num_layers % max(n_stages, 1) != 0:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by pp size {n_stages}"
+        )
+    if n_stages == 1 and seq_axis is None:
+        # degenerate: sequential scan over layers on one device group (only
+        # when the body needs no named-axis context)
         def body(h, p):
             return stage_fn(p, h), None
 
         out, _ = jax.lax.scan(body, x, stacked_params)
         return out
-
-    b = x.shape[0]
-    if b % num_microbatches != 0:
-        raise ValueError(
-            f"batch {b} not divisible by num_microbatches {num_microbatches}"
-        )
-    x_mb = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
 
     from jax.experimental.shard_map import shard_map
 
@@ -122,22 +171,26 @@ def gpipe(
     param_specs = jax.tree_util.tree_map(
         lambda _: P(axis_name), stacked_params
     )
-    x_spec = P(None, batch_spec)
-    out_spec = P(None, batch_spec)
+    # microbatching happens per-device inside the body: the in_spec matches
+    # the loader/constraint layout exactly, so entering the pipeline moves
+    # zero bytes
+    data_axes_spec = [batch_spec] + [None] * (x.ndim - 1)
+    if seq_axis is not None and x.ndim >= 2:
+        data_axes_spec[1] = seq_axis  # (batch, seq, ...)
+    x_spec = P(*data_axes_spec)
+    out_spec = x_spec
 
     fn = shard_map(
         functools.partial(
             _gpipe_local,
-            stage_fn=lambda p, h: stage_fn(
-                jax.tree_util.tree_map(lambda a: a[0], p), h
-            ),
+            stage_fn=stage_fn,
             axis_name=axis_name,
             num_microbatches=num_microbatches,
+            num_stages=n_stages,
         ),
         mesh=mesh,
         in_specs=(param_specs, x_spec),
         out_specs=out_spec,
         check_rep=False,
     )
-    out_mb = fn(stacked_params, x_mb)
-    return out_mb.reshape(b, *out_mb.shape[2:])
+    return fn(stacked_params, x)

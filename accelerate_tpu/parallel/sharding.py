@@ -36,11 +36,13 @@ def plan_param_spec(
     fsdp_exempt: bool = False,
 ) -> P:
     """Decide the PartitionSpec for one parameter."""
-    tp_size = mesh.shape.get("tp", 1)
     fsdp_size = mesh.shape.get("fsdp", 1)
     spec = [None] * len(shape)
 
-    if tp_plan and tp_size > 1:
+    if tp_plan:
+        # templates name their own mesh axes (tp, pp, ...); size-1 axes are
+        # no-ops, so apply unconditionally — a pp-sharded layer stack must be
+        # laid out even when tp=1
         for pattern, template in tp_plan.items():
             if re.fullmatch(pattern, name) or re.search(pattern, name):
                 template = list(template) + [None] * (len(shape) - len(template))

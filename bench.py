@@ -141,7 +141,11 @@ def main() -> None:
     dt = time.perf_counter() - t0
     recompiled = len(step._cache) != n_cached
 
-    tokens_per_sec = batch * seq * steps / dt
+    n_devices = len(jax.devices())
+    # the Accelerator dp-shards the batch over every visible chip: divide the
+    # aggregate throughput down so the per-chip metric/MFU stay honest on
+    # multi-chip hosts
+    tokens_per_sec = batch * seq * steps / dt / n_devices
     n_params = model.num_parameters
     flops_per_token = 6 * n_params
     model_flops = tokens_per_sec * flops_per_token
@@ -151,7 +155,7 @@ def main() -> None:
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / A100_BASELINE_TOKENS_PER_SEC, 4),
         "platform": platform,
-        "n_devices": len(jax.devices()),
+        "n_devices": n_devices,
         "params_m": round(n_params / 1e6, 1),
         "batch": batch,
         "seq": seq,
