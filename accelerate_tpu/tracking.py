@@ -247,12 +247,167 @@ class CometMLTracker(GeneralTracker):
         self.writer.end()
 
 
+class AimTracker(GeneralTracker):
+    """Aim backend (reference tracking.py:480)."""
+
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__()
+        from aim import Run
+
+        self.run_name = run_name
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @property
+    def name(self) -> str:
+        return "aim"
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        for key, value in values.items():
+            self.writer.track(value, name=key, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.close()
+
+
+class ClearMLTracker(GeneralTracker):
+    """ClearML backend (reference tracking.py:777)."""
+
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        from clearml import Task
+
+        self.run_name = run_name
+        self._initialized_externally = Task.current_task() is not None
+        self.task = Task.current_task() or Task.init(
+            project_name=run_name, task_name=run_name, **kwargs
+        )
+
+    @property
+    def name(self) -> str:
+        return "clearml"
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.task.connect_configuration(dict(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        clogger = self.task.get_logger()
+        for k, v in values.items():
+            if not isinstance(v, (int, float)):
+                continue
+            if step is None:
+                clogger.report_single_value(name=k, value=v, **kwargs)
+            else:
+                # reference convention: "title/series" keys split into panels
+                title, _, series = k.partition("/")
+                clogger.report_scalar(
+                    title=title, series=series or title, value=v,
+                    iteration=step, **kwargs,
+                )
+
+    @on_main_process
+    def finish(self) -> None:
+        if not self._initialized_externally:
+            self.task.close()
+
+
+class DVCLiveTracker(GeneralTracker):
+    """DVCLive backend (reference tracking.py:929)."""
+
+    def __init__(self, run_name: str, live=None, **kwargs):
+        super().__init__()
+        from dvclive import Live
+
+        self.run_name = run_name
+        self.live = live if live is not None else Live(**kwargs)
+
+    @property
+    def name(self) -> str:
+        return "dvclive"
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.live.log_params(dict(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.live.log_metric(k, v, **kwargs)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self) -> None:
+        self.live.end()
+
+
+class SwanLabTracker(GeneralTracker):
+    """SwanLab backend (reference tracking.py:1015-area; probe already shipped)."""
+
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import swanlab
+
+        self.run_name = run_name
+        self.writer = swanlab.init(project=run_name, **kwargs)
+        self._swanlab = swanlab
+
+    @property
+    def name(self) -> str:
+        return "swanlab"
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer.config.update(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs) -> None:
+        metrics = {k: v for k, v in values.items() if isinstance(v, (int, float))}
+        self._swanlab.log(metrics, step=step)
+
+    @on_main_process
+    def finish(self) -> None:
+        self._swanlab.finish()
+
+
 LOGGER_TYPE_TO_CLASS = {
     "jsonl": JSONLTracker,
     "tensorboard": TensorBoardTracker,
     "wandb": WandBTracker,
     "mlflow": MLflowTracker,
     "comet_ml": CometMLTracker,
+    "aim": AimTracker,
+    "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
+    "swanlab": SwanLabTracker,
 }
 
 _AVAILABILITY = {
