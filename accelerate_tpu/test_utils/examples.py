@@ -1,0 +1,79 @@
+"""Example-diff checker: every ``by_feature`` snippet must appear in the
+``complete_*`` example.
+
+Counterpart of the reference's AST/line-level example checker
+(test_utils/examples.py:26-146): each feature script is the base example plus
+a marked feature; the complete example must textually contain every line the
+feature added.  Implemented as normalized line-set subtraction over the
+``training_function``/``main`` bodies — comments and blanks are stripped, so
+``# New Code #`` markers and doc drift don't produce false diffs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def extract_function(lines: list[str], name: str) -> list[str]:
+    """Return the source lines of top-level ``def name`` up to the next
+    top-level statement."""
+    out: list[str] = []
+    in_fn = False
+    for line in lines:
+        if not in_fn:
+            if line.startswith(f"def {name}"):
+                in_fn = True
+                out.append(line)
+            continue
+        # body lines are indented (or blank); a new top-level def/if ends it
+        if line.strip() and not line.startswith((" ", "\t", ")")):
+            break
+        out.append(line)
+    return out
+
+
+def normalize(lines: list[str]) -> set[str]:
+    """Strip comments/blanks and whitespace-normalize for set comparison."""
+    cleaned = set()
+    for line in lines:
+        s = line.strip()
+        if not s or s.startswith("#"):
+            continue
+        s = s.split("  # ")[0].strip()  # trailing inline comments
+        cleaned.add(s)
+    return cleaned
+
+
+def feature_additions(
+    feature_path: str, base_path: str, function: str = "training_function"
+) -> set[str]:
+    """Lines ``function`` in the feature script adds relative to the base."""
+    with open(feature_path) as f:
+        feature = f.readlines()
+    with open(base_path) as f:
+        base = f.readlines()
+    return normalize(extract_function(feature, function)) - normalize(
+        extract_function(base, function)
+    )
+
+
+def missing_from_complete(
+    complete_path: str,
+    feature_path: str,
+    base_path: str,
+    function: str = "training_function",
+    ignore: Optional[set[str]] = None,
+) -> set[str]:
+    """Feature-added lines absent from the complete example (empty == pass)."""
+    with open(complete_path) as f:
+        complete = normalize(extract_function(f.readlines(), function))
+    added = feature_additions(feature_path, base_path, function)
+    if ignore:
+        added = {line for line in added if line not in ignore}
+    return added - complete
+
+
+def examples_dir() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(here, "examples")

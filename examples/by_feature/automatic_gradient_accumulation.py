@@ -1,0 +1,86 @@
+"""Feature: derive gradient-accumulation steps from a target global batch.
+
+Counterpart of /root/reference/examples/by_feature/automatic_gradient_accumulation.py:
+pick the observed per-step batch, compute how many micro-steps reach the
+desired effective batch, and reconfigure the accelerator.  Lines marked
+`# New Code #` are what this feature adds to nlp_example.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+import accelerate_tpu.nn as nn  # noqa: E402
+import accelerate_tpu.optim as optim  # noqa: E402
+from accelerate_tpu import Accelerator  # noqa: E402
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification  # noqa: E402
+
+
+def training_function(args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    nn.manual_seed(args.seed)
+    train_dl, val_dl, vocab = get_dataloaders(accelerator, args.batch_size, args.seed)
+
+    # New Code #
+    # observed per-optimizer-step samples = batch_size × data shards; divide
+    # the target effective batch down to micro-steps
+    observed_batch = args.batch_size * accelerator.num_devices
+    accumulation_steps = max(1, args.target_global_batch // observed_batch)
+    accelerator.gradient_accumulation_steps = accumulation_steps
+    accelerator.print(
+        f"accumulating {accumulation_steps} micro-steps "
+        f"({observed_batch} observed → {args.target_global_batch} target)"
+    )
+
+    cfg = BertConfig.small() if args.small else BertConfig.base()
+    cfg.vocab_size = max(cfg.vocab_size, vocab)
+    model = BertForSequenceClassification(cfg)
+    optimizer = optim.AdamW(model.parameters(), lr=args.lr)
+    scheduler = optim.get_linear_schedule_with_warmup(
+        optimizer, 100, len(train_dl) * args.num_epochs * accelerator.num_devices
+    )
+    model, optimizer, train_dl, val_dl, scheduler = accelerator.prepare(
+        model, optimizer, train_dl, val_dl, scheduler
+    )
+
+    for epoch in range(args.num_epochs):
+        model.train()
+        for step, batch in enumerate(train_dl):
+            with accelerator.accumulate(model):
+                out = model(
+                    batch["input_ids"],
+                    attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"],
+                    labels=batch["labels"],
+                )
+                accelerator.backward(out["loss"])
+                optimizer.step()
+                scheduler.step()
+                optimizer.zero_grad()
+        accelerator.print(f"epoch {epoch}: loss={float(out['loss'].item()):.4f}")
+    return model
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=2e-5)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--small", action="store_true")
+    # New Code #
+    parser.add_argument("--target_global_batch", type=int, default=64)
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

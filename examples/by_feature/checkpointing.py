@@ -1,0 +1,103 @@
+"""Feature: checkpoint/resume with ``save_state``/``load_state``.
+
+Counterpart of /root/reference/examples/by_feature/checkpointing.py: save the
+full training state (model/optimizer/scheduler/sampler/RNG) every epoch or
+every N steps, and resume mid-epoch with ``skip_first_batches``.
+Lines marked `# New Code #` are what this feature adds to nlp_example.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+import accelerate_tpu.nn as nn  # noqa: E402
+import accelerate_tpu.optim as optim  # noqa: E402
+from accelerate_tpu import Accelerator  # noqa: E402
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification  # noqa: E402
+
+
+def training_function(args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    nn.manual_seed(args.seed)
+    train_dl, val_dl, vocab = get_dataloaders(accelerator, args.batch_size, args.seed)
+
+    cfg = BertConfig.small() if args.small else BertConfig.base()
+    cfg.vocab_size = max(cfg.vocab_size, vocab)
+    model = BertForSequenceClassification(cfg)
+    optimizer = optim.AdamW(model.parameters(), lr=args.lr)
+    scheduler = optim.get_linear_schedule_with_warmup(
+        optimizer, 100, len(train_dl) * args.num_epochs * accelerator.num_devices
+    )
+    model, optimizer, train_dl, val_dl, scheduler = accelerator.prepare(
+        model, optimizer, train_dl, val_dl, scheduler
+    )
+
+    # New Code #
+    # resume: restore model/optimizer/scheduler/sampler/RNG state, then skip
+    # the batches the checkpointed epoch already consumed
+    start_epoch = 0
+    resume_step = 0
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+        tag = os.path.basename(args.resume_from_checkpoint.rstrip("/"))
+        if "epoch" in tag:
+            start_epoch = int(tag.replace("epoch_", "")) + 1
+        elif "step" in tag:
+            resume_step = int(tag.replace("step_", ""))
+            start_epoch = resume_step // len(train_dl)
+            resume_step -= start_epoch * len(train_dl)
+
+    overall_step = 0
+    for epoch in range(start_epoch, args.num_epochs):
+        model.train()
+        # New Code #
+        active_dl = train_dl
+        if args.resume_from_checkpoint and epoch == start_epoch and resume_step:
+            active_dl = accelerator.skip_first_batches(train_dl, resume_step)
+        for step, batch in enumerate(active_dl):
+            optimizer.zero_grad()
+            out = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                labels=batch["labels"],
+            )
+            accelerator.backward(out["loss"])
+            optimizer.step()
+            scheduler.step()
+            overall_step += 1
+            # New Code #
+            if args.checkpointing_steps == "step":
+                accelerator.save_state(os.path.join(args.output_dir, f"step_{overall_step}"))
+        # New Code #
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(os.path.join(args.output_dir, f"epoch_{epoch}"))
+        accelerator.print(f"epoch {epoch}: loss={float(out['loss'].item()):.4f}")
+    return model
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=2e-5)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--small", action="store_true")
+    # New Code #
+    parser.add_argument("--checkpointing_steps", type=str, default="epoch", choices=["epoch", "step", "no"])
+    parser.add_argument("--resume_from_checkpoint", type=str, default=None)
+    parser.add_argument("--output_dir", type=str, default="ckpt_example")
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Feature: exact distributed eval metrics with ``gather_for_metrics``.
+
+Counterpart of /root/reference/examples/by_feature/multi_process_metrics.py:
+the SPMD loader pads the final global batch by looping back to the epoch
+start; ``gather_for_metrics`` tracks that remainder and truncates the
+duplicates so metrics match a single-process run exactly.  Lines marked
+`# New Code #` are what this feature adds to nlp_example.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+import accelerate_tpu.nn as nn  # noqa: E402
+import accelerate_tpu.optim as optim  # noqa: E402
+from accelerate_tpu import Accelerator  # noqa: E402
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification  # noqa: E402
+
+
+def training_function(args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    nn.manual_seed(args.seed)
+    train_dl, val_dl, vocab = get_dataloaders(accelerator, args.batch_size, args.seed)
+
+    cfg = BertConfig.small() if args.small else BertConfig.base()
+    cfg.vocab_size = max(cfg.vocab_size, vocab)
+    model = BertForSequenceClassification(cfg)
+    optimizer = optim.AdamW(model.parameters(), lr=args.lr)
+    scheduler = optim.get_linear_schedule_with_warmup(
+        optimizer, 100, len(train_dl) * args.num_epochs * accelerator.num_devices
+    )
+    model, optimizer, train_dl, val_dl, scheduler = accelerator.prepare(
+        model, optimizer, train_dl, val_dl, scheduler
+    )
+
+    for epoch in range(args.num_epochs):
+        model.train()
+        for step, batch in enumerate(train_dl):
+            optimizer.zero_grad()
+            out = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                labels=batch["labels"],
+            )
+            accelerator.backward(out["loss"])
+            optimizer.step()
+            scheduler.step()
+
+        model.eval()
+        # New Code #
+        # accumulate predictions/references across the whole eval set; the
+        # final-batch duplicates (loop-back padding) are truncated by
+        # gather_for_metrics using the loader's tracked remainder
+        all_preds, all_labels = [], []
+        for batch in val_dl:
+            out = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+            )
+            preds = out["logits"].data.argmax(-1)
+            # New Code #
+            preds, labels = accelerator.gather_for_metrics(
+                (preds, batch["labels"])
+            )
+            all_preds.append(np.asarray(preds))
+            all_labels.append(np.asarray(labels))
+        # New Code #
+        preds = np.concatenate(all_preds)
+        labels = np.concatenate(all_labels)
+        acc = float((preds == labels).mean())
+        accelerator.print(
+            f"epoch {epoch}: accuracy={acc:.4f} over exactly {len(labels)} samples"
+        )
+    return acc
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=2e-5)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--small", action="store_true")
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

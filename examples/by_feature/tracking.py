@@ -1,0 +1,108 @@
+"""Feature: experiment tracking via ``init_trackers``/``log``/``end_training``.
+
+Counterpart of /root/reference/examples/by_feature/tracking.py.  Lines marked
+`# New Code #` are what this feature adds to nlp_example.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+import accelerate_tpu.nn as nn  # noqa: E402
+import accelerate_tpu.optim as optim  # noqa: E402
+from accelerate_tpu import Accelerator  # noqa: E402
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification  # noqa: E402
+
+
+def training_function(args):
+    # New Code #
+    # log_with="all" resolves every installed tracker backend (jsonl always)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        log_with="all" if args.with_tracking else None,
+        project_dir=args.project_dir,
+    )
+    nn.manual_seed(args.seed)
+    train_dl, val_dl, vocab = get_dataloaders(accelerator, args.batch_size, args.seed)
+
+    cfg = BertConfig.small() if args.small else BertConfig.base()
+    cfg.vocab_size = max(cfg.vocab_size, vocab)
+    model = BertForSequenceClassification(cfg)
+    optimizer = optim.AdamW(model.parameters(), lr=args.lr)
+    scheduler = optim.get_linear_schedule_with_warmup(
+        optimizer, 100, len(train_dl) * args.num_epochs * accelerator.num_devices
+    )
+    model, optimizer, train_dl, val_dl, scheduler = accelerator.prepare(
+        model, optimizer, train_dl, val_dl, scheduler
+    )
+
+    # New Code #
+    if args.with_tracking:
+        accelerator.init_trackers("nlp_example_tracking", config=vars(args))
+
+    for epoch in range(args.num_epochs):
+        model.train()
+        # New Code #
+        total_loss = 0.0
+        for step, batch in enumerate(train_dl):
+            optimizer.zero_grad()
+            out = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                labels=batch["labels"],
+            )
+            accelerator.backward(out["loss"])
+            optimizer.step()
+            scheduler.step()
+            # New Code #
+            total_loss += float(out["loss"].item())
+
+        model.eval()
+        correct = total = 0
+        for batch in val_dl:
+            out = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+            )
+            preds = out["logits"].data.argmax(-1)
+            preds = accelerator.gather_for_metrics(preds)
+            labels = accelerator.gather_for_metrics(batch["labels"])
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            total += len(np.asarray(labels))
+        acc = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: accuracy={acc:.4f}")
+        # New Code #
+        if args.with_tracking:
+            accelerator.log({"train_loss": total_loss / len(train_dl), "accuracy": acc}, step=epoch)
+    # New Code #
+    if args.with_tracking:
+        accelerator.end_training()
+    return acc
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=2e-5)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--small", action="store_true")
+    # New Code #
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--project_dir", type=str, default="logs")
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""BERT fine-tuning with every by_feature capability in one script.
+
+Counterpart of /root/reference/examples/complete_nlp_example.py: the base
+nlp_example loop plus checkpoint/resume, experiment tracking, gradient
+accumulation, and cross-process early stopping — the diff checker
+(tests/test_examples.py) asserts this file contains every line those feature
+scripts add.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.append(os.path.dirname(os.path.abspath(__file__)))
+from nlp_example import get_dataloaders  # noqa: E402
+
+import accelerate_tpu.nn as nn  # noqa: E402
+import accelerate_tpu.optim as optim  # noqa: E402
+from accelerate_tpu import Accelerator  # noqa: E402
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification  # noqa: E402
+
+
+def training_function(args):
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+        log_with="all" if args.with_tracking else None,
+        project_dir=args.project_dir,
+    )
+    nn.manual_seed(args.seed)
+    train_dl, val_dl, vocab = get_dataloaders(accelerator, args.batch_size, args.seed)
+
+    cfg = BertConfig.small() if args.small else BertConfig.base()
+    cfg.vocab_size = max(cfg.vocab_size, vocab)
+    model = BertForSequenceClassification(cfg)
+    optimizer = optim.AdamW(model.parameters(), lr=args.lr)
+    scheduler = optim.get_linear_schedule_with_warmup(
+        optimizer, 100, len(train_dl) * args.num_epochs * accelerator.num_devices
+    )
+    model, optimizer, train_dl, val_dl, scheduler = accelerator.prepare(
+        model, optimizer, train_dl, val_dl, scheduler
+    )
+
+    if args.with_tracking:
+        accelerator.init_trackers("nlp_example_tracking", config=vars(args))
+
+    # checkpoint resume: restore full state, then skip consumed batches
+    start_epoch = 0
+    resume_step = 0
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+        tag = os.path.basename(args.resume_from_checkpoint.rstrip("/"))
+        if "epoch" in tag:
+            start_epoch = int(tag.replace("epoch_", "")) + 1
+        elif "step" in tag:
+            resume_step = int(tag.replace("step_", ""))
+            start_epoch = resume_step // len(train_dl)
+            resume_step -= start_epoch * len(train_dl)
+
+    overall_step = 0
+    stop_training = False
+    for epoch in range(start_epoch, args.num_epochs):
+        model.train()
+        total_loss = 0.0
+        active_dl = train_dl
+        if args.resume_from_checkpoint and epoch == start_epoch and resume_step:
+            active_dl = accelerator.skip_first_batches(train_dl, resume_step)
+        for step, batch in enumerate(active_dl):
+            with accelerator.accumulate(model):
+                out = model(
+                    batch["input_ids"],
+                    attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"],
+                    labels=batch["labels"],
+                )
+                accelerator.backward(out["loss"])
+                optimizer.step()
+                scheduler.step()
+                optimizer.zero_grad()
+            total_loss += float(out["loss"].item())
+            overall_step += 1
+            if args.checkpointing_steps == "step":
+                accelerator.save_state(os.path.join(args.output_dir, f"step_{overall_step}"))
+            # any process may pull the trigger on its local condition...
+            if float(out["loss"].item()) < args.early_stop_threshold:
+                accelerator.set_trigger()
+            # ...and ALL processes see it (all-reduced) and break together
+            if accelerator.check_trigger():
+                stop_training = True
+                break
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(os.path.join(args.output_dir, f"epoch_{epoch}"))
+
+        model.eval()
+        correct = total = 0
+        for batch in val_dl:
+            out = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+            )
+            preds = out["logits"].data.argmax(-1)
+            preds = accelerator.gather_for_metrics(preds)
+            labels = accelerator.gather_for_metrics(batch["labels"])
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            total += len(np.asarray(labels))
+        acc = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: accuracy={acc:.4f}")
+        if args.with_tracking:
+            accelerator.log({"train_loss": total_loss / len(train_dl), "accuracy": acc}, step=epoch)
+        if stop_training:
+            accelerator.print(f"early stop at epoch {epoch}")
+            break
+    if args.with_tracking:
+        accelerator.end_training()
+    return acc
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=2e-5)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--small", action="store_true")
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=2)
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--project_dir", type=str, default="logs")
+    parser.add_argument("--checkpointing_steps", type=str, default="epoch", choices=["epoch", "step", "no"])
+    parser.add_argument("--resume_from_checkpoint", type=str, default=None)
+    parser.add_argument("--output_dir", type=str, default="ckpt_example")
+    parser.add_argument("--early_stop_threshold", type=float, default=0.0)
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -77,7 +77,11 @@ def get_dataloaders(accelerator: Accelerator, batch_size: int, seed: int = 0):
                 )
             return data
 
-        train_data, val_data = make(1024), make(256)
+        import os as _os
+
+        n_train = int(_os.environ.get("EXAMPLES_N_TRAIN", 1024))
+        n_val = int(_os.environ.get("EXAMPLES_N_VAL", 256))
+        train_data, val_data = make(n_train), make(n_val)
 
     train_dl = prepare_data_loader(
         dataset=train_data, batch_size=batch_size, shuffle=True, data_seed=seed
