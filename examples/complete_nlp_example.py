@@ -134,7 +134,7 @@ def main():
     parser.add_argument("--checkpointing_steps", type=str, default="epoch", choices=["epoch", "step", "no"])
     parser.add_argument("--resume_from_checkpoint", type=str, default=None)
     parser.add_argument("--output_dir", type=str, default="ckpt_example")
-    parser.add_argument("--early_stop_threshold", type=float, default=0.0)
+    parser.add_argument("--early_stop_threshold", type=float, default=0.1)
     args = parser.parse_args()
     training_function(args)
 

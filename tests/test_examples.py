@@ -80,11 +80,15 @@ def test_feature_scripts_parse():
 def test_example_smoke_train_save_resume(tmp_path, script):
     """Run the checkpointing example end-to-end on tiny synthetic data, then
     resume from its epoch checkpoint."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(
         os.environ,
         EXAMPLES_N_TRAIN="32",
         EXAMPLES_N_VAL="16",
         JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.pathsep.join(
+            p for p in (repo_root, os.environ.get("PYTHONPATH", "")) if p
+        ),
     )
     out_dir = str(tmp_path / "ckpt")
     cmd = [
