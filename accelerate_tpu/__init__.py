@@ -9,6 +9,20 @@ wrapper modules; collectives are compiled into the step by XLA and ride ICI.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # honor the standard JAX platform env var even when a container
+    # sitecustomize (e.g. the axon TPU tunnel) has re-pinned the platform
+    # after env processing — otherwise JAX_PLATFORMS=cpu subprocesses (test
+    # launchers, example smoke runs) silently land on the TPU backend
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # already-initialized backend or exotic value: keep going
+        pass
+
 from .accelerator import Accelerator
 from .big_modeling import (
     cpu_offload,

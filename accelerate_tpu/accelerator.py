@@ -92,8 +92,12 @@ class Accelerator:
         self.fp8_recipe_handler = None
         from .utils.dataclasses import FP8RecipeKwargs
 
+        from .utils.dataclasses import AutocastKwargs
+
         for handler in kwargs_handlers or []:
-            if isinstance(handler, GradScalerKwargs):
+            if isinstance(handler, AutocastKwargs):
+                self.autocast_handler = handler
+            elif isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
             elif isinstance(handler, InitProcessGroupKwargs):
                 self.init_handler = handler
@@ -704,8 +708,25 @@ class Accelerator:
     # --------------------------------------------------------------- contexts
     @contextlib.contextmanager
     def autocast(self, autocast_handler=None):
-        """Parity context: precision policy is applied at prepare() time on
-        TPU (params/compute dtype), not per-region; yields unchanged."""
+        """Local precision override (reference accelerator.py:3587).
+
+        The *ambient* policy lands at prepare() time (params cast to bf16 and
+        compute follows), so with ``enabled=True`` this yields unchanged.
+        ``AutocastKwargs(enabled=False)`` opens a locally-fp32 region: the
+        numerically-sensitive ``F.*`` ops traced inside (matmuls, norms,
+        softmaxes, losses, attention) compute in fp32 regardless of param
+        dtype — the reference's "disable autocast around the loss" idiom.
+        Pure element-wise activations keep their operand dtype.  The region
+        is a trace-time property: under ``compile_step`` the policy active at
+        capture time is baked into the replayed program.
+        """
+        from .nn.amp import autocast_region
+
+        handler = autocast_handler or self.autocast_handler
+        if handler is not None and not handler.enabled:
+            with autocast_region(jnp.float32):
+                yield
+            return
         yield
 
     @contextlib.contextmanager

@@ -179,17 +179,42 @@ class GlobalBatchSampler:
         self.remainder = 0
         self.dropped = 0
         if self.split_batches:
+            seen_split: list[int] = []
+            target_global = self.batch_size
             for batch in self.batch_sampler:
-                if len(batch) % self.num_shards != 0:
+                if target_global and len(seen_split) < target_global:
+                    # padding only ever reads the first global batch's worth
+                    # of the epoch stream — don't hold every index in memory
+                    seen_split.extend(batch[: target_global - len(seen_split)])
+                elif not target_global:
+                    seen_split.extend(batch)
+                full = max(
+                    target_global or 0,
+                    self.num_shards * math.ceil(len(batch) / self.num_shards),
+                )
+                if len(batch) != full:
+                    # a short global batch breaks per-shard shapes even when
+                    # it divides evenly over num_shards (e.g. 2 of 4 samples
+                    # on 2 shards would yield size-1 shard batches).  An
+                    # over-long batch (custom sampler lying about batch_size)
+                    # is padded up to the next num_shards multiple instead.
                     if not self.even_batches:
+                        self.dropped += len(batch)
                         continue
-                    # pad the short global batch by looping back into itself
-                    needed = (
-                        self.num_shards * math.ceil(len(batch) / self.num_shards)
-                        - len(batch)
+                    # pad from the start of the epoch's sample stream
+                    needed = full - len(batch)
+                    src = (
+                        seen_split
+                        if len(seen_split) >= needed
+                        else seen_split * math.ceil(needed / max(len(seen_split), 1))
                     )
+                    # `remainder` is "duplicates in the most recent batch":
+                    # consumers (gather_for_metrics) read it after the final
+                    # batch to truncate the looped-back tail
                     self.remainder = needed
-                    batch = batch + batch[:needed]
+                    batch = batch + src[:needed]
+                else:
+                    self.remainder = 0
                 shard_size = len(batch) // self.num_shards
                 yield [
                     batch[i * shard_size : (i + 1) * shard_size]
@@ -203,17 +228,41 @@ class GlobalBatchSampler:
         for batch in self.batch_sampler:
             seen.extend(batch)
             group.append(batch)
-            if len(group) == self.num_shards and all(
-                target is None or len(b) == target for b in group
-            ):
-                yield group
+            if len(group) == self.num_shards:
+                # decide the group's fate the moment it fills: with a torch
+                # BatchSampler only the epoch's last batch can be short, but a
+                # custom batch_sampler may emit short batches anywhere — each
+                # group is padded/dropped independently so iteration never
+                # stalls on an over-full group
+                if all(target is None or len(b) == target for b in group):
+                    self.remainder = 0
+                    yield group
+                else:
+                    ragged = self._finish_ragged_group(group, seen, target)
+                    if ragged is not None:
+                        yield ragged
                 group = []
-        if not group or (len(group) == self.num_shards and all(
-            target is None or len(b) == target for b in group
-        )):
-            if group:
-                yield group
+        if not group:
             return
+        ragged = self._finish_ragged_group(group, seen, target)
+        if ragged is not None:
+            yield ragged
+
+    def _finish_ragged_group(
+        self,
+        group: list[list[int]],
+        seen: list[int],
+        target: Optional[int],
+    ) -> Optional[list[list[int]]]:
+        """Even out (or drop) a group with missing/short batches.
+
+        ``even_batches=True``: loop indices back to the start of the epoch's
+        sample stream until every shard holds a full ``batch_size`` batch
+        (reference BatchSamplerShard semantics, data_loader.py:195-262);
+        duplicates are counted in ``remainder`` for gather_for_metrics.
+        ``even_batches=False``: the ragged group is dropped — SPMD needs every
+        shard on identical shapes — with a one-time warning.
+        """
         if not self.even_batches:
             # SPMD requires every shard to run the same program on the same
             # shapes; a ragged tail group has no uniform global batch, so it
@@ -223,7 +272,7 @@ class GlobalBatchSampler:
             # the ragged tail to the shards that have data; we diverge, so
             # warn (once) with the number of samples the epoch loses.
             dropped = sum(len(b) for b in group)
-            self.dropped = dropped
+            self.dropped += dropped
             if not self._warned_ragged_drop:
                 self._warned_ragged_drop = True
                 logger.warning(
@@ -234,27 +283,25 @@ class GlobalBatchSampler:
                     "through this loader omit these samples; use "
                     "even_batches=True with gather_for_metrics to dedup instead."
                 )
-            return
+            return None
         # loop back to the start of the epoch's sample stream to even out
-    # (reference semantics: indices restart from the first samples)
+        # (reference semantics: indices restart from the first samples)
         flat = list(itertools.chain.from_iterable(group))
-        needed_total = self.num_shards * (target or len(group[0]))
-        dup_source = seen if len(seen) >= needed_total else (seen * math.ceil(needed_total / max(len(seen), 1)))
-        padded = flat + dup_source[: needed_total - len(flat)]
-        self.remainder = needed_total - len(flat)
         size = target or len(group[0])
-        yield [padded[i * size : (i + 1) * size] for i in range(self.num_shards)]
+        needed_total = self.num_shards * size
+        dup_source = seen if len(seen) >= needed_total else (seen * math.ceil(needed_total / max(len(seen), 1)))
+        padded = flat + dup_source[: max(0, needed_total - len(flat))]
+        # "duplicates in the most recent group" — assignment, not +=: the
+        # value consumers see after exhaustion must describe the FINAL group,
+        # which is what gather_for_metrics truncates (mid-epoch duplicates
+        # from nonstandard samplers cannot be deduped there)
+        self.remainder = max(0, needed_total - len(flat))
+        return [padded[i * size : (i + 1) * size] for i in range(self.num_shards)]
 
-    def __len__(self) -> int:
-        if self.split_batches:
-            return len(self.batch_sampler)
+    def _num_full_batches(self) -> int:
+        """Count of full ``batch_size`` batches the inner sampler will emit
+        (exact for torch-style samplers where only the last batch is short)."""
         n = len(self.batch_sampler)
-        if self.even_batches:
-            return math.ceil(n / self.num_shards)
-        # ragged tail groups are dropped (see __iter__): only groups made of
-        # num_shards FULL batches count, and a trailing short batch poisons
-        # the group it lands in
-        n_full = n
         sampler = getattr(self.batch_sampler, "sampler", None)
         if (
             self.batch_size
@@ -262,12 +309,24 @@ class GlobalBatchSampler:
             and not getattr(self.batch_sampler, "drop_last", False)
         ):
             try:
-                total = len(sampler)
+                return len(sampler) // self.batch_size
             except TypeError:
-                total = None
-            if total is not None:
-                n_full = total // self.batch_size
-        return n_full // self.num_shards
+                pass
+        return n
+
+    def __len__(self) -> int:
+        if self.split_batches:
+            if self.even_batches:
+                return len(self.batch_sampler)
+            # short global batches are dropped, full ones pass through
+            return self._num_full_batches()
+        n = len(self.batch_sampler)
+        if self.even_batches:
+            return math.ceil(n / self.num_shards)
+        # ragged tail groups are dropped (see __iter__): only groups made of
+        # num_shards FULL batches count, and a trailing short batch poisons
+        # the group it lands in
+        return self._num_full_batches() // self.num_shards
 
     @property
     def total_batch_size(self) -> int:

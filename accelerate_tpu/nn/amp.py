@@ -1,0 +1,60 @@
+"""Trace-time autocast regions.
+
+The ambient mixed-precision policy is applied at ``prepare()`` time (params
+cast to bf16, compute follows).  ``autocast_region`` is the *local* override
+the reference gets from ``torch.autocast`` / ``AutocastKwargs``
+(reference accelerator.py:3587, dataclasses.py:107): inside the region every
+``F.*`` op computes in the region dtype regardless of parameter dtype — the
+canonical use is a locally-fp32 loss/metric block inside a bf16 model.
+
+XLA has no runtime context manager, so the region is a *trace-time* property:
+ops traced while the region is open are compiled at the region dtype.  Under
+``compile_step`` that means the policy active at capture time is baked into
+the replayed program (documented on ``Accelerator.autocast``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.dtype = None
+
+
+_amp_state = _AmpState()
+
+
+def autocast_dtype():
+    """The dtype forced by the innermost open region, or None."""
+    return _amp_state.dtype
+
+
+@contextlib.contextmanager
+def autocast_region(dtype):
+    """Force ``F.*`` compute inside the region to ``dtype`` (None = ambient)."""
+    if dtype is not None:
+        dtype = jnp.dtype(dtype)
+    prev = _amp_state.dtype
+    _amp_state.dtype = dtype
+    try:
+        yield
+    finally:
+        _amp_state.dtype = prev
+
+
+def region_cast(*arrays):
+    """Cast floating-point jnp arrays to the open region's dtype (if any)."""
+    dt = _amp_state.dtype
+    if dt is None:
+        return arrays if len(arrays) != 1 else arrays[0]
+    out = tuple(
+        a.astype(dt) if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != dt else a
+        for a in arrays
+    )
+    return out if len(out) != 1 else out[0]

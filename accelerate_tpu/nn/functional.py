@@ -38,19 +38,37 @@ def tanh(x):
 
 
 def softmax(x, axis: int = -1):
-    return tape_op(lambda v: jax.nn.softmax(v, axis=axis), x)
+    from .amp import region_cast
+
+    return tape_op(lambda v: jax.nn.softmax(region_cast(v), axis=axis), x)
 
 
 def log_softmax(x, axis: int = -1):
-    return tape_op(lambda v: jax.nn.log_softmax(v, axis=axis), x)
+    from .amp import region_cast
+
+    return tape_op(lambda v: jax.nn.log_softmax(region_cast(v), axis=axis), x)
 
 
 # -- linear algebra ---------------------------------------------------------
 def linear(x, weight, bias=None):
-    """x @ W^T + b with torch weight layout (out, in)."""
+    """x @ W^T + b with torch weight layout (out, in).
+
+    Honors an open ``autocast_region`` (nn/amp.py): inputs and params are
+    cast to the region dtype before the matmul.
+    """
+    from .amp import region_cast
+
+    def _mm(v, w):
+        v, w = region_cast(v, w)
+        return v @ w.T
+
+    def _mm_bias(v, w, b):
+        v, w, b = region_cast(v, w, b)
+        return v @ w.T + b
+
     if bias is None:
-        return tape_op(lambda v, w: v @ w.T, x, weight)
-    return tape_op(lambda v, w, b: v @ w.T + b, x, weight, bias)
+        return tape_op(_mm, x, weight)
+    return tape_op(_mm_bias, x, weight, bias)
 
 
 def embedding(ids, weight):
@@ -66,6 +84,13 @@ def one_hot(ids, num_classes: int):
 # -- normalization ----------------------------------------------------------
 def layer_norm(x, normalized_shape, weight=None, bias=None, eps: float = 1e-5):
     def _ln(v, *wb):
+        from .amp import region_cast
+
+        casted = region_cast(v, *wb)
+        if wb:
+            v, wb = casted[0], casted[1:]
+        else:
+            v = casted
         mean = v.mean(axis=-1, keepdims=True)
         var = ((v - mean) ** 2).mean(axis=-1, keepdims=True)
         out = (v - mean) * jax.lax.rsqrt(var + eps)
@@ -104,6 +129,9 @@ def cross_entropy(logits, labels, ignore_index: Optional[int] = -100, label_smoo
     labels = _unwrap(labels) if isinstance(labels, Tensor) else jnp.asarray(labels)
 
     def _ce(lg):
+        from .amp import region_cast
+
+        lg = region_cast(lg)
         logp = jax.nn.log_softmax(lg, axis=-1)
         num_classes = lg.shape[-1]
         safe_labels = jnp.where(labels == ignore_index, 0, labels) if ignore_index is not None else labels
@@ -123,17 +151,29 @@ def nll_loss(log_probs, labels):
     labels = _unwrap(labels) if isinstance(labels, Tensor) else jnp.asarray(labels)
 
     def _nll(lp):
+        from .amp import region_cast
+
+        lp = region_cast(lp)
         return -jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0].mean()
 
     return tape_op(_nll, log_probs)
 
 
 def mse_loss(pred, target):
-    return tape_op(lambda p, t: ((p - t) ** 2).mean(), pred, target)
+    from .amp import region_cast
+
+    def _mse(p, t):
+        p, t = region_cast(p, t)
+        return ((p - t) ** 2).mean()
+
+    return tape_op(_mse, pred, target)
 
 
 def binary_cross_entropy_with_logits(logits, targets):
     def _bce(lg, t):
+        from .amp import region_cast
+
+        lg, t = region_cast(lg, t)
         return jnp.mean(jnp.maximum(lg, 0) - lg * t + jnp.log1p(jnp.exp(-jnp.abs(lg))))
 
     return tape_op(_bce, logits, targets)
@@ -167,7 +207,9 @@ def scaled_dot_product_attention(
 
     def _sdpa(q_, k_, v_):
         from ..ops.attention import sdpa_reference, sdpa_tpu
+        from .amp import region_cast
 
+        q_, k_, v_ = region_cast(q_, k_, v_)
         return sdpa_tpu(q_, k_, v_, mask=mask_arr, is_causal=is_causal, scale=scale)
 
     out = tape_op(_sdpa, q, k, v)

@@ -137,6 +137,21 @@ class Tensor:
     def item(self):
         return self.data.item()
 
+    # scalar conversions (torch parity: float(loss), int(count), if tensor:)
+    def __float__(self) -> float:
+        return float(self.data)
+
+    def __int__(self) -> int:
+        return int(self.data)
+
+    def __bool__(self) -> bool:
+        return bool(self.data)
+
+    def __format__(self, spec: str) -> str:
+        if self.data.ndim == 0:
+            return format(self.data.item(), spec)
+        return format(self.data, spec)
+
     def tolist(self):
         return self.numpy().tolist()
 

@@ -333,3 +333,16 @@ def get_cosine_schedule_with_warmup(
         return max(0.0, 0.5 * (1.0 + math.cos(math.pi * progress)))
 
     return LambdaLR(optimizer, lr_lambda, last_epoch)
+
+
+# torch-spelling namespace: ``optim.lr_scheduler.StepLR`` works exactly like
+# ``torch.optim.lr_scheduler.StepLR`` for ported training loops
+import types as _types
+
+lr_scheduler = _types.SimpleNamespace(
+    LRScheduler=LRScheduler,
+    _LRScheduler=LRScheduler,  # old torch spelling
+    LambdaLR=LambdaLR,
+    StepLR=StepLR,
+    CosineAnnealingLR=CosineAnnealingLR,
+)

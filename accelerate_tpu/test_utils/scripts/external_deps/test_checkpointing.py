@@ -1,0 +1,112 @@
+"""Checkpoint/resume equivalence (analog of reference
+test_utils/scripts/external_deps/test_checkpointing.py).
+
+Trains a tiny GPT, snapshots mid-run with ``save_state``, keeps training to
+the end (run A); then rebuilds everything fresh, ``load_state``s the
+snapshot, and trains the same remaining steps (run B).  Every parameter,
+optimizer moment, and the LR-schedule position must match run A exactly —
+resume is bitwise, not approximate.  Also covers ``skip_first_batches``
+mid-epoch resume (reference data_loader.py:1349).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator, set_seed
+from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+from accelerate_tpu.state import PartialState
+
+STEPS_TOTAL = 8
+STEPS_BEFORE = 3
+BATCH, SEQ = 8, 32
+
+
+def _build():
+    set_seed(7)
+    acc = Accelerator()
+    cfg = GPTConfig(
+        vocab_size=128, n_positions=SEQ, n_embd=32, n_layer=2, n_head=2, dropout=0.0
+    )
+    model = GPTLMHeadModel(cfg)
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    sched = optim.lr_scheduler.StepLR(opt, step_size=2, gamma=0.5)
+    model, opt, sched = acc.prepare(model, opt, sched)
+    return acc, model, opt, sched
+
+
+def _batches():
+    rng = np.random.default_rng(3)
+    return [
+        rng.integers(0, 128, size=(BATCH, SEQ), dtype=np.int32)
+        for _ in range(STEPS_TOTAL)
+    ]
+
+
+def _step(acc, model, opt, sched, ids):
+    out = model(ids, labels=ids)
+    acc.backward(out["loss"])
+    opt.step()
+    sched.step()
+    opt.zero_grad()
+    return float(out["loss"])
+
+
+def _params_flat(model) -> dict[str, np.ndarray]:
+    return {k: np.asarray(p.data) for k, p in model.named_parameters()}
+
+
+def main():
+    batches = _batches()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = os.path.join(tmp, "mid")
+
+        # run A: straight through, snapshotting at STEPS_BEFORE
+        acc, model, opt, sched = _build()
+        for i in range(STEPS_TOTAL):
+            if i == STEPS_BEFORE:
+                acc.save_state(ckpt)
+            _step(acc, model, opt, sched, batches[i])
+        final_a = _params_flat(model)
+        lr_a = opt.lr
+        PartialState._reset_state()
+
+        # run B: fresh everything, resume from the snapshot
+        acc, model, opt, sched = _build()
+        acc.load_state(ckpt)
+        for i in range(STEPS_BEFORE, STEPS_TOTAL):
+            _step(acc, model, opt, sched, batches[i])
+        final_b = _params_flat(model)
+        lr_b = opt.lr
+        PartialState._reset_state()
+
+    assert final_a.keys() == final_b.keys()
+    for name in final_a:
+        np.testing.assert_array_equal(
+            final_a[name], final_b[name], err_msg=f"param {name} diverged after resume"
+        )
+    assert float(lr_a) == float(lr_b), (lr_a, lr_b)
+
+    # mid-epoch resume: skip_first_batches yields exactly the tail
+    acc = Accelerator()
+    data = list(range(20))
+    import torch.utils.data as tud
+
+    dl = acc.prepare(tud.DataLoader(data, batch_size=2))
+    skipped = acc.skip_first_batches(dl, 3)
+    seen = [int(np.asarray(b).ravel()[0]) for b in skipped]
+    full = [int(np.asarray(b).ravel()[0]) for b in dl]
+    assert seen == full[3:], (seen, full[3:])
+    PartialState._reset_state()
+
+    print("All checkpointing checks passed")
+
+
+if __name__ == "__main__":
+    main()

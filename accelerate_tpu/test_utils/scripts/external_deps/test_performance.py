@@ -1,0 +1,102 @@
+"""Accuracy-parity across execution modes (analog of reference
+test_utils/scripts/external_deps/test_performance.py).
+
+The reference trains bert-base on MRPC under each distributed backend and
+asserts the eval accuracy/F1 stay above a threshold.  Zero-egress analog:
+a tiny native BERT classifier on a deterministic, linearly-separable token
+task, trained three ways —
+
+* eager tape loop (the debugging path),
+* ``compile_step``-captured loop (the perf path),
+* captured loop with gradient accumulation (2 micro-steps),
+
+— all from identical seeds.  Final train accuracy must clear an absolute
+floor AND the three runs must agree within a tolerance, which is the same
+contract the reference enforces between backends.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator, set_seed
+from accelerate_tpu.models.bert import BertConfig, BertForSequenceClassification
+from accelerate_tpu.state import PartialState
+
+VOCAB = 64
+SEQ = 16
+N = 256
+BATCH = 32
+EPOCHS = 8
+ACC_FLOOR = 0.80
+PARITY_TOL = 0.08
+
+
+def _tiny_config() -> BertConfig:
+    return BertConfig(
+        vocab_size=VOCAB,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=SEQ,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        num_labels=2,
+    )
+
+
+def _make_data(seed: int = 0):
+    """Label = whether tokens from the 'positive' half dominate."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, VOCAB, size=(N, SEQ), dtype=np.int32)
+    labels = (np.sum(ids >= VOCAB // 2, axis=1) > SEQ // 2).astype(np.int64)
+    return ids, labels
+
+
+def _train(mode: str) -> float:
+    set_seed(42)
+    accum = 2 if mode == "captured_accum" else 1
+    acc = Accelerator(gradient_accumulation_steps=accum)
+    model = BertForSequenceClassification(_tiny_config())
+    opt = optim.AdamW(model.parameters(), lr=5e-3)
+    model, opt = acc.prepare(model, opt)
+    ids, labels = _make_data()
+
+    def loop_body(batch_ids, batch_labels):
+        out = model(batch_ids, labels=batch_labels)
+        acc.backward(out["loss"])
+        opt.step()
+        opt.zero_grad()
+        return out["loss"]
+
+    step = acc.compile_step(loop_body) if mode.startswith("captured") else loop_body
+
+    micro = BATCH // accum
+    for _ in range(EPOCHS):
+        for start in range(0, N, micro):
+            with acc.accumulate(model):
+                step(ids[start : start + micro], labels[start : start + micro])
+
+    model.eval()
+    logits = model(ids)["logits"]
+    preds = np.asarray(logits.data).argmax(-1)
+    accuracy = float((preds == labels).mean())
+    PartialState._reset_state()
+    return accuracy
+
+
+def main():
+    results = {m: _train(m) for m in ("eager", "captured", "captured_accum")}
+    print("accuracies:", results)
+    for mode, accuracy in results.items():
+        assert accuracy >= ACC_FLOOR, f"{mode}: {accuracy:.3f} < floor {ACC_FLOOR}"
+    spread = max(results.values()) - min(results.values())
+    assert spread <= PARITY_TOL, f"parity spread {spread:.3f} > {PARITY_TOL}"
+    print("All performance-parity checks passed")
+
+
+if __name__ == "__main__":
+    main()

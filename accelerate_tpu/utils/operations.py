@@ -310,11 +310,30 @@ def broadcast(tensor, from_process: int = 0):
 
 
 def broadcast_object_list(object_list: list, from_process: int = 0):
-    """Broadcast picklable objects from one process, in place (reference :560)."""
+    """Broadcast picklable objects from one process, in place (reference :560).
+
+    True one-to-all: only ``from_process`` pickles; everyone else contributes
+    a zero buffer.  Two ``broadcast_one_to_all`` rounds (size, then payload)
+    keep per-step dispatch traffic O(payload), not O(world × payload) — the
+    reference's dispatcher leans on this every batch (data_loader.py:778).
+    """
     if _num_processes() == 1:
         return object_list
-    results = gather_object(list(object_list))
-    src = results[from_process]
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    is_source = jax.process_index() == from_process
+    if is_source:
+        payload = np.frombuffer(pickle.dumps(list(object_list)), dtype=np.uint8)
+    else:
+        payload = np.zeros(0, dtype=np.uint8)
+    size = multihost_utils.broadcast_one_to_all(
+        np.array([payload.size], dtype=np.int64), is_source=is_source
+    )
+    buf = payload if is_source else np.zeros(int(size[0]), dtype=np.uint8)
+    data = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    src = pickle.loads(np.asarray(data).tobytes())
     for i in range(len(object_list)):
         object_list[i] = src[i]
     return object_list

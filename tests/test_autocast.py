@@ -1,0 +1,52 @@
+"""autocast(): locally-fp32 regions inside a bf16 model (reference
+accelerator.py:3587 ``torch.autocast`` disable idiom)."""
+
+import jax.numpy as jnp
+
+import accelerate_tpu.nn as nn
+from accelerate_tpu import Accelerator
+from accelerate_tpu.nn.amp import autocast_dtype, autocast_region
+from accelerate_tpu.utils.dataclasses import AutocastKwargs
+
+
+def test_region_state_nests_and_restores():
+    assert autocast_dtype() is None
+    with autocast_region(jnp.float32):
+        assert autocast_dtype() == jnp.float32
+        with autocast_region(jnp.bfloat16):
+            assert autocast_dtype() == jnp.bfloat16
+        assert autocast_dtype() == jnp.float32
+    assert autocast_dtype() is None
+
+
+def test_disabled_autocast_upcasts_linear_to_fp32():
+    acc = Accelerator(mixed_precision="bf16")
+    model = nn.Linear(8, 4)
+    model = acc.prepare(model)
+    assert model.weight.dtype == jnp.bfloat16
+    x = nn.Tensor(jnp.ones((2, 8), jnp.bfloat16))
+
+    out_ambient = model(x)
+    assert out_ambient.dtype == jnp.bfloat16
+
+    with acc.autocast(autocast_handler=AutocastKwargs(enabled=False)):
+        out_fp32 = model(x)
+    assert out_fp32.dtype == jnp.float32
+
+    # handler can also be installed at construction time
+    acc2 = Accelerator(
+        mixed_precision="bf16", kwargs_handlers=[AutocastKwargs(enabled=False)]
+    )
+    model2 = acc2.prepare(nn.Linear(8, 4))
+    with acc2.autocast():
+        out2 = model2(x)
+    assert out2.dtype == jnp.float32
+    Accelerator._reset_state()
+
+
+def test_cross_entropy_upcasts_in_fp32_region():
+    logits = nn.Tensor(jnp.asarray([[2.0, 0.0], [0.0, 2.0]], jnp.bfloat16))
+    labels = jnp.asarray([0, 1])
+    with autocast_region(jnp.float32):
+        loss = nn.F.cross_entropy(logits, labels)
+    assert loss.dtype == jnp.float32

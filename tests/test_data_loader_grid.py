@@ -1,0 +1,202 @@
+"""Combinatorial grid over the batch-sharding engine.
+
+The reference pins exact shard index lists for every (batch_size, drop_last,
+even_batches, split_batches) combination in its 867-LoC test_data_loader.py.
+Here the same coverage comes from invariants checked across the whole grid —
+plus a handful of hand-pinned cases so the semantics (not just
+self-consistency) are locked down.
+
+Invariants, per (n, batch_size, num_shards, drop_last, even_batches,
+split_batches) cell:
+
+* every yielded group has exactly ``num_shards`` shard batches;
+* ``even_batches=True``: every shard batch has the full per-shard size;
+* ``len(sampler)`` equals the number of groups actually yielded (exactness —
+  reference's __len__ contract; a scheduler/progress bar trusts this);
+* ``even_batches=True`` & no drop_last: every sample index appears at least
+  once (nothing silently lost), and the duplicate count equals ``remainder``;
+* ``even_batches=False``: yielded indices are unique (no padding), and
+  ``dropped`` counts exactly the samples not delivered;
+* BatchSamplerShard process views partition the global groups.
+"""
+
+import itertools
+
+import pytest
+
+from accelerate_tpu.data_loader import (
+    BatchSampler,
+    BatchSamplerShard,
+    GlobalBatchSampler,
+    SequentialSampler,
+)
+
+
+def make_global(n, batch_size, num_shards, drop_last, even_batches, split_batches):
+    # split_batches reads batch_size as the GLOBAL batch; keep it divisible
+    bs = batch_size * num_shards if split_batches else batch_size
+    inner = BatchSampler(SequentialSampler(n), batch_size=bs, drop_last=drop_last)
+    return GlobalBatchSampler(
+        inner,
+        num_shards,
+        split_batches=split_batches,
+        even_batches=even_batches,
+    )
+
+
+GRID = [
+    (n, bs, k, dl, eb, sb)
+    for n in (0, 1, 2, 3, 7, 8, 16, 22, 24, 31, 33)
+    for bs in (1, 2, 3, 4)
+    for k in (1, 2, 3, 4)
+    for dl in (False, True)
+    for eb in (True, False)
+    for sb in (False, True)
+]
+
+
+@pytest.mark.parametrize("n,bs,k,dl,eb,sb", GRID)
+def test_grid_invariants(n, bs, k, dl, eb, sb):
+    sampler = make_global(n, bs, k, dl, eb, sb)
+    groups = list(sampler)
+
+    # shape invariants
+    for group in groups:
+        assert len(group) == k
+        if eb:
+            assert all(len(b) == bs for b in group), (group, bs)
+
+    # __len__ is exact, not an estimate
+    assert len(sampler) == len(groups), (
+        f"__len__={len(sampler)} but yielded {len(groups)} groups "
+        f"(n={n} bs={bs} shards={k} drop_last={dl} even={eb} split={sb})"
+    )
+
+    flat = [i for g in groups for b in g for i in b]
+    assert all(0 <= i < n for i in flat)
+
+    if eb and not dl:
+        # nothing lost: every sample delivered at least once
+        assert set(flat) == set(range(n)) or n == 0
+        # duplicates are exactly what remainder reports
+        assert len(flat) - len(set(flat)) == sampler.remainder or n == 0, (
+            len(flat), len(set(flat)), sampler.remainder
+        )
+    if eb and dl:
+        # drop_last trims the stream to full inner batches before sharding:
+        # no duplicates are ever needed for the batch dimension itself under
+        # split_batches (global batches are already even)
+        if sb:
+            assert sampler.remainder == 0
+            assert len(flat) == len(set(flat))
+    if not eb:
+        # no padding in this mode: indices are unique, dropped is exact
+        assert len(flat) == len(set(flat))
+        delivered = set(flat)
+        lost = n - len(delivered) if not dl else None
+        if not dl:
+            assert sampler.dropped == lost, (sampler.dropped, lost)
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 4])
+@pytest.mark.parametrize("sb", [False, True])
+def test_shard_views_partition_global(k, sb):
+    n, bs = 27, 2
+    shards = [
+        BatchSamplerShard(
+            BatchSampler(
+                SequentialSampler(n), batch_size=bs * k if sb else bs, drop_last=False
+            ),
+            num_processes=k,
+            process_index=p,
+            split_batches=sb,
+            even_batches=True,
+        )
+        for p in range(k)
+    ]
+    per_shard = [list(s) for s in shards]
+    # every shard yields the same number of equally-sized batches
+    assert len({len(b) for b in per_shard}) == 1
+    for batches in zip(*per_shard):
+        assert len({len(b) for b in batches}) == 1
+    # recombining the shard streams equals the global stream
+    global_sampler = make_global(n, bs, k, False, True, sb)
+    recombined = [list(group) for group in zip(*per_shard)]
+    assert recombined == [[b for b in g] for g in global_sampler]
+
+
+# ---------------------------------------------------------------------------
+# hand-pinned cases: semantics, not just self-consistency
+# ---------------------------------------------------------------------------
+def test_even_tail_loops_back_to_epoch_start():
+    # 10 samples, bs=3, 2 shards: batches [0-2][3-5][6-8][9]; the short final
+    # batch pads from the START of the epoch's stream (reference
+    # BatchSamplerShard semantics, data_loader.py:195-262)
+    sampler = make_global(10, 3, 2, False, True, False)
+    groups = list(sampler)
+    assert groups == [
+        [[0, 1, 2], [3, 4, 5]],
+        [[6, 7, 8], [9, 0, 1]],
+    ]
+    assert sampler.remainder == 2
+
+
+def test_even_tail_missing_whole_shard_batch():
+    # 8 samples, bs=3, 3 shards: batches [0-2][3-5][6,7] → one group, the
+    # third shard's batch completed by looping back
+    sampler = make_global(8, 3, 3, False, True, False)
+    groups = list(sampler)
+    assert groups == [[[0, 1, 2], [3, 4, 5], [6, 7, 0]]]
+    assert sampler.remainder == 1
+
+
+def test_uneven_drops_ragged_group():
+    # 10 samples, bs=3, 2 shards, even_batches=False: group 2 has a short
+    # batch → dropped entirely (SPMD divergence, documented)
+    sampler = make_global(10, 3, 2, False, False, False)
+    groups = list(sampler)
+    assert groups == [[[0, 1, 2], [3, 4, 5]]]
+    assert sampler.dropped == 4
+    assert len(sampler) == 1
+
+
+def test_split_batches_divides_global_batch():
+    # split_batches: each inner batch IS the global batch, split k ways
+    sampler = make_global(8, 2, 2, False, True, True)  # global bs = 4
+    groups = list(sampler)
+    assert groups == [[[0, 1], [2, 3]], [[4, 5], [6, 7]]]
+    assert sampler.remainder == 0
+
+
+def test_split_batches_short_global_batch_pads_itself():
+    sampler = make_global(6, 2, 2, False, True, True)  # global bs=4: [0-3],[4,5]
+    groups = list(sampler)
+    assert groups == [[[0, 1], [2, 3]], [[4, 5], [0, 1]]]
+
+
+def test_mid_stream_short_batch_does_not_stall():
+    """A custom batch sampler emitting a short batch mid-stream must not
+    wedge the group machinery (regression: the group-complete check could
+    never fire once a group overshot num_shards)."""
+
+    class WeirdBatches:
+        batch_size = 2
+
+        def __iter__(self):
+            yield [0, 1]
+            yield [2]  # short, mid-stream
+            yield [3, 4]
+            yield [5, 6]
+
+        def __len__(self):
+            return 4
+
+    even = GlobalBatchSampler(WeirdBatches(), 2, even_batches=True)
+    groups = list(even)
+    assert len(groups) == 2
+    assert all(len(b) == 2 for g in groups for b in g)
+
+    uneven = GlobalBatchSampler(WeirdBatches(), 2, even_batches=False)
+    groups = list(uneven)
+    # first group [0,1],[2] is ragged → dropped; second [3,4],[5,6] survives
+    assert groups == [[[3, 4], [5, 6]]]

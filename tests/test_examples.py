@@ -86,6 +86,12 @@ def test_example_smoke_train_save_resume(tmp_path, script):
         EXAMPLES_N_TRAIN="32",
         EXAMPLES_N_VAL="16",
         JAX_PLATFORMS="cpu",
+        # single virtual device: this smoke covers save→resume equivalence
+        # (SPMD paths are covered by the suite's own 8-device mesh).  On a
+        # loaded 1-core box, XLA CPU *cross-module* collectives need every
+        # participant thread to arrive within a 40s rendezvous window or the
+        # process SIGABRTs — eager multi-device runs of a real BERT here flake
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
         PYTHONPATH=os.pathsep.join(
             p for p in (repo_root, os.environ.get("PYTHONPATH", "")) if p
         ),

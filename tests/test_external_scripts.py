@@ -1,0 +1,47 @@
+"""Drive the external-deps analog scripts through subprocesses (reference
+Pattern 2/6: tests/test_multigpu.py → test_utils/scripts/external_deps/*)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.test_utils.testing import slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(module: str, timeout: int = 420) -> str:
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PYTHONPATH=os.pathsep.join(p for p in (REPO, os.environ.get("PYTHONPATH", "")) if p),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", module],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_checkpointing_script():
+    out = _run("accelerate_tpu.test_utils.scripts.external_deps.test_checkpointing")
+    assert "All checkpointing checks passed" in out
+
+
+def test_peak_memory_script():
+    out = _run("accelerate_tpu.test_utils.scripts.external_deps.test_peak_memory_usage")
+    assert "All peak-memory checks passed" in out
+
+
+@slow
+def test_performance_script():
+    out = _run("accelerate_tpu.test_utils.scripts.external_deps.test_performance")
+    assert "All performance-parity checks passed" in out
