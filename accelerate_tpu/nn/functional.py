@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from . import random as nn_random
+from .amp import region_cast
 from .tape import Tensor, tape_op, _unwrap, is_grad_enabled
 
 
@@ -38,14 +39,10 @@ def tanh(x):
 
 
 def softmax(x, axis: int = -1):
-    from .amp import region_cast
-
     return tape_op(lambda v: jax.nn.softmax(region_cast(v), axis=axis), x)
 
 
 def log_softmax(x, axis: int = -1):
-    from .amp import region_cast
-
     return tape_op(lambda v: jax.nn.log_softmax(region_cast(v), axis=axis), x)
 
 
@@ -56,8 +53,6 @@ def linear(x, weight, bias=None):
     Honors an open ``autocast_region`` (nn/amp.py): inputs and params are
     cast to the region dtype before the matmul.
     """
-    from .amp import region_cast
-
     def _mm(v, w):
         v, w = region_cast(v, w)
         return v @ w.T
@@ -84,8 +79,6 @@ def one_hot(ids, num_classes: int):
 # -- normalization ----------------------------------------------------------
 def layer_norm(x, normalized_shape, weight=None, bias=None, eps: float = 1e-5):
     def _ln(v, *wb):
-        from .amp import region_cast
-
         casted = region_cast(v, *wb)
         if wb:
             v, wb = casted[0], casted[1:]
@@ -129,8 +122,6 @@ def cross_entropy(logits, labels, ignore_index: Optional[int] = -100, label_smoo
     labels = _unwrap(labels) if isinstance(labels, Tensor) else jnp.asarray(labels)
 
     def _ce(lg):
-        from .amp import region_cast
-
         lg = region_cast(lg)
         logp = jax.nn.log_softmax(lg, axis=-1)
         num_classes = lg.shape[-1]
@@ -151,8 +142,6 @@ def nll_loss(log_probs, labels):
     labels = _unwrap(labels) if isinstance(labels, Tensor) else jnp.asarray(labels)
 
     def _nll(lp):
-        from .amp import region_cast
-
         lp = region_cast(lp)
         return -jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0].mean()
 
@@ -160,8 +149,6 @@ def nll_loss(log_probs, labels):
 
 
 def mse_loss(pred, target):
-    from .amp import region_cast
-
     def _mse(p, t):
         p, t = region_cast(p, t)
         return ((p - t) ** 2).mean()
@@ -171,8 +158,6 @@ def mse_loss(pred, target):
 
 def binary_cross_entropy_with_logits(logits, targets):
     def _bce(lg, t):
-        from .amp import region_cast
-
         lg, t = region_cast(lg, t)
         return jnp.mean(jnp.maximum(lg, 0) - lg * t + jnp.log1p(jnp.exp(-jnp.abs(lg))))
 
@@ -206,8 +191,7 @@ def scaled_dot_product_attention(
     mask_arr = _unwrap(attn_mask) if attn_mask is not None else None
 
     def _sdpa(q_, k_, v_):
-        from ..ops.attention import sdpa_reference, sdpa_tpu
-        from .amp import region_cast
+        from ..ops.attention import sdpa_tpu
 
         q_, k_, v_ = region_cast(q_, k_, v_)
         return sdpa_tpu(q_, k_, v_, mask=mask_arr, is_causal=is_causal, scale=scale)

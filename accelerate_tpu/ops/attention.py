@@ -46,9 +46,15 @@ def sdpa_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
-def _on_tpu(x: jax.Array) -> bool:
+# single source of truth for "the Pallas kernels are safe here" — shared
+# with ops/ring_attention.py so the two dispatchers cannot drift
+_MXU_HEAD_DIMS = (64, 128, 256)
+_TPU_BACKENDS = ("tpu", "axon")
+
+
+def _on_tpu(x: Optional[jax.Array] = None) -> bool:
     try:
-        return jax.default_backend() in ("tpu", "axon")
+        return jax.default_backend() in _TPU_BACKENDS
     except Exception:
         return False
 
@@ -68,7 +74,7 @@ def sdpa_tpu(
         and mask is None
         and seq_q % 128 == 0
         and seq_k % 128 == 0
-        and head_dim in (64, 128, 256)
+        and head_dim in _MXU_HEAD_DIMS
     )
     if use_flash:
         try:

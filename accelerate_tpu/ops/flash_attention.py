@@ -50,9 +50,11 @@ _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 _INTERPRET = False
 
 
-def _causal_mask(s, qi, ki, block_q, block_k):
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+def _causal_mask(s, qi, ki, block_q, block_k, q_off=0, k_off=0):
+    """Causal mask on GLOBAL positions: local tile indices plus the chunk
+    offsets a ring-attention hop supplies (0 for plain self-attention)."""
+    q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = k_off + ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     return jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
 
@@ -60,6 +62,7 @@ def _causal_mask(s, qi, ki, block_q, block_k):
 # forward
 # ---------------------------------------------------------------------------
 def _flash_kernel(
+    off_ref,  # (2,) int32 SMEM: [q_offset, k_offset] global chunk offsets
     q_ref,  # (1, block_q, d)
     k_ref,  # (1, block_k, d)
     v_ref,  # (1, block_k, d)
@@ -84,10 +87,12 @@ def _flash_kernel(
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    # causal: skip blocks strictly above the diagonal
+    # causal: skip blocks strictly above the (offset-aware) diagonal — a
+    # dynamic scalar predicate, so ring hops skip real MXU work, not a select
     should_compute = True
     if is_causal:
-        should_compute = qi * block_q + block_q - 1 >= ki * block_k
+        q_off, k_off = off_ref[0], off_ref[1]
+        should_compute = q_off + qi * block_q + block_q - 1 >= k_off + ki * block_k
 
     @pl.when(should_compute)
     def _compute():
@@ -102,7 +107,7 @@ def _flash_kernel(
         )
         s = s * scale
         if is_causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+            s = _causal_mask(s, qi, ki, block_q, block_k, off_ref[0], off_ref[1])
 
         m_prev = m_scratch[:, 0:1]
         l_prev = l_scratch[:, 0:1]
@@ -133,6 +138,17 @@ def _flash_kernel(
             )
 
 
+def _offsets_arr(q_offset, k_offset) -> jax.Array:
+    """Pack the (possibly traced) chunk offsets for SMEM prefetch."""
+    return jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32), jnp.asarray(k_offset, jnp.int32)]
+    )
+
+
+def _off_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
 def _flash_forward(
     q: jax.Array,
     k: jax.Array,
@@ -142,6 +158,8 @@ def _flash_forward(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     return_lse: bool = False,
+    q_offset=0,
+    k_offset=0,
 ):
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -149,6 +167,14 @@ def _flash_forward(
     q3 = q.reshape(bh, sq, d)
     k3 = k.reshape(bh, sk, d)
     v3 = v.reshape(bh, sk, d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash attention needs seq divisible by the block size: got "
+            f"q_seq={sq} (block {block_q}), k_seq={sk} (block {block_k}); "
+            "rows beyond the last full block would be silently dropped"
+        )
     grid = (bh, sq // block_q, sk // block_k)
 
     kernel = functools.partial(
@@ -180,6 +206,7 @@ def _flash_forward(
         kernel,
         grid=grid,
         in_specs=[
+            _off_spec(),
             pl.BlockSpec(
                 (1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0), memory_space=pltpu.VMEM
             ),
@@ -198,21 +225,22 @@ def _flash_forward(
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=_INTERPRET,
-    )(q3, k3, v3)
+    )(_offsets_arr(q_offset, k_offset), q3, k3, v3)
     if return_lse:
         out, lse = outs
         return out.reshape(b, h, sq, d), lse
     return outs.reshape(b, h, sq, d)
 
 
-def _drop_lse_arg(kernel, q_ref, k_ref, v_ref, o_ref, *scratch, **kw):
-    return kernel(q_ref, k_ref, v_ref, o_ref, None, *scratch, **kw)
+def _drop_lse_arg(kernel, off_ref, q_ref, k_ref, v_ref, o_ref, *scratch, **kw):
+    return kernel(off_ref, q_ref, k_ref, v_ref, o_ref, None, *scratch, **kw)
 
 
 # ---------------------------------------------------------------------------
 # backward: dkv kernel (grid over k-blocks, stream q-blocks)
 # ---------------------------------------------------------------------------
 def _flash_bwd_dkv_kernel(
+    off_ref,  # (2,) int32 SMEM: [q_offset, k_offset]
     q_ref,  # (1, block_q, d)
     k_ref,  # (1, block_k, d)
     v_ref,  # (1, block_k, d)
@@ -241,7 +269,8 @@ def _flash_bwd_dkv_kernel(
     should_compute = True
     if is_causal:
         # this (q-block, k-block) tile contributes only if some q >= some k
-        should_compute = qi * block_q + block_q - 1 >= ki * block_k
+        q_off, k_off = off_ref[0], off_ref[1]
+        should_compute = q_off + qi * block_q + block_q - 1 >= k_off + ki * block_k
 
     @pl.when(should_compute)
     def _compute():
@@ -263,7 +292,7 @@ def _flash_bwd_dkv_kernel(
         )
         s = s * scale
         if is_causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+            s = _causal_mask(s, qi, ki, block_q, block_k, off_ref[0], off_ref[1])
         # p is exactly the forward's normalized softmax tile (recompute)
         p = jnp.exp(s - lse)  # (block_q, block_k); masked entries exp(-inf)=0
         # dv += pᵀ · dO
@@ -299,6 +328,7 @@ def _flash_bwd_dkv_kernel(
 # backward: dq kernel (grid over q-blocks, stream k-blocks)
 # ---------------------------------------------------------------------------
 def _flash_bwd_dq_kernel(
+    off_ref,  # (2,) int32 SMEM: [q_offset, k_offset]
     q_ref,  # (1, block_q, d)
     k_ref,  # (1, block_k, d)
     v_ref,  # (1, block_k, d)
@@ -323,7 +353,8 @@ def _flash_bwd_dq_kernel(
 
     should_compute = True
     if is_causal:
-        should_compute = qi * block_q + block_q - 1 >= ki * block_k
+        q_off, k_off = off_ref[0], off_ref[1]
+        should_compute = q_off + qi * block_q + block_q - 1 >= k_off + ki * block_k
 
     @pl.when(should_compute)
     def _compute():
@@ -345,7 +376,7 @@ def _flash_bwd_dq_kernel(
         )
         s = s * scale
         if is_causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+            s = _causal_mask(s, qi, ki, block_q, block_k, off_ref[0], off_ref[1])
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do,
@@ -378,10 +409,20 @@ def _flash_backward(
     is_causal: bool,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    q_offset=0,
+    k_offset=0,
+    delta_adjust=None,
 ):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bh = b * h
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash attention backward needs seq divisible by the block size: "
+            f"got q_seq={sq} (block {block_q}), k_seq={sk} (block {block_k})"
+        )
     q3 = q.reshape(bh, sq, d)
     k3 = k.reshape(bh, sk, d)
     v3 = v.reshape(bh, sk, d)
@@ -394,6 +435,10 @@ def _flash_backward(
     lse3 = jnp.broadcast_to(lse[..., None], (bh, sq, _LANES))
     # delta_i = Σ_d dO_i·O_i  — cheap rank-reduction, XLA fuses it
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+    if delta_adjust is not None:
+        # hop-level vjp: the lse output's own cotangent g_lse enters as
+        # ds += p·g_lse, equivalent to delta' = delta - g_lse
+        delta = delta + delta_adjust.astype(jnp.float32)
     delta3 = jnp.broadcast_to(delta[..., None], (bh, sq, _LANES))
 
     q_spec = pl.BlockSpec(
@@ -413,10 +458,11 @@ def _flash_backward(
         block_q=block_q,
         block_k=block_k,
     )
+    offs = _offsets_arr(q_offset, k_offset)
     dk3, dv3 = pl.pallas_call(
         dkv_kernel,
         grid=(bh, sk // block_k, sq // block_q),
-        in_specs=[q_spec, kv_spec_dkv, kv_spec_dkv, q_spec, row_spec, row_spec],
+        in_specs=[_off_spec(), q_spec, kv_spec_dkv, kv_spec_dkv, q_spec, row_spec, row_spec],
         out_specs=[
             pl.BlockSpec(
                 (1, block_k, d), lambda bh_, ki, a: (bh_, ki, 0), memory_space=pltpu.VMEM
@@ -434,7 +480,7 @@ def _flash_backward(
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_INTERPRET,
-    )(q3, k3, v3, do3, lse3, delta3)
+    )(offs, q3, k3, v3, do3, lse3, delta3)
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel,
@@ -455,14 +501,14 @@ def _flash_backward(
     dq3 = pl.pallas_call(
         dq_kernel,
         grid=(bh, sq // block_q, sk // block_k),
-        in_specs=[q_spec_dq, kv_spec_dq, kv_spec_dq, q_spec_dq, row_spec_dq, row_spec_dq],
+        in_specs=[_off_spec(), q_spec_dq, kv_spec_dq, kv_spec_dq, q_spec_dq, row_spec_dq, row_spec_dq],
         out_specs=pl.BlockSpec(
             (1, block_q, d), lambda bh_, qi, a: (bh_, qi, 0), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_INTERPRET,
-    )(q3, k3, v3, do3, lse3, delta3)
+    )(offs, q3, k3, v3, do3, lse3, delta3)
 
     return (
         dq3.reshape(b, h, sq, d),
@@ -509,3 +555,65 @@ def _bwd(is_causal, scale, residuals, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# hop-level API for ring attention: per-(q-chunk, kv-chunk) partial attention
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def flash_attention_hop(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offset,
+    k_offset,
+    is_causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """One ring-attention hop: q attends to ONE k/v chunk, masked on global
+    positions (q_offset/k_offset are traced scalars from ``axis_index``).
+
+    Returns ``(out, lse)`` where ``out`` is normalized over this chunk only
+    and ``lse`` is the per-row logsumexp — the pair composes across hops via
+    the standard logsumexp merge (ops/ring_attention.py).  Offset-aware tile
+    skipping inside the kernel means diagonal hops do triangle work only.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    out, lse = _flash_forward(
+        q, k, v, scale, is_causal, return_lse=True,
+        q_offset=q_offset, k_offset=k_offset,
+    )
+    return out, lse[..., 0].reshape(q.shape[0], q.shape[1], q.shape[2])
+
+
+def _hop_fwd(q, k, v, q_offset, k_offset, is_causal, scale):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    out, lse = flash_attention_hop(q, k, v, q_offset, k_offset, is_causal, scale)
+    return (out, lse), (q, k, v, out, lse, q_offset, k_offset)
+
+
+def _hop_bwd(is_causal, scale, residuals, g):
+    q, k, v, out, lse, q_offset, k_offset = residuals
+    b, h, sq, _ = q.shape
+    g_out, g_lse = g
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    # lse's own cotangent: d lse/d s = p (the normalized probs), which the
+    # delta term already encodes — fold g_lse into delta:
+    #   ds = p * (dp - delta);  with L-cotangent ds += p * g_lse
+    # i.e. delta' = delta - g_lse.  _flash_backward computes delta from
+    # (dO, O); shift it by feeding dO' = dO and delta adjustment via out:
+    # simplest correct route: recompute here with an adjusted delta by
+    # passing g_lse through the XLA-side delta precomputation.
+    lse_flat = lse.reshape(b * h, sq)
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse_flat, g_out, scale, is_causal,
+        q_offset=q_offset, k_offset=k_offset,
+        delta_adjust=(-g_lse.reshape(b * h, sq) if g_lse is not None else None),
+    )
+    return dq, dk, dv, None, None
+
+
+flash_attention_hop.defvjp(_hop_fwd, _hop_bwd)

@@ -12,8 +12,13 @@ pass-through flags).  Here it is first-class and TPU-native:
 * softmax is computed online (running max/denominator, the flash-attention
   recurrence) so the full (S × S) score matrix never exists anywhere and the
   per-device memory is O(S/n · S/n) per block pair;
-* causal masking skips fully-masked chunk pairs via ``lax.cond`` so the
-  causal ring does ~half the FLOPs.
+* causal masking: fully-masked hops are skipped by a per-device ``lax.cond``
+  (a real branch — shard_map bodies are scalar programs, not vmapped lanes)
+  and, on TPU, partially-masked hops run the Pallas hop kernel whose
+  offset-aware tile predicate skips MXU work above the diagonal.  The saving
+  is ~half the *FLOPs/energy*; ring *latency* is still n lockstep hops, so
+  per-step wall-clock is bounded by the busiest device (a zigzag/striped
+  layout would balance that and is future work).
 
 Design follows the blockwise/ring attention literature (see PAPERS.md);
 no reference code exists for this path.
@@ -50,49 +55,113 @@ def _block_update(q, k, v, m, l, acc, q_offset, k_offset, scale, is_causal):
     return m_new, l_new, acc_new
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, is_causal: bool, scale: float):
-    """Per-device body under shard_map: q stays, k/v ride the ring."""
+# test hook: force the Pallas hop path off-TPU (kernels in interpret mode)
+_FORCE_FLASH_HOPS = False
+
+
+def _use_flash_hops(chunk: int, d: int) -> bool:
+    from .attention import _MXU_HEAD_DIMS, _on_tpu
+
+    if _FORCE_FLASH_HOPS:
+        return True
+    return _on_tpu(None) and chunk % 128 == 0 and d in _MXU_HEAD_DIMS
+
+
+def _ring_hops(k, v, carry0, do_step, *, axis_name: str, is_causal: bool, chunk: int):
+    """Shared ring skeleton: rotate k/v with ``ppermute``, apply ``do_step``
+    per hop, skip fully-masked hops under causal masking.
+
+    The causal skip is a real branch: shard_map bodies are per-device scalar
+    programs, not vmapped lanes, so ``lax.cond`` lowers to an HLO conditional.
+    The final hop's rotation is NOT issued — XLA cannot DCE a collective
+    inside a loop, so the loop runs n-1 hops-with-rotation and the last hop
+    happens outside it.
+    """
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
-    b, h, sq, d = q.shape
-    chunk = sq  # local chunk length (== global_seq / n)
-    q32 = q.astype(jnp.float32)
-
-    m0 = jnp.full((b, h, sq, 1), _NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((b, h, sq, 1), dtype=jnp.float32)
-    acc0 = jnp.zeros((b, h, sq, d), dtype=jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(step, carry):
-        k_cur, v_cur, m, l, acc = carry
+    def hop(step, k_cur, v_cur, inner):
         # after `step` rotations this device holds the chunk that started at
         # ring position (my_idx - step) mod n
         k_idx = jax.lax.rem(my_idx - step + n, n)
         q_offset = my_idx * chunk
         k_offset = k_idx * chunk
-
-        def do_update(args):
-            m_, l_, acc_ = args
-            return _block_update(
-                q32, k_cur.astype(jnp.float32), v_cur, m_, l_, acc_,
-                q_offset, k_offset, scale, is_causal,
-            )
-
+        update = functools.partial(do_step, k_cur, v_cur, q_offset, k_offset)
         if is_causal:
             # whole chunk strictly in the future → nothing to accumulate
-            m, l, acc = jax.lax.cond(
-                k_offset > q_offset + chunk - 1,
-                lambda args: args,
-                do_update,
-                (m, l, acc),
+            return jax.lax.cond(
+                k_offset > q_offset + chunk - 1, lambda args: args, update, inner
             )
-        else:
-            m, l, acc = do_update((m, l, acc))
+        return update(inner)
+
+    def body(step, carry):
+        k_cur, v_cur, inner = carry
+        inner = hop(step, k_cur, v_cur, inner)
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        return k_next, v_next, m, l, acc
+        return k_next, v_next, inner
 
-    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    k_last, v_last, inner = jax.lax.fori_loop(0, n - 1, body, (k, v, carry0))
+    return hop(n - 1, k_last, v_last, inner)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, is_causal: bool, scale: float):
+    """Per-device body under shard_map: q stays, k/v ride the ring.
+
+    Two inner-block engines on the shared ``_ring_hops`` skeleton:
+
+    * **Pallas hop kernel** (TPU, MXU-tileable chunks): each hop calls
+      ``flash_attention_hop`` — offset-aware causal masking with tile-level
+      skipping inside the kernel — and hops merge by the logsumexp rule.
+      Diagonal hops do triangle work only.  The causal saving is in
+      FLOPs/energy, not ring latency — hops are lockstep (ppermute), so the
+      wall-clock lower bound is the busiest device's diagonal+past hops.
+    * **jnp online-softmax** (CPU tests, odd shapes): the m/l/acc recurrence,
+      fused by XLA.
+    """
+    b, h, sq, d = q.shape
+    chunk = sq  # local chunk length (== global_seq / n)
+
+    if _use_flash_hops(chunk, d):
+        from .flash_attention import flash_attention_hop
+
+        def do_step(k_cur, v_cur, q_offset, k_offset, inner):
+            out, lse = inner
+            o_hop, lse_hop = flash_attention_hop(
+                q, k_cur, v_cur, q_offset, k_offset, is_causal, scale
+            )
+            lse_new = jnp.logaddexp(lse, lse_hop)
+            w_old = jnp.exp(lse - lse_new)[..., None]
+            w_hop = jnp.exp(lse_hop - lse_new)[..., None]
+            return out * w_old + o_hop.astype(jnp.float32) * w_hop, lse_new
+
+        carry0 = (
+            jnp.zeros((b, h, sq, d), dtype=jnp.float32),
+            jnp.full((b, h, sq), _NEG_INF, dtype=jnp.float32),
+        )
+        out, _ = _ring_hops(
+            k, v, carry0, do_step, axis_name=axis_name, is_causal=is_causal, chunk=chunk
+        )
+        return out.astype(q.dtype)
+
+    q32 = q.astype(jnp.float32)
+
+    def do_step(k_cur, v_cur, q_offset, k_offset, inner):
+        m, l, acc = inner
+        return _block_update(
+            q32, k_cur.astype(jnp.float32), v_cur, m, l, acc,
+            q_offset, k_offset, scale, is_causal,
+        )
+
+    carry0 = (
+        jnp.full((b, h, sq, 1), _NEG_INF, dtype=jnp.float32),
+        jnp.zeros((b, h, sq, 1), dtype=jnp.float32),
+        jnp.zeros((b, h, sq, d), dtype=jnp.float32),
+    )
+    m, l, acc = _ring_hops(
+        k, v, carry0, do_step, axis_name=axis_name, is_causal=is_causal, chunk=chunk
+    )
     l = jnp.where(l == 0.0, 1.0, l)
     return (acc / l).astype(q.dtype)
 

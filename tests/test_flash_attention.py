@@ -83,3 +83,77 @@ def test_bf16_forward_close():
         atol=3e-2,
         rtol=3e-2,
     )
+
+
+# ---------------------------------------------------------------------------
+# hop-level API (ring attention inner block)
+# ---------------------------------------------------------------------------
+def _merge_hops(parts):
+    """Logsumexp-merge [(out, lse), ...] partial attentions."""
+    out, lse = parts[0]
+    out = out.astype(jnp.float32)
+    for o, l in parts[1:]:
+        lse_new = jnp.logaddexp(lse, l)
+        out = out * jnp.exp(lse - lse_new)[..., None] + o.astype(jnp.float32) * jnp.exp(
+            l - lse_new
+        )[..., None]
+        lse = lse_new
+    return out
+
+
+def test_hop_decomposition_matches_full_causal():
+    """Chunked hops with offsets merge to exactly full causal attention."""
+    q, k, v = _rand_qkv(s=256)
+    ref = sdpa_reference(q, k, v, is_causal=True)
+    half = 128
+    q1 = q[:, :, half:]
+    parts = [
+        fa.flash_attention_hop(q1, k[:, :, :half], v[:, :, :half], half, 0, True, None),
+        fa.flash_attention_hop(q1, k[:, :, half:], v[:, :, half:], half, half, True, None),
+    ]
+    merged = _merge_hops(parts)
+    np.testing.assert_allclose(
+        np.asarray(merged), np.asarray(ref[:, :, half:]), atol=2e-5, rtol=2e-5
+    )
+    # first chunk attends only to itself (diagonal hop)
+    o0, l0 = fa.flash_attention_hop(
+        q[:, :, :half], k[:, :, :half], v[:, :, :half], 0, 0, True, None
+    )
+    np.testing.assert_allclose(
+        np.asarray(o0), np.asarray(ref[:, :, :half]), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_hop_gradients_match_reference():
+    """Grads through hop merge == grads through monolithic reference,
+    including the lse cotangent path (delta_adjust)."""
+    q, k, v = _rand_qkv(s=256, h=1)
+    half = 128
+    q1 = q[:, :, half:]
+    k0, k1 = k[:, :, :half], k[:, :, half:]
+    v0, v1 = v[:, :, :half], v[:, :, half:]
+    d = q.shape[-1]
+    w = jnp.arange(d, dtype=jnp.float32)
+
+    def loss_hops(q1, k0, v0, k1, v1):
+        parts = [
+            fa.flash_attention_hop(q1, k0, v0, half, 0, True, None),
+            fa.flash_attention_hop(q1, k1, v1, half, half, True, None),
+        ]
+        return (_merge_hops(parts) * w).sum()
+
+    def loss_ref(q1, k0, v0, k1, v1):
+        kk = jnp.concatenate([k0, k1], axis=2)
+        vv = jnp.concatenate([v0, v1], axis=2)
+        s = kk.shape[2]
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q1, kk) * (d**-0.5)
+        qpos = half + jnp.arange(half)[:, None]
+        kpos = jnp.arange(s)[None, :]
+        scores = jnp.where(qpos >= kpos, scores, -0.7 * np.finfo(np.float32).max)
+        p = jax.nn.softmax(scores, axis=-1)
+        return ((p @ vv) * w).sum()
+
+    g_hops = jax.grad(loss_hops, argnums=(0, 1, 2, 3, 4))(q1, k0, v0, k1, v1)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(q1, k0, v0, k1, v1)
+    for gh, gr in zip(g_hops, g_ref):
+        np.testing.assert_allclose(np.asarray(gh), np.asarray(gr), atol=3e-4, rtol=3e-4)
