@@ -27,6 +27,12 @@ class GPTConfig:
     n_head: int = 12
     dropout: float = 0.0
     layer_norm_eps: float = 1e-5
+    # MoE (Switch-style): every `moe_every`-th block swaps its MLP for a
+    # MixtureOfExperts over the `ep` mesh axis; 0 experts = dense model
+    n_experts: int = 0
+    moe_top_k: int = 1
+    moe_every: int = 2
+    moe_aux_weight: float = 0.01
 
     @classmethod
     def small(cls) -> "GPTConfig":
@@ -35,6 +41,13 @@ class GPTConfig:
     @classmethod
     def tiny(cls) -> "GPTConfig":
         return cls(vocab_size=1024, n_positions=256, n_embd=128, n_layer=2, n_head=4)
+
+    @classmethod
+    def tiny_moe(cls) -> "GPTConfig":
+        return cls(
+            vocab_size=1024, n_positions=256, n_embd=128, n_layer=2, n_head=4,
+            n_experts=4, moe_every=2,
+        )
 
     @classmethod
     def medium(cls) -> "GPTConfig":
@@ -54,10 +67,15 @@ def _gpt2_init(model: nn.Module, config: GPTConfig) -> None:
     for name, p in model.named_parameters():
         if is_meta(p.data):
             continue  # init_empty_weights: nothing to initialise
-        if name.endswith(".bias") or ".ln" in name or "ln_" in name:
+        if (
+            name.endswith(".bias")
+            or name.endswith(("b_in", "b_out"))  # MoE bias stacks are 2-D
+            or ".ln" in name
+            or "ln_" in name
+        ):
             if p.ndim == 1 and name.endswith("weight"):
                 continue  # LN weight stays ones
-            if name.endswith("bias"):
+            if name.endswith("bias") or name.endswith(("b_in", "b_out")):
                 p.data = jnp.zeros_like(p.data)
             continue
         if p.ndim >= 2:
@@ -98,12 +116,20 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
         self.ln_1 = nn.LayerNorm(config.n_embd, eps=config.layer_norm_eps)
         self.attn = CausalSelfAttention(config)
         self.ln_2 = nn.LayerNorm(config.n_embd, eps=config.layer_norm_eps)
-        self.mlp = MLP(config)
+        # Switch convention: every moe_every-th block routes its FFN through
+        # experts (sharded over the `ep` mesh axis); the rest stay dense
+        if config.n_experts > 0 and layer_idx % config.moe_every == config.moe_every - 1:
+            self.mlp = nn.MixtureOfExperts(
+                config.n_embd, 4 * config.n_embd, config.n_experts,
+                top_k=config.moe_top_k, dropout=config.dropout,
+            )
+        else:
+            self.mlp = MLP(config)
 
     def forward(self, x):
         x = x + self.attn(self.ln_1(x))
@@ -119,6 +145,11 @@ class GPTLMHeadModel(nn.Module):
         r".*\.c_fc\.bias": ("tp",),
         r".*\.c_proj\.weight": (None, "tp"),
         r"wte\.weight": ("tp", None),
+        # MoE expert stacks: leading expert axis over ep (router replicated)
+        r".*\.mlp\.w_in": ("ep", None, None),
+        r".*\.mlp\.b_in": ("ep", None),
+        r".*\.mlp\.w_out": ("ep", None, None),
+        r".*\.mlp\.b_out": ("ep", None),
     }
 
     def __init__(self, config: GPTConfig):
@@ -127,7 +158,9 @@ class GPTLMHeadModel(nn.Module):
         self.wte = nn.Embedding(config.vocab_size, config.n_embd)
         self.wpe = nn.Embedding(config.n_positions, config.n_embd)
         self.drop = nn.Dropout(config.dropout)
-        self.h = nn.ModuleList([Block(config) for _ in range(config.n_layer)])
+        self.h = nn.ModuleList(
+            [Block(config, layer_idx=i) for i in range(config.n_layer)]
+        )
         self.ln_f = nn.LayerNorm(config.n_embd, eps=config.layer_norm_eps)
         # LM head weight-tied to wte by Parameter-object sharing (reference
         # find_tied_parameters semantics, utils/modeling.py:559); a real
@@ -160,6 +193,11 @@ class GPTLMHeadModel(nn.Module):
             shift_logits = logits[:, :-1, :].reshape(-1, self.config.vocab_size)
             shift_labels = lab[:, 1:].reshape(-1)
             loss = F.cross_entropy(shift_logits, shift_labels)
+            if self.config.n_experts > 0:
+                for block in self.h:
+                    aux = getattr(block.mlp, "last_aux_loss", None)
+                    if aux is not None:
+                        loss = loss + self.config.moe_aux_weight * aux
             return {"loss": loss, "logits": logits}
         return {"logits": logits}
 

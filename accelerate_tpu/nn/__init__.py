@@ -21,5 +21,6 @@ from .layers import (
     Tanh,
 )
 from .module import Buffer, Module, ModuleDict, ModuleList, Parameter, Sequential
+from .moe import MixtureOfExperts
 from .random import manual_seed
 from .tape import Tensor, backward, enable_grad, is_grad_enabled, no_grad, tape_op

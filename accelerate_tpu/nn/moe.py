@@ -1,0 +1,166 @@
+"""Mixture-of-Experts feed-forward with expert parallelism over ``ep``.
+
+The reference has no MoE layer at all — only a DeepSpeed leaf-module hint
+(reference accelerator.py:1881, SURVEY.md §2.2 row EP) — so this is new
+capability, built the TPU way (GShard/Switch formulation):
+
+* routing, dispatch and combine are DENSE one-hot einsums over static shapes
+  (tokens × experts × capacity) — no gathers, no dynamic shapes, everything
+  tiles onto the MXU and ``jit`` sees one fixed program;
+* the stacked expert weights carry a leading expert axis that the sharding
+  planner lays on the ``ep`` mesh axis (see ``tp_plan`` entries in models
+  using the layer); GSPMD then inserts the all_to_all pair around the expert
+  computation — the manual NCCL alltoall of GPU MoE stacks is compiled in;
+* tokens beyond an expert's capacity are dropped (their combine weight is
+  zero and the residual stream carries them unchanged) — Switch semantics;
+* the load-balancing auxiliary loss (Switch eq. 4: E · Σ_e f_e · P_e) is
+  stashed on the module as ``last_aux_loss`` after every forward; training
+  loops (e.g. models/gpt.py) add it into the objective with a small weight.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import init
+from .module import Module, Parameter
+from .tape import Tensor, tape_op
+
+
+def _switch_moe_forward(
+    x,  # (tokens, d_model)
+    router_w,  # (E, d_model)
+    router_b,  # (E,)
+    w_in,  # (E, d_ff, d_model)
+    b_in,  # (E, d_ff)
+    w_out,  # (E, d_model, d_ff)
+    b_out,  # (E, d_model)
+    *,
+    capacity: int,
+    top_k: int,
+):
+    """Dense Switch/top-k MoE over flattened tokens. Returns y."""
+    g, d = x.shape
+    E = router_w.shape[0]
+
+    logits = x @ router_w.T + router_b  # (g, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    combine = jnp.zeros((g, E, capacity), dtype=jnp.float32)
+    remaining = probs
+    # per-expert slot counters evolve as each top-k choice claims capacity
+    fill = jnp.zeros((E,), dtype=jnp.int32)
+    for _ in range(top_k):
+        choice = jnp.argmax(remaining, axis=-1)  # (g,)
+        gate = jnp.take_along_axis(remaining, choice[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.float32)  # (g, E)
+        # position of each token within its chosen expert's buffer
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (g, E)
+        pos = jnp.sum(pos_in_expert, axis=-1) + jnp.take(fill, choice)  # (g,)
+        keep = pos < capacity
+        slot = jax.nn.one_hot(
+            jnp.where(keep, pos, capacity), capacity + 1, dtype=jnp.float32
+        )[:, :capacity]  # (g, capacity); dropped tokens hit the phantom slot
+        combine = combine + (gate * keep)[:, None, None] * (
+            onehot[:, :, None] * slot[:, None, :]
+        )
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)  # next choice excludes this one
+
+    dispatch = (combine > 0.0).astype(x.dtype)  # (g, E, capacity)
+
+    # all_to_all pair happens here under GSPMD when w_in/w_out are ep-sharded
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch, x)  # (E, capacity, d)
+    h = jnp.einsum("ecd,efd->ecf", expert_in, w_in) + b_in[:, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    expert_out = jnp.einsum("ecf,edf->ecd", h, w_out) + b_out[:, None, :]
+    return jnp.einsum("gec,ecd->gd", combine.astype(x.dtype), expert_out)
+
+
+def _switch_aux_loss(x, router_w, router_b):
+    """Switch load-balancing loss (eq. 4): E · Σ_e f_e · P_e.
+
+    Recomputes the (cheap) router probs so it can live in its own tape op —
+    grads w.r.t. the router flow from both the gates (main path) and here.
+    """
+    E = router_w.shape[0]
+    logits = x.reshape(-1, x.shape[-1]) @ router_w.T + router_b
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), E, dtype=jnp.float32)
+    f = top1.mean(axis=0)
+    p = probs.mean(axis=0)
+    return E * jnp.sum(f * p)
+
+
+class MixtureOfExperts(Module):
+    """Drop-in MoE replacement for an MLP block (Switch top-1 / top-2).
+
+    Stacked expert weights ``w_in/w_out`` carry the leading expert axis —
+    shard it over ``ep`` via the owning model's ``tp_plan`` (e.g.
+    ``r".*moe\\.w_in": ("ep", None, None)``).
+    """
+
+    def __init__(
+        self,
+        d_model: int,
+        d_ff: int,
+        num_experts: int,
+        top_k: int = 1,
+        capacity_factor: float = 1.25,
+        dropout: float = 0.0,
+        dtype=jnp.float32,
+    ):
+        super().__init__()
+        if top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {top_k}")
+        from .layers import Dropout
+
+        self.dropout = Dropout(dropout)
+        self.d_model = d_model
+        self.d_ff = d_ff
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        bound_in = 1.0 / math.sqrt(d_model)
+        bound_out = 1.0 / math.sqrt(d_ff)
+        self.router = Parameter(init.uniform((num_experts, d_model), bound_in, dtype))
+        self.router_bias = Parameter(init.zeros((num_experts,), dtype))
+        self.w_in = Parameter(init.uniform((num_experts, d_ff, d_model), bound_in, dtype))
+        self.b_in = Parameter(init.zeros((num_experts, d_ff), dtype))
+        self.w_out = Parameter(init.uniform((num_experts, d_model, d_ff), bound_out, dtype))
+        self.b_out = Parameter(init.zeros((num_experts, d_model), dtype))
+        self.last_aux_loss: Optional[Tensor] = None
+
+    def capacity(self, tokens: int) -> int:
+        cap = int(math.ceil(tokens * self.top_k / self.num_experts * self.capacity_factor))
+        return max(cap, self.top_k)
+
+    def forward(self, x):
+        xv = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        cap = self.capacity(int(jnp.size(xv) // xv.shape[-1]))
+
+        def _moe(v, rw, rb, wi, bi, wo, bo):
+            flat = v.reshape(-1, v.shape[-1])
+            y = _switch_moe_forward(
+                flat, rw, rb, wi, bi, wo, bo, capacity=cap, top_k=self.top_k
+            )
+            return y.reshape(v.shape)
+
+        y = tape_op(
+            _moe, x, self.router, self.router_bias,
+            self.w_in, self.b_in, self.w_out, self.b_out,
+        )
+        self.last_aux_loss = tape_op(
+            _switch_aux_loss, x, self.router, self.router_bias
+        )
+        return self.dropout(y)
+
+    def __repr__(self):
+        return (
+            f"MixtureOfExperts(d_model={self.d_model}, d_ff={self.d_ff}, "
+            f"experts={self.num_experts}, top_k={self.top_k})"
+        )

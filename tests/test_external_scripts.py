@@ -45,3 +45,13 @@ def test_peak_memory_script():
 def test_performance_script():
     out = _run("accelerate_tpu.test_utils.scripts.external_deps.test_performance")
     assert "All performance-parity checks passed" in out
+
+
+def test_distributed_data_loop_script():
+    out = _run("accelerate_tpu.test_utils.scripts.test_distributed_data_loop")
+    assert "All distributed data-loop checks passed" in out
+
+
+def test_merge_weights_script():
+    out = _run("accelerate_tpu.test_utils.scripts.test_merge_weights")
+    assert "All merge-weights checks passed" in out
