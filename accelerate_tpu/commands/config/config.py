@@ -35,14 +35,14 @@ def _ask_field(
 
 
 def _ask_choice(prompt: str, choices: list[str], default: str) -> str:
-    labels = "/".join(c if c != default else c.upper() for c in choices)
-    while True:
-        raw = input(f"{prompt} [{labels}]: ").strip().lower()
-        if not raw:
-            return default
-        if raw in choices:
-            return raw
-        print(f"please answer one of: {', '.join(choices)}")
+    """Arrow-key bullet menu on a TTY (reference commands/menu); on piped
+    stdin fall back to the classic typed prompt so scripted config works."""
+    from ..menu import BulletMenu
+
+    # BulletMenu renders arrows on a TTY and falls back to a numbered
+    # prompt (accepting index, name, or empty-for-default) on piped stdin
+    idx = BulletMenu(prompt, choices).run(default=choices.index(default))
+    return choices[idx]
 
 
 def _yes_no(prompt: str, default: bool = False) -> bool:

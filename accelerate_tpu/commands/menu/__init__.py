@@ -1,0 +1,12 @@
+"""Arrow-key terminal menu for the config questionnaire.
+
+Counterpart of the reference's ``commands/menu`` package (426 LoC of
+cursor/keymap/input/selection modules): a single-file bullet menu driven by
+raw-mode keyboard input.  Degrades gracefully — when stdin is not a TTY (CI,
+pipes, ``accelerate-tpu config < answers.txt``) it falls back to the numbered
+``input()`` prompt, so scripted configuration keeps working.
+"""
+
+from .selection_menu import BulletMenu
+
+__all__ = ["BulletMenu"]

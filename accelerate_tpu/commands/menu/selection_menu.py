@@ -1,0 +1,133 @@
+"""Bullet-style selection menu on raw terminal input.
+
+Reference parity: commands/menu/selection_menu.py (BulletMenu with ↑/↓, j/k,
+digit shortcuts, Enter to confirm, Ctrl-C/Ctrl-D abort) — rebuilt as one
+module on termios/tty directly instead of the reference's four-module
+cursor/keymap stack.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+_UP = "\x1b[A"
+_DOWN = "\x1b[B"
+_HIDE_CURSOR = "\x1b[?25l"
+_SHOW_CURSOR = "\x1b[?25h"
+_CLEAR_LINE = "\x1b[2K"
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _raw_mode(fd: int):
+    """Hold the tty in raw mode for the WHOLE menu session.
+
+    One raw window, not one per key: switching back to canonical mode
+    between keys makes the line discipline reprocess (and discard) any
+    queued bytes — a pasted "↑↑⏎" would lose its tail.
+    """
+    import termios
+    import tty
+
+    old = termios.tcgetattr(fd)
+    try:
+        tty.setraw(fd)
+        yield
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+
+def _read_key(fd: Optional[int] = None) -> str:
+    """One keypress from a raw-mode fd (escape sequences folded to one key).
+
+    A bare Escape press is returned as "\\x1b" — the CSI suffix is read only
+    when bytes are already pending (select peek), so Esc never blocks waiting
+    for two keys that aren't coming.  os.read on the raw fd, not the buffered
+    TextIO: readahead would hide pending bytes from the peek.
+    """
+    import select
+
+    if fd is None:
+        fd = sys.stdin.fileno()
+    ch = os.read(fd, 1).decode(errors="replace")
+    if ch == "\x1b":
+        seq = b""
+        for _ in range(2):
+            ready, _w, _x = select.select([fd], [], [], 0.05)
+            if not ready:
+                break
+            seq += os.read(fd, 1)
+        return ch + seq.decode(errors="replace")
+    return ch
+
+
+class BulletMenu:
+    """Interactive single-choice menu; returns the selected index.
+
+    Keys: ↑/↓ or k/j move, 0-9 jump, Enter select, Ctrl-C/Ctrl-D raise
+    KeyboardInterrupt.  Non-TTY stdin → numbered input() fallback.
+    """
+
+    def __init__(self, prompt: str, choices: list[str]):
+        self.prompt = prompt
+        self.choices = list(choices)
+
+    # -- rendering -----------------------------------------------------------
+    def _render(self, pos: int, first: bool, out) -> None:
+        if not first:
+            out.write(f"\x1b[{len(self.choices)}A")  # cursor up N lines
+        for i, choice in enumerate(self.choices):
+            marker = "➤ " if i == pos else "  "
+            out.write(f"{_CLEAR_LINE}{marker}{choice}\r\n")
+        out.flush()
+
+    # -- fallback ------------------------------------------------------------
+    def _numbered_fallback(self, default: Optional[int]) -> int:
+        labels = " / ".join(f"{i}:{c}" for i, c in enumerate(self.choices))
+        suffix = f" [default {default}]" if default is not None else ""
+        while True:
+            raw = input(f"{self.prompt} ({labels}){suffix}: ").strip()
+            if not raw and default is not None:
+                return default
+            if raw.isdigit() and 0 <= int(raw) < len(self.choices):
+                return int(raw)
+            lowered = raw.lower()
+            for i, c in enumerate(self.choices):
+                if c.lower() == lowered:
+                    return i
+            print(f"Please answer 0-{len(self.choices) - 1} or a choice name.")
+
+    # -- main loop -----------------------------------------------------------
+    def run(self, default: Optional[int] = 0) -> int:
+        if not sys.stdin.isatty() or not sys.stdout.isatty():
+            return self._numbered_fallback(default)
+
+        out = sys.stdout
+        pos = default or 0
+        out.write(self.prompt + "\r\n")
+        out.write(_HIDE_CURSOR)
+        fd = sys.stdin.fileno()
+        try:
+            with _raw_mode(fd):
+                first = True
+                while True:
+                    self._render(pos, first, out)
+                    first = False
+                    key = _read_key(fd)
+                    if key in (_UP, "k"):
+                        pos = (pos - 1) % len(self.choices)
+                    elif key in (_DOWN, "j"):
+                        pos = (pos + 1) % len(self.choices)
+                    elif key.isdigit() and int(key) < len(self.choices):
+                        pos = int(key)
+                    elif key in ("\r", "\n"):
+                        return pos
+                    elif key in ("\x03", "\x04"):  # Ctrl-C / Ctrl-D
+                        raise KeyboardInterrupt
+        finally:
+            out.write(_SHOW_CURSOR)
+            out.flush()

@@ -79,7 +79,12 @@ def _gpt2_init(model: nn.Module, config: GPTConfig) -> None:
                 p.data = jnp.zeros_like(p.data)
             continue
         if p.ndim >= 2:
-            std = resid_scale if "c_proj" in name else scale
+            # MoE w_out plays the same residual-projection role as c_proj
+            std = (
+                resid_scale
+                if ("c_proj" in name or name.endswith("w_out"))
+                else scale
+            )
             p.data = std * jax.random.normal(
                 nn_random.next_key(), p.shape, dtype=p.dtype
             )

@@ -63,7 +63,9 @@ def _switch_moe_forward(
         pos = jnp.sum(pos_in_expert, axis=-1) + jnp.take(fill, choice)  # (g,)
         keep = pos < capacity
         slot = jax.nn.one_hot(
-            jnp.where(keep, pos, capacity), capacity + 1, dtype=jnp.float32
+            jnp.where(keep, pos, capacity).astype(jnp.int32),
+            capacity + 1,
+            dtype=jnp.float32,
         )[:, :capacity]  # (g, capacity); dropped tokens hit the phantom slot
         combine = combine + (gate * keep)[:, None, None] * (
             onehot[:, :, None] * slot[:, None, :]
@@ -141,14 +143,23 @@ class MixtureOfExperts(Module):
 
     def forward(self, x):
         xv = x.data if isinstance(x, Tensor) else jnp.asarray(x)
-        cap = self.capacity(int(jnp.size(xv) // xv.shape[-1]))
+        # GShard-style routing groups: route independently per leading-axis
+        # group (sequence row) so capacity — and with it the (tokens, E,
+        # capacity) dispatch tensors — stays CONSTANT per group instead of
+        # scaling with the global batch (O(tokens) total memory, not O(g²))
+        group_tokens = xv.shape[-2] if xv.ndim >= 3 else xv.shape[0]
+        cap = self.capacity(int(group_tokens))
 
         def _moe(v, rw, rb, wi, bi, wo, bo):
-            flat = v.reshape(-1, v.shape[-1])
-            y = _switch_moe_forward(
-                flat, rw, rb, wi, bi, wo, bo, capacity=cap, top_k=self.top_k
-            )
-            return y.reshape(v.shape)
+            def one_group(t):
+                return _switch_moe_forward(
+                    t, rw, rb, wi, bi, wo, bo, capacity=cap, top_k=self.top_k
+                )
+
+            if v.ndim == 2:
+                return one_group(v)
+            groups = v.reshape(-1, v.shape[-2], v.shape[-1])
+            return jax.vmap(one_group)(groups).reshape(v.shape)
 
         y = tape_op(
             _moe, x, self.router, self.router_bias,

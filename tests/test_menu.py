@@ -1,0 +1,116 @@
+"""BulletMenu: real keystroke handling through a pty + non-TTY fallback
+(reference commands/menu/selection_menu.py parity)."""
+
+import os
+import pty
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MENU_SCRIPT = """
+import sys
+from accelerate_tpu.commands.menu import BulletMenu
+idx = BulletMenu("Pick one:", ["alpha", "beta", "gamma"]).run(default=0)
+print(f"RESULT={idx}")
+"""
+
+
+def _run_in_pty(keys: bytes, timeout: float = 120.0) -> str:
+    """Run the menu under a pseudo-terminal, feed raw keys, return output.
+
+    Expect-style: keys are written only after the menu has rendered its
+    cursor marker — input sent while the child is still in canonical mode
+    does not survive the switch to raw mode.
+    """
+    import select
+    import time
+
+    leader, follower = pty.openpty()
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join(
+            p for p in (REPO, os.environ.get("PYTHONPATH", "")) if p
+        ),
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", MENU_SCRIPT],
+        stdin=follower,
+        stdout=follower,
+        stderr=subprocess.DEVNULL,
+        env=env,
+        close_fds=True,
+    )
+    os.close(follower)
+    buf = b""
+    deadline = time.monotonic() + timeout
+    try:
+        while "➤".encode() not in buf:
+            remaining = deadline - time.monotonic()
+            assert remaining > 0, f"menu never rendered: {buf[-300:]!r}"
+            ready, _, _ = select.select([leader], [], [], remaining)
+            assert ready, f"menu never rendered: {buf[-300:]!r}"
+            buf += os.read(leader, 4096)
+        time.sleep(0.3)  # let the renderer re-enter the raw-mode key read
+        os.write(leader, keys)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            ready, _, _ = select.select([leader], [], [], min(remaining, 1.0))
+            if not ready:
+                if proc.poll() is not None:
+                    break
+                continue
+            try:
+                data = os.read(leader, 4096)
+            except OSError:
+                break
+            if not data:
+                break
+            buf += data
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        os.close(leader)
+    return buf.decode(errors="replace")
+
+
+@pytest.mark.parametrize(
+    "keys,expected",
+    [
+        (b"\r", 0),  # Enter on the default
+        (b"\x1b[B\r", 1),  # arrow down once
+        (b"\x1b[B\x1b[B\r", 2),  # down twice
+        (b"j\x1b[A\r", 0),  # vim down then arrow up
+        (b"2\r", 2),  # digit jump
+    ],
+)
+def test_keystrokes_select(keys, expected):
+    out = _run_in_pty(keys)
+    assert f"RESULT={expected}" in out, out[-400:]
+
+
+def test_non_tty_fallback_accepts_number_and_name():
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join(
+            p for p in (REPO, os.environ.get("PYTHONPATH", "")) if p
+        ),
+        JAX_PLATFORMS="cpu",
+    )
+    for stdin_text, expected in [("1\n", 1), ("gamma\n", 2), ("\n", 0)]:
+        proc = subprocess.run(
+            [sys.executable, "-c", MENU_SCRIPT],
+            input=stdin_text,
+            capture_output=True,
+            text=True,
+            timeout=60,
+            env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-400:]
+        assert f"RESULT={expected}" in proc.stdout
