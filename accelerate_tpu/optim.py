@@ -218,6 +218,74 @@ class AdamW(Optimizer):
         super().__init__(params, tx, {"lr": lr, "betas": betas, "eps": eps, "weight_decay": weight_decay})
 
 
+class AdamWScheduleFree(Optimizer):
+    """Schedule-free AdamW (Defazio et al., 2024) via optax.contrib.
+
+    No LR schedule needed: the optimizer interpolates between the fast
+    iterate z and the Polyak-style average x, evaluating gradients at
+    y = (1-b1)·z + b1·x.  The params the model holds are the TRAINING
+    iterates; call :meth:`eval` before evaluation/checkpoint-for-serving to
+    swap in the averaged x weights and :meth:`train` to swap back (the same
+    contract as the reference example's schedulefree package,
+    reference examples/by_feature/schedule_free.py).
+    """
+
+    def __init__(self, params, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, warmup_steps: int = 0):
+        def make(learning_rate):
+            return optax.contrib.schedule_free_adamw(
+                learning_rate=learning_rate, b1=betas[0], b2=betas[1], eps=eps,
+                weight_decay=weight_decay, warmup_steps=warmup_steps,
+            )
+
+        tx = optax.inject_hyperparams(make)(learning_rate=lr)
+        super().__init__(
+            params, tx,
+            {"lr": lr, "betas": betas, "eps": eps, "weight_decay": weight_decay},
+        )
+        self._eval_mode = False
+        self._saved_train_params: Optional[list] = None
+
+    def _inner_state(self):
+        state = self.opt_state
+        return state.inner_state if hasattr(state, "inner_state") else state
+
+    def eval(self) -> None:
+        """Swap model params to the averaged x weights (inference mode)."""
+        if self._eval_mode:
+            return
+        # evaluate from the fp32 masters, not half-precision p.data: late in
+        # training |y - z| is small and x = (y - (1-b1)z)/b1 would be
+        # dominated by bf16 quantization noise
+        self._ensure_master()
+        y32 = [
+            m if m is not None else p.data.astype(jnp.float32)
+            for m, p in zip(self.master_params, self.param_list)
+        ]
+        eval_params = optax.contrib.schedule_free_eval_params(self._inner_state(), y32)
+        self._saved_train_params = [p.data for p in self.param_list]
+        for p, ev in zip(self.param_list, eval_params):
+            p.data = ev.astype(p.dtype)
+        self._eval_mode = True
+
+    def train(self) -> None:
+        """Swap the training iterates back after :meth:`eval`."""
+        if not self._eval_mode:
+            return
+        for p, saved in zip(self.param_list, self._saved_train_params):
+            p.data = saved
+        self._saved_train_params = None
+        self._eval_mode = False
+
+    def step(self, closure=None, grad_scale=None) -> None:
+        if self._eval_mode:
+            raise RuntimeError(
+                "optimizer.step() called in eval mode — call .train() first "
+                "(schedule-free gradients must be taken at the y iterates)"
+            )
+        super().step(closure=closure, grad_scale=grad_scale)
+
+
 class Adafactor(Optimizer):
     """Memory-frugal choice for large models on TPU (factored second moment)."""
 

@@ -1,0 +1,105 @@
+"""Feature: k-fold cross validation with metric gathering.
+
+Counterpart of /root/reference/examples/by_feature/cross_validation.py: the
+dataset is split into k folds, one model trains per fold on the other k-1,
+and per-fold predictions are gathered (deduped through gather_for_metrics)
+into one out-of-fold accuracy.  Lines marked `# New Code #` are what this
+feature adds to nlp_example.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+import accelerate_tpu.nn as nn  # noqa: E402
+import accelerate_tpu.optim as optim  # noqa: E402
+from accelerate_tpu import Accelerator  # noqa: E402
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification  # noqa: E402
+
+
+def training_function(args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    nn.manual_seed(args.seed)
+
+    # New Code #
+    # one dataloader pair per fold: get_dataloaders(fold=i) rotates which
+    # slice of the training set is held out for validation
+    fold_predictions = []
+    fold_labels = []
+    for fold in range(args.num_folds):
+        train_dl, val_dl, vocab = get_dataloaders(
+            accelerator, args.batch_size, args.seed, fold=fold, num_folds=args.num_folds
+        )
+        cfg = BertConfig.small() if args.small else BertConfig.base()
+        cfg.vocab_size = max(cfg.vocab_size, vocab)
+        model = BertForSequenceClassification(cfg)
+        optimizer = optim.AdamW(model.parameters(), lr=args.lr)
+        scheduler = optim.get_linear_schedule_with_warmup(
+            optimizer, 10, len(train_dl) * args.num_epochs * accelerator.num_devices
+        )
+        model, optimizer, train_dl, val_dl, scheduler = accelerator.prepare(
+            model, optimizer, train_dl, val_dl, scheduler
+        )
+
+        for epoch in range(args.num_epochs):
+            model.train()
+            for batch in train_dl:
+                optimizer.zero_grad()
+                out = model(
+                    batch["input_ids"],
+                    attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"],
+                    labels=batch["labels"],
+                )
+                accelerator.backward(out["loss"])
+                optimizer.step()
+                scheduler.step()
+
+        # New Code #
+        # out-of-fold predictions, deduped across shards
+        model.eval()
+        for batch in val_dl:
+            with nn.no_grad():
+                out = model(
+                    batch["input_ids"],
+                    attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"],
+                )
+            logits, refs = accelerator.gather_for_metrics(
+                (out["logits"].data, batch["labels"])
+            )
+            fold_predictions.append(np.asarray(logits))
+            fold_labels.append(np.asarray(refs))
+        accelerator.free_memory()
+
+    # New Code #
+    # ensemble metric over every held-out sample of every fold
+    preds = np.concatenate(fold_predictions).argmax(-1)
+    refs = np.concatenate(fold_labels)
+    accuracy = float((preds == refs).mean())
+    accelerator.print(f"out-of-fold accuracy over {args.num_folds} folds: {accuracy:.3f}")
+    return accuracy
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--num_folds", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=2e-4)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--small", action="store_true")
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""Feature: ZeRO/FSDP parameter sharding with per-device memory tracking.
+
+Counterpart of /root/reference/examples/by_feature/fsdp_with_peak_mem_tracking.py:
+the reference wraps the model in torch FSDP and reads
+``torch.cuda.max_memory_allocated``; here sharding is a mesh layout
+(``ParallelismConfig(fsdp_size=N)``) and the tracked quantity is what TPU
+memory actually obeys — per-device bytes of the sharded params, optimizer
+state, and (on TPU) live HBM from ``device.memory_stats()``.  Lines marked
+`# New Code #` are what this feature adds to nlp_example.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+import accelerate_tpu.nn as nn  # noqa: E402
+import accelerate_tpu.optim as optim  # noqa: E402
+from accelerate_tpu import Accelerator  # noqa: E402
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification  # noqa: E402
+from accelerate_tpu.utils.dataclasses import ParallelismConfig  # noqa: E402
+
+
+# New Code #
+def per_device_bytes(model, optimizer) -> dict:
+    """Bytes device 0 actually holds: sharded params + optimizer state."""
+    import jax
+
+    def shard_bytes(arr):
+        shard = arr.addressable_shards[0]
+        return int(np.prod(shard.data.shape)) * arr.dtype.itemsize
+
+    params = sum(shard_bytes(p.data) for _, p in model.named_parameters())
+    opt_bytes = 0
+    seen = set()
+
+    def leaf(x):
+        nonlocal opt_bytes
+        if isinstance(x, jax.Array) and x.ndim > 0 and id(x) not in seen:
+            seen.add(id(x))
+            opt_bytes += shard_bytes(x)
+
+    jax.tree_util.tree_map(leaf, optimizer.optimizer.capture_state())
+    hbm = None
+    try:  # real TPU: live HBM from the runtime
+        stats = jax.local_devices()[0].memory_stats()
+        hbm = stats.get("bytes_in_use")
+    except Exception:
+        pass
+    return {"param_bytes": params, "opt_state_bytes": opt_bytes, "hbm_in_use": hbm}
+
+
+def training_function(args):
+    # New Code #
+    # fsdp_size lays parameters (and optimizer state) across the mesh's
+    # fsdp axis — ZeRO semantics as a sharding, not a wrapper module
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        parallelism_config=ParallelismConfig(fsdp_size=args.fsdp_size),
+    )
+    nn.manual_seed(args.seed)
+    train_dl, val_dl, vocab = get_dataloaders(accelerator, args.batch_size, args.seed)
+
+    cfg = BertConfig.small() if args.small else BertConfig.base()
+    cfg.vocab_size = max(cfg.vocab_size, vocab)
+    model = BertForSequenceClassification(cfg)
+    optimizer = optim.AdamW(model.parameters(), lr=args.lr)
+    scheduler = optim.get_linear_schedule_with_warmup(
+        optimizer, 100, len(train_dl) * args.num_epochs * accelerator.num_devices
+    )
+    model, optimizer, train_dl, val_dl, scheduler = accelerator.prepare(
+        model, optimizer, train_dl, val_dl, scheduler
+    )
+
+    for epoch in range(args.num_epochs):
+        model.train()
+        for batch in train_dl:
+            optimizer.zero_grad()
+            out = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                labels=batch["labels"],
+            )
+            accelerator.backward(out["loss"])
+            optimizer.step()
+            scheduler.step()
+        # New Code #
+        mem = per_device_bytes(model, optimizer)
+        accelerator.print(
+            f"epoch {epoch}: loss={float(out['loss'].item()):.4f} "
+            f"param_bytes/device={mem['param_bytes']:,} "
+            f"opt_state_bytes/device={mem['opt_state_bytes']:,}"
+            + (f" hbm_in_use={mem['hbm_in_use']:,}" if mem["hbm_in_use"] else "")
+        )
+    return model
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--fsdp_size", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=2e-5)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--small", action="store_true")
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Feature: token-weighted gradient accumulation for causal-LM training.
+
+Counterpart of
+/root/reference/examples/by_feature/gradient_accumulation_for_autoregressive_models.py:
+plain per-micro-batch loss averaging is WRONG for autoregressive models when
+micro-batches hold different numbers of real (non-padding) tokens — the
+correct objective divides by the total token count of the whole accumulation
+window.  Here each micro-loss is rescaled by its token share before
+``accelerator.backward``.  Lines marked `# New Code #` show the adjustment.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator
+from accelerate_tpu.data_loader import prepare_data_loader
+from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+
+def get_lm_dataloader(batch_size: int, seq_len: int, seed: int = 0):
+    """Synthetic causal-LM batches with ragged real lengths (padding=-100)."""
+    rng = np.random.default_rng(seed)
+    n = int(np.int64(batch_size) * 16)
+    data = []
+    for _ in range(n):
+        length = int(rng.integers(seq_len // 4, seq_len + 1))
+        ids = rng.integers(1, 512, size=seq_len).astype(np.int32)
+        labels = ids.astype(np.int64).copy()
+        ids[length:] = 0
+        labels[length:] = -100  # ignore_index: padding emits no loss
+        data.append({"input_ids": ids, "labels": labels})
+    return prepare_data_loader(dataset=data, batch_size=batch_size, shuffle=True, data_seed=seed)
+
+
+def training_function(args):
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+    )
+    nn.manual_seed(args.seed)
+    dl = get_lm_dataloader(args.batch_size, args.seq_len, args.seed)
+
+    cfg = GPTConfig(
+        vocab_size=512, n_positions=args.seq_len, n_embd=128, n_layer=2, n_head=4
+    )
+    model = GPTLMHeadModel(cfg)
+    optimizer = optim.AdamW(model.parameters(), lr=args.lr)
+    model, optimizer, dl = accelerator.prepare(model, optimizer, dl)
+
+    G = args.gradient_accumulation_steps
+    for epoch in range(args.num_epochs):
+        model.train()
+        # New Code #
+        # token counts vary per micro-batch: the correct objective averages
+        # over the accumulation WINDOW's real tokens, not its micro-batches.
+        # Buffer each window first so its true token total is known, then
+        # rescale every micro-loss (a mean over its own tokens) by
+        # n_i · G / window_total before backward — the G micro-gradients then
+        # sum to the token-weighted window gradient.
+        batches = list(dl)
+        for start in range(0, len(batches), G):
+            window = batches[start : start + G]
+            window_tokens = sum(
+                int((np.asarray(b["labels"]) != -100).sum()) for b in window
+            )
+            for batch in window:
+                n_tokens = int((np.asarray(batch["labels"]) != -100).sum())
+                with accelerator.accumulate(model):
+                    out = model(batch["input_ids"], labels=batch["labels"])
+                    # New Code #
+                    scale = n_tokens * len(window) / window_tokens
+                    accelerator.backward(out["loss"] * scale)
+                    optimizer.step()
+                    optimizer.zero_grad()
+        accelerator.print(f"epoch {epoch}: loss={float(out['loss'].item()):.4f}")
+    return model
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--small", action="store_true")
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

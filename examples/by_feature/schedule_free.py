@@ -1,0 +1,93 @@
+"""Feature: schedule-free training with ``optim.AdamWScheduleFree``.
+
+Counterpart of /root/reference/examples/by_feature/schedule_free.py (which
+uses the schedulefree package): no LR scheduler at all — the optimizer
+maintains fast/averaged iterates internally.  The one training-loop contract
+is switching the optimizer (and with it the model weights) between
+``.train()`` and ``.eval()`` around evaluation.  Lines marked `# New Code #`
+are what this feature adds to nlp_example.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+import accelerate_tpu.nn as nn  # noqa: E402
+import accelerate_tpu.optim as optim  # noqa: E402
+from accelerate_tpu import Accelerator  # noqa: E402
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification  # noqa: E402
+
+
+def training_function(args):
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    nn.manual_seed(args.seed)
+    train_dl, val_dl, vocab = get_dataloaders(accelerator, args.batch_size, args.seed)
+
+    cfg = BertConfig.small() if args.small else BertConfig.base()
+    cfg.vocab_size = max(cfg.vocab_size, vocab)
+    model = BertForSequenceClassification(cfg)
+    # New Code #
+    # schedule-free: no scheduler object anywhere; warmup happens inside
+    optimizer = optim.AdamWScheduleFree(
+        model.parameters(), lr=args.lr, warmup_steps=args.warmup_steps
+    )
+    model, optimizer, train_dl, val_dl = accelerator.prepare(
+        model, optimizer, train_dl, val_dl
+    )
+
+    for epoch in range(args.num_epochs):
+        model.train()
+        # New Code #
+        optimizer.train()  # gradients must be taken at the fast y iterates
+        for batch in train_dl:
+            optimizer.zero_grad()
+            out = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                labels=batch["labels"],
+            )
+            accelerator.backward(out["loss"])
+            optimizer.step()
+
+        model.eval()
+        # New Code #
+        optimizer.eval()  # swap in the averaged x weights for evaluation
+        correct = total = 0
+        for batch in val_dl:
+            with nn.no_grad():
+                out = model(
+                    batch["input_ids"],
+                    attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"],
+                )
+            preds = out["logits"].data.argmax(-1)
+            preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int((np.asarray(preds) == np.asarray(refs)).sum())
+            total += int(np.asarray(refs).size)
+        accelerator.print(f"epoch {epoch}: accuracy={correct / max(total, 1):.3f}")
+    return model
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--warmup_steps", type=int, default=10)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--small", action="store_true")
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -29,8 +29,19 @@ from accelerate_tpu.nn import Tensor
 MAX_LEN = 128
 
 
-def get_dataloaders(accelerator: Accelerator, batch_size: int, seed: int = 0):
-    """Real MRPC if cached locally; synthetic otherwise (same shapes)."""
+def get_dataloaders(
+    accelerator: Accelerator,
+    batch_size: int,
+    seed: int = 0,
+    fold: int = 0,
+    num_folds: int = 0,
+):
+    """Real MRPC if cached locally; synthetic otherwise (same shapes).
+
+    ``num_folds > 0`` switches to k-fold mode (by_feature/cross_validation):
+    the training set is split into ``num_folds`` slices, slice ``fold``
+    becomes the validation set, the rest train.
+    """
     try:
         from datasets import load_dataset
         from transformers import AutoTokenizer
@@ -82,6 +93,12 @@ def get_dataloaders(accelerator: Accelerator, batch_size: int, seed: int = 0):
         n_train = int(_os.environ.get("EXAMPLES_N_TRAIN", 1024))
         n_val = int(_os.environ.get("EXAMPLES_N_VAL", 256))
         train_data, val_data = make(n_train), make(n_val)
+
+    if num_folds > 0:
+        # k-fold mode: deterministic round-robin split of the training set
+        all_data = train_data
+        train_data = [r for i, r in enumerate(all_data) if i % num_folds != fold]
+        val_data = [r for i, r in enumerate(all_data) if i % num_folds == fold]
 
     train_dl = prepare_data_loader(
         dataset=train_data, batch_size=batch_size, shuffle=True, data_seed=seed

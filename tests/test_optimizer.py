@@ -145,3 +145,37 @@ def test_accelerated_scheduler_skips_when_accumulating():
 def test_optimizer_empty_params_raises():
     with pytest.raises(ValueError):
         optim.SGD([], lr=0.1)
+
+
+def test_schedule_free_adamw_converges_and_swaps_weights():
+    """AdamWScheduleFree: converges without a scheduler; .eval() swaps in the
+    averaged x weights, .train() restores the fast iterates, and stepping in
+    eval mode is refused (reference by_feature/schedule_free.py contract)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu.nn import Tensor
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+    nn.manual_seed(0)
+    model = RegressionModel()
+    opt = optim.AdamWScheduleFree(model.parameters(), lr=0.2, warmup_steps=2)
+    data = RegressionDataset(length=64, seed=3)
+    for _ in range(200):
+        pred = model(Tensor(data.x))
+        loss = nn.F.mse_loss(pred, Tensor(data.y))
+        nn.backward(loss, jnp.ones(()))
+        opt.step()
+        opt.zero_grad()
+
+    train_a = float(np.asarray(model.a.data))
+    opt.eval()
+    eval_a, eval_b = float(np.asarray(model.a.data)), float(np.asarray(model.b.data))
+    assert abs(eval_a - 2.0) < 0.5 and abs(eval_b - 3.0) < 0.5, (eval_a, eval_b)
+    with pytest.raises(RuntimeError):
+        opt.step()
+    opt.train()
+    assert float(np.asarray(model.a.data)) == train_a
