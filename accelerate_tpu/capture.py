@@ -150,6 +150,36 @@ class CapturedStep:
         _, args_treedef = jax.tree_util.tree_flatten(args_template)
         captured_ctx = CaptureContext()
 
+        # Pin the carried state's layout to the layout it arrives with.
+        # jax.jit caches on input *shardings* as well as shapes: left alone,
+        # GSPMD picks arbitrary output layouts for the first step's new state
+        # (e.g. a transposed spec for a weight grad), those feed back in as
+        # call 2's inputs, and the whole program re-traces and re-compiles —
+        # a second multi-minute XLA compile for byte-identical computation.
+        # Constraining every output leaf to its input sharding makes the state
+        # layout a fixed point from the first call.
+        _NOPIN = object()
+
+        def _leaf_sharding(x):
+            s = getattr(x, "sharding", None)
+            return s if isinstance(s, jax.sharding.NamedSharding) else _NOPIN
+
+        ref_shardings = {
+            k: jax.tree_util.tree_map(_leaf_sharding, state_template[k])
+            for k in ("params", "buffers", "grads", "opt", "scaler")
+            if state_template.get(k) is not None
+        }
+
+        def _pin_layout(new_state):
+            pinned = dict(new_state)
+            for k, shardings in ref_shardings.items():
+                pinned[k] = jax.tree_util.tree_map(
+                    lambda x, s: x if s is _NOPIN else jax.lax.with_sharding_constraint(x, s),
+                    new_state[k],
+                    shardings,
+                )
+            return pinned
+
         def traced(state, *flat_args):
             call_args = jax.tree_util.tree_unflatten(args_treedef, flat_args)
             prev_rng_state = nn_random.default_rng.get_state()
@@ -165,7 +195,7 @@ class CapturedStep:
                 nn_random.default_rng.set_key(state["rng"])
                 out = self.fn(*call_args)
                 out = _unwrap_tree(out)
-                new_state = self._snapshot_state()
+                new_state = _pin_layout(self._snapshot_state())
                 return new_state, out
             finally:
                 _capture_state.active = prev_capture

@@ -470,7 +470,9 @@ def batch_to_global_array(batch, mesh=None, sharding=None):
     if sharding is None:
         if mesh is None:
             mesh = AcceleratorState().mesh
-        sharding = NamedSharding(mesh, P(data_axes(mesh)))
+        from .parallel.sharding import canonical_spec
+
+        sharding = NamedSharding(mesh, canonical_spec(P(data_axes(mesh)), mesh))
 
     multi_host = jax.process_count() > 1
 

@@ -120,6 +120,18 @@ class Optimizer:
             if m is not None:
                 self.master_params[i] = jax.device_put(m, shardings[i])
 
+        # scalar leaves (step counters, hyperparams) must be *committed* too:
+        # jax.jit caches on argument placement, and an uncommitted host scalar
+        # on step 1 vs the same scalar committed by step 1's donated output
+        # re-traces the entire train step on step 2
+        replicated = None
+        for s in shardings:
+            if isinstance(s, jax.sharding.NamedSharding):
+                replicated = jax.sharding.NamedSharding(
+                    s.mesh, jax.sharding.PartitionSpec()
+                )
+                break
+
         leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(self.opt_state)
         new_leaves = []
         for path, leaf in leaves_with_path:
@@ -135,6 +147,12 @@ class Optimizer:
                 and tuple(leaf.shape) == shapes[idx]
             ):
                 leaf = jax.device_put(leaf, shardings[idx])
+            elif (
+                replicated is not None
+                and isinstance(leaf, jax.Array)
+                and leaf.ndim == 0
+            ):
+                leaf = jax.device_put(leaf, replicated)
             new_leaves.append(leaf)
         self.opt_state = jax.tree_util.tree_unflatten(
             treedef, new_leaves

@@ -27,6 +27,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..utils.dataclasses import FullyShardedDataParallelPlugin, TensorParallelPlugin
 
 
+def canonical_spec(spec: P, mesh: Mesh) -> P:
+    """Drop size-1 mesh axes and trailing Nones from a PartitionSpec.
+
+    ``P('tp')`` over a tp:1 mesh is semantically ``P()`` but compares unequal,
+    and ``jax.jit`` caches on input shardings: GSPMD canonicalizes program
+    *outputs* to the axis-free form, so a non-canonical spec on a parameter
+    makes the next step's carried state arrive with a "new" sharding and
+    silently recompiles the whole train step.
+    """
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if mesh.shape.get(a, 1) > 1)
+            out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        else:
+            out.append(entry if mesh.shape.get(entry, 1) > 1 else None)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
 def plan_param_spec(
     name: str,
     shape: tuple,
@@ -59,7 +82,7 @@ def plan_param_spec(
             if spec[axis] is None and shape[axis] % fsdp_size == 0 and shape[axis] >= fsdp_size:
                 spec[axis] = "fsdp"
                 break
-    return P(*spec)
+    return canonical_spec(P(*spec), mesh)
 
 
 def shard_module_params(
@@ -112,7 +135,7 @@ def activation_spec(ndim: int, mesh: Mesh) -> P:
     from .mesh import data_axes
 
     batch_axes = data_axes(mesh)
-    return P(batch_axes, *([None] * (ndim - 1)))
+    return canonical_spec(P(batch_axes, *([None] * (ndim - 1))), mesh)
 
 
 def constrain_activation(x, mesh: Optional[Mesh] = None):

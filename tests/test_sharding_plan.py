@@ -44,7 +44,7 @@ def test_plan_skips_fsdp_for_exempt_params():
         "wte.weight", (1024, 128), mesh, plugin,
         tp_plan={r"wte\.weight": ("tp", None)}, fsdp_exempt=True,
     )
-    assert spec == P("tp", None), f"embedding table must not be fsdp-sharded, got {spec}"
+    assert spec == P("tp"), f"embedding table must not be fsdp-sharded, got {spec}"
     # non-exempt params still get ZeRO sharding
     spec2 = plan_param_spec("h.0.mlp.c_fc.weight", (512, 128), mesh, plugin)
     assert "fsdp" in [a for a in spec2 if a is not None]
@@ -64,8 +64,8 @@ def test_gpt_plan_has_no_fsdp_on_embeddings():
 
 def test_activation_spec_matches_loader_layout():
     mesh = _mesh()
-    assert activation_spec(3, mesh) == P(("dp", "fsdp"), None, None)
-    assert activation_spec(2, mesh) == P(("dp", "fsdp"), None)
+    assert activation_spec(3, mesh) == P(("dp", "fsdp"))
+    assert activation_spec(2, mesh) == P(("dp", "fsdp"))
 
 
 def test_constrain_activation_applies_batch_sharding():
