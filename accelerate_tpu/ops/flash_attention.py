@@ -41,13 +41,47 @@ except Exception:  # pragma: no cover
 
 from .attention import sdpa_reference
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+import os
+
+DEFAULT_BLOCK_Q = int(os.environ.get("ACCELERATE_TPU_FLASH_BLOCK_Q", 512))
+DEFAULT_BLOCK_K = int(os.environ.get("ACCELERATE_TPU_FLASH_BLOCK_K", 1024))
+# the backward kernels keep (block_q, block_k) f32 score/ds tiles live at
+# once, so they get their own tiling knobs
+DEFAULT_BWD_BLOCK_Q = int(os.environ.get("ACCELERATE_TPU_FLASH_BWD_BLOCK_Q", 512))
+DEFAULT_BWD_BLOCK_K = int(os.environ.get("ACCELERATE_TPU_FLASH_BWD_BLOCK_K", 512))
 _LANES = 128  # TPU lane count: last-dim tile width for every dtype
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 # interpret-mode escape hatch so the kernels are testable on CPU CI
 _INTERPRET = False
+
+
+def _compiler_params():
+    """Mark (bh, outer-block) grid dims parallel, the streamed dim arbitrary.
+
+    Without this Mosaic treats every grid dimension as sequential: no
+    cross-iteration DMA pipelining and no core-level parallelism — measured
+    ~5× slower than XLA's fused attention at seq 1024 on v5e.
+    """
+    if not _HAS_PLTPU or _INTERPRET:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        vmem_limit_bytes=100 * 1024 * 1024,
+    )
+
+
+
+def _fit_block(block: int, seq: int) -> int:
+    """Largest block ≤ ``block`` that divides ``seq`` (halving steps).
+
+    The dispatcher admits any seq divisible by 128; the tuned defaults are
+    512/1024, so e.g. seq 640 must step down (512 → 256 → 128) rather than
+    raise."""
+    block = min(block, seq)
+    while block > 1 and seq % block:
+        block //= 2
+    return block
 
 
 def _causal_mask(s, qi, ki, block_q, block_k, q_off=0, k_off=0):
@@ -167,8 +201,8 @@ def _flash_forward(
     q3 = q.reshape(bh, sq, d)
     k3 = k.reshape(bh, sk, d)
     v3 = v.reshape(bh, sk, d)
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
     if sq % block_q or sk % block_k:
         raise ValueError(
             f"flash attention needs seq divisible by the block size: got "
@@ -225,6 +259,7 @@ def _flash_forward(
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=_INTERPRET,
+        compiler_params=_compiler_params(),
     )(_offsets_arr(q_offset, k_offset), q3, k3, v3)
     if return_lse:
         out, lse = outs
@@ -278,12 +313,11 @@ def _flash_bwd_dkv_kernel(
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0, :, :block_k] if block_k <= _LANES else jnp.tile(
-            lse_ref[0, :, 0:1], (1, block_k)
-        )
-        delta = delta_ref[0, :, :block_k] if block_k <= _LANES else jnp.tile(
-            delta_ref[0, :, 0:1], (1, block_k)
-        )
+        # (block_q, 1) slices broadcast against the (block_q, block_k) score
+        # tile inside the VPU — no materialized lane tile, so block_k is free
+        # to exceed the 128-lane width
+        lse = lse_ref[0, :, 0:1]
+        delta = delta_ref[0, :, 0:1]
         s = jax.lax.dot_general(
             q,
             k,
@@ -362,12 +396,11 @@ def _flash_bwd_dq_kernel(
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0, :, :block_k] if block_k <= _LANES else jnp.tile(
-            lse_ref[0, :, 0:1], (1, block_k)
-        )
-        delta = delta_ref[0, :, :block_k] if block_k <= _LANES else jnp.tile(
-            delta_ref[0, :, 0:1], (1, block_k)
-        )
+        # (block_q, 1) slices broadcast against the (block_q, block_k) score
+        # tile inside the VPU — no materialized lane tile, so block_k is free
+        # to exceed the 128-lane width
+        lse = lse_ref[0, :, 0:1]
+        delta = delta_ref[0, :, 0:1]
         s = jax.lax.dot_general(
             q,
             k,
@@ -407,8 +440,8 @@ def _flash_backward(
     g: jax.Array,
     scale: float,
     is_causal: bool,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: int = DEFAULT_BWD_BLOCK_Q,
+    block_k: int = DEFAULT_BWD_BLOCK_K,
     q_offset=0,
     k_offset=0,
     delta_adjust=None,
@@ -416,8 +449,8 @@ def _flash_backward(
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bh = b * h
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
     if sq % block_q or sk % block_k:
         raise ValueError(
             f"flash attention backward needs seq divisible by the block size: "
@@ -480,6 +513,7 @@ def _flash_backward(
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_INTERPRET,
+        compiler_params=_compiler_params(),
     )(offs, q3, k3, v3, do3, lse3, delta3)
 
     dq_kernel = functools.partial(
@@ -508,6 +542,7 @@ def _flash_backward(
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_INTERPRET,
+        compiler_params=_compiler_params(),
     )(offs, q3, k3, v3, do3, lse3, delta3)
 
     return (

@@ -36,15 +36,23 @@ def canonical_spec(spec: P, mesh: Mesh) -> P:
     makes the next step's carried state arrive with a "new" sharding and
     silently recompiles the whole train step.
     """
+    def _size(a):
+        if a not in mesh.shape:
+            raise ValueError(
+                f"PartitionSpec axis {a!r} does not exist in mesh axes "
+                f"{tuple(mesh.shape)} — typo in a tp_plan / sharding spec?"
+            )
+        return mesh.shape[a]
+
     out = []
     for entry in spec:
         if entry is None:
             out.append(None)
         elif isinstance(entry, (tuple, list)):
-            kept = tuple(a for a in entry if mesh.shape.get(a, 1) > 1)
+            kept = tuple(a for a in entry if _size(a) > 1)
             out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
         else:
-            out.append(entry if mesh.shape.get(entry, 1) > 1 else None)
+            out.append(entry if _size(entry) > 1 else None)
     while out and out[-1] is None:
         out.pop()
     return P(*out)
