@@ -12,6 +12,8 @@ window.  Here each micro-loss is rescaled by its token share before
 from __future__ import annotations
 
 import argparse
+import contextlib
+import itertools
 
 import numpy as np
 
@@ -58,22 +60,30 @@ def training_function(args):
         # New Code #
         # token counts vary per micro-batch: the correct objective averages
         # over the accumulation WINDOW's real tokens, not its micro-batches.
-        # Buffer each window first so its true token total is known, then
-        # rescale every micro-loss (a mean over its own tokens) by
-        # n_i · G / window_total before backward — the G micro-gradients then
-        # sum to the token-weighted window gradient.
-        batches = list(dl)
-        for start in range(0, len(batches), G):
-            window = batches[start : start + G]
+        # Buffer one window at a time (live iterator — a trailing short
+        # window of L < G batches still flushes) so its true token total is
+        # known, then rescale every micro-loss (a mean over its own tokens)
+        # by n_i · G / window_total: backward divides by G, so the window's
+        # micro-gradients sum to the token-weighted gradient for ANY L.
+        it = iter(dl)
+        while True:
+            window = list(itertools.islice(it, G))
+            if not window:
+                break
             window_tokens = sum(
                 int((np.asarray(b["labels"]) != -100).sum()) for b in window
             )
-            for batch in window:
+            for j, batch in enumerate(window):
                 n_tokens = int((np.asarray(batch["labels"]) != -100).sum())
-                with accelerator.accumulate(model):
+                # New Code #
+                # no_sync on every micro-batch but the window's last:
+                # optimizer.step()/zero_grad() no-op while accumulating, and
+                # the explicit window bound means a ragged tail still steps
+                sync = j == len(window) - 1
+                ctx = contextlib.nullcontext() if sync else accelerator.no_sync(model)
+                with ctx:
                     out = model(batch["input_ids"], labels=batch["labels"])
-                    # New Code #
-                    scale = n_tokens * len(window) / window_tokens
+                    scale = n_tokens * G / window_tokens
                     accelerator.backward(out["loss"] * scale)
                     optimizer.step()
                     optimizer.zero_grad()
