@@ -9,17 +9,17 @@ fully-masked k-blocks.
 
 The backward is the FlashAttention-2 recompute scheme, also in Pallas: the
 forward additionally emits the per-row logsumexp (LSE); the backward
-recomputes each (q-block, k-block) probability tile from q/k/LSE inside the
-kernel and contracts it against dO — so no O(S²) tensor ever reaches HBM in
-either direction.  Two kernels: dkv (grid over k-blocks, streaming q-blocks)
-and dq (grid over q-blocks, streaming k-blocks), plus a cheap XLA-fused
-``delta = rowsum(dO·O)`` precomputation.
+recomputes each (q-block, k-block) probability tile from q/k/LSE inside ONE
+fused kernel and contracts it against dO for dq, dk AND dv — so no O(S²)
+tensor ever reaches HBM in either direction and the QKᵀ recompute + DMA
+streams are paid once, not twice.  A cheap XLA-fused
+``delta = rowsum(dO·O)`` precomputation feeds it.
 
 The reference framework has no attention kernels at all (SURVEY.md §2.7 —
 fused kernels came from vendored TE/Megatron binaries); this is the TPU-native
-equivalent written directly against Mosaic.  Following the layout rules of
-the official TPU flash kernels, LSE/delta are stored lane-broadcast as
-(bh, seq, 128) so the backward never needs a lane→sublane transpose.
+equivalent written directly against Mosaic.  LSE/delta are stored as
+single-lane (bh, seq, 1) arrays — kernels read (block_q, 1) tiles and let
+the VPU broadcast them against score tiles.
 """
 
 from __future__ import annotations
@@ -56,17 +56,19 @@ _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 _INTERPRET = False
 
 
-def _compiler_params():
-    """Mark (bh, outer-block) grid dims parallel, the streamed dim arbitrary.
+def _compiler_params(semantics=("parallel", "parallel", "arbitrary")):
+    """Grid dimension semantics + VMEM budget for the kernels.
 
-    Without this Mosaic treats every grid dimension as sequential: no
-    cross-iteration DMA pipelining and no core-level parallelism — measured
-    ~5× slower than XLA's fused attention at seq 1024 on v5e.
+    Without explicit semantics Mosaic treats every grid dimension as
+    sequential: no cross-iteration DMA pipelining and no core-level
+    parallelism — measured ~5× slower than XLA's fused attention at seq 1024
+    on v5e.  Dimensions that carry accumulator state across iterations
+    (scratch or revisited output blocks) MUST be "arbitrary".
     """
     if not _HAS_PLTPU or _INTERPRET:
         return None
     return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        dimension_semantics=semantics,
         vmem_limit_bytes=100 * 1024 * 1024,
     )
 
@@ -101,7 +103,7 @@ def _flash_kernel(
     k_ref,  # (1, block_k, d)
     v_ref,  # (1, block_k, d)
     o_ref,  # (1, block_q, d)
-    lse_ref,  # (1, block_q, 128) f32 or None
+    lse_ref,  # (1, block_q, 1) f32 or None
     m_scratch,  # (block_q, 128) f32
     l_scratch,  # (block_q, 128) f32
     acc_scratch,  # (block_q, d) f32
@@ -166,10 +168,10 @@ def _flash_kernel(
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
         if lse_ref is not None:
-            lse = m_scratch[:, 0:1] + jnp.log(l_safe)  # (block_q, 1)
-            lse_ref[0] = jax.lax.broadcast_in_dim(
-                lse, lse_ref.shape[1:], (0, 1)
-            )
+            # single-lane store: the backward reads (block_q, 1) and lets the
+            # VPU broadcast against score tiles, so the O(S·128) lane
+            # broadcast (≈50 MB/layer on GPT-2-small) never touches HBM
+            lse_ref[0] = m_scratch[:, 0:1] + jnp.log(l_safe)
 
 
 def _offsets_arr(q_offset, k_offset) -> jax.Array:
@@ -225,10 +227,10 @@ def _flash_forward(
         )
     ]
     if return_lse:
-        out_shapes.append(jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32))
+        out_shapes.append(jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32))
         out_specs.append(
             pl.BlockSpec(
-                (1, block_q, _LANES),
+                (1, block_q, 1),
                 lambda bh_, qi, ki: (bh_, qi, 0),
                 memory_space=pltpu.VMEM,
             )
@@ -272,18 +274,20 @@ def _drop_lse_arg(kernel, off_ref, q_ref, k_ref, v_ref, o_ref, *scratch, **kw):
 
 
 # ---------------------------------------------------------------------------
-# backward: dkv kernel (grid over k-blocks, stream q-blocks)
+# backward: ONE fused kernel for dq, dk, dv (FlashAttention-2 recompute)
 # ---------------------------------------------------------------------------
-def _flash_bwd_dkv_kernel(
+def _flash_bwd_kernel(
     off_ref,  # (2,) int32 SMEM: [q_offset, k_offset]
     q_ref,  # (1, block_q, d)
     k_ref,  # (1, block_k, d)
     v_ref,  # (1, block_k, d)
     do_ref,  # (1, block_q, d)
-    lse_ref,  # (1, block_q, 128) f32
-    delta_ref,  # (1, block_q, 128) f32
+    lse_ref,  # (1, block_q, 1) f32
+    delta_ref,  # (1, block_q, 1) f32
+    dq_ref,  # (1, block_q, d) out
     dk_ref,  # (1, block_k, d) out
     dv_ref,  # (1, block_k, d) out
+    dq_scratch,  # (seq_q, d) f32 — FULL q-length accumulator
     dk_scratch,  # (block_k, d) f32
     dv_scratch,  # (block_k, d) f32
     *,
@@ -292,18 +296,35 @@ def _flash_bwd_dkv_kernel(
     block_q: int,
     block_k: int,
 ):
+    """Grid (bh, k-block, q-block).  Per tile the probability block ``p`` is
+    recomputed ONCE and contracted into all three gradients — the split
+    dkv/dq kernel pair paid the QKᵀ recompute and the q/k/v/do DMA streams
+    twice.
+
+    dq needs accumulation across the OUTER k dimension while dk/dv accumulate
+    across the inner q dimension, so dq lives in a full-q-length fp32 VMEM
+    scratch (seq·d·4 B — 256 KB at seq 1024; ring hops keep per-chip seq
+    bounded): Pallas does NOT reload non-consecutively revisited output
+    blocks, so accumulating into dq_ref across ki would silently read stale
+    buffer contents whenever the k grid exceeds the VMEM window, and bf16
+    output accumulation would round partial sums every hop."""
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     num_q = pl.num_programs(2)
+    num_k = pl.num_programs(1)
+    q_rows = pl.ds(qi * block_q, block_q)
+
+    @pl.when(ki == 0)
+    def _zero_dq():
+        dq_scratch[q_rows, :] = jnp.zeros((block_q, dq_scratch.shape[1]), jnp.float32)
 
     @pl.when(qi == 0)
-    def _init():
+    def _zero_dkv():
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
 
     should_compute = True
     if is_causal:
-        # this (q-block, k-block) tile contributes only if some q >= some k
         q_off, k_off = off_ref[0], off_ref[1]
         should_compute = q_off + qi * block_q + block_q - 1 >= k_off + ki * block_k
 
@@ -313,11 +334,8 @@ def _flash_bwd_dkv_kernel(
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        # (block_q, 1) slices broadcast against the (block_q, block_k) score
-        # tile inside the VPU — no materialized lane tile, so block_k is free
-        # to exceed the 128-lane width
-        lse = lse_ref[0, :, 0:1]
-        delta = delta_ref[0, :, 0:1]
+        lse = lse_ref[0]  # (block_q, 1), broadcasts against score tiles
+        delta = delta_ref[0]
         s = jax.lax.dot_general(
             q,
             k,
@@ -327,8 +345,7 @@ def _flash_bwd_dkv_kernel(
         s = s * scale
         if is_causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, off_ref[0], off_ref[1])
-        # p is exactly the forward's normalized softmax tile (recompute)
-        p = jnp.exp(s - lse)  # (block_q, block_k); masked entries exp(-inf)=0
+        p = jnp.exp(s - lse)  # forward softmax tile; masked entries exp(-inf)=0
         # dv += pᵀ · dO
         dv_scratch[:] += jax.lax.dot_general(
             p.astype(do.dtype),
@@ -336,81 +353,7 @@ def _flash_bwd_dkv_kernel(
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        # dp = dO · vᵀ
-        dp = jax.lax.dot_general(
-            do,
-            v,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta) * scale  # (block_q, block_k) f32
-        # dk += dsᵀ · q
-        dk_scratch[:] += jax.lax.dot_general(
-            ds.astype(q.dtype),
-            q,
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-
-    @pl.when(qi == num_q - 1)
-    def _finalize():
-        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
-
-
-# ---------------------------------------------------------------------------
-# backward: dq kernel (grid over q-blocks, stream k-blocks)
-# ---------------------------------------------------------------------------
-def _flash_bwd_dq_kernel(
-    off_ref,  # (2,) int32 SMEM: [q_offset, k_offset]
-    q_ref,  # (1, block_q, d)
-    k_ref,  # (1, block_k, d)
-    v_ref,  # (1, block_k, d)
-    do_ref,  # (1, block_q, d)
-    lse_ref,  # (1, block_q, 128) f32
-    delta_ref,  # (1, block_q, 128) f32
-    dq_ref,  # (1, block_q, d) out
-    dq_scratch,  # (block_q, d) f32
-    *,
-    scale: float,
-    is_causal: bool,
-    block_q: int,
-    block_k: int,
-):
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    num_k = pl.num_programs(2)
-
-    @pl.when(ki == 0)
-    def _init():
-        dq_scratch[:] = jnp.zeros_like(dq_scratch)
-
-    should_compute = True
-    if is_causal:
-        q_off, k_off = off_ref[0], off_ref[1]
-        should_compute = q_off + qi * block_q + block_q - 1 >= k_off + ki * block_k
-
-    @pl.when(should_compute)
-    def _compute():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        # (block_q, 1) slices broadcast against the (block_q, block_k) score
-        # tile inside the VPU — no materialized lane tile, so block_k is free
-        # to exceed the 128-lane width
-        lse = lse_ref[0, :, 0:1]
-        delta = delta_ref[0, :, 0:1]
-        s = jax.lax.dot_general(
-            q,
-            k,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        s = s * scale
-        if is_causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k, off_ref[0], off_ref[1])
-        p = jnp.exp(s - lse)
+        # dp = dO · vᵀ ; ds = p ⊙ (dp − delta) · scale
         dp = jax.lax.dot_general(
             do,
             v,
@@ -418,17 +361,30 @@ def _flash_bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta) * scale
-        # dq += ds · k
-        dq_scratch[:] += jax.lax.dot_general(
-            ds.astype(k.dtype),
+        ds_cast = ds.astype(q.dtype)
+        # dk += dsᵀ · q
+        dk_scratch[:] += jax.lax.dot_general(
+            ds_cast,
+            q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dq_block += ds · k   (fp32 scratch row-slice for this q block)
+        dq_scratch[q_rows, :] += jax.lax.dot_general(
+            ds_cast,
             k,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
     @pl.when(ki == num_k - 1)
+    def _flush_dq():
+        dq_ref[0] = dq_scratch[q_rows, :].astype(dq_ref.dtype)
+
+    @pl.when(qi == num_q - 1)
     def _finalize():
-        dq_ref[0] = dq_scratch[:].astype(dq_ref.dtype)
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
 
 
 def _flash_backward(
@@ -462,87 +418,63 @@ def _flash_backward(
     do3 = g.reshape(bh, sq, d)
     o3 = out.reshape(bh, sq, d)
 
-    # the saved residual is compact (bh, sq); kernels read lane-broadcast
-    # (block_q, 128) tiles, so expand here — XLA materializes these only for
-    # the backward's lifetime, the forward residual stays O(S)
-    lse3 = jnp.broadcast_to(lse[..., None], (bh, sq, _LANES))
+    # compact O(S) per-row tensors; the kernel broadcasts (block_q, 1) tiles
+    lse3 = lse[..., None]
     # delta_i = Σ_d dO_i·O_i  — cheap rank-reduction, XLA fuses it
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
     if delta_adjust is not None:
         # hop-level vjp: the lse output's own cotangent g_lse enters as
         # ds += p·g_lse, equivalent to delta' = delta - g_lse
         delta = delta + delta_adjust.astype(jnp.float32)
-    delta3 = jnp.broadcast_to(delta[..., None], (bh, sq, _LANES))
+    delta3 = delta[..., None]
 
     q_spec = pl.BlockSpec(
-        (1, block_q, d), lambda bh_, a, qi: (bh_, qi, 0), memory_space=pltpu.VMEM
+        (1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0), memory_space=pltpu.VMEM
     )
-    kv_spec_dkv = pl.BlockSpec(
-        (1, block_k, d), lambda bh_, ki, a: (bh_, ki, 0), memory_space=pltpu.VMEM
+    kv_spec = pl.BlockSpec(
+        (1, block_k, d), lambda bh_, ki, qi: (bh_, ki, 0), memory_space=pltpu.VMEM
     )
     row_spec = pl.BlockSpec(
-        (1, block_q, _LANES), lambda bh_, a, qi: (bh_, qi, 0), memory_space=pltpu.VMEM
+        (1, block_q, 1), lambda bh_, ki, qi: (bh_, qi, 0), memory_space=pltpu.VMEM
     )
 
-    dkv_kernel = functools.partial(
-        _flash_bwd_dkv_kernel,
+    kernel = functools.partial(
+        _flash_bwd_kernel,
         scale=scale,
         is_causal=is_causal,
         block_q=block_q,
         block_k=block_k,
     )
     offs = _offsets_arr(q_offset, k_offset)
-    dk3, dv3 = pl.pallas_call(
-        dkv_kernel,
+    dq3, dk3, dv3 = pl.pallas_call(
+        kernel,
         grid=(bh, sk // block_k, sq // block_q),
-        in_specs=[_off_spec(), q_spec, kv_spec_dkv, kv_spec_dkv, q_spec, row_spec, row_spec],
+        in_specs=[_off_spec(), q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=[
             pl.BlockSpec(
-                (1, block_k, d), lambda bh_, ki, a: (bh_, ki, 0), memory_space=pltpu.VMEM
+                (1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (1, block_k, d), lambda bh_, ki, a: (bh_, ki, 0), memory_space=pltpu.VMEM
+                (1, block_k, d), lambda bh_, ki, qi: (bh_, ki, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh_, ki, qi: (bh_, ki, 0), memory_space=pltpu.VMEM
             ),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
         scratch_shapes=[
+            pltpu.VMEM((sq, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_INTERPRET,
-        compiler_params=_compiler_params(),
-    )(offs, q3, k3, v3, do3, lse3, delta3)
-
-    dq_kernel = functools.partial(
-        _flash_bwd_dq_kernel,
-        scale=scale,
-        is_causal=is_causal,
-        block_q=block_q,
-        block_k=block_k,
-    )
-    q_spec_dq = pl.BlockSpec(
-        (1, block_q, d), lambda bh_, qi, a: (bh_, qi, 0), memory_space=pltpu.VMEM
-    )
-    kv_spec_dq = pl.BlockSpec(
-        (1, block_k, d), lambda bh_, a, ki: (bh_, ki, 0), memory_space=pltpu.VMEM
-    )
-    row_spec_dq = pl.BlockSpec(
-        (1, block_q, _LANES), lambda bh_, qi, a: (bh_, qi, 0), memory_space=pltpu.VMEM
-    )
-    dq3 = pl.pallas_call(
-        dq_kernel,
-        grid=(bh, sq // block_q, sk // block_k),
-        in_specs=[_off_spec(), q_spec_dq, kv_spec_dq, kv_spec_dq, q_spec_dq, row_spec_dq, row_spec_dq],
-        out_specs=pl.BlockSpec(
-            (1, block_q, d), lambda bh_, qi, a: (bh_, qi, 0), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=_INTERPRET,
-        compiler_params=_compiler_params(),
+        # ki carries the dq scratch, qi carries the dk/dv scratch: both are
+        # loop-carried, only bh is safe to parallelize
+        compiler_params=_compiler_params(("parallel", "arbitrary", "arbitrary")),
     )(offs, q3, k3, v3, do3, lse3, delta3)
 
     return (

@@ -31,7 +31,7 @@ TPU_PEAK_FLOPS = float(os.environ.get("BENCH_TPU_PEAK_FLOPS", 197e12))
 
 BATCH = int(os.environ.get("BENCH_BATCH", 8))
 SEQ = int(os.environ.get("BENCH_SEQ", 1024))
-STEPS = int(os.environ.get("BENCH_STEPS", 20))
+STEPS = int(os.environ.get("BENCH_STEPS", 50))
 WARMUP = int(os.environ.get("BENCH_WARMUP", 5))
 INIT_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", 3))
 INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT", 240))

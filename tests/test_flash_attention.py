@@ -157,3 +157,47 @@ def test_hop_gradients_match_reference():
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(q1, k0, v0, k1, v1)
     for gh, gr in zip(g_hops, g_ref):
         np.testing.assert_allclose(np.asarray(gh), np.asarray(gr), atol=3e-4, rtol=3e-4)
+
+
+def test_backward_many_k_blocks_parity():
+    """dq must accumulate correctly across MANY backward k-blocks.
+
+    Regression guard: accumulating dq into a non-consecutively revisited
+    output block reads stale VMEM whenever the k grid exceeds the window —
+    correct at 2 k-blocks, silently corrupt at 3+.  Forcing tiny blocks makes
+    seq 512 span 4 k-blocks even in interpret mode.
+    """
+    import numpy as np
+
+    from accelerate_tpu.ops import flash_attention as fa
+    from accelerate_tpu.ops.attention import sdpa_reference
+
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((1, 2, 512, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 512, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, 512, 64)), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    for causal in (True, False):
+        out, lse = fa._flash_forward(
+            q, k, v, 64**-0.5, causal, block_q=128, block_k=128, return_lse=True
+        )
+        g = jnp.ones_like(out)
+        dq, dk, dv = fa._flash_backward(
+            q, k, v, out, lse[..., 0], g, 64**-0.5, causal,
+            block_q=128, block_k=128,
+        )
+        ref_grads = jax.grad(
+            loss(lambda q, k, v: sdpa_reference(q, k, v, is_causal=causal)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        # same cotangent as the ref loss: d(sum o^2)/do = 2*o
+        dq2, dk2, dv2 = fa._flash_backward(
+            q, k, v, out, lse[..., 0], 2 * out, 64**-0.5, causal,
+            block_q=128, block_k=128,
+        )
+        for got, want in zip((dq2, dk2, dv2), ref_grads):
+            err = float(jnp.abs(got - want).max() / jnp.abs(want).max())
+            assert err < 5e-3, f"causal={causal}: rel err {err}"
