@@ -11,8 +11,11 @@ The backward is the FlashAttention-2 recompute scheme, also in Pallas: the
 forward additionally emits the per-row logsumexp (LSE); the backward
 recomputes each (q-block, k-block) probability tile from q/k/LSE inside ONE
 fused kernel and contracts it against dO for dq, dk AND dv — so no O(S²)
-tensor ever reaches HBM in either direction and the QKᵀ recompute + DMA
-streams are paid once, not twice.  A cheap XLA-fused
+tensor ever reaches HBM in either direction and the QKᵀ recompute + input
+DMA streams are paid once, not twice (known cost: dq's output block is
+flushed on every inner q step, so its HBM writes scale with the k-block
+count — garbage until the last k iteration, then overwritten; correct, but
+write-amplified whenever sk/block_k > 1).  A cheap XLA-fused
 ``delta = rowsum(dO·O)`` precomputation feeds it.
 
 The reference framework has no attention kernels at all (SURVEY.md §2.7 —
@@ -43,12 +46,12 @@ from .attention import sdpa_reference
 
 import os
 
-DEFAULT_BLOCK_Q = int(os.environ.get("ACCELERATE_TPU_FLASH_BLOCK_Q", 512))
+DEFAULT_BLOCK_Q = int(os.environ.get("ACCELERATE_TPU_FLASH_BLOCK_Q", 1024))
 DEFAULT_BLOCK_K = int(os.environ.get("ACCELERATE_TPU_FLASH_BLOCK_K", 1024))
 # the backward kernels keep (block_q, block_k) f32 score/ds tiles live at
 # once, so they get their own tiling knobs
-DEFAULT_BWD_BLOCK_Q = int(os.environ.get("ACCELERATE_TPU_FLASH_BWD_BLOCK_Q", 512))
-DEFAULT_BWD_BLOCK_K = int(os.environ.get("ACCELERATE_TPU_FLASH_BWD_BLOCK_K", 512))
+DEFAULT_BWD_BLOCK_Q = int(os.environ.get("ACCELERATE_TPU_FLASH_BWD_BLOCK_Q", 1024))
+DEFAULT_BWD_BLOCK_K = int(os.environ.get("ACCELERATE_TPU_FLASH_BWD_BLOCK_K", 1024))
 _LANES = 128  # TPU lane count: last-dim tile width for every dtype
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
@@ -509,8 +512,8 @@ def _fwd(q, k, v, is_causal, scale):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     out, lse = _flash_forward(q, k, v, scale, is_causal, return_lse=True)
-    # keep only one lane of the lane-broadcast kernel output: the residual
-    # held across the whole forward is O(S), not O(S·128)
+    # squeeze the kernel's single-lane (bh, sq, 1) output to the compact
+    # (bh, sq) residual held across the whole forward
     return out, (q, k, v, out, lse[..., 0])
 
 

@@ -9,7 +9,11 @@ from .testing import (
     require_cpu,
     require_multi_device,
     require_non_cpu,
+    require_fp8,
+    require_multi_host,
+    require_pallas,
     require_single_device,
+    require_torch,
     require_tpu,
     require_transformers,
     run_command,

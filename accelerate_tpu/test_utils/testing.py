@@ -27,6 +27,9 @@ __all__ = [
     "require_single_device",
     "require_transformers",
     "require_torch",
+    "require_multi_host",
+    "require_pallas",
+    "require_fp8",
     "require_datasets",
     "skip",
     "execute_subprocess",
@@ -106,6 +109,37 @@ def require_single_device(test_case):
     except Exception:
         ok = False
     return unittest.skipUnless(ok, "test requires a single device")(test_case)
+
+
+def require_multi_host(test_case):
+    """Skip unless the job spans >1 host process (TPU pod slice)."""
+    try:
+        import jax
+
+        ok = jax.process_count() > 1
+    except Exception:
+        ok = False
+    return unittest.skipUnless(ok, "test requires a multi-host job")(test_case)
+
+
+def require_pallas(test_case):
+    """Skip unless the Pallas TPU (Mosaic) backend is importable."""
+    try:
+        from ..ops.flash_attention import _HAS_PLTPU as ok
+    except Exception:
+        ok = False
+    return unittest.skipUnless(ok, "test requires the Pallas TPU backend")(test_case)
+
+
+def require_fp8(test_case):
+    """Skip unless jnp exposes fp8 dtypes (float8_e4m3fn/e5m2)."""
+    try:
+        import jax.numpy as jnp
+
+        ok = hasattr(jnp, "float8_e4m3fn") and hasattr(jnp, "float8_e5m2")
+    except Exception:
+        ok = False
+    return unittest.skipUnless(ok, "test requires fp8 dtypes")(test_case)
 
 
 def _require_importable(module_name: str):
