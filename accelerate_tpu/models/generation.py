@@ -1,0 +1,260 @@
+"""KV-cache autoregressive decoding for the GPT family.
+
+The reference framework delegates generation to transformers' ``generate``
+(its big-model-inference benchmark, benchmarks/big_model_inference/, times
+exactly load + per-token decode); here decode is a first-class TPU program:
+prefill and every decode step run inside ONE jitted function, the layer
+stack is a ``lax.scan`` over stacked per-layer parameters (no Python loop in
+the trace), and the KV cache is a preallocated static-shape buffer updated
+with ``lax.dynamic_update_slice`` — no retracing, no dynamic shapes, one
+device launch per ``generate`` call.
+
+Inference-only by design: it reads the module's parameter arrays directly
+(no tape), so it composes with ``shard_for_inference`` — cache entries and
+activations inherit the params' GSPMD layouts.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _ln(x, w, b, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (out * w + b).astype(x.dtype)
+
+
+def _gelu(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
+def stack_gpt_params(model) -> dict:
+    """Raw-array param pytree with the (identical) blocks stacked on axis 0.
+
+    Dense trunks only — MoE routing is data-dependent per block and does not
+    stack; ``generate`` raises for it upstream.
+    """
+    import numpy as np  # noqa: F401  (shape sanity only)
+
+    def arr(t):
+        return t.data
+
+    blocks = list(model.h)
+    names = [
+        ("ln_1", "weight"), ("ln_1", "bias"),
+        ("attn", "c_attn", "weight"), ("attn", "c_attn", "bias"),
+        ("attn", "c_proj", "weight"), ("attn", "c_proj", "bias"),
+        ("ln_2", "weight"), ("ln_2", "bias"),
+        ("mlp", "c_fc", "weight"), ("mlp", "c_fc", "bias"),
+        ("mlp", "c_proj", "weight"), ("mlp", "c_proj", "bias"),
+    ]
+
+    def get(block, path):
+        obj = block
+        for part in path:
+            obj = getattr(obj, part)
+        return arr(obj)
+
+    stacked = {
+        "_".join(path): jnp.stack([get(b, path) for b in blocks]) for path in names
+    }
+    stacked["wte"] = arr(model.wte.weight)
+    stacked["wpe"] = arr(model.wpe.weight)
+    stacked["ln_f_weight"] = arr(model.ln_f.weight)
+    stacked["ln_f_bias"] = arr(model.ln_f.bias)
+    return stacked
+
+
+def _block_step(params_l, x, k_cache, v_cache, pos_mask, n_head, eps):
+    """One transformer block over a (b, s, c) slice with an explicit cache.
+
+    ``k_cache``/``v_cache`` are the FULL (b, h, S, d) buffers for this layer
+    (already containing this step's keys); ``pos_mask`` (S,) marks valid
+    cache positions ≤ current.
+    """
+    b, s, c = x.shape
+    d = c // n_head
+    h = _ln(x, params_l["ln_1_weight"], params_l["ln_1_bias"], eps)
+    qkv = h @ params_l["attn_c_attn_weight"].T + params_l["attn_c_attn_bias"]
+    q = qkv[..., :c].reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+    ) * (d ** -0.5)
+    scores = jnp.where(pos_mask[None, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    att = jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, c)
+    x = x + att @ params_l["attn_c_proj_weight"].T + params_l["attn_c_proj_bias"]
+    h2 = _ln(x, params_l["ln_2_weight"], params_l["ln_2_bias"], eps)
+    h2 = _gelu(h2 @ params_l["mlp_c_fc_weight"].T + params_l["mlp_c_fc_bias"])
+    return x + h2 @ params_l["mlp_c_proj_weight"].T + params_l["mlp_c_proj_bias"]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_head", "n_layer", "eps", "max_new", "cache_len", "temperature"),
+)
+def _generate_jit(
+    params,
+    ids,  # (b, prompt_len) int32
+    rng,
+    *,
+    n_head: int,
+    n_layer: int,
+    eps: float,
+    max_new: int,
+    cache_len: int,
+    temperature: float,
+):
+    b, prompt_len = ids.shape
+    c = params["wte"].shape[1]
+    d = c // n_head
+    dtype = params["wte"].dtype
+
+    def qkv_for(params_l, x):
+        h = _ln(x, params_l["ln_1_weight"], params_l["ln_1_bias"], eps)
+        qkv = h @ params_l["attn_c_attn_weight"].T + params_l["attn_c_attn_bias"]
+        to_heads = lambda t: t.reshape(t.shape[0], t.shape[1], n_head, d).transpose(0, 2, 1, 3)
+        return (
+            to_heads(qkv[..., :c]),
+            to_heads(qkv[..., c : 2 * c]),
+            to_heads(qkv[..., 2 * c :]),
+        )
+
+    # ---- prefill: full prompt through a scan over stacked layers ----------
+    pos = jnp.arange(prompt_len)
+    x = params["wte"][ids] + params["wpe"][pos][None]
+
+    def prefill_layer(x, params_l):
+        qh, k, v = qkv_for(params_l, x)
+        # cache layout: keys/values padded out to the full decode length
+        pad = [(0, 0), (0, 0), (0, cache_len - prompt_len), (0, 0)]
+        kc = jnp.pad(k, pad)
+        vc = jnp.pad(v, pad)
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", qh, k, preferred_element_type=jnp.float32
+        ) * (d ** -0.5)
+        causal = pos[:, None] >= pos[None, :]
+        scores = jnp.where(causal[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+        att = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        att = att.transpose(0, 2, 1, 3).reshape(b, prompt_len, c)
+        h1 = x + att @ params_l["attn_c_proj_weight"].T + params_l["attn_c_proj_bias"]
+        h2 = _ln(h1, params_l["ln_2_weight"], params_l["ln_2_bias"], eps)
+        h2 = _gelu(h2 @ params_l["mlp_c_fc_weight"].T + params_l["mlp_c_fc_bias"])
+        out = h1 + h2 @ params_l["mlp_c_proj_weight"].T + params_l["mlp_c_proj_bias"]
+        return out, (kc, vc)
+
+    layer_params = {
+        k: v
+        for k, v in params.items()
+        if k not in ("wte", "wpe", "ln_f_weight", "ln_f_bias")
+    }
+    x, (k_cache, v_cache) = jax.lax.scan(prefill_layer, x, layer_params)
+    x = _ln(x, params["ln_f_weight"], params["ln_f_bias"], eps)
+    logits = x[:, -1] @ params["wte"].T  # (b, V)
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    rng, key = jax.random.split(rng)
+    next_tok = sample(logits, key)
+
+    # ---- decode: one token per scan step, cache updated in place ----------
+    def decode_step(carry, _):
+        k_cache, v_cache, tok, position, rng = carry
+        x = params["wte"][tok][:, None, :] + params["wpe"][position][None, None]
+
+        def layer(x, layer_in):
+            params_l, kc, vc = layer_in
+            _, k, v = qkv_for(params_l, x)
+            kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, position, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, position, 0))
+            mask = jnp.arange(cache_len) <= position
+            out = _block_step(
+                params_l, x, kc, vc, mask, n_head, eps
+            )
+            return out, (kc, vc)
+
+        x, (k_cache, v_cache) = jax.lax.scan(
+            layer, x, (layer_params, k_cache, v_cache)
+        )
+        x = _ln(x, params["ln_f_weight"], params["ln_f_bias"], eps)
+        logits = x[:, -1] @ params["wte"].T
+        rng, key = jax.random.split(rng)
+        nxt = sample(logits, key)
+        return (k_cache, v_cache, nxt, position + 1, rng), nxt
+
+    (_, _, _, _, _), toks = jax.lax.scan(
+        decode_step,
+        (k_cache, v_cache, next_tok, jnp.int32(prompt_len), rng),
+        None,
+        length=max_new - 1,
+    )
+    new_tokens = jnp.concatenate([next_tok[None], toks], axis=0).T  # (b, max_new)
+    return jnp.concatenate([ids, new_tokens], axis=1)
+
+
+def generate(
+    model,
+    input_ids,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+):
+    """Greedy (``temperature=0``) or sampled decode with a KV cache.
+
+    One jitted program per (prompt_len, max_new_tokens) pair; the cache is
+    sized ``prompt + max_new`` (must fit ``config.n_positions``).
+    """
+    cfg = model.config
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "generate() supports dense GPT trunks; MoE routing does not stack"
+        )
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    ids = jnp.asarray(
+        input_ids.data if hasattr(input_ids, "data") else input_ids, jnp.int32
+    )
+    if ids.ndim == 1:
+        ids = ids[None]
+    cache_len = ids.shape[1] + max_new_tokens
+    if cache_len > cfg.n_positions:
+        raise ValueError(
+            f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds n_positions ({cfg.n_positions})"
+        )
+    # memoize the stacked copy: restacking is a full param-set copy per
+    # call (≈1.5 GB for GPT-2-large) and would pollute per-token latency
+    key = tuple(id(p.data) for _, p in model.named_parameters())
+    cached = getattr(model, "_generation_param_cache", None)
+    if cached is not None and cached[0] == key:
+        params = cached[1]
+    else:
+        params = stack_gpt_params(model)
+        model._generation_param_cache = (key, params)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _generate_jit(
+        params,
+        ids,
+        rng,
+        n_head=cfg.n_head,
+        n_layer=cfg.n_layer,
+        eps=cfg.layer_norm_eps,
+        max_new=max_new_tokens,
+        cache_len=cache_len,
+        temperature=float(temperature),
+    )

@@ -69,6 +69,10 @@ class GPTConfig:
     def medium(cls) -> "GPTConfig":
         return cls(n_embd=1024, n_layer=24, n_head=16)
 
+    @classmethod
+    def large(cls) -> "GPTConfig":
+        return cls(n_embd=1280, n_layer=36, n_head=20)
+
 
 def _gpt2_init(model: nn.Module, config: GPTConfig) -> None:
     """GPT-2 init: N(0, 0.02) weights, zero biases, residual-proj scaling."""
@@ -218,6 +222,12 @@ class GPTLMHeadModel(nn.Module):
                         loss = loss + self.config.moe_aux_weight * aux
             return {"loss": loss, "logits": logits}
         return {"logits": logits}
+
+    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0, rng=None):
+        """KV-cache greedy/sampled decode — see models/generation.py."""
+        from .generation import generate
+
+        return generate(self, input_ids, max_new_tokens, temperature, rng)
 
     @property
     def num_flops_per_token(self) -> float:

@@ -55,3 +55,8 @@ def test_distributed_data_loop_script():
 def test_merge_weights_script():
     out = _run("accelerate_tpu.test_utils.scripts.test_merge_weights")
     assert "All merge-weights checks passed" in out
+
+
+def test_metrics_script():
+    out = _run("accelerate_tpu.test_utils.scripts.external_deps.test_metrics")
+    assert "All metrics checks passed" in out
