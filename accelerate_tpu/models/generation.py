@@ -41,8 +41,6 @@ def stack_gpt_params(model) -> dict:
     Dense trunks only — MoE routing is data-dependent per block and does not
     stack; ``generate`` raises for it upstream.
     """
-    import numpy as np  # noqa: F401  (shape sanity only)
-
     def arr(t):
         return t.data
 
@@ -99,7 +97,7 @@ def _block_step(params_l, x, k_cache, v_cache, pos_mask, n_head, eps):
 
 @partial(
     jax.jit,
-    static_argnames=("n_head", "n_layer", "eps", "max_new", "cache_len", "temperature"),
+    static_argnames=("n_head", "eps", "max_new", "cache_len", "temperature"),
 )
 def _generate_jit(
     params,
@@ -107,7 +105,6 @@ def _generate_jit(
     rng,
     *,
     n_head: int,
-    n_layer: int,
     eps: float,
     max_new: int,
     cache_len: int,
@@ -237,14 +234,22 @@ def generate(
             f"exceeds n_positions ({cfg.n_positions})"
         )
     # memoize the stacked copy: restacking is a full param-set copy per
-    # call (≈1.5 GB for GPT-2-large) and would pollute per-token latency
-    key = tuple(id(p.data) for _, p in model.named_parameters())
+    # call (≈1.5 GB for GPT-2-large) and would pollute per-token latency.
+    # The cache holds STRONG references to the source arrays and compares
+    # with `is` — an id()-tuple key can silently match recycled object ids
+    # after training rebinds p.data, serving stale weights.  Cost: at most
+    # one superseded param set stays alive until the next generate().
+    current = [p.data for _, p in model.named_parameters()]
     cached = getattr(model, "_generation_param_cache", None)
-    if cached is not None and cached[0] == key:
+    if (
+        cached is not None
+        and len(cached[0]) == len(current)
+        and all(a is b for a, b in zip(cached[0], current))
+    ):
         params = cached[1]
     else:
         params = stack_gpt_params(model)
-        model._generation_param_cache = (key, params)
+        model._generation_param_cache = (current, params)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_jit(
@@ -252,7 +257,6 @@ def generate(
         ids,
         rng,
         n_head=cfg.n_head,
-        n_layer=cfg.n_layer,
         eps=cfg.layer_norm_eps,
         max_new=max_new_tokens,
         cache_len=cache_len,

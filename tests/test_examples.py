@@ -70,7 +70,12 @@ def test_feature_scripts_parse():
 
     by_feature = os.path.join(EXAMPLES, "by_feature")
     scripts = [os.path.join(by_feature, f) for f in sorted(os.listdir(by_feature)) if f.endswith(".py")]
-    scripts += [BASE, COMPLETE, os.path.join(EXAMPLES, "cv_example.py")]
+    scripts += [
+        BASE,
+        COMPLETE,
+        os.path.join(EXAMPLES, "cv_example.py"),
+        os.path.join(EXAMPLES, "complete_cv_example.py"),
+    ]
     assert len(scripts) >= 10
     for script in scripts:
         py_compile.compile(script, doraise=True)
@@ -118,3 +123,33 @@ def test_example_smoke_train_save_resume(tmp_path, script):
     )
     assert resume.returncode == 0, resume.stderr[-2000:]
     assert os.path.isdir(os.path.join(out_dir, "epoch_1"))
+
+
+def test_complete_cv_train_ckpt_resume(tmp_path):
+    """complete_cv_example end-to-end: train+ckpt, then mid-training resume."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=os.pathsep.join(
+            p for p in (repo_root, EXAMPLES, os.environ.get("PYTHONPATH", "")) if p
+        ),
+    )
+    out = str(tmp_path / "cv")
+    base_cmd = [
+        sys.executable, os.path.join(EXAMPLES, "complete_cv_example.py"),
+        "--batch_size", "16", "--checkpointing_steps", "epoch", "--project_dir", out,
+    ]
+    proc = subprocess.run(
+        base_cmd + ["--num_epochs", "1"],
+        capture_output=True, text=True, timeout=480, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert os.path.isdir(os.path.join(out, "epoch_0"))
+    proc = subprocess.run(
+        base_cmd + ["--num_epochs", "2", "--resume_from_checkpoint", os.path.join(out, "epoch_0")],
+        capture_output=True, text=True, timeout=480, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "epoch 1" in proc.stdout and "epoch 0" not in proc.stdout

@@ -36,10 +36,18 @@ def test_greedy_matches_full_forward(tiny_model):
 
 
 def test_generate_is_one_program(tiny_model):
-    """Whole decode (prefill + N steps) is a single jitted call."""
+    """Whole decode (prefill + N steps) is ONE jitted program, cached."""
+    from accelerate_tpu.models import generation as gen
+
+    gen._generate_jit.clear_cache()
     ids = np.zeros((1, 4), dtype=np.int32)
     out = tiny_model.generate(ids, max_new_tokens=5)
     assert out.shape == (1, 9)
+    tiny_model.generate(ids, max_new_tokens=5)
+    # same geometry -> zero retraces; the decode loop lives inside the one
+    # compiled program (a Python-loop regression would show N cache entries
+    # or per-call misses)
+    assert gen._generate_jit._cache_size() == 1
 
 
 def test_sampled_decode_shapes_and_determinism(tiny_model):
