@@ -1,0 +1,136 @@
+"""DeepSpeed-config ingestion: map a ``ds_config.json`` onto mesh plugins.
+
+The reference hands the whole model to the DeepSpeed engine
+(/root/reference/src/accelerate/accelerator.py:1745, utils/deepspeed.py:121
+``HfDeepSpeedConfig`` querying ``zero_optimization.*``).  On TPU there is no
+engine to delegate to — ZeRO stages ARE sharding layouts on the ``fsdp``
+mesh axis — but users migrating from the reference carry ds_config.json
+files, so this module reads the common fields and returns the equivalent
+native configuration:
+
+  zero_optimization.stage 0      → NO_SHARD (pure DP)
+  zero_optimization.stage 1/2    → SHARD_GRAD_OP (grads+opt-state sharded)
+  zero_optimization.stage 3      → FULL_SHARD (params too)
+  fp16.enabled / bf16.enabled    → mixed_precision
+  train_micro_batch_size_per_gpu → per-device batch size
+  gradient_accumulation_steps    → gradient_accumulation_steps
+  gradient_clipping              → clip value for clip_grad_norm_
+  offload_{param,optimizer}      → warning (host offload is the big-model
+                                   path here, not a ZeRO knob)
+
+``"auto"`` values resolve to the caller-supplied defaults, mirroring the
+reference's auto-fill contract (utils/deepspeed.py:253).
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .dataclasses import FullyShardedDataParallelPlugin
+
+_STAGE_TO_STRATEGY = {
+    0: "NO_SHARD",
+    1: "SHARD_GRAD_OP",
+    2: "SHARD_GRAD_OP",
+    3: "FULL_SHARD",
+}
+
+
+@dataclass
+class DeepSpeedCompatConfig:
+    """The native equivalents extracted from one ds_config dict."""
+
+    fsdp_plugin: Optional[FullyShardedDataParallelPlugin]
+    mixed_precision: str
+    gradient_accumulation_steps: int
+    micro_batch_size: Optional[int]
+    gradient_clipping: Optional[float]
+    zero_stage: int
+    raw: dict = field(repr=False, default_factory=dict)
+
+    def accelerator_kwargs(self) -> dict[str, Any]:
+        """kwargs ready to splat into ``Accelerator(...)``."""
+        kwargs: dict[str, Any] = {
+            "mixed_precision": self.mixed_precision,
+            "gradient_accumulation_steps": self.gradient_accumulation_steps,
+        }
+        if self.fsdp_plugin is not None:
+            kwargs["fsdp_plugin"] = self.fsdp_plugin
+        return kwargs
+
+
+def _get(cfg: dict, dotted: str, default=None):
+    node: Any = cfg
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return default
+        node = node[part]
+    return node
+
+
+def _resolve_auto(value, fallback):
+    return fallback if value in ("auto", None) else value
+
+
+def from_deepspeed_config(
+    config: "dict | str",
+    *,
+    micro_batch_size: Optional[int] = None,
+    gradient_accumulation_steps: int = 1,
+) -> DeepSpeedCompatConfig:
+    """Parse a DeepSpeed config (dict or path to JSON) into native settings.
+
+    Keyword fallbacks fill ``"auto"`` entries the way the reference's
+    ``deepspeed_config_process`` does.
+    """
+    if isinstance(config, str):
+        with open(config) as f:
+            cfg = json.load(f)
+    else:
+        cfg = dict(config)
+
+    stage = _resolve_auto(_get(cfg, "zero_optimization.stage", 0), 0)
+    if stage not in _STAGE_TO_STRATEGY:
+        raise ValueError(f"unsupported zero_optimization.stage: {stage!r}")
+
+    fsdp_plugin = None
+    if stage > 0:
+        fsdp_plugin = FullyShardedDataParallelPlugin(
+            sharding_strategy=_STAGE_TO_STRATEGY[stage]
+        )
+
+    for knob in ("offload_param.device", "offload_optimizer.device"):
+        dev = _get(cfg, f"zero_optimization.{knob}")
+        if dev in ("cpu", "nvme"):
+            warnings.warn(
+                f"ds_config requests zero_optimization.{knob}={dev!r}; TPU HBM "
+                "sharding replaces ZeRO offload — use big_modeling host/disk "
+                "offload (cpu_offload/disk_offload) for models beyond HBM",
+                stacklevel=2,
+            )
+
+    if _resolve_auto(_get(cfg, "bf16.enabled"), False):
+        mixed_precision = "bf16"
+    elif _resolve_auto(_get(cfg, "fp16.enabled"), False):
+        mixed_precision = "fp16"
+    else:
+        mixed_precision = "no"
+
+    accum = _resolve_auto(
+        _get(cfg, "gradient_accumulation_steps"), gradient_accumulation_steps
+    )
+    mbs = _resolve_auto(_get(cfg, "train_micro_batch_size_per_gpu"), micro_batch_size)
+    clip = _resolve_auto(_get(cfg, "gradient_clipping"), None)
+
+    return DeepSpeedCompatConfig(
+        fsdp_plugin=fsdp_plugin,
+        mixed_precision=mixed_precision,
+        gradient_accumulation_steps=int(accum),
+        micro_batch_size=None if mbs is None else int(mbs),
+        gradient_clipping=None if clip is None else float(clip),
+        zero_stage=int(stage),
+        raw=cfg,
+    )

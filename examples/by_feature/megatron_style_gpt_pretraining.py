@@ -1,0 +1,97 @@
+"""Feature: Megatron-class GPT pretraining — tp x pp x dp in one program.
+
+Counterpart of reference examples/by_feature/megatron_lm_gpt_pretraining.py.
+The reference rebuilds the model inside the Megatron-LM engine
+(utils/megatron_lm.py) to get tensor/pipeline/data parallel training; here
+the SAME capabilities are mesh-axis layouts of one compiled step:
+
+* tp   — attention/MLP weights sharded per the model's tp_plan,
+* pp   — the trunk runs as GPipe microbatches over the ``pp`` axis
+         (PipelinedGPTLMHeadModel, shard_map + ppermute),
+* dp   — whatever devices remain consume distinct batch shards,
+* distributed optimizer — optimizer state follows the param shardings
+         (the fsdp axis generalizes it; see docs/sharding.md).
+
+Run on any machine: 8 virtual CPU devices stand in for a pod slice —
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python megatron_style_gpt_pretraining.py --pp 2 --sp 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.data_loader import prepare_data_loader
+from accelerate_tpu.models import GPTConfig, GPTLMHeadModel, PipelinedGPTLMHeadModel
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--pp", type=int, default=1)
+    parser.add_argument("--sp", type=int, default=1)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--num_steps", type=int, default=20)
+    parser.add_argument("--num_microbatches", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(
+        mixed_precision="bf16",
+        parallelism_config=ParallelismConfig(
+            tp_size=args.tp, pp_size=args.pp, sp_size=args.sp
+        ),
+    )
+    accelerator.print(f"mesh: {dict(accelerator.mesh.shape)}")
+
+    nn.manual_seed(0)
+    cfg = GPTConfig.tiny()
+    cfg.n_positions = max(cfg.n_positions, args.seq_len)
+    if args.pp > 1:
+        # pipeline trunk: GPipe microbatch schedule over the pp axis
+        model = PipelinedGPTLMHeadModel(cfg, num_microbatches=args.num_microbatches)
+    else:
+        model = GPTLMHeadModel(cfg)
+    optimizer = optim.AdamW(model.parameters(), lr=args.lr)
+
+    rng = np.random.default_rng(0)
+    data = [
+        {"input_ids": rng.integers(1, cfg.vocab_size, args.seq_len).astype(np.int32)}
+        for _ in range(args.batch_size * 8)
+    ]
+    dl = prepare_data_loader(dataset=data, batch_size=args.batch_size, shuffle=True)
+    model, optimizer, dl = accelerator.prepare(model, optimizer, dl)
+
+    def step_fn(ids):
+        optimizer.zero_grad()
+        out = model(ids, labels=ids)
+        accelerator.backward(out["loss"])
+        optimizer.step()
+        return out["loss"]
+
+    step = accelerator.compile_step(step_fn)
+
+    done = 0
+    t0 = time.perf_counter()
+    while done < args.num_steps:
+        for batch in dl:
+            loss = step(batch["input_ids"])
+            done += 1
+            if done >= args.num_steps:
+                break
+    accelerator.print(
+        f"{done} steps: final loss={float(loss.item()):.4f} "
+        f"({(time.perf_counter() - t0) / done * 1e3:.0f} ms/step)"
+    )
+
+
+if __name__ == "__main__":
+    main()

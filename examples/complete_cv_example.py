@@ -66,6 +66,7 @@ def training_function(args):
     overall_step = 0
     starting_epoch = 0
     resume_step = None
+    acc = None  # eval accuracy; None when resume skips all remaining epochs
     if args.resume_from_checkpoint:
         accelerator.print(f"resuming from {args.resume_from_checkpoint}")
         accelerator.load_state(args.resume_from_checkpoint)
@@ -127,6 +128,10 @@ def training_function(args):
 
     if args.with_tracking:
         accelerator.end_training()
+    if acc is None:
+        accelerator.print(
+            f"nothing to do: resumed at epoch {starting_epoch} >= num_epochs {args.num_epochs}"
+        )
     return acc
 
 

@@ -1,0 +1,59 @@
+"""ds_config.json ingestion → native mesh plugins (ZeRO subsumption)."""
+
+import json
+
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.deepspeed_compat import from_deepspeed_config
+
+
+ZERO3 = {
+    "zero_optimization": {
+        "stage": 3,
+        "offload_optimizer": {"device": "none"},
+        "offload_param": {"device": "none"},
+    },
+    "bf16": {"enabled": True},
+    "gradient_accumulation_steps": 4,
+    "train_micro_batch_size_per_gpu": "auto",
+    "gradient_clipping": 1.0,
+}
+
+
+def test_zero3_maps_to_full_shard(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps(ZERO3))
+    compat = from_deepspeed_config(str(path), micro_batch_size=8)
+    assert compat.zero_stage == 3
+    assert compat.fsdp_plugin.sharding_strategy == "FULL_SHARD"
+    assert compat.mixed_precision == "bf16"
+    assert compat.gradient_accumulation_steps == 4
+    assert compat.micro_batch_size == 8  # "auto" resolved from caller
+    assert compat.gradient_clipping == 1.0
+
+
+def test_zero2_and_fp16_and_stage0():
+    c2 = from_deepspeed_config({"zero_optimization": {"stage": 2}, "fp16": {"enabled": True}})
+    assert c2.fsdp_plugin.sharding_strategy == "SHARD_GRAD_OP"
+    assert c2.mixed_precision == "fp16"
+    c0 = from_deepspeed_config({})
+    assert c0.fsdp_plugin is None and c0.zero_stage == 0 and c0.mixed_precision == "no"
+
+
+def test_offload_warns():
+    cfg = {"zero_optimization": {"stage": 3, "offload_param": {"device": "cpu"}}}
+    with pytest.warns(UserWarning, match="offload"):
+        from_deepspeed_config(cfg)
+
+
+def test_unsupported_stage_raises():
+    with pytest.raises(ValueError):
+        from_deepspeed_config({"zero_optimization": {"stage": 7}})
+
+
+def test_kwargs_build_a_working_accelerator():
+    compat = from_deepspeed_config(ZERO3)
+    acc = Accelerator(**compat.accelerator_kwargs())
+    assert acc.mixed_precision == "bf16"
+    assert acc.gradient_state.num_steps == 4
