@@ -98,9 +98,11 @@ def from_deepspeed_config(
 
     fsdp_plugin = None
     if stage > 0:
-        fsdp_plugin = FullyShardedDataParallelPlugin(
-            sharding_strategy=_STAGE_TO_STRATEGY[stage]
-        )
+        fsdp_plugin = FullyShardedDataParallelPlugin()
+        # assign AFTER construction: __post_init__ re-reads
+        # FSDP_SHARDING_STRATEGY from the environment (launcher protocol)
+        # and would silently override the ds_config-derived stage
+        fsdp_plugin.sharding_strategy = _STAGE_TO_STRATEGY[stage]
 
     for knob in ("offload_param.device", "offload_optimizer.device"):
         dev = _get(cfg, f"zero_optimization.{knob}")

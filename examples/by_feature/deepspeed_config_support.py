@@ -39,7 +39,6 @@ def main():
     parser.add_argument("--batch_size", type=int, default=8)
     parser.add_argument("--num_epochs", type=int, default=1)
     parser.add_argument("--lr", type=float, default=1e-3)
-    parser.add_argument("--small", action="store_true")
     args = parser.parse_args()
 
     if args.ds_config is None:

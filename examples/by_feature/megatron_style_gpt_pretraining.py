@@ -79,6 +79,8 @@ def main():
 
     step = accelerator.compile_step(step_fn)
 
+    if args.num_steps < 1:
+        raise SystemExit("--num_steps must be >= 1")
     done = 0
     t0 = time.perf_counter()
     while done < args.num_steps:

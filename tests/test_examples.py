@@ -153,3 +153,26 @@ def test_complete_cv_train_ckpt_resume(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "epoch 1" in proc.stdout and "epoch 0" not in proc.stdout
+
+
+def test_megatron_style_pretraining_pp2(tmp_path):
+    """tp/pp/sp pretraining example runs on the virtual 8-device mesh."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PYTHONPATH=os.pathsep.join(
+            p for p in (repo_root, os.environ.get("PYTHONPATH", "")) if p
+        ),
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(EXAMPLES, "by_feature", "megatron_style_gpt_pretraining.py"),
+            "--pp", "2", "--num_steps", "3",
+        ],
+        capture_output=True, text=True, timeout=480, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "'pp': 2" in proc.stdout and "final loss=" in proc.stdout
