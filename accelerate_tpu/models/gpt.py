@@ -247,21 +247,26 @@ def _pure_layernorm(x, w, b, eps):
     return ((x - mu) * jax.lax.rsqrt(var + eps)) * w + b
 
 
-def _pipelined_block(p, h, *, n_head: int, eps: float, seq_axis: str):
+def _pipelined_block(p, h, *, n_head: int, eps: float, seq_axis: str, sp_mode: str = "ring"):
     """One pre-norm GPT block as pure jnp, runnable inside shard_map.
 
-    Attention goes through the ring-attention per-device body over
-    ``seq_axis`` — with sp=1 the ring has one hop and reduces to plain causal
-    SDPA, so pp-only and pp×sp use the same code.
+    Attention goes through the selected sequence-parallel per-device body
+    over ``seq_axis`` (``SequenceParallelPlugin.mode``: "ring" streams k/v
+    chunks via ppermute, "all_to_all" re-partitions heads Ulysses-style) —
+    with sp=1 the ring has one hop and reduces to plain causal SDPA, so
+    pp-only and pp×sp use the same code.
     """
-    from ..ops.ring_attention import _ring_attention_local
+    from ..ops.ring_attention import _ring_attention_local, _ulysses_attention_local
 
+    local_attn = (
+        _ulysses_attention_local if sp_mode == "all_to_all" else _ring_attention_local
+    )
     b, s, c = h.shape
     hd = c // n_head
     h1 = _pure_layernorm(h, p["ln1_w"], p["ln1_b"], eps)
     qkv = h1 @ p["qkv_w"].T + p["qkv_b"]
     qkv = qkv.reshape(b, s, 3, n_head, hd).transpose(2, 0, 3, 1, 4)
-    att = _ring_attention_local(
+    att = local_attn(
         qkv[0], qkv[1], qkv[2], axis_name=seq_axis, is_causal=True, scale=hd**-0.5
     )
     att = att.transpose(0, 2, 1, 3).reshape(b, s, c)
@@ -366,6 +371,20 @@ class PipelinedGPTLMHeadModel(nn.Module):
 
         cfg = self.config
         names = _StackedBlocks._ORDER
+        # SequenceParallelPlugin.mode selects the sp attention engine; the
+        # ulysses body needs heads divisible across the sp axis, else ring
+        from ..state import AcceleratorState
+
+        sp_mode = "ring"
+        state = AcceleratorState._shared_state and AcceleratorState()
+        sp_plugin = getattr(state, "sp_plugin", None) if state else None
+        if (
+            sp_plugin is not None
+            and mesh is not None
+            and getattr(sp_plugin, "mode", "ring") == "all_to_all"
+            and cfg.n_head % mesh.shape.get("sp", 1) == 0
+        ):
+            sp_mode = "all_to_all"
 
         def trunk(xv, *flat_params):
             stacked = dict(zip(names, flat_params))
@@ -374,6 +393,7 @@ class PipelinedGPTLMHeadModel(nn.Module):
                 return _pipelined_block(
                     layer_params, h,
                     n_head=cfg.n_head, eps=cfg.layer_norm_eps, seq_axis="sp",
+                    sp_mode=sp_mode,
                 )
 
             return gpipe(

@@ -166,6 +166,115 @@ def _ring_attention_local(q, k, v, *, axis_name: str, is_causal: bool, scale: fl
     return (acc / l).astype(q.dtype)
 
 
+def _ulysses_attention_local(
+    q, k, v, *, axis_name: str, is_causal: bool, scale: float
+):
+    """Per-device body of Ulysses-style (all-to-all) sequence parallelism.
+
+    Instead of rotating k/v around a ring, an ``all_to_all`` re-partitions
+    the problem: heads split across the ``sp`` devices, each device then
+    holding h/n heads at FULL sequence length, runs ordinary causal
+    attention locally (the Pallas flash kernel on TPU — no per-hop masking
+    logic at all), and a second ``all_to_all`` restores the seq-sharded
+    layout.  q/k/v are stacked so the inbound redistribution is ONE
+    collective (two per attention call total, vs the ring's n-1 ppermute
+    hops): better at moderate sequence lengths when h >= n; the ring wins
+    when per-device memory must stay O(s/n) (Ulysses holds full-seq k/v
+    for its head slice).
+    """
+    # heads -> devices, seq gathered: (3, b, h, s/n, d) -> (3, b, h/n, s, d)
+    qkv = jnp.stack([q, k, v])
+    qkv = jax.lax.all_to_all(qkv, axis_name, split_axis=2, concat_axis=3, tiled=True)
+    from .attention import sdpa_tpu
+
+    out = sdpa_tpu(qkv[0], qkv[1], qkv[2], is_causal=is_causal, scale=scale)
+    # seq -> devices, heads gathered back
+    return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _shard_mapped_attention(
+    local_fn, q, k, v, mesh, is_causal, scale, axis_name, batch_axes
+):
+    """Shared wrapper: resolve mesh/scale, sp=1 fast path, shard_map setup."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        mesh = AcceleratorState().mesh
+    if mesh.shape.get(axis_name, 1) == 1:
+        from .attention import sdpa_tpu
+
+        return None, mesh, scale  # caller runs the single-device path
+    batch_spec = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    spec = P(batch_spec, None, axis_name, None)
+    from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(
+        functools.partial(
+            local_fn, axis_name=axis_name, is_causal=is_causal, scale=scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn, mesh, scale
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    is_causal: bool = False,
+    scale: Optional[float] = None,
+    axis_name: str = "sp",
+    batch_axes: tuple = ("dp", "fsdp"),
+) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention.
+
+    Same contract as :func:`ring_attention` — (batch, heads, seq, head_dim)
+    with seq sharded over ``axis_name`` — but the parallelism re-partitions
+    heads across devices with an ``all_to_all`` pair instead of streaming
+    k/v chunks.  Requires ``heads % sp_size == 0``; falls back to the ring
+    otherwise.  Select per model via ``SequenceParallelPlugin(mode=...)``.
+    """
+    fn, mesh, scale = _shard_mapped_attention(
+        _ulysses_attention_local, q, k, v, mesh, is_causal, scale, axis_name, batch_axes
+    )
+    if fn is None:
+        from .attention import sdpa_tpu
+
+        return sdpa_tpu(q, k, v, is_causal=is_causal, scale=scale)
+    if q.shape[1] % mesh.shape[axis_name] != 0:
+        return ring_attention(
+            q, k, v, mesh, is_causal, scale, axis_name, batch_axes
+        )
+    return fn(q, k, v)
+
+
+_SP_MODES = ("ring", "all_to_all")
+
+
+def sequence_parallel_attention(
+    q,
+    k,
+    v,
+    mesh: Optional[Mesh] = None,
+    is_causal: bool = False,
+    scale: Optional[float] = None,
+    axis_name: str = "sp",
+    batch_axes: tuple = ("dp", "fsdp"),
+    mode: str = "ring",
+):
+    """Dispatch on ``SequenceParallelPlugin.mode``: "ring" | "all_to_all"."""
+    if mode not in _SP_MODES:
+        raise ValueError(f"unknown sequence-parallel mode {mode!r}; use one of {_SP_MODES}")
+    impl = ulysses_attention if mode == "all_to_all" else ring_attention
+    return impl(q, k, v, mesh, is_causal, scale, axis_name, batch_axes)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -183,28 +292,11 @@ def ring_attention(
     ppermute automatically), jit-compatible, composes with dp/fsdp batch
     sharding.
     """
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    if mesh is None:
-        from ..state import AcceleratorState
-
-        mesh = AcceleratorState().mesh
-    if mesh.shape.get(axis_name, 1) == 1:
+    fn, mesh, scale = _shard_mapped_attention(
+        _ring_attention_local, q, k, v, mesh, is_causal, scale, axis_name, batch_axes
+    )
+    if fn is None:
         from .attention import sdpa_tpu
 
         return sdpa_tpu(q, k, v, is_causal=is_causal, scale=scale)
-
-    batch_spec = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
-    spec = P(batch_spec, None, axis_name, None)
-    from jax.experimental.shard_map import shard_map
-
-    fn = shard_map(
-        functools.partial(
-            _ring_attention_local, axis_name=axis_name, is_causal=is_causal, scale=scale
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_rep=False,
-    )
     return fn(q, k, v)
