@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import warnings
 from typing import Optional
 
 import jax
@@ -382,9 +383,19 @@ class PipelinedGPTLMHeadModel(nn.Module):
             sp_plugin is not None
             and mesh is not None
             and getattr(sp_plugin, "mode", "ring") == "all_to_all"
-            and cfg.n_head % mesh.shape.get("sp", 1) == 0
         ):
-            sp_mode = "all_to_all"
+            if cfg.n_head % mesh.shape.get("sp", 1) == 0:
+                sp_mode = "all_to_all"
+            else:
+                # captured steps keep whatever mode the first trace chose, so
+                # a silent fallback would be invisible for the whole run
+                warnings.warn(
+                    f"SequenceParallelPlugin(mode='all_to_all') ignored: "
+                    f"n_head={cfg.n_head} is not divisible by the sp axis "
+                    f"size {mesh.shape.get('sp', 1)}; falling back to ring "
+                    "attention for this (and, under capture, every) step.",
+                    stacklevel=2,
+                )
 
         def trunk(xv, *flat_params):
             stacked = dict(zip(names, flat_params))

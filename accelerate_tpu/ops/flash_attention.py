@@ -78,14 +78,27 @@ def _compiler_params(semantics=("parallel", "parallel", "arbitrary")):
 
 
 def _fit_block(block: int, seq: int) -> int:
-    """Largest block ≤ ``block`` that divides ``seq`` (halving steps).
+    """Largest block ≤ ``block`` that divides ``seq``.
 
-    The dispatcher admits any seq divisible by 128; the tuned defaults are
-    512/1024, so e.g. seq 640 must step down (512 → 256 → 128) rather than
-    raise."""
+    First clamps to ``seq`` (so seq 640 with the 1024 default yields 640 —
+    no halving happens when the clamped block already divides seq), then
+    halves until it divides (seq 768 with block 512 halves once to 256,
+    which divides 768).  The result must stay a multiple of 128 — Mosaic
+    lane tiling requires it — which holds for any 128-multiple seq and
+    power-of-two default, but an env-overridden non-128-multiple block
+    (e.g. ``ACCELERATE_TPU_FLASH_BLOCK_K=192`` with seq 384) would pass the
+    divisibility check and then die inside Mosaic with an opaque error, so
+    we validate here instead."""
     block = min(block, seq)
     while block > 1 and seq % block:
         block //= 2
+    if block % 128 != 0:
+        raise ValueError(
+            f"flash-attention block size resolved to {block} for seq {seq}, "
+            "which is not a multiple of 128 (Mosaic lane-tile requirement). "
+            "Check ACCELERATE_TPU_FLASH_BLOCK_Q/K overrides: they must be "
+            "multiples of 128 that divide the sequence length."
+        )
     return block
 
 

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Periodically probe the TPU backend; record status to /tmp/tpu_status.txt.
+# Spaced retries: the observed outage pattern is hang-then-UNAVAILABLE, so
+# occasional probes over a long window can catch the backend coming back.
+while true; do
+  ts=$(date +%s)
+  out=$(timeout 120 python -c "
+import jax
+ds = jax.devices()
+print('OK', ds[0].platform, len(ds))
+" 2>&1 | tail -1)
+  echo "$ts $out" >> /tmp/tpu_status.txt
+  if echo "$out" | grep -q '^OK'; then
+    echo "$ts TPU_UP" >> /tmp/tpu_status.txt
+  fi
+  sleep 240
+done
