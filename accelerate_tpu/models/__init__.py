@@ -1,5 +1,7 @@
 from .bert import BertConfig, BertForSequenceClassification, BertModel
 from .gpt import GPTConfig, GPTLMHeadModel, PipelinedGPTLMHeadModel
+from .llama import LlamaConfig, LlamaForCausalLM
+from .opt import OPTConfig, OPTForCausalLM
 
 # name → zero-arg builder; used by `accelerate-tpu estimate-memory` and tests
 MODEL_REGISTRY = {
@@ -9,4 +11,9 @@ MODEL_REGISTRY = {
     "gpt-tiny": lambda: GPTLMHeadModel(GPTConfig.tiny()),
     "gpt-small": lambda: GPTLMHeadModel(GPTConfig.small()),
     "gpt-medium": lambda: GPTLMHeadModel(GPTConfig.medium()),
+    "llama-tiny": lambda: LlamaForCausalLM(LlamaConfig.tiny()),
+    "llama-7b": lambda: LlamaForCausalLM(LlamaConfig.llama2_7b()),
+    "opt-tiny": lambda: OPTForCausalLM(OPTConfig.tiny()),
+    "opt-125m": lambda: OPTForCausalLM(OPTConfig.opt_125m()),
+    "opt-6.7b": lambda: OPTForCausalLM(OPTConfig.opt_6_7b()),
 }

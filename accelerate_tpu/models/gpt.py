@@ -230,6 +230,59 @@ class GPTLMHeadModel(nn.Module):
 
         return generate(self, input_ids, max_new_tokens, temperature, rng)
 
+    def _decoder_spec(self):
+        """Hooks for the generic KV-cache engine (models/generation.py) —
+        the math is gpt_attn_in/gpt_attn_out, the same functions the
+        pipelined trunk trains with."""
+        from .generation import DecoderSpec
+
+        cfg = self.config
+        if cfg.n_experts > 0:
+            raise NotImplementedError(
+                "generate() supports dense GPT trunks; MoE routing does not stack"
+            )
+        return DecoderSpec(
+            family=GPT_DECODER,
+            cfg=_GPTDecodeCfg(
+                n_head=cfg.n_head,
+                n_kv_head=cfg.n_head,
+                head_dim=cfg.n_embd // cfg.n_head,
+                eps=cfg.layer_norm_eps,
+            ),
+            max_len=cfg.n_positions,
+            stack=self._stack_decoder_params,
+        )
+
+    def _stack_decoder_params(self) -> tuple[dict, dict]:
+        """(globals, per-layer stacks) raw-array pytrees for cached decode,
+        keyed like _StackedBlocks._ORDER so the pure block math reads both."""
+        blocks = list(self.h)
+
+        def stk(get):
+            return jnp.stack([get(b).data for b in blocks])
+
+        layers = {
+            "ln1_w": stk(lambda b: b.ln_1.weight),
+            "ln1_b": stk(lambda b: b.ln_1.bias),
+            "qkv_w": stk(lambda b: b.attn.c_attn.weight),
+            "qkv_b": stk(lambda b: b.attn.c_attn.bias),
+            "proj_w": stk(lambda b: b.attn.c_proj.weight),
+            "proj_b": stk(lambda b: b.attn.c_proj.bias),
+            "ln2_w": stk(lambda b: b.ln_2.weight),
+            "ln2_b": stk(lambda b: b.ln_2.bias),
+            "fc_w": stk(lambda b: b.mlp.c_fc.weight),
+            "fc_b": stk(lambda b: b.mlp.c_fc.bias),
+            "fcproj_w": stk(lambda b: b.mlp.c_proj.weight),
+            "fcproj_b": stk(lambda b: b.mlp.c_proj.bias),
+        }
+        g = {
+            "wte": self.wte.weight.data,
+            "wpe": self.wpe.weight.data,
+            "ln_f_w": self.ln_f.weight.data,
+            "ln_f_b": self.ln_f.bias.data,
+        }
+        return g, layers
+
     @property
     def num_flops_per_token(self) -> float:
         """Approximate training FLOPs/token (6N + attention term)."""
@@ -240,12 +293,81 @@ class GPTLMHeadModel(nn.Module):
 
 
 # ---------------------------------------------------------------------------
-# Pipelined variant: stacked per-layer params + GPipe over pp + ring over sp
+# Pure per-layer block math — the SINGLE source of truth shared by the
+# pipelined trunk (shard_map training) and KV-cache decode (generation.py).
+# Parameter keys follow _StackedBlocks._ORDER; weights are (out, in) like
+# nn.Linear, applied as ``x @ w.T``.
 # ---------------------------------------------------------------------------
 def _pure_layernorm(x, w, b, eps):
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return ((x - mu) * jax.lax.rsqrt(var + eps)) * w + b
+    # fp32 statistics regardless of activation dtype (bf16-safe), output
+    # cast back so the residual stream keeps its dtype
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return (((x32 - mu) * jax.lax.rsqrt(var + eps)) * w + b).astype(x.dtype)
+
+
+def gpt_attn_in(p, x, *, n_head: int, eps: float):
+    """LN1 + fused qkv projection, heads split: (b,s,c) → 3×(b,h,s,d)."""
+    b, s, c = x.shape
+    hd = c // n_head
+    h = _pure_layernorm(x, p["ln1_w"], p["ln1_b"], eps)
+    qkv = h @ p["qkv_w"].T + p["qkv_b"]
+    qkv = qkv.reshape(b, s, 3, n_head, hd).transpose(2, 0, 3, 1, 4)
+    return qkv[0], qkv[1], qkv[2]
+
+
+def gpt_attn_out(p, x, att, *, eps: float):
+    """Output projection + residual, then LN2 + gelu-MLP + residual.
+
+    ``att`` arrives in (b, h, s, d) head layout straight from whichever
+    attention engine ran (flash, ring, ulysses, or cached decode).
+    """
+    b, s, c = x.shape
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, c)
+    h = x + att @ p["proj_w"].T + p["proj_b"]
+    h2 = _pure_layernorm(h, p["ln2_w"], p["ln2_b"], eps)
+    ff = jax.nn.gelu(h2 @ p["fc_w"].T + p["fc_b"], approximate=True)
+    return h + ff @ p["fcproj_w"].T + p["fcproj_b"]
+
+
+@dataclasses.dataclass(frozen=True)
+class _GPTDecodeCfg:
+    n_head: int
+    n_kv_head: int
+    head_dim: int
+    eps: float
+
+
+def _dec_embed(g, ids, positions, cfg):
+    return g["wte"][ids] + g["wpe"][positions][None]
+
+
+def _dec_attn_in(l, x, positions, cfg):
+    return gpt_attn_in(l, x, n_head=cfg.n_head, eps=cfg.eps)
+
+
+def _dec_attn_out(l, x, att, cfg):
+    return gpt_attn_out(l, x, att, eps=cfg.eps)
+
+
+def _dec_finalize(g, x, cfg):
+    x = _pure_layernorm(x[:, -1], g["ln_f_w"], g["ln_f_b"], cfg.eps)
+    return x @ g["wte"].T  # weight-tied head
+
+
+def _make_gpt_decoder():
+    from .generation import DecoderFamily
+
+    return DecoderFamily(
+        embed=_dec_embed,
+        attn_in=_dec_attn_in,
+        attn_out=_dec_attn_out,
+        finalize=_dec_finalize,
+    )
+
+
+GPT_DECODER = _make_gpt_decoder()
 
 
 def _pipelined_block(p, h, *, n_head: int, eps: float, seq_axis: str, sp_mode: str = "ring"):
@@ -262,19 +384,10 @@ def _pipelined_block(p, h, *, n_head: int, eps: float, seq_axis: str, sp_mode: s
     local_attn = (
         _ulysses_attention_local if sp_mode == "all_to_all" else _ring_attention_local
     )
-    b, s, c = h.shape
-    hd = c // n_head
-    h1 = _pure_layernorm(h, p["ln1_w"], p["ln1_b"], eps)
-    qkv = h1 @ p["qkv_w"].T + p["qkv_b"]
-    qkv = qkv.reshape(b, s, 3, n_head, hd).transpose(2, 0, 3, 1, 4)
-    att = local_attn(
-        qkv[0], qkv[1], qkv[2], axis_name=seq_axis, is_causal=True, scale=hd**-0.5
-    )
-    att = att.transpose(0, 2, 1, 3).reshape(b, s, c)
-    h = h + att @ p["proj_w"].T + p["proj_b"]
-    h2 = _pure_layernorm(h, p["ln2_w"], p["ln2_b"], eps)
-    ff = jax.nn.gelu(h2 @ p["fc_w"].T + p["fc_b"], approximate=True)
-    return h + ff @ p["fcproj_w"].T + p["fcproj_b"]
+    hd = h.shape[-1] // n_head
+    q, k, v = gpt_attn_in(p, h, n_head=n_head, eps=eps)
+    att = local_attn(q, k, v, axis_name=seq_axis, is_causal=True, scale=hd**-0.5)
+    return gpt_attn_out(p, h, att, eps=eps)
 
 
 class _StackedBlocks(nn.Module):

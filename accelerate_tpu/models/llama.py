@@ -1,0 +1,334 @@
+"""Llama-family decoder: RMSNorm / rotary positions / SwiGLU / grouped-query
+attention — the BASELINE.json config-4 north-star family ("FSDP-wrapped
+Llama-2-7B", reference tests/fsdp + accelerator.py:1421 any-module prepare).
+
+TPU-first structure, one math implementation: every decoder layer's forward
+is ONE ``tape_op`` over the pure per-layer functions ``llama_attn_in`` /
+``llama_attn_out`` — the exact functions the KV-cache decode engine
+(models/generation.py) scans over — so training, sharded inference and
+generation cannot drift.  Module/parameter naming mirrors the HF layout
+(``layers.N.self_attn.q_proj.weight`` …) so checkpoint ingestion
+(utils/hf.py) and the torch bridge are near-identity key maps.
+
+GQA on TPU: k/v are computed with ``n_kv_head`` heads; for training's flash
+kernel they broadcast to the full head count (an O(S·d) repeat XLA folds
+into the attention fusion), while cached decode attends grouped directly
+(generation.cached_attention) so the cache stays at its n_kv_head size —
+the whole point of GQA at 7B scale (the 32→32 MHA cache for seq 4096 is
+2 GB/layer-group; GQA-8 cuts it 4×).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import Tensor
+from .gpt import lm_shift_loss
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000  # already a 128 multiple (250×128) — MXU-clean
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        # n_kv < n_head so every test exercises the GQA path
+        return cls(
+            vocab_size=1024, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=256,
+        )
+
+    @classmethod
+    def llama2_7b(cls) -> "LlamaConfig":
+        return cls()  # the defaults are Llama-2-7B
+
+    @classmethod
+    def llama2_7b_proxy(cls) -> "LlamaConfig":
+        """7B layer geometry at 8-layer depth — same per-layer math/sharding,
+        fits one v5e chip for bench/dryrun work."""
+        return cls(num_hidden_layers=8, max_position_embeddings=2048)
+
+
+# ---------------------------------------------------------------------------
+# Pure per-layer math — single source of truth for training AND decode.
+# Keys: ln1_w, q_w, k_w, v_w, o_w, ln2_w, gate_w, up_w, down_w
+# (weights (out, in) like nn.Linear, applied as  x @ w.T; no biases in Llama).
+# ---------------------------------------------------------------------------
+def _pure_rmsnorm(x, w, eps):
+    # HF order: fp32 variance, cast back to activation dtype, THEN scale
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return w * (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+
+
+def _rope_rotate(x, positions, theta):
+    """Rotate-half rotary embedding on (b, h, s, d), positions (s,) global.
+
+    HF convention (transformers LlamaRotaryEmbedding): fp32 angle tables,
+    ``emb = cat(freqs, freqs)``, ``x*cos + rotate_half(x)*sin``.
+    """
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = positions.astype(jnp.float32)[:, None] * inv[None, :]  # (s, d/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # (s, d)
+    cos = jnp.cos(emb).astype(x.dtype)[None, None]
+    sin = jnp.sin(emb).astype(x.dtype)[None, None]
+    x1, x2 = x[..., : d // 2], x[..., d // 2 :]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos + rotated * sin
+
+
+def llama_attn_in(l, x, positions, *, n_head: int, n_kv_head: int, eps: float, theta: float):
+    """RMSNorm + q/k/v projections + RoPE: (b,s,c) → q (b,H,s,d), k/v (b,Hkv,s,d)."""
+    b, s, c = x.shape
+    d = c // n_head
+    h = _pure_rmsnorm(x, l["ln1_w"], eps)
+
+    def heads(t, n):
+        return t.reshape(b, s, n, d).transpose(0, 2, 1, 3)
+
+    q = heads(h @ l["q_w"].T, n_head)
+    k = heads(h @ l["k_w"].T, n_kv_head)
+    v = heads(h @ l["v_w"].T, n_kv_head)
+    return _rope_rotate(q, positions, theta), _rope_rotate(k, positions, theta), v
+
+
+def llama_attn_out(l, x, att, *, eps: float):
+    """o_proj + residual, then RMSNorm + SwiGLU MLP + residual."""
+    b, s, c = x.shape
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, c)
+    h = x + att @ l["o_w"].T
+    h2 = _pure_rmsnorm(h, l["ln2_w"], eps)
+    ff = jax.nn.silu(h2 @ l["gate_w"].T) * (h2 @ l["up_w"].T)
+    return h + ff @ l["down_w"].T
+
+
+_LAYER_KEYS = ("ln1_w", "q_w", "k_w", "v_w", "o_w", "ln2_w", "gate_w", "up_w", "down_w")
+
+
+def _llama_block(l, x, positions, *, n_head, n_kv_head, eps, theta):
+    """Full-causal training block: the pure pair around flash attention."""
+    from ..ops.attention import sdpa_tpu
+
+    q, k, v = llama_attn_in(
+        l, x, positions, n_head=n_head, n_kv_head=n_kv_head, eps=eps, theta=theta
+    )
+    group = n_head // n_kv_head
+    if group > 1:  # flash kernel wants matched head counts
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    att = sdpa_tpu(q, k, v, is_causal=True)
+    return llama_attn_out(l, x, att, eps=eps)
+
+
+# ---------------------------------------------------------------------------
+# Modules (HF-shaped naming for key-mapped checkpoint load / torch bridge)
+# ---------------------------------------------------------------------------
+class LlamaAttention(nn.Module):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c, d = config.hidden_size, config.hidden_size // config.num_attention_heads
+        self.q_proj = nn.Linear(c, config.num_attention_heads * d, bias=False)
+        self.k_proj = nn.Linear(c, config.num_key_value_heads * d, bias=False)
+        self.v_proj = nn.Linear(c, config.num_key_value_heads * d, bias=False)
+        self.o_proj = nn.Linear(config.num_attention_heads * d, c, bias=False)
+
+
+class LlamaMLP(nn.Module):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c, i = config.hidden_size, config.intermediate_size
+        self.gate_proj = nn.Linear(c, i, bias=False)
+        self.up_proj = nn.Linear(c, i, bias=False)
+        self.down_proj = nn.Linear(i, c, bias=False)
+
+
+class LlamaDecoderLayer(nn.Module):
+    """Parameters live in HF-named submodules; forward is one tape_op over
+    the pure block math (llama_attn_in / llama_attn_out)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, eps=config.rms_norm_eps)
+        self.post_attention_layernorm = nn.RMSNorm(
+            config.hidden_size, eps=config.rms_norm_eps
+        )
+
+    def param_tensors(self):
+        a, m = self.self_attn, self.mlp
+        return [  # order == _LAYER_KEYS
+            self.input_layernorm.weight, a.q_proj.weight, a.k_proj.weight,
+            a.v_proj.weight, a.o_proj.weight, self.post_attention_layernorm.weight,
+            m.gate_proj.weight, m.up_proj.weight, m.down_proj.weight,
+        ]
+
+    def forward(self, x):
+        cfg = self.config
+        s = x.shape[1]
+        positions = jnp.arange(s)
+
+        def fn(xv, *flat):
+            l = dict(zip(_LAYER_KEYS, flat))
+            return _llama_block(
+                l, xv, positions,
+                n_head=cfg.num_attention_heads,
+                n_kv_head=cfg.num_key_value_heads,
+                eps=cfg.rms_norm_eps, theta=cfg.rope_theta,
+            )
+
+        return nn.tape_op(fn, x, *self.param_tensors())
+
+
+class LlamaForCausalLM(nn.Module):
+    _no_split_modules = ["LlamaDecoderLayer"]  # device_map: keep residuals intact
+    tp_plan = {
+        # Megatron layout: qkv/gate/up column-parallel, o/down row-parallel
+        r".*\.(q_proj|k_proj|v_proj|gate_proj|up_proj)\.weight": ("tp", None),
+        r".*\.(o_proj|down_proj)\.weight": (None, "tp"),
+        r"embed_tokens\.weight": ("tp", None),
+        r"lm_head\.weight": ("tp", None),
+    }
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.ModuleList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.norm = nn.RMSNorm(config.hidden_size, eps=config.rms_norm_eps)
+        from ..nn.meta import is_meta, meta_init
+
+        if config.tie_word_embeddings:
+            with meta_init():
+                self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias=False)
+            self.lm_head.weight = self.embed_tokens.weight
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias=False)
+        # HF init: N(0, initializer_range) for all matmul weights, ones for norms
+        from ..nn import random as nn_random
+
+        std = config.initializer_range
+        for name, p in self.named_parameters():
+            if is_meta(p.data) or p.ndim < 2:
+                continue
+            p.data = std * jax.random.normal(nn_random.next_key(), p.shape, p.dtype)
+
+    def forward(self, input_ids, labels=None):
+        from ..parallel.sharding import constrain_activation
+
+        ids = jnp.asarray(input_ids.data if isinstance(input_ids, Tensor) else input_ids)
+        x = self.embed_tokens(ids)
+        x = constrain_activation(x)
+        for layer in self.layers:
+            x = constrain_activation(layer(x))
+        x = self.norm(x)
+        logits = self.lm_head(x)
+        if labels is not None:
+            loss = lm_shift_loss(logits, labels, self.config.vocab_size)
+            return {"loss": loss, "logits": logits}
+        return {"logits": logits}
+
+    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0, rng=None):
+        from .generation import generate
+
+        return generate(self, input_ids, max_new_tokens, temperature, rng)
+
+    @property
+    def num_flops_per_token(self) -> float:
+        n = self.num_parameters
+        c = self.config
+        attn = 12 * c.num_hidden_layers * c.hidden_size * c.max_position_embeddings
+        return 6 * n + attn
+
+    # -- cached decode hooks (generic engine in models/generation.py) -------
+    def _decoder_spec(self):
+        from .generation import DecoderSpec
+
+        cfg = self.config
+        return DecoderSpec(
+            family=LLAMA_DECODER,
+            cfg=_LlamaDecodeCfg(
+                n_head=cfg.num_attention_heads,
+                n_kv_head=cfg.num_key_value_heads,
+                head_dim=cfg.hidden_size // cfg.num_attention_heads,
+                eps=cfg.rms_norm_eps,
+                theta=cfg.rope_theta,
+            ),
+            max_len=cfg.max_position_embeddings,
+            stack=self._stack_decoder_params,
+        )
+
+    def _stack_decoder_params(self) -> tuple[dict, dict]:
+        layer_stacks = [layer.param_tensors() for layer in self.layers]
+        layers = {
+            key: jnp.stack([ts[i].data for ts in layer_stacks])
+            for i, key in enumerate(_LAYER_KEYS)
+        }
+        g = {
+            "wte": self.embed_tokens.weight.data,
+            "norm_w": self.norm.weight.data,
+            "head_w": self.lm_head.weight.data,
+        }
+        return g, layers
+
+
+@dataclasses.dataclass(frozen=True)
+class _LlamaDecodeCfg:
+    n_head: int
+    n_kv_head: int
+    head_dim: int
+    eps: float
+    theta: float
+
+
+def _dec_embed(g, ids, positions, cfg):
+    return g["wte"][ids]
+
+
+def _dec_attn_in(l, x, positions, cfg):
+    return llama_attn_in(
+        l, x, positions,
+        n_head=cfg.n_head, n_kv_head=cfg.n_kv_head, eps=cfg.eps, theta=cfg.theta,
+    )
+
+
+def _dec_attn_out(l, x, att, cfg):
+    return llama_attn_out(l, x, att, eps=cfg.eps)
+
+
+def _dec_finalize(g, x, cfg):
+    x = _pure_rmsnorm(x[:, -1], g["norm_w"], cfg.eps)
+    return x @ g["head_w"].T
+
+
+def _make_llama_decoder():
+    from .generation import DecoderFamily
+
+    return DecoderFamily(
+        embed=_dec_embed,
+        attn_in=_dec_attn_in,
+        attn_out=_dec_attn_out,
+        finalize=_dec_finalize,
+    )
+
+
+LLAMA_DECODER = _make_llama_decoder()

@@ -1,0 +1,311 @@
+"""OPT-family decoder — the BASELINE.json config-5 north-star family
+("benchmarks/big_model_inference OPT-6.7B device_map='auto' sharded
+inference", reference benchmarks/big_model_inference/README.md:31-37).
+
+Pre-norm decoder with learned positions (HF's +2 offset), separate biased
+q/k/v/out projections, ReLU FFN and a weight-tied head.  Same one-math
+structure as models/llama.py: each layer's forward is a single ``tape_op``
+over the pure ``opt_attn_in`` / ``opt_attn_out`` pair that the KV-cache
+decode engine (models/generation.py) scans over.  Parameter naming mirrors
+the HF layout (``layers.N.self_attn.q_proj`` …) for key-mapped checkpoint
+ingestion (utils/hf.py) and the torch bridge.
+
+Only ``do_layer_norm_before=True`` geometry is supported (every OPT except
+350m; the 6.7B target is pre-norm), and ``word_embed_proj_dim`` must equal
+``hidden_size`` (true for 125m/1.3b/2.7b/6.7b/13b/30b/66b).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import Tensor
+from .gpt import _pure_layernorm, lm_shift_loss
+
+
+@dataclasses.dataclass
+class OPTConfig:
+    vocab_size: int = 50272  # HF value, kept unpadded (head is weight-tied;
+    # XLA pads the lone head matmul's N dim internally — measured immaterial
+    # next to the decode-loop gathers)
+    hidden_size: int = 4096
+    ffn_dim: int = 16384
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    do_layer_norm_before: bool = True
+
+    @classmethod
+    def tiny(cls) -> "OPTConfig":
+        return cls(
+            vocab_size=1024, hidden_size=128, ffn_dim=256,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=256,
+        )
+
+    @classmethod
+    def opt_125m(cls) -> "OPTConfig":
+        return cls(hidden_size=768, ffn_dim=3072, num_hidden_layers=12,
+                   num_attention_heads=12)
+
+    @classmethod
+    def opt_1_3b(cls) -> "OPTConfig":
+        return cls(hidden_size=2048, ffn_dim=8192, num_hidden_layers=24,
+                   num_attention_heads=32)
+
+    @classmethod
+    def opt_6_7b(cls) -> "OPTConfig":
+        return cls()  # the defaults are OPT-6.7B
+
+    def __post_init__(self):
+        if not self.do_layer_norm_before:
+            raise NotImplementedError(
+                "OPT post-norm geometry (do_layer_norm_before=False, i.e. "
+                "opt-350m) is not supported; every other OPT size is pre-norm"
+            )
+
+
+# HF OPTLearnedPositionalEmbedding reserves 2 rows (legacy padding offset):
+# table has max_positions + 2 rows, position p reads row p + 2
+_POS_OFFSET = 2
+
+# ---------------------------------------------------------------------------
+# Pure per-layer math — single source of truth for training AND decode.
+# Keys: ln1_{w,b}, {q,k,v,o}_{w,b}, ln2_{w,b}, fc1_{w,b}, fc2_{w,b}
+# ---------------------------------------------------------------------------
+_LAYER_KEYS = (
+    "ln1_w", "ln1_b", "q_w", "q_b", "k_w", "k_b", "v_w", "v_b",
+    "o_w", "o_b", "ln2_w", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b",
+)
+
+
+def opt_attn_in(l, x, positions, *, n_head: int, eps: float):
+    """Pre-norm LN + separate biased q/k/v projections, heads split."""
+    b, s, c = x.shape
+    d = c // n_head
+    h = _pure_layernorm(x, l["ln1_w"], l["ln1_b"], eps)
+
+    def heads(t):
+        return t.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
+
+    q = heads(h @ l["q_w"].T + l["q_b"])
+    k = heads(h @ l["k_w"].T + l["k_b"])
+    v = heads(h @ l["v_w"].T + l["v_b"])
+    return q, k, v
+
+
+def opt_attn_out(l, x, att, *, eps: float):
+    """out_proj + residual, then LN + ReLU FFN + residual."""
+    b, s, c = x.shape
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, c)
+    h = x + att @ l["o_w"].T + l["o_b"]
+    h2 = _pure_layernorm(h, l["ln2_w"], l["ln2_b"], eps)
+    ff = jnp.maximum(h2 @ l["fc1_w"].T + l["fc1_b"], 0.0)
+    return h + ff @ l["fc2_w"].T + l["fc2_b"]
+
+
+def _opt_block(l, x, positions, *, n_head, eps):
+    from ..ops.attention import sdpa_tpu
+
+    q, k, v = opt_attn_in(l, x, positions, n_head=n_head, eps=eps)
+    att = sdpa_tpu(q, k, v, is_causal=True)
+    return opt_attn_out(l, x, att, eps=eps)
+
+
+# ---------------------------------------------------------------------------
+# Modules
+# ---------------------------------------------------------------------------
+class OPTAttention(nn.Module):
+    def __init__(self, config: OPTConfig):
+        super().__init__()
+        c = config.hidden_size
+        self.q_proj = nn.Linear(c, c)
+        self.k_proj = nn.Linear(c, c)
+        self.v_proj = nn.Linear(c, c)
+        self.out_proj = nn.Linear(c, c)
+
+
+class OPTDecoderLayer(nn.Module):
+    def __init__(self, config: OPTConfig):
+        super().__init__()
+        self.config = config
+        self.self_attn = OPTAttention(config)
+        self.self_attn_layer_norm = nn.LayerNorm(
+            config.hidden_size, eps=config.layer_norm_eps
+        )
+        self.fc1 = nn.Linear(config.hidden_size, config.ffn_dim)
+        self.fc2 = nn.Linear(config.ffn_dim, config.hidden_size)
+        self.final_layer_norm = nn.LayerNorm(
+            config.hidden_size, eps=config.layer_norm_eps
+        )
+
+    def param_tensors(self):
+        a = self.self_attn
+        return [  # order == _LAYER_KEYS
+            self.self_attn_layer_norm.weight, self.self_attn_layer_norm.bias,
+            a.q_proj.weight, a.q_proj.bias, a.k_proj.weight, a.k_proj.bias,
+            a.v_proj.weight, a.v_proj.bias, a.out_proj.weight, a.out_proj.bias,
+            self.final_layer_norm.weight, self.final_layer_norm.bias,
+            self.fc1.weight, self.fc1.bias, self.fc2.weight, self.fc2.bias,
+        ]
+
+    def forward(self, x):
+        cfg = self.config
+        positions = jnp.arange(x.shape[1])
+
+        def fn(xv, *flat):
+            l = dict(zip(_LAYER_KEYS, flat))
+            return _opt_block(
+                l, xv, positions,
+                n_head=cfg.num_attention_heads, eps=cfg.layer_norm_eps,
+            )
+
+        return nn.tape_op(fn, x, *self.param_tensors())
+
+
+class OPTForCausalLM(nn.Module):
+    _no_split_modules = ["OPTDecoderLayer"]
+    tp_plan = {
+        r".*\.(q_proj|k_proj|v_proj)\.weight": ("tp", None),
+        r".*\.(q_proj|k_proj|v_proj)\.bias": ("tp",),
+        r".*\.out_proj\.weight": (None, "tp"),
+        r".*\.fc1\.weight": ("tp", None),
+        r".*\.fc1\.bias": ("tp",),
+        r".*\.fc2\.weight": (None, "tp"),
+        r"embed_tokens\.weight": ("tp", None),
+    }
+
+    def __init__(self, config: OPTConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.embed_positions = nn.Embedding(
+            config.max_position_embeddings + _POS_OFFSET, config.hidden_size
+        )
+        self.layers = nn.ModuleList(
+            [OPTDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.final_layer_norm = nn.LayerNorm(
+            config.hidden_size, eps=config.layer_norm_eps
+        )
+        from ..nn.meta import is_meta, meta_init
+
+        with meta_init():  # weight-tied head (OPT ties like GPT-2)
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias=False)
+        self.lm_head.weight = self.embed_tokens.weight
+        from ..nn import random as nn_random
+
+        import jax as _jax
+
+        std = config.initializer_range
+        for name, p in self.named_parameters():
+            if is_meta(p.data):
+                continue
+            if p.ndim >= 2:
+                p.data = std * _jax.random.normal(nn_random.next_key(), p.shape, p.dtype)
+            elif name.endswith("bias"):
+                p.data = jnp.zeros_like(p.data)
+
+    def forward(self, input_ids, labels=None):
+        from ..parallel.sharding import constrain_activation
+
+        ids = jnp.asarray(input_ids.data if isinstance(input_ids, Tensor) else input_ids)
+        s = ids.shape[1]
+        pos = jnp.arange(s)[None, :] + _POS_OFFSET
+        x = self.embed_tokens(ids) + self.embed_positions(pos)
+        x = constrain_activation(x)
+        for layer in self.layers:
+            x = constrain_activation(layer(x))
+        x = self.final_layer_norm(x)
+        logits = self.lm_head(x)
+        if labels is not None:
+            loss = lm_shift_loss(logits, labels, self.config.vocab_size)
+            return {"loss": loss, "logits": logits}
+        return {"logits": logits}
+
+    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0, rng=None):
+        from .generation import generate
+
+        return generate(self, input_ids, max_new_tokens, temperature, rng)
+
+    @property
+    def num_flops_per_token(self) -> float:
+        n = self.num_parameters
+        c = self.config
+        attn = 12 * c.num_hidden_layers * c.hidden_size * c.max_position_embeddings
+        return 6 * n + attn
+
+    # -- cached decode hooks -------------------------------------------------
+    def _decoder_spec(self):
+        from .generation import DecoderSpec
+
+        cfg = self.config
+        return DecoderSpec(
+            family=OPT_DECODER,
+            cfg=_OPTDecodeCfg(
+                n_head=cfg.num_attention_heads,
+                n_kv_head=cfg.num_attention_heads,
+                head_dim=cfg.hidden_size // cfg.num_attention_heads,
+                eps=cfg.layer_norm_eps,
+            ),
+            max_len=cfg.max_position_embeddings,
+            stack=self._stack_decoder_params,
+        )
+
+    def _stack_decoder_params(self) -> tuple[dict, dict]:
+        layer_stacks = [layer.param_tensors() for layer in self.layers]
+        layers = {
+            key: jnp.stack([ts[i].data for ts in layer_stacks])
+            for i, key in enumerate(_LAYER_KEYS)
+        }
+        g = {
+            "wte": self.embed_tokens.weight.data,
+            "wpe": self.embed_positions.weight.data,
+            "ln_f_w": self.final_layer_norm.weight.data,
+            "ln_f_b": self.final_layer_norm.bias.data,
+        }
+        return g, layers
+
+
+@dataclasses.dataclass(frozen=True)
+class _OPTDecodeCfg:
+    n_head: int
+    n_kv_head: int
+    head_dim: int
+    eps: float
+
+
+def _dec_embed(g, ids, positions, cfg):
+    return g["wte"][ids] + g["wpe"][positions + _POS_OFFSET][None]
+
+
+def _dec_attn_in(l, x, positions, cfg):
+    return opt_attn_in(l, x, positions, n_head=cfg.n_head, eps=cfg.eps)
+
+
+def _dec_attn_out(l, x, att, cfg):
+    return opt_attn_out(l, x, att, eps=cfg.eps)
+
+
+def _dec_finalize(g, x, cfg):
+    x = _pure_layernorm(x[:, -1], g["ln_f_w"], g["ln_f_b"], cfg.eps)
+    return x @ g["wte"].T  # weight-tied head
+
+
+def _make_opt_decoder():
+    from .generation import DecoderFamily
+
+    return DecoderFamily(
+        embed=_dec_embed,
+        attn_in=_dec_attn_in,
+        attn_out=_dec_attn_out,
+        finalize=_dec_finalize,
+    )
+
+
+OPT_DECODER = _make_opt_decoder()

@@ -123,6 +123,33 @@ def map_gpt2_key(hf_key: str) -> Optional[tuple[str, bool]]:
     return key, bool(_GPT2_TRANSPOSE.search(key))
 
 
+def map_llama_key(hf_key: str) -> Optional[str]:
+    """HF LlamaForCausalLM key → models/llama.py key.
+
+    Our modules are HF-named on purpose (models/llama.py docstring) so the
+    map is just the ``model.`` prefix strip; rotary tables are computed, not
+    stored, so ``rotary_emb.inv_freq`` buffers are skipped.
+    """
+    if "rotary_emb" in hf_key:
+        return None
+    key = hf_key
+    if key.startswith("model."):
+        key = key[len("model."):]
+    return key
+
+
+def map_opt_key(hf_key: str) -> Optional[str]:
+    """HF OPTForCausalLM key → models/opt.py key (prefix strip + tied head)."""
+    if hf_key == "lm_head.weight":
+        return None  # weight-tied to embed_tokens
+    key = hf_key
+    for prefix in ("model.decoder.", "decoder.", "model."):
+        if key.startswith(prefix):
+            key = key[len(prefix):]
+            break
+    return key
+
+
 # ---------------------------------------------------------------------------
 # generic application
 # ---------------------------------------------------------------------------
@@ -215,6 +242,51 @@ def gpt2_config_from_hf(cfg: dict):
     )
 
 
+def llama_config_from_hf(cfg: dict):
+    from ..models.llama import LlamaConfig
+
+    # refuse configs whose math we would silently get wrong: Llama-3.1+
+    # rope scaling changes the rotary frequencies, attention_bias adds
+    # projections our layer math does not carry
+    if cfg.get("rope_scaling"):
+        raise NotImplementedError(
+            f"rope_scaling={cfg['rope_scaling']!r} is not supported; only "
+            "plain-theta rotary embeddings (Llama-1/2 geometry) are implemented"
+        )
+    if cfg.get("attention_bias"):
+        raise NotImplementedError(
+            "attention_bias=True Llama variants are not supported "
+            "(q/k/v/o projections are bias-free in models/llama.py)"
+        )
+    heads = cfg.get("num_attention_heads", 32)
+    return LlamaConfig(
+        vocab_size=cfg.get("vocab_size", 32000),
+        hidden_size=cfg.get("hidden_size", 4096),
+        intermediate_size=cfg.get("intermediate_size", 11008),
+        num_hidden_layers=cfg.get("num_hidden_layers", 32),
+        num_attention_heads=heads,
+        num_key_value_heads=cfg.get("num_key_value_heads") or heads,
+        max_position_embeddings=cfg.get("max_position_embeddings", 4096),
+        rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+        rope_theta=cfg.get("rope_theta", 10000.0),
+        tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+    )
+
+
+def opt_config_from_hf(cfg: dict):
+    from ..models.opt import OPTConfig
+
+    return OPTConfig(
+        vocab_size=cfg.get("vocab_size", 50272),
+        hidden_size=cfg.get("hidden_size", 4096),
+        ffn_dim=cfg.get("ffn_dim", 16384),
+        num_hidden_layers=cfg.get("num_hidden_layers", 32),
+        num_attention_heads=cfg.get("num_attention_heads", 32),
+        max_position_embeddings=cfg.get("max_position_embeddings", 2048),
+        do_layer_norm_before=cfg.get("do_layer_norm_before", True),
+    )
+
+
 def from_pretrained(path: str, architecture: Optional[str] = None, num_labels: int = 2):
     """Build + load a native model from a local HF checkpoint directory.
 
@@ -228,9 +300,14 @@ def from_pretrained(path: str, architecture: Optional[str] = None, num_labels: i
             architecture = "bert"
         elif model_type == "gpt2" or "GPT2" in archs:
             architecture = "gpt2"
+        elif model_type == "llama" or "Llama" in archs:
+            architecture = "llama"
+        elif model_type == "opt" or "OPT" in archs:
+            architecture = "opt"
         else:
             raise ValueError(
-                f"cannot infer architecture from {path}; pass architecture='bert'|'gpt2'"
+                f"cannot infer architecture from {path}; pass "
+                "architecture='bert'|'gpt2'|'llama'|'opt'"
             )
     state = load_hf_state_dict(path)
     if architecture == "bert":
@@ -254,5 +331,24 @@ def from_pretrained(path: str, architecture: Optional[str] = None, num_labels: i
         missing = [m for m in missing if "lm_head" not in m]
         if missing:
             raise ValueError(f"GPT-2 load left weights uninitialised: {missing[:8]}")
+        return model
+    if architecture == "llama":
+        from ..models.llama import LlamaForCausalLM
+
+        model = LlamaForCausalLM(llama_config_from_hf(cfg))
+        missing, _ = load_mapped_state_dict(model, state, map_llama_key)
+        if model.config.tie_word_embeddings:
+            missing = [m for m in missing if "lm_head" not in m]
+        if missing:
+            raise ValueError(f"Llama load left weights uninitialised: {missing[:8]}")
+        return model
+    if architecture == "opt":
+        from ..models.opt import OPTForCausalLM
+
+        model = OPTForCausalLM(opt_config_from_hf(cfg))
+        missing, _ = load_mapped_state_dict(model, state, map_opt_key)
+        missing = [m for m in missing if "lm_head" not in m]
+        if missing:
+            raise ValueError(f"OPT load left weights uninitialised: {missing[:8]}")
         return model
     raise ValueError(f"unsupported architecture {architecture!r}")

@@ -5,11 +5,11 @@ accelerator.py:1421).  A JAX rebuild cannot run arbitrary torch forwards, but
 the two cases that cover the reference's own test/bench surface convert
 exactly:
 
-1. **Known transformers architectures** (BertForSequenceClassification /
-   BertModel / GPT2LMHeadModel): rebuilt as the native ``models/`` classes
-   with the torch state dict name-mapped in (``utils/hf.py``) — the native
-   forward reproduces the HF forward (parity-tested in
-   tests/test_torch_bridge.py).
+1. **Known transformers architectures** (Bert* / GPT2* / Llama* / OPT*):
+   rebuilt as the native ``models/`` classes with the torch state dict
+   name-mapped in (``utils/hf.py``) — the native forward reproduces the HF
+   forward (parity-tested in tests/test_torch_bridge.py, tests/test_llama.py,
+   tests/test_opt.py).
 2. **Structural containers** (``torch.nn.Sequential`` of standard layers —
    Linear/Embedding/LayerNorm/Dropout/activations): converted layer-by-layer;
    the container's semantics ARE its structure, so conversion is exact.
@@ -104,9 +104,13 @@ def _convert_transformers(tm):
     from .hf import (
         bert_config_from_hf,
         gpt2_config_from_hf,
+        llama_config_from_hf,
         load_mapped_state_dict,
         map_bert_key,
         map_gpt2_key,
+        map_llama_key,
+        map_opt_key,
+        opt_config_from_hf,
     )
 
     cls_name = type(tm).__name__
@@ -130,6 +134,30 @@ def _convert_transformers(tm):
         model = GPTLMHeadModel(gcfg)
         load_mapped_state_dict(model, state, map_gpt2_key, pad_vocab_to=gcfg.vocab_size)
         return model
+    if cls_name in ("LlamaForCausalLM", "LlamaModel"):
+        from ..models.llama import LlamaForCausalLM
+
+        model = LlamaForCausalLM(llama_config_from_hf(cfg))
+        missing, _ = load_mapped_state_dict(model, state, map_llama_key)
+        if model.config.tie_word_embeddings:
+            missing = [m for m in missing if "lm_head" not in m]
+        if missing:
+            # a bare LlamaModel has no (untied) lm_head: converting it would
+            # silently leave a randomly-initialised head producing garbage
+            raise ValueError(
+                f"Llama conversion left weights uninitialised: {missing[:4]} — "
+                "pass a LlamaForCausalLM (the bare LlamaModel carries no LM head)"
+            )
+        return model
+    if cls_name in ("OPTForCausalLM", "OPTModel"):
+        from ..models.opt import OPTForCausalLM
+
+        model = OPTForCausalLM(opt_config_from_hf(cfg))
+        missing, _ = load_mapped_state_dict(model, state, map_opt_key)
+        missing = [m for m in missing if "lm_head" not in m]  # tied to wte
+        if missing:
+            raise ValueError(f"OPT conversion left weights uninitialised: {missing[:4]}")
+        return model
     return None
 
 
@@ -142,9 +170,9 @@ def convert_torch_module(tm):
         raise TypeError(
             f"cannot convert {type(tm).__name__}: arbitrary torch forwards "
             "don't translate to XLA. Either (a) use a supported architecture "
-            "(transformers Bert*/GPT2*, or Sequential of standard layers), "
-            "(b) rewrite the model against accelerate_tpu.nn (torch-shaped "
-            "API), or (c) load its checkpoint via "
+            "(transformers Bert*/GPT2*/Llama*/OPT*, or Sequential of standard "
+            "layers), (b) rewrite the model against accelerate_tpu.nn "
+            "(torch-shaped API), or (c) load its checkpoint via "
             "accelerate_tpu.utils.hf.from_pretrained."
         )
     if tm.training:

@@ -4,14 +4,18 @@
 # occasional probes over a long window can catch the backend coming back.
 while true; do
   ts=$(date +%s)
-  out=$(timeout 120 python -c "
+  full=$(timeout 120 python -c "
 import jax
 ds = jax.devices()
-print('OK', ds[0].platform, len(ds))
-" 2>&1 | tail -1)
-  echo "$ts $out" >> /tmp/tpu_status.txt
-  if echo "$out" | grep -q '^OK'; then
-    echo "$ts TPU_UP" >> /tmp/tpu_status.txt
+print('PROBE_OK', ds[0].platform, len(ds))
+" 2>&1)
+  ok=$(echo "$full" | grep PROBE_OK | tail -1)
+  if [ -n "$ok" ]; then
+    echo "$ts TPU_UP $ok" >> /tmp/tpu_status.txt
+  else
+    # keep the failure detail: hang (timeout kill, empty tail) vs UNAVAILABLE
+    # etc. is the distinction worth recording
+    echo "$ts DOWN $(echo "$full" | grep -v Warning | tail -1 | cut -c1-200)" >> /tmp/tpu_status.txt
   fi
   sleep 240
 done
