@@ -593,7 +593,13 @@ class Accelerator:
             )
         self._custom_objects.extend(objects)
 
-    def save_state(self, output_dir: Optional[str] = None, safe_serialization: bool = True, **kwargs) -> str:
+    def save_state(
+        self,
+        output_dir: Optional[str] = None,
+        safe_serialization: bool = True,
+        sharded_state: Optional[bool] = None,
+        **kwargs,
+    ) -> str:
         from .checkpointing import save_accelerator_state
 
         if self.project_configuration.automatic_checkpoint_naming:
@@ -615,6 +621,15 @@ class Accelerator:
         if output_dir is None:
             raise ValueError("save_state needs output_dir (or automatic_checkpoint_naming)")
         os.makedirs(output_dir, exist_ok=True)
+        if sharded_state is None:
+            # default: shard the checkpoint exactly when the state is sharded
+            # (fsdp axis populated) and the plugin doesn't demand FULL —
+            # reference FSDP state_dict_type semantics (fsdp_utils.py:66)
+            plugin = getattr(self.state, "fsdp_plugin", None)
+            fsdp_axis = dict(self.mesh.shape).get("fsdp", 1) if self.mesh else 1
+            sharded_state = fsdp_axis > 1 and (
+                plugin is None or plugin.state_dict_type == "SHARDED_STATE_DICT"
+            )
         save_accelerator_state(
             output_dir,
             models=self._models,
@@ -625,6 +640,7 @@ class Accelerator:
             step=self.step,
             scaler=self.scaler,
             safe_serialization=safe_serialization,
+            sharded_state=sharded_state,
         )
         return output_dir
 

@@ -144,24 +144,69 @@ def save_accelerator_state(
     step: int = 0,
     scaler=None,
     safe_serialization: bool = True,
+    sharded_state: bool = False,
 ) -> str:
-    """Reference save_accelerator_state checkpointing.py:57."""
+    """Reference save_accelerator_state checkpointing.py:57.
+
+    ``sharded_state=True`` writes model weights AND optimizer state as
+    per-host GSPMD shard files (utils/fsdp_utils.py) instead of gathering
+    full arrays to every host — no full-model materialisation, O(shard)
+    host memory, N→M resharded restore.  Counterpart of the reference's
+    FSDP SHARDED_STATE_DICT path incl. the optimizer
+    (fsdp_utils.py:66-246, save_fsdp_optimizer :175).
+    """
     state = PartialState()
     os.makedirs(output_dir, exist_ok=True)
+
+    # A reused checkpoint directory may hold artifacts from a PREVIOUS save
+    # with a different world size or sharded-ness: the loader globs every
+    # {name}.shard-* file and prefers an index.json, so stale files would be
+    # silently mixed into (or preferred over) the new state.  Main process
+    # clears conflicting artifacts for every name we are about to write,
+    # then everyone synchronises before writing.
+    import glob as _glob
+
+    if state.is_main_process:
+        names = [MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}" for i in range(len(models))]
+        names += [OPTIMIZER_NAME if i == 0 else f"{OPTIMIZER_NAME}_{i}" for i in range(len(optimizers))]
+        for name in names:
+            stale = _glob.glob(os.path.join(output_dir, f"{name}.shard-*.safetensors"))
+            stale += [
+                os.path.join(output_dir, f)
+                for f in (f"{name}.index.json", f"{name}.safetensors", f"{name}.npz", f"{name}.bin", f"{name}.meta.bin")
+            ]
+            for path in stale:
+                if os.path.exists(path):
+                    os.remove(path)
+    state.wait_for_everyone()
 
     # Payload assembly may involve cross-host allgathers of sharded arrays,
     # so EVERY process must execute it (collectives deadlock otherwise); only
     # the file writes are gated on the main process.
     payloads: list[tuple[str, Any, str]] = []  # (filename, payload, kind)
-    for i, model in enumerate(models):
-        name = MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}"
-        arrays = {k: _gather_numpy(v) for k, v in model.state_dict().items()}
-        payloads.append((name, arrays, "weights"))
-    for i, opt in enumerate(optimizers):
-        name = f"{OPTIMIZER_NAME}.bin" if i == 0 else f"{OPTIMIZER_NAME}_{i}.bin"
-        payloads.append(
-            (name, jax.tree_util.tree_map(_maybe_numpy, opt.state_dict()), "pickle")
-        )
+    if sharded_state:
+        from .utils.fsdp_utils import save_sharded_model_state
+
+        # every process writes its own shard files — NOT rank-gated
+        for i, model in enumerate(models):
+            name = MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}"
+            save_sharded_model_state(model.state_dict(), output_dir, name=name)
+        for i, opt in enumerate(optimizers):
+            inner = opt.optimizer if hasattr(opt, "optimizer") else opt
+            oname = OPTIMIZER_NAME if i == 0 else f"{OPTIMIZER_NAME}_{i}"
+            arrays, meta = inner.sharded_state_arrays()
+            save_sharded_model_state(arrays, output_dir, name=oname)
+            payloads.append((f"{oname}.meta.bin", meta, "pickle"))
+    else:
+        for i, model in enumerate(models):
+            name = MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}"
+            arrays = {k: _gather_numpy(v) for k, v in model.state_dict().items()}
+            payloads.append((name, arrays, "weights"))
+        for i, opt in enumerate(optimizers):
+            name = f"{OPTIMIZER_NAME}.bin" if i == 0 else f"{OPTIMIZER_NAME}_{i}.bin"
+            payloads.append(
+                (name, jax.tree_util.tree_map(_maybe_numpy, opt.state_dict()), "pickle")
+            )
     for i, sched in enumerate(schedulers):
         name = f"{SCHEDULER_NAME}.bin" if i == 0 else f"{SCHEDULER_NAME}_{i}.bin"
         payloads.append((name, sched.state_dict(), "pickle"))
@@ -212,8 +257,17 @@ def load_accelerator_state(
     if not os.path.isdir(input_dir):
         raise FileNotFoundError(f"checkpoint dir {input_dir} does not exist")
 
+    from .utils.fsdp_utils import load_sharded_resharded, sharded_index_path
+
     for i, model in enumerate(models):
         name = MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}"
+        if os.path.exists(sharded_index_path(input_dir, name)):
+            # sharded checkpoint: assemble only this host's blocks, on the
+            # CURRENT layout (N→M resharding is free — bounds are global)
+            targets = model.state_dict()
+            loaded = load_sharded_resharded(targets, input_dir, name=name)
+            model.load_state_dict(loaded)
+            continue
         weights = load_model_weights(input_dir, name=name)
         prior_shardings = {
             n: (p.data.sharding if isinstance(p.data, jax.Array) else None)
@@ -226,6 +280,16 @@ def load_accelerator_state(
             if sharding is not None:
                 p.data = jax.device_put(p.data, sharding)
     for i, opt in enumerate(optimizers):
+        oname = OPTIMIZER_NAME if i == 0 else f"{OPTIMIZER_NAME}_{i}"
+        if os.path.exists(sharded_index_path(input_dir, oname)):
+            inner = opt.optimizer if hasattr(opt, "optimizer") else opt
+            with open(os.path.join(input_dir, f"{oname}.meta.bin"), "rb") as f:
+                meta = pickle.load(f)
+            arrays = load_sharded_resharded(
+                inner.sharded_state_targets(), input_dir, name=oname
+            )
+            inner.load_sharded_state_arrays(arrays, meta)
+            continue
         name = f"{OPTIMIZER_NAME}.bin" if i == 0 else f"{OPTIMIZER_NAME}_{i}.bin"
         with open(os.path.join(input_dir, name), "rb") as f:
             opt.load_state_dict(pickle.load(f))

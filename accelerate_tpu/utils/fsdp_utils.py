@@ -35,6 +35,7 @@ from .constants import MODEL_NAME
 __all__ = [
     "save_sharded_model_state",
     "load_sharded_model_state",
+    "load_sharded_resharded",
     "merge_sharded_weights",
     "sharded_index_path",
 ]
@@ -242,6 +243,165 @@ def merge_sharded_weights(
     else:
         np.savez(output_path, **merged)
     return output_path
+
+
+# diagnostics written by load_sharded_resharded: {"max_block_bytes": int,
+# "tensors": {name: (max_block_bytes, full_bytes, n_unique_blocks)}} — lets
+# tests (and operators) verify the loader never materialised a full tensor
+load_stats: dict = {}
+
+
+def _intersect(a: tuple, b: tuple):
+    """Intersection of two bounds lists [(start, stop), ...], or None."""
+    out = []
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return out
+
+
+def _scan_shard_entries(directory: str, name: str) -> dict[str, list]:
+    """tensor → [(bounds, file, key)] across every shard file, WITHOUT
+    loading any tensor data (safetensors header scan only)."""
+    from safetensors import safe_open
+
+    entries: dict[str, list] = {}
+    found = False
+    for fname in sorted(os.listdir(directory)):
+        if fname.startswith(f"{name}.shard-") and fname.endswith(".safetensors"):
+            found = True
+            path = os.path.join(directory, fname)
+            with safe_open(path, framework="numpy") as f:
+                for key in f.keys():
+                    tensor_name, bounds = _parse_slice_key(key)
+                    entries.setdefault(tensor_name, []).append((bounds, path, key))
+    if not found:
+        raise FileNotFoundError(f"no {name}.shard-*.safetensors files under {directory}")
+    return entries
+
+
+def load_sharded_resharded(
+    targets: dict[str, Any], input_dir: str, name: str = MODEL_NAME
+) -> dict[str, Any]:
+    """Restore a sharded checkpoint onto the CURRENT mesh layout, N→M safe.
+
+    ``targets`` maps tensor name → a live ``jax.Array`` template whose
+    sharding/dtype describe where the restored tensor must land (typically
+    ``model.state_dict()`` of the freshly-prepared model).  For every tensor
+    the loader assembles only the blocks THIS process's devices own, range-
+    reading the stored slices via safetensors lazy slicing — per-host peak
+    memory is O(local shard bytes), never O(full tensor), which is the whole
+    point of sharded checkpoints at 7B+ scale (reference saves per-rank
+    ``__{rank}_0.distcp`` for the same reason, fsdp_utils.py:66-246).
+
+    The stored slice bounds are GLOBAL coordinates, so the checkpoint's
+    process count / mesh shape is irrelevant: saving on fsdp=8 and restoring
+    on fsdp=4 (or tp×fsdp, or replicated) reads whichever stored pieces
+    intersect each new local block.
+    """
+    import jax
+    from safetensors import safe_open
+
+    index_file = sharded_index_path(input_dir, name)
+    if not os.path.exists(index_file):
+        raise FileNotFoundError(
+            f"{index_file} not found — not a sharded checkpoint directory"
+        )
+    with open(index_file) as f:
+        index = json.load(f)
+    entries = _scan_shard_entries(input_dir, name)
+
+    handles: dict[str, Any] = {}
+
+    def handle(path):
+        if path not in handles:
+            handles[path] = safe_open(path, framework="numpy")
+        return handles[path]
+
+    out: dict[str, Any] = {}
+    load_stats.setdefault("max_block_bytes", 0)
+    load_stats.setdefault("tensors", {})
+    try:
+        for tensor_name, template in targets.items():
+            entry = index["tensors"].get(tensor_name)
+            if entry is None:
+                raise KeyError(f"tensor {tensor_name!r} not in checkpoint index")
+            shape = tuple(entry["shape"])
+            if shape != tuple(template.shape):
+                raise ValueError(
+                    f"shape mismatch for {tensor_name!r}: checkpoint {shape} vs "
+                    f"target {tuple(template.shape)} (resharding cannot change shapes)"
+                )
+            pieces = entries.get(tensor_name)
+            if not pieces:
+                raise ValueError(f"no shards found for tensor {tensor_name!r}")
+            stored_dtype = entry["dtype"]
+            sharding = template.sharding
+            dev_indices = sharding.addressable_devices_indices_map(shape)
+            block_cache: dict[tuple, np.ndarray] = {}
+            device_arrays = []
+            for device, idx in dev_indices.items():
+                bounds = tuple(
+                    (int(s.start or 0), int(s.stop if s.stop is not None else dim))
+                    for s, dim in zip(idx, shape)
+                ) if idx is not None else tuple((0, int(d)) for d in shape)
+                if bounds not in block_cache:
+                    block_shape = [b - a for a, b in bounds]
+                    np_dtype = (
+                        np.dtype(np.uint16)
+                        if stored_dtype == "bfloat16"
+                        else np.dtype(stored_dtype)
+                    )
+                    block = np.zeros(block_shape, dtype=np_dtype)
+                    covered = np.zeros(block_shape, dtype=bool) if block_shape else None
+                    for piece_bounds, path, key in pieces:
+                        if not piece_bounds:  # scalar entry
+                            block[...] = handle(path).get_tensor(key)
+                            covered = None
+                            continue
+                        inter = _intersect(bounds, tuple(piece_bounds))
+                        if inter is None:
+                            continue
+                        src = tuple(
+                            slice(lo - p0, hi - p0)
+                            for (lo, hi), (p0, _) in zip(inter, piece_bounds)
+                        )
+                        dst = tuple(
+                            slice(lo - b0, hi - b0)
+                            for (lo, hi), (b0, _) in zip(inter, bounds)
+                        )
+                        block[dst] = handle(path).get_slice(key)[src]
+                        if covered is not None:
+                            covered[dst] = True
+                    if covered is not None and not covered.all():
+                        raise ValueError(
+                            f"tensor {tensor_name!r}: local block {bounds} has "
+                            "uncovered regions — incomplete checkpoint (were all "
+                            "hosts' shard files copied to shared storage?)"
+                        )
+                    block_cache[bounds] = _maybe_bf16_from_view(block, stored_dtype)
+                device_arrays.append(
+                    jax.device_put(block_cache[bounds], device)
+                )
+            arr = jax.make_array_from_single_device_arrays(
+                shape, sharding, device_arrays
+            )
+            if arr.dtype != template.dtype:
+                arr = arr.astype(template.dtype)
+            out[tensor_name] = arr
+            max_block = max((b.nbytes for b in block_cache.values()), default=0)
+            full_bytes = int(np.prod(shape)) * next(iter(block_cache.values())).itemsize if block_cache else 0
+            load_stats["tensors"][tensor_name] = (
+                max_block, full_bytes, len(block_cache)
+            )
+            load_stats["max_block_bytes"] = max(
+                load_stats["max_block_bytes"], max_block
+            )
+    finally:
+        handles.clear()
+    return out
 
 
 def load_sharded_model_state(

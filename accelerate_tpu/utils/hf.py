@@ -258,6 +258,12 @@ def llama_config_from_hf(cfg: dict):
             "attention_bias=True Llama variants are not supported "
             "(q/k/v/o projections are bias-free in models/llama.py)"
         )
+    if cfg.get("mlp_bias"):
+        raise NotImplementedError(
+            "mlp_bias=True Llama variants are not supported (gate/up/down "
+            "projections are bias-free in models/llama.py; loading would "
+            "silently drop the bias tensors)"
+        )
     heads = cfg.get("num_attention_heads", 32)
     return LlamaConfig(
         vocab_size=cfg.get("vocab_size", 32000),
@@ -276,6 +282,21 @@ def llama_config_from_hf(cfg: dict):
 def opt_config_from_hf(cfg: dict):
     from ..models.opt import OPTConfig
 
+    # refuse what models/opt.py would silently get wrong: its FFN is
+    # hard-coded ReLU, and the embed→hidden projection of narrow variants
+    # (opt-350m, Galactica-125m) has no counterpart in the layer math
+    act = cfg.get("activation_function", "relu")
+    if act != "relu":
+        raise NotImplementedError(
+            f"activation_function={act!r} is not supported; models/opt.py "
+            "implements the ReLU FFN used by every standard OPT size"
+        )
+    proj = cfg.get("word_embed_proj_dim")
+    if proj is not None and proj != cfg.get("hidden_size", 4096):
+        raise NotImplementedError(
+            f"word_embed_proj_dim={proj} != hidden_size is not supported "
+            "(true only of opt-350m among standard OPT checkpoints)"
+        )
     return OPTConfig(
         vocab_size=cfg.get("vocab_size", 50272),
         hidden_size=cfg.get("hidden_size", 4096),

@@ -249,6 +249,125 @@ def _big_model_inference_workload(on_accel: bool) -> dict:
     }
 
 
+def _llama_fsdp_workload(on_accel: bool) -> dict:
+    """BASELINE.json config 4: FSDP-sharded Llama-family training.
+
+    On one chip the fsdp axis is 1 (ZeRO needs peers to shard over), so the
+    measured thing is the Llama block math (RMSNorm/RoPE/SwiGLU/GQA) at a
+    7B-like width scaled to fit one v5e; the sharded path itself is proven
+    on the 8-device mesh in tests/test_llama.py and __graft_entry__.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    # the singleton still carries the primary workload's dp-only config; a
+    # conflicting ParallelismConfig re-init raises without a reset
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    n_dev = len(jax.devices())
+    fsdp = n_dev if n_dev > 1 else 1
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(fsdp_size=fsdp), mixed_precision="bf16"
+    )
+    if on_accel:
+        # 7B layer ratios (head 128, inter/hidden ≈ 2.7, GQA 4:1) at a width
+        # whose AdamW state fits one 16 GB chip
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=4,
+            max_position_embeddings=2048,
+        )
+        batch, seq, steps = 4, 1024, 20
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 2, 32, 2
+    model = LlamaForCausalLM(cfg)
+    opt = optim.AdamW(model.parameters(), lr=1e-4)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    rng = np.random.default_rng(0)
+    ids = batch_to_global_array(
+        jnp.asarray(rng.integers(0, cfg.vocab_size, (batch * max(1, n_dev), seq)), jnp.int32),
+        mesh=acc.mesh,
+    )
+    t0 = _time.perf_counter()
+    float(step(ids))
+    compile_s = _time.perf_counter() - t0
+    float(step(ids))
+    t0 = _time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids)
+    float(loss)
+    dt = _time.perf_counter() - t0
+    tokens_per_sec = batch * max(1, n_dev) * seq * steps / dt / n_dev
+    flops = tokens_per_sec * 6 * model.num_parameters
+    return {
+        "llama_params_m": round(model.num_parameters / 1e6, 1),
+        "llama_train_tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "llama_mfu_pct": round(flops / TPU_PEAK_FLOPS * 100, 1) if on_accel else None,
+        "llama_compile_s": round(compile_s, 1),
+        "llama_fsdp_size": fsdp,
+    }
+
+
+def _opt_inference_workload(on_accel: bool) -> dict:
+    """BASELINE.json config 5: OPT device_map='auto'-style sharded inference
+    (reference benchmarks/big_model_inference/README.md:31-37 form: load
+    time + per-token decode latency)."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.big_modeling import shard_for_inference
+    from accelerate_tpu.models import OPTConfig, OPTForCausalLM
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(mixed_precision="bf16")
+    t0 = _time.perf_counter()
+    cfg = OPTConfig.opt_1_3b() if on_accel else OPTConfig.tiny()
+    model = shard_for_inference(OPTForCausalLM(cfg), mesh=acc.mesh)
+    model.eval()
+    load_s = _time.perf_counter() - t0
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (1, 128 if on_accel else 16), dtype=np.int32)
+    new = 64 if on_accel else 4
+    t0 = _time.perf_counter()
+    out = model.generate(prompt, max_new_tokens=new)
+    _ = np.asarray(out)
+    compile_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    out = model.generate(prompt, max_new_tokens=new)
+    _ = np.asarray(out)
+    gen_s = _time.perf_counter() - t0
+    return {
+        "opt_params_m": round(model.num_parameters / 1e6, 1),
+        "opt_load_s": round(load_s, 2),
+        "opt_generate_s_per_token": round(gen_s / new, 4),
+        "opt_generate_compile_s": round(compile_s, 1),
+    }
+
+
 def main() -> None:
     _arm_deadline()
     diag = _init_backend()
@@ -355,6 +474,14 @@ def main() -> None:
             result.update(_big_model_inference_workload(on_accel))
         except Exception as exc:
             result["bigmodel_error"] = f"{type(exc).__name__}: {exc}"[:300]
+        try:
+            result.update(_llama_fsdp_workload(on_accel))
+        except Exception as exc:
+            result["llama_error"] = f"{type(exc).__name__}: {exc}"[:300]
+        try:
+            result.update(_opt_inference_workload(on_accel))
+        except Exception as exc:
+            result["opt_error"] = f"{type(exc).__name__}: {exc}"[:300]
     _emit_once(result)
 
 
