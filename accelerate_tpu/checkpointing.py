@@ -50,7 +50,10 @@ def _gather_numpy(value) -> np.ndarray:
         from jax.experimental import multihost_utils
 
         value = multihost_utils.process_allgather(value, tiled=True)
-    return np.ascontiguousarray(np.asarray(jax.device_get(value)))
+    arr = np.asarray(jax.device_get(value))
+    # ascontiguousarray promotes 0-d to (1,) — scalar params (e.g. a bare
+    # nn.Parameter(0.)) must round-trip with their shape intact
+    return np.ascontiguousarray(arr).reshape(arr.shape)
 
 
 def _write_weight_arrays(arrays: dict, directory: str, safe_serialization: bool, name: str) -> str:
@@ -158,27 +161,28 @@ def save_accelerator_state(
     state = PartialState()
     os.makedirs(output_dir, exist_ok=True)
 
-    # A reused checkpoint directory may hold artifacts from a PREVIOUS save
-    # with a different world size or sharded-ness: the loader globs every
-    # {name}.shard-* file and prefers an index.json, so stale files would be
-    # silently mixed into (or preferred over) the new state.  Main process
-    # clears conflicting artifacts for every name we are about to write,
-    # then everyone synchronises before writing.
+    # Record which artifacts already exist for every name we are about to
+    # write: a reused checkpoint directory may hold files from a PREVIOUS
+    # save with a different world size or sharded-ness, and the loader globs
+    # every {name}.shard-* file / prefers an index.json — stale files would
+    # be silently mixed into (or preferred over) the new state.  Cleanup
+    # runs AFTER the new artifacts are fully written (deleting first would
+    # destroy the only checkpoint if this save crashes mid-write), gated per
+    # HOST (dirs may be host-local, not shared storage).
     import glob as _glob
 
-    if state.is_main_process:
-        names = [MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}" for i in range(len(models))]
-        names += [OPTIMIZER_NAME if i == 0 else f"{OPTIMIZER_NAME}_{i}" for i in range(len(optimizers))]
-        for name in names:
-            stale = _glob.glob(os.path.join(output_dir, f"{name}.shard-*.safetensors"))
-            stale += [
-                os.path.join(output_dir, f)
-                for f in (f"{name}.index.json", f"{name}.safetensors", f"{name}.npz", f"{name}.bin", f"{name}.meta.bin")
-            ]
-            for path in stale:
-                if os.path.exists(path):
-                    os.remove(path)
-    state.wait_for_everyone()
+    ckpt_names = [MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}" for i in range(len(models))]
+    ckpt_names += [
+        OPTIMIZER_NAME if i == 0 else f"{OPTIMIZER_NAME}_{i}" for i in range(len(optimizers))
+    ]
+    preexisting: set[str] = set()
+    for name in ckpt_names:
+        preexisting.update(_glob.glob(os.path.join(output_dir, f"{name}.shard-*.safetensors")))
+        for f in (f"{name}.index.json", f"{name}.safetensors", f"{name}.npz",
+                  f"{name}.bin", f"{name}.meta.bin"):
+            path = os.path.join(output_dir, f)
+            if os.path.exists(path):
+                preexisting.add(path)
 
     # Payload assembly may involve cross-host allgathers of sharded arrays,
     # so EVERY process must execute it (collectives deadlock otherwise); only
@@ -237,6 +241,32 @@ def save_accelerator_state(
     rng_file = os.path.join(output_dir, f"{RNG_STATE_NAME}_{state.process_index}.pkl")
     with open(rng_file, "wb") as f:
         pickle.dump(_rng_states(), f)
+    state.wait_for_everyone()
+
+    # post-write cleanup: drop PREEXISTING artifacts this save did not
+    # overwrite (e.g. shard files from a different world size, or a stale
+    # index.json after a sharded→full transition).  Per host, after every
+    # process finished writing, so a crash mid-save never deletes the only
+    # loadable checkpoint.
+    if getattr(state, "is_local_main_process", state.is_main_process):
+        world = state.num_processes
+        valid: set[str] = set()
+        for name in ckpt_names:
+            if sharded_state:
+                valid.update(
+                    _glob.glob(
+                        os.path.join(output_dir, f"{name}.shard-*-of-{world:05d}.safetensors")
+                    )
+                )
+                valid.add(os.path.join(output_dir, f"{name}.index.json"))
+                valid.add(os.path.join(output_dir, f"{name}.meta.bin"))
+            else:
+                valid.add(os.path.join(output_dir, f"{name}.safetensors"))
+                valid.add(os.path.join(output_dir, f"{name}.npz"))
+                valid.add(os.path.join(output_dir, f"{name}.bin"))
+        for path in preexisting - valid:
+            if os.path.exists(path):
+                os.remove(path)
     state.wait_for_everyone()
     logger.info(f"Saved accelerator state to {output_dir}")
     return output_dir

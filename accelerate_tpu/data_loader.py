@@ -457,11 +457,17 @@ def default_collate(samples: Sequence[Any]):
 # Device placement
 # ---------------------------------------------------------------------------
 def batch_to_global_array(batch, mesh=None, sharding=None):
-    """Host batch (numpy pytree) → sharded global jax.Array pytree.
+    """Host GLOBAL batch (numpy pytree) → sharded global jax.Array pytree.
 
     Single host: ``device_put`` with a batch-dim NamedSharding (XLA splits
-    across local devices).  Multi-host: each host contributes its local shard
-    via ``jax.make_array_from_process_local_data``.
+    across local devices).  Multi-host: ``x`` is still the full global batch
+    (every process collates the same global batch from the synchronized
+    sampler), so each process device_puts exactly the slices its OWN devices
+    are assigned under the sharding and assembles the global array from
+    those — handing the whole batch to
+    ``jax.make_array_from_process_local_data`` instead would treat it as
+    this process's shard and silently double the batch (caught by the
+    2-process run of test_script.py: every sample appeared twice).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -478,11 +484,12 @@ def batch_to_global_array(batch, mesh=None, sharding=None):
 
     def _place(x):
         x = np.asarray(x)
-        spec_ndim = len(sharding.spec)
         if x.ndim == 0:
             return jnp.asarray(x)
         if multi_host:
-            return jax.make_array_from_process_local_data(sharding, x)
+            idx_map = sharding.addressable_devices_indices_map(x.shape)
+            arrs = [jax.device_put(x[idx], d) for d, idx in idx_map.items()]
+            return jax.make_array_from_single_device_arrays(x.shape, sharding, arrs)
         return jax.device_put(x, sharding)
 
     from .utils.operations import recursively_apply

@@ -11,6 +11,8 @@ Every check works at any device/process count, including one.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import accelerate_tpu.nn as nn
@@ -161,6 +163,46 @@ def test_gather_for_metrics():
     print("gather_for_metrics ok")
 
 
+def test_save_load_roundtrip():
+    """Multi-process checkpoint: save (rank-gated writes + per-process RNG),
+    perturb, load, assert exact restoration on every process."""
+    import shutil
+    import tempfile
+
+    acc = Accelerator()
+    model = RegressionModel()
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+    # one training step so optimizer state is non-trivial
+    ds = [{"x": np.float32(i), "y": np.float32(2 * i + 1)} for i in range(8)]
+    dl = acc.prepare(prepare_data_loader(dataset=ds, batch_size=4))
+    batch = next(iter(dl))
+    opt.zero_grad()
+    loss = nn.F.mse_loss(model(batch["x"]), Tensor(batch["y"]))
+    acc.backward(loss)
+    opt.step()
+    saved_a = float(np.asarray(model.a.data))
+
+    # every process must resolve the SAME directory: derive from the
+    # coordinator address (unique per launch, shared across its processes);
+    # single-process launches have no coordinator, so key on the pid to keep
+    # concurrent runs on one machine from racing on the same dir
+    tag = os.environ.get("ACCELERATE_COORDINATOR_ADDRESS") or f"pid{os.getpid()}"
+    tag = tag.replace(":", "_").replace(".", "_")
+    ckpt = os.path.join(tempfile.gettempdir(), f"acc_tpu_ckpt_{tag}")
+    try:
+        acc.save_state(ckpt)
+        model.a.data = model.a.data * 0.0 + 123.0  # clobber
+        acc.load_state(ckpt)
+        got = float(np.asarray(model.a.data))
+        assert abs(got - saved_a) < 1e-7, f"restore mismatch: {got} vs {saved_a}"
+        acc.wait_for_everyone()
+    finally:
+        if acc.is_main_process:
+            shutil.rmtree(ckpt, ignore_errors=True)
+    print("save/load roundtrip ok")
+
+
 def test_trigger():
     acc = Accelerator()
     acc.flag_tensor = None
@@ -184,6 +226,7 @@ def main():
     test_skip_first_batches()
     test_gather_for_metrics()
     mock_training()
+    test_save_load_roundtrip()
     test_trigger()
     state.wait_for_everyone()
     if state.is_main_process:
