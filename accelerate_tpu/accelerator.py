@@ -68,6 +68,7 @@ class Accelerator:
         fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
         tp_plugin: Optional[TensorParallelPlugin] = None,
         sp_plugin: Optional[SequenceParallelPlugin] = None,
+        pp_plugin=None,
         parallelism_config: Optional[ParallelismConfig] = None,
         rng_types: Optional[list] = None,
         log_with: Optional[Union[str, list]] = None,
@@ -116,6 +117,7 @@ class Accelerator:
             fsdp_plugin=fsdp_plugin,
             tp_plugin=tp_plugin,
             sp_plugin=sp_plugin,
+            pp_plugin=pp_plugin,
             _from_accelerator=True,
             **(
                 {"init_process_group_kwargs": self.init_handler}

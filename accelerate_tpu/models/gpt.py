@@ -370,6 +370,35 @@ def _make_gpt_decoder():
 GPT_DECODER = _make_gpt_decoder()
 
 
+def _pure_lm_head_loss(h, labels, extra, *, eps: float):
+    """Final LN + (tied) head + shifted causal CE as pure jnp — the loss a
+    1F1B pipeline computes INSIDE its last stage per microbatch.
+
+    Numerically matches lm_shift_loss ∘ lm_head ∘ ln_f: mean NLL over the
+    s−1 predicting positions (final position masked, same as the -100
+    ignore-index form), fp32 logsumexp.
+    """
+    ln_w, ln_b, head_w = extra
+    h = _pure_layernorm(h, ln_w, ln_b, eps)
+    logits = (h @ head_w.T).astype(jnp.float32)  # (b, s, V)
+    lse = jax.nn.logsumexp(logits, axis=-1)  # (b, s)
+    b, s = labels.shape
+    shifted = jnp.concatenate(
+        [labels[:, 1:], jnp.zeros((b, 1), labels.dtype)], axis=1
+    )
+    # ignore_index semantics: -100 labels (HF padding convention) drop out of
+    # numerator AND denominator, exactly like F.cross_entropy in the gpipe
+    # path; gather on a clipped index so -100 never wraps into the vocab
+    valid = shifted >= 0
+    safe = jnp.where(valid, shifted, 0)
+    picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    nll = lse - picked
+    mask = valid.astype(jnp.float32) * jnp.concatenate(
+        [jnp.ones((b, s - 1), jnp.float32), jnp.zeros((b, 1), jnp.float32)], axis=1
+    )
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 def _pipelined_block(p, h, *, n_head: int, eps: float, seq_axis: str, sp_mode: str = "ring"):
     """One pre-norm GPT block as pure jnp, runnable inside shard_map.
 
@@ -510,16 +539,52 @@ class PipelinedGPTLMHeadModel(nn.Module):
                     stacklevel=2,
                 )
 
+        def stage_fn(layer_params, h):
+            return _pipelined_block(
+                layer_params, h,
+                n_head=cfg.n_head, eps=cfg.layer_norm_eps, seq_axis="sp",
+                sp_mode=sp_mode,
+            )
+
+        # -- fused 1F1B training path (PipelineParallelPlugin.schedule) ------
+        pp_plugin = getattr(state, "pp_plugin", None) if state else None
+        schedule = getattr(pp_plugin, "schedule", "gpipe") if pp_plugin else "gpipe"
+        pp_size = mesh.shape.get("pp", 1) if mesh is not None else 1
+        if labels is not None and schedule == "1f1b" and pp_size > 1:
+            if mesh.shape.get("sp", 1) > 1:
+                raise NotImplementedError(
+                    "schedule='1f1b' computes the loss inside the pipeline and "
+                    "does not yet compose with sequence parallelism (the "
+                    "shifted CE crosses seq-chunk boundaries); use "
+                    "schedule='gpipe' with sp>1"
+                )
+            from ..parallel.pipeline import pipeline_loss_1f1b
+
+            lbl = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
+            n_names = len(names)
+
+            def fused(xv, *flat):
+                stacked = dict(zip(names, flat[:n_names]))
+                extra = tuple(flat[n_names:])  # (ln_f w, ln_f b, head w)
+
+                def loss_fn(out, lbl_mb, ep):
+                    return _pure_lm_head_loss(out, lbl_mb, ep, eps=cfg.layer_norm_eps)
+
+                f = pipeline_loss_1f1b(
+                    stage_fn, loss_fn, lbl, self.num_microbatches, mesh=mesh
+                )
+                return f(stacked, xv, extra)
+
+            loss = nn.tape_op(
+                fused, x, *self.blocks.param_tensors(),
+                self.ln_f.weight, self.ln_f.bias, self.lm_head.weight,
+            )
+            # logits never materialise in the fused schedule — that is the
+            # memory point; callers needing logits use schedule='gpipe'
+            return {"loss": loss, "logits": None}
+
         def trunk(xv, *flat_params):
             stacked = dict(zip(names, flat_params))
-
-            def stage_fn(layer_params, h):
-                return _pipelined_block(
-                    layer_params, h,
-                    n_head=cfg.n_head, eps=cfg.layer_norm_eps, seq_axis="sp",
-                    sp_mode=sp_mode,
-                )
-
             return gpipe(
                 stage_fn,
                 stacked,

@@ -112,6 +112,349 @@ def _gpipe_local(
     return outputs.reshape(x.shape[0], *outputs.shape[2:])
 
 
+# ---------------------------------------------------------------------------
+# 1F1B: fused forward+backward schedule (Megatron-style memory profile)
+# ---------------------------------------------------------------------------
+def residual_window(num_stages: int) -> int:
+    """In-flight stage-input slots a 1F1B stage must hold: ``2·S − 1``.
+
+    Derivation: stage ``s`` forwards microbatch ``f`` at tick ``s+f`` and
+    backwards microbatch ``b`` at tick ``2S−2−s+b``; the slot for ``b`` is
+    next overwritten by ``f = b + W`` at tick ``s+b+W``, and
+    ``2S−2−s+b < s+b+W`` for all ``s`` iff ``W ≥ 2S−1``.  Independent of
+    the microbatch count — the 1F1B memory win over fill-drain GPipe is
+    exactly ``M`` → ``2S−1`` stage inputs (reference obtains this from
+    megatron.core's 1F1B forward_backward_func, utils/megatron_lm.py:40).
+    """
+    return 2 * num_stages - 1
+
+
+def schedule_ticks(num_microbatches: int, num_stages: int) -> int:
+    """Lockstep cycles for the fused schedule: ``M + 2S − 2`` (each cycle
+    is one forward slot + one backward slot; bubble fraction matches
+    non-interleaved 1F1B: ``(S−1)/(M+S−1)`` per direction)."""
+    return num_microbatches + 2 * num_stages - 2
+
+
+def _one_f_one_b_local(
+    stage_params,
+    x,
+    labels,
+    extra_params,
+    *,
+    stage_fn,
+    loss_fn,
+    axis_name: str,
+    num_microbatches: int,
+    num_stages: int,
+    batch_axes_present: tuple = (),
+    batch_group: int = 1,
+):
+    """Per-device fused fwd+bwd 1F1B under shard_map.
+
+    One ``fori_loop`` carries activations up the ring (``ppermute`` +1) and
+    loss cotangents down it (−1).  The LAST stage computes
+    ``loss_fn(stage_out, labels_mb, extra_params)`` and seeds its own
+    backward in the same tick, so microbatch ``b``'s backward overlaps
+    microbatch ``b+1..``'s forwards — the defining 1F1B property.  Stage
+    activations are not saved by AD: each stage stores only its INPUT per
+    in-flight microbatch (window ``2S−1``) and recomputes the forward inside
+    ``jax.vjp`` at backward time (activation-checkpoint at stage
+    granularity, the Megatron default).
+
+    Returns ``(mean_loss, dstage_params, dx, dextra_params)`` — gradients
+    computed HERE, not by transposing this function.
+    """
+    s_idx = jax.lax.axis_index(axis_name)
+    M, S = num_microbatches, num_stages
+    if x.shape[0] % M != 0:
+        raise ValueError(
+            f"per-device batch {x.shape[0]} not divisible by num_microbatches {M}"
+        )
+    mb = x.shape[0] // M
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+    labels_mb = labels.reshape(M, mb, *labels.shape[1:])
+    W = residual_window(S)
+    T = schedule_ticks(M, S)
+
+    def fwd_apply(p, inp):
+        return _apply_local_layers(stage_fn, p, inp)
+
+    sample_out = jax.eval_shape(fwd_apply, stage_params, x_mb[0])
+    if sample_out.shape != x_mb.shape[1:] or sample_out.dtype != x_mb.dtype:
+        raise ValueError(
+            "1f1b requires shape/dtype-preserving stages (GPipe classic): "
+            f"stage maps {x_mb.shape[1:]}/{x_mb.dtype} → "
+            f"{sample_out.shape}/{sample_out.dtype}"
+        )
+
+    perm_up = [(i, (i + 1) % S) for i in range(S)]
+    perm_dn = [(i, (i - 1) % S) for i in range(S)]
+
+    carry0 = (
+        jnp.zeros(x_mb.shape[1:], x_mb.dtype),  # incoming activation
+        jnp.zeros(x_mb.shape[1:], x_mb.dtype),  # incoming cotangent
+        jnp.zeros((W,) + x_mb.shape[1:], x_mb.dtype),  # stage-input window
+        jax.tree_util.tree_map(jnp.zeros_like, stage_params),  # grad accum
+        jax.tree_util.tree_map(jnp.zeros_like, extra_params),
+        jnp.zeros_like(x_mb),  # dx per microbatch (stage 0 only)
+        jnp.zeros((), jnp.float32),  # loss accumulator
+    )
+
+    def tick(t, carry):
+        act_in, cot_in, window, dparams, dextra, dx_mb, loss_sum = carry
+
+        # -- forward slot ---------------------------------------------------
+        f = t - s_idx
+        f_active = jnp.logical_and(f >= 0, f < M)
+        f_idx = jnp.clip(f, 0, M - 1)
+        my_in = jnp.where(
+            s_idx == 0,
+            jax.lax.dynamic_index_in_dim(x_mb, f_idx, keepdims=False),
+            act_in,
+        )
+        slot = f_idx % W
+        keep = jax.lax.dynamic_index_in_dim(window, slot, keepdims=False)
+        window = jax.lax.dynamic_update_index_in_dim(
+            window, jnp.where(f_active, my_in, keep), slot, 0
+        )
+        out = fwd_apply(stage_params, my_in)
+        out = jnp.where(f_active, out, jnp.zeros_like(out))
+        act_nxt = jax.lax.ppermute(out, axis_name, perm_up)
+
+        # -- backward slot --------------------------------------------------
+        b = t - (2 * S - 2 - s_idx)
+        b_active = jnp.logical_and(b >= 0, b < M)
+        b_idx = jnp.clip(b, 0, M - 1)
+        saved_in = jax.lax.dynamic_index_in_dim(window, b_idx % W, keepdims=False)
+        lbl = jax.lax.dynamic_index_in_dim(labels_mb, b_idx, keepdims=False)
+
+        def last_stage(_):
+            # loss lives here: vjp through stage span + loss head, seeded
+            # with d(total)/d(mb loss) = 1/M
+            def f_last(p, inp, ep):
+                return loss_fn(fwd_apply(p, inp), lbl, ep)
+
+            lval, vjp = jax.vjp(f_last, stage_params, saved_in, extra_params)
+            dp, dinp, dep = vjp(jnp.float32(1.0 / M))
+            return lval / M, dp, dinp, dep
+
+        def mid_stage(_):
+            def f_mid(p, inp):
+                return fwd_apply(p, inp)
+
+            _, vjp = jax.vjp(f_mid, stage_params, saved_in)
+            dp, dinp = vjp(cot_in)
+            return (
+                jnp.zeros((), jnp.float32),
+                dp,
+                dinp,
+                jax.tree_util.tree_map(jnp.zeros_like, extra_params),
+            )
+
+        lval, dp, dinp, dep = jax.lax.cond(
+            s_idx == S - 1, last_stage, mid_stage, None
+        )
+        bmask = b_active.astype(jnp.float32)
+        dparams = jax.tree_util.tree_map(
+            lambda a, g: a + bmask.astype(g.dtype) * g, dparams, dp
+        )
+        dextra = jax.tree_util.tree_map(
+            lambda a, g: a + bmask.astype(g.dtype) * g, dextra, dep
+        )
+        loss_sum = loss_sum + bmask * lval
+        dinp = jnp.where(b_active, dinp, jnp.zeros_like(dinp))
+        # stage 0's dinp is the trunk-input gradient for this microbatch
+        dx_mb = jax.lax.cond(
+            jnp.logical_and(b_active, s_idx == 0),
+            lambda d: jax.lax.dynamic_update_index_in_dim(d, dinp.astype(d.dtype), b_idx, 0),
+            lambda d: d,
+            dx_mb,
+        )
+        cot_nxt = jax.lax.ppermute(dinp, axis_name, perm_dn)
+
+        return (act_nxt, cot_nxt, window, dparams, dextra, dx_mb, loss_sum)
+
+    (_, _, _, dparams, dextra, dx_mb, loss_sum) = jax.lax.fori_loop(
+        0, T, tick, carry0
+    )
+    # Manual reductions — nothing transposes this program, so the data-
+    # parallel grad allreduce the AD transpose normally inserts must be
+    # written out: per-device values are d(local batch-shard mean)/dθ, the
+    # global loss is the mean over batch groups.  pp-psum replicates the
+    # last-stage-only (loss, dextra) and stage-0-only (dx) values around
+    # the ring.
+    ba = tuple(batch_axes_present)
+    inv_bg = 1.0 / float(batch_group)
+    loss = jax.lax.psum(loss_sum, (axis_name,) + ba) * inv_bg
+    dparams = jax.tree_util.tree_map(
+        lambda g: (jax.lax.psum(g, ba) if ba else g) * jnp.asarray(inv_bg, g.dtype),
+        dparams,
+    )
+    dextra = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, (axis_name,) + ba) * jnp.asarray(inv_bg, g.dtype),
+        dextra,
+    )
+    dx = (jax.lax.psum(dx_mb, axis_name) * inv_bg).astype(x.dtype).reshape(x.shape)
+    return loss, dparams, dx, dextra
+
+
+def _resolve_pipeline_layout(
+    stacked_params,
+    mesh: Optional[Mesh],
+    axis_name: str,
+    batch_axes: tuple,
+    seq_axis: Optional[str],
+    allow_trivial_mesh: bool,
+):
+    """Shared mesh/spec resolution for both schedules.
+
+    Returns ``(mesh, n_stages, param_specs, data_spec)`` where
+    ``data_spec(arr)`` builds the (batch, seq?, ...) PartitionSpec for an
+    input array — one definition so gpipe and 1F1B can never shard their
+    inputs differently.
+    """
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        if AcceleratorState._shared_state:
+            mesh = AcceleratorState().mesh
+    if mesh is None:
+        if not allow_trivial_mesh:
+            raise ValueError("pipeline needs a mesh (or Accelerator context)")
+        # no Accelerator context: trivial one-device full-axes mesh so stage
+        # bodies that use named axes (ring attention) still have axis context
+        import numpy as np
+
+        from ..utils.constants import ALL_MESH_AXES
+
+        mesh = Mesh(
+            np.asarray(jax.devices()[:1]).reshape((1,) * len(ALL_MESH_AXES)),
+            ALL_MESH_AXES,
+        )
+    n_stages = mesh.shape.get(axis_name, 1)
+    num_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if num_layers % max(n_stages, 1) != 0:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by pp size {n_stages}"
+        )
+    batch_spec = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+
+    def data_spec(arr) -> P:
+        axes = [batch_spec] + [None] * (arr.ndim - 1)
+        if seq_axis is not None and arr.ndim >= 2:
+            axes[1] = seq_axis  # (batch, seq, ...)
+        return P(*axes)
+
+    return mesh, n_stages, param_specs, data_spec
+
+
+def pipeline_train_1f1b(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    labels: jax.Array,
+    extra_params,
+    loss_fn: Callable,
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "pp",
+    batch_axes: tuple = ("dp", "fsdp"),
+    seq_axis: Optional[str] = None,
+):
+    """Fused 1F1B pipeline training step over the ``pp`` mesh axis.
+
+    Returns ``(loss, dstacked_params, dx, dextra_params)``.  Unlike
+    :func:`gpipe`, gradients are computed INSIDE the schedule (backward of
+    microbatch ``b`` overlaps forward of ``b+1..``), so peak in-flight
+    activations per stage are ``residual_window(S)`` stage inputs instead of
+    ``num_microbatches`` — wrap with ``jax.custom_vjp`` (models do this) so
+    JAX never transposes this function.
+    """
+    mesh, n_stages, param_specs, data_spec = _resolve_pipeline_layout(
+        stacked_params, mesh, axis_name, batch_axes, seq_axis,
+        allow_trivial_mesh=False,
+    )
+
+    from jax.experimental.shard_map import shard_map
+
+    extra_specs = jax.tree_util.tree_map(lambda _: P(), extra_params)
+    x_spec = data_spec(x)
+    lbl_spec = data_spec(labels)
+
+    batch_axes_present = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    batch_group = 1
+    for a in batch_axes_present:
+        batch_group *= mesh.shape[a]
+
+    fn = shard_map(
+        functools.partial(
+            _one_f_one_b_local,
+            stage_fn=stage_fn,
+            loss_fn=loss_fn,
+            axis_name=axis_name,
+            num_microbatches=num_microbatches,
+            num_stages=n_stages,
+            batch_axes_present=batch_axes_present,
+            batch_group=batch_group,
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, x_spec, lbl_spec, extra_specs),
+        out_specs=(P(), param_specs, x_spec, extra_specs),
+        check_rep=False,
+    )
+    return fn(stacked_params, x, labels, extra_params)
+
+
+def pipeline_loss_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    labels: jax.Array,
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "pp",
+    batch_axes: tuple = ("dp", "fsdp"),
+    seq_axis: Optional[str] = None,
+):
+    """Scalar-loss wrapper around the fused 1F1B schedule.
+
+    Returns ``f(stacked_params, x, extra_params) -> loss`` whose
+    ``custom_vjp`` runs :func:`pipeline_train_1f1b` in the FORWARD pass
+    (computing loss and all gradients in one fused loop) and whose backward
+    merely scales the stored gradients — JAX never transposes the pipeline,
+    so the fill-drain activation blowup of differentiating :func:`gpipe`
+    never materialises.  The primal-only path (inference/no-grad) runs the
+    cheap plain-forward gpipe instead.
+    """
+
+    @jax.custom_vjp
+    def f(stacked, x, extra):
+        out = gpipe(
+            stage_fn, stacked, x, num_microbatches,
+            mesh=mesh, axis_name=axis_name, batch_axes=batch_axes, seq_axis=seq_axis,
+        )
+        return loss_fn(out, labels, extra)
+
+    def f_fwd(stacked, x, extra):
+        loss, dstacked, dx, dextra = pipeline_train_1f1b(
+            stage_fn, stacked, x, labels, extra, loss_fn, num_microbatches,
+            mesh=mesh, axis_name=axis_name, batch_axes=batch_axes, seq_axis=seq_axis,
+        )
+        return loss, (dstacked, dx, dextra)
+
+    def f_bwd(res, g):
+        dstacked, dx, dextra = res
+
+        def sc(tree):
+            return jax.tree_util.tree_map(lambda a: (a * g).astype(a.dtype), tree)
+
+        return sc(dstacked), (dx * g).astype(dx.dtype), sc(dextra)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
 def gpipe(
     stage_fn: Callable,
     stacked_params,
@@ -134,28 +477,10 @@ def gpipe(
     Constraint (GPipe classic): every layer must map activations to the same
     shape/dtype.  Embedding/head layers live outside the pipelined trunk.
     """
-    if mesh is None:
-        from ..state import AcceleratorState
-
-        if AcceleratorState._shared_state:
-            mesh = AcceleratorState().mesh
-    if mesh is None:
-        # no Accelerator context: trivial one-device full-axes mesh so stage
-        # bodies that use named axes (ring attention) still have axis context
-        import numpy as np
-
-        from ..utils.constants import ALL_MESH_AXES
-
-        mesh = Mesh(
-            np.asarray(jax.devices()[:1]).reshape((1,) * len(ALL_MESH_AXES)),
-            ALL_MESH_AXES,
-        )
-    n_stages = mesh.shape.get(axis_name, 1)
-    num_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
-    if num_layers % max(n_stages, 1) != 0:
-        raise ValueError(
-            f"num_layers {num_layers} not divisible by pp size {n_stages}"
-        )
+    mesh, n_stages, param_specs, data_spec = _resolve_pipeline_layout(
+        stacked_params, mesh, axis_name, batch_axes, seq_axis,
+        allow_trivial_mesh=True,
+    )
     if n_stages == 1 and seq_axis is None:
         # degenerate: sequential scan over layers on one device group (only
         # when the body needs no named-axis context)
@@ -167,17 +492,10 @@ def gpipe(
 
     from jax.experimental.shard_map import shard_map
 
-    batch_spec = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
-    param_specs = jax.tree_util.tree_map(
-        lambda _: P(axis_name), stacked_params
-    )
     # microbatching happens per-device inside the body: the in_spec matches
     # the loader/constraint layout exactly, so entering the pipeline moves
     # zero bytes
-    data_axes_spec = [batch_spec] + [None] * (x.ndim - 1)
-    if seq_axis is not None and x.ndim >= 2:
-        data_axes_spec[1] = seq_axis  # (batch, seq, ...)
-    x_spec = P(*data_axes_spec)
+    x_spec = data_spec(x)
     out_spec = x_spec
 
     fn = shard_map(

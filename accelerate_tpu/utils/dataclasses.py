@@ -310,14 +310,36 @@ class SequenceParallelPlugin:
 
 @dataclass
 class PipelineParallelPlugin:
-    """GPipe-style microbatch pipelining over the ``pp`` mesh axis."""
+    """Microbatch pipelining over the ``pp`` mesh axis.
+
+    ``schedule``:
+      * ``"gpipe"`` — fill-drain: all forwards, then all backwards (JAX AD
+        transposes the forward loop).  Peak activation state grows with
+        ``num_microbatches``.
+      * ``"1f1b"`` — fused one-forward-one-backward: loss and backward run
+        INSIDE the pipeline loop, so each stage holds at most ``2·S−1``
+        in-flight stage inputs regardless of microbatch count (the
+        Megatron-style memory profile; reference delegates to
+        megatron.core's get_forward_backward_func, utils/megatron_lm.py:40).
+        Requires the loss to be computed by the pipelined program — models
+        opt in via their pipelined loss path (PipelinedGPTLMHeadModel).
+    """
 
     pp_size: int = 1
     num_microbatches: int = 1
+    schedule: str = "gpipe"  # "gpipe" | "1f1b"
 
     def __post_init__(self):
         if self.pp_size == 1 and "PP_SIZE" in os.environ:
             self.pp_size = int(os.environ["PP_SIZE"])
+        # env fallback only when the field still holds its default — an
+        # explicitly constructed schedule wins (same pattern as PP_SIZE)
+        if self.schedule == "gpipe" and "PP_SCHEDULE" in os.environ:
+            self.schedule = os.environ["PP_SCHEDULE"]
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipeline schedule {self.schedule!r}; use 'gpipe' or '1f1b'"
+            )
 
 
 @dataclass
