@@ -1,0 +1,45 @@
+# Test-suite splits mirroring the reference Makefile:25-60 (test_core /
+# test_cli / test_big_modeling / test_fsdp / test_examples...), adapted to
+# the TPU-native layout. All targets run on the virtual 8-device CPU mesh
+# (tests/conftest.py forces it) — no hardware needed.
+
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_slow bench
+
+test:
+	python -m pytest tests/ -q
+
+test_core:
+	python -m pytest tests/test_accelerator.py tests/test_state.py \
+	  tests/test_operations.py tests/test_data_loader.py \
+	  tests/test_data_loader_grid.py tests/test_optimizer.py \
+	  tests/test_capture_stability.py tests/test_precision.py \
+	  tests/test_fp16_capture.py tests/test_autocast.py -q
+
+test_models:
+	python -m pytest tests/test_models.py tests/test_llama.py \
+	  tests/test_opt.py tests/test_generation.py tests/test_moe.py \
+	  tests/test_torch_bridge.py tests/test_nn.py -q
+
+test_parallel:
+	python -m pytest tests/test_sharding_plan.py tests/test_zero_sharding.py \
+	  tests/test_pipeline.py tests/test_1f1b.py tests/test_ring_attention.py \
+	  tests/test_flash_attention.py -q
+
+test_cli:
+	python -m pytest tests/test_cli.py tests/test_menu.py tests/test_launcher.py -q
+
+test_big_modeling:
+	python -m pytest tests/test_big_modeling.py tests/test_hooks.py \
+	  tests/test_offload.py tests/test_modeling_utils.py -q
+
+test_checkpoint:
+	python -m pytest tests/test_sharded_checkpoint.py tests/test_fsdp_utils.py -q
+
+test_examples:
+	python -m pytest tests/test_examples.py tests/test_external_scripts.py -q
+
+test_slow:
+	RUN_SLOW=1 python -m pytest tests/ -q
+
+bench:
+	python bench.py

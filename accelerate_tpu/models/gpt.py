@@ -374,9 +374,14 @@ def _pure_lm_head_loss(h, labels, extra, *, eps: float):
     """Final LN + (tied) head + shifted causal CE as pure jnp — the loss a
     1F1B pipeline computes INSIDE its last stage per microbatch.
 
-    Numerically matches lm_shift_loss ∘ lm_head ∘ ln_f: mean NLL over the
-    s−1 predicting positions (final position masked, same as the -100
-    ignore-index form), fp32 logsumexp.
+    Returns ``(nll_sum, valid_count)`` — UN-normalised, so the pipeline can
+    divide by the GLOBAL valid-token count after accumulating over
+    microbatches and shards.  A per-microbatch mean would over-weight
+    microbatches with more -100 padding; sum-and-count reproduces
+    F.cross_entropy's global token mean exactly (the gpipe path's
+    semantics).  -100 labels (HF padding convention) drop out of numerator
+    AND denominator; gather on a clipped index so -100 never wraps into the
+    vocab.  fp32 logsumexp.
     """
     ln_w, ln_b, head_w = extra
     h = _pure_layernorm(h, ln_w, ln_b, eps)
@@ -386,9 +391,6 @@ def _pure_lm_head_loss(h, labels, extra, *, eps: float):
     shifted = jnp.concatenate(
         [labels[:, 1:], jnp.zeros((b, 1), labels.dtype)], axis=1
     )
-    # ignore_index semantics: -100 labels (HF padding convention) drop out of
-    # numerator AND denominator, exactly like F.cross_entropy in the gpipe
-    # path; gather on a clipped index so -100 never wraps into the vocab
     valid = shifted >= 0
     safe = jnp.where(valid, shifted, 0)
     picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
@@ -396,7 +398,7 @@ def _pure_lm_head_loss(h, labels, extra, *, eps: float):
     mask = valid.astype(jnp.float32) * jnp.concatenate(
         [jnp.ones((b, s - 1), jnp.float32), jnp.zeros((b, 1), jnp.float32)], axis=1
     )
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(nll * mask), jnp.sum(mask)
 
 
 def _pipelined_block(p, h, *, n_head: int, eps: float, seq_axis: str, sp_mode: str = "ring"):

@@ -148,15 +148,17 @@ def _one_f_one_b_local(
     num_microbatches: int,
     num_stages: int,
     batch_axes_present: tuple = (),
-    batch_group: int = 1,
 ):
     """Per-device fused fwd+bwd 1F1B under shard_map.
 
     One ``fori_loop`` carries activations up the ring (``ppermute`` +1) and
     loss cotangents down it (−1).  The LAST stage computes
-    ``loss_fn(stage_out, labels_mb, extra_params)`` and seeds its own
-    backward in the same tick, so microbatch ``b``'s backward overlaps
-    microbatch ``b+1..``'s forwards — the defining 1F1B property.  Stage
+    ``loss_fn(stage_out, labels_mb, extra_params) -> (loss_sum, weight)``
+    (an UN-normalised sum plus its token count/weight — normalisation by the
+    global weight happens once after the loop, preserving exact token-mean
+    semantics under uneven ignore-index padding) and seeds its own backward
+    in the same tick, so microbatch ``b``'s backward overlaps microbatch
+    ``b+1..``'s forwards — the defining 1F1B property.  Stage
     activations are not saved by AD: each stage stores only its INPUT per
     in-flight microbatch (window ``2S−1``) and recomputes the forward inside
     ``jax.vjp`` at backward time (activation-checkpoint at stage
@@ -198,11 +200,12 @@ def _one_f_one_b_local(
         jax.tree_util.tree_map(jnp.zeros_like, stage_params),  # grad accum
         jax.tree_util.tree_map(jnp.zeros_like, extra_params),
         jnp.zeros_like(x_mb),  # dx per microbatch (stage 0 only)
-        jnp.zeros((), jnp.float32),  # loss accumulator
+        jnp.zeros((), jnp.float32),  # loss-sum accumulator
+        jnp.zeros((), jnp.float32),  # loss-weight accumulator
     )
 
     def tick(t, carry):
-        act_in, cot_in, window, dparams, dextra, dx_mb, loss_sum = carry
+        act_in, cot_in, window, dparams, dextra, dx_mb, loss_sum, weight_sum = carry
 
         # -- forward slot ---------------------------------------------------
         f = t - s_idx
@@ -230,14 +233,21 @@ def _one_f_one_b_local(
         lbl = jax.lax.dynamic_index_in_dim(labels_mb, b_idx, keepdims=False)
 
         def last_stage(_):
-            # loss lives here: vjp through stage span + loss head, seeded
-            # with d(total)/d(mb loss) = 1/M
+            # loss lives here: vjp through stage span + loss head.  loss_fn
+            # returns (UN-normalised loss sum, weight) — seed 1.0 and
+            # normalise by the GLOBAL weight after the loop, so uneven
+            # ignore-index padding across microbatches/shards weights every
+            # token equally (exact F.cross_entropy global-mean semantics;
+            # a per-microbatch mean would over-weight short microbatches)
             def f_last(p, inp, ep):
-                return loss_fn(fwd_apply(p, inp), lbl, ep)
+                lsum, w = loss_fn(fwd_apply(p, inp), lbl, ep)
+                return lsum, w
 
-            lval, vjp = jax.vjp(f_last, stage_params, saved_in, extra_params)
-            dp, dinp, dep = vjp(jnp.float32(1.0 / M))
-            return lval / M, dp, dinp, dep
+            lsum, vjp, w = jax.vjp(
+                f_last, stage_params, saved_in, extra_params, has_aux=True
+            )
+            dp, dinp, dep = vjp(jnp.float32(1.0))
+            return lsum, jnp.asarray(w, jnp.float32), dp, dinp, dep
 
         def mid_stage(_):
             def f_mid(p, inp):
@@ -247,12 +257,13 @@ def _one_f_one_b_local(
             dp, dinp = vjp(cot_in)
             return (
                 jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.float32),
                 dp,
                 dinp,
                 jax.tree_util.tree_map(jnp.zeros_like, extra_params),
             )
 
-        lval, dp, dinp, dep = jax.lax.cond(
+        lsum, w, dp, dinp, dep = jax.lax.cond(
             s_idx == S - 1, last_stage, mid_stage, None
         )
         bmask = b_active.astype(jnp.float32)
@@ -262,7 +273,8 @@ def _one_f_one_b_local(
         dextra = jax.tree_util.tree_map(
             lambda a, g: a + bmask.astype(g.dtype) * g, dextra, dep
         )
-        loss_sum = loss_sum + bmask * lval
+        loss_sum = loss_sum + bmask * lsum
+        weight_sum = weight_sum + bmask * w
         dinp = jnp.where(b_active, dinp, jnp.zeros_like(dinp))
         # stage 0's dinp is the trunk-input gradient for this microbatch
         dx_mb = jax.lax.cond(
@@ -273,29 +285,32 @@ def _one_f_one_b_local(
         )
         cot_nxt = jax.lax.ppermute(dinp, axis_name, perm_dn)
 
-        return (act_nxt, cot_nxt, window, dparams, dextra, dx_mb, loss_sum)
+        return (act_nxt, cot_nxt, window, dparams, dextra, dx_mb, loss_sum, weight_sum)
 
-    (_, _, _, dparams, dextra, dx_mb, loss_sum) = jax.lax.fori_loop(
+    (_, _, _, dparams, dextra, dx_mb, loss_sum, weight_sum) = jax.lax.fori_loop(
         0, T, tick, carry0
     )
     # Manual reductions — nothing transposes this program, so the data-
     # parallel grad allreduce the AD transpose normally inserts must be
-    # written out: per-device values are d(local batch-shard mean)/dθ, the
-    # global loss is the mean over batch groups.  pp-psum replicates the
-    # last-stage-only (loss, dextra) and stage-0-only (dx) values around
-    # the ring.
+    # written out.  Per-device values are d(UN-normalised loss sum)/dθ;
+    # the global loss is total_sum / total_weight (exact token-mean
+    # semantics under uneven ignore-index padding), so every gradient is
+    # scaled by 1/total_weight.  pp-psum replicates the last-stage-only
+    # (loss, weight, dextra) and stage-0-only (dx) values around the ring.
     ba = tuple(batch_axes_present)
-    inv_bg = 1.0 / float(batch_group)
-    loss = jax.lax.psum(loss_sum, (axis_name,) + ba) * inv_bg
+    total_sum = jax.lax.psum(loss_sum, (axis_name,) + ba)
+    total_w = jnp.maximum(jax.lax.psum(weight_sum, (axis_name,) + ba), 1e-9)
+    loss = total_sum / total_w
+    inv_w = 1.0 / total_w
     dparams = jax.tree_util.tree_map(
-        lambda g: (jax.lax.psum(g, ba) if ba else g) * jnp.asarray(inv_bg, g.dtype),
+        lambda g: (jax.lax.psum(g, ba) if ba else g) * inv_w.astype(g.dtype),
         dparams,
     )
     dextra = jax.tree_util.tree_map(
-        lambda g: jax.lax.psum(g, (axis_name,) + ba) * jnp.asarray(inv_bg, g.dtype),
+        lambda g: jax.lax.psum(g, (axis_name,) + ba) * inv_w.astype(g.dtype),
         dextra,
     )
-    dx = (jax.lax.psum(dx_mb, axis_name) * inv_bg).astype(x.dtype).reshape(x.shape)
+    dx = (jax.lax.psum(dx_mb, axis_name) * inv_w).astype(x.dtype).reshape(x.shape)
     return loss, dparams, dx, dextra
 
 
@@ -384,9 +399,6 @@ def pipeline_train_1f1b(
     lbl_spec = data_spec(labels)
 
     batch_axes_present = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
-    batch_group = 1
-    for a in batch_axes_present:
-        batch_group *= mesh.shape[a]
 
     fn = shard_map(
         functools.partial(
@@ -397,7 +409,6 @@ def pipeline_train_1f1b(
             num_microbatches=num_microbatches,
             num_stages=n_stages,
             batch_axes_present=batch_axes_present,
-            batch_group=batch_group,
         ),
         mesh=mesh,
         in_specs=(param_specs, x_spec, lbl_spec, extra_specs),
@@ -434,7 +445,8 @@ def pipeline_loss_1f1b(
             stage_fn, stacked, x, num_microbatches,
             mesh=mesh, axis_name=axis_name, batch_axes=batch_axes, seq_axis=seq_axis,
         )
-        return loss_fn(out, labels, extra)
+        lsum, w = loss_fn(out, labels, extra)
+        return lsum / jnp.maximum(w, 1e-9)
 
     def f_fwd(stacked, x, extra):
         loss, dstacked, dx, dextra = pipeline_train_1f1b(

@@ -327,15 +327,15 @@ class PipelineParallelPlugin:
 
     pp_size: int = 1
     num_microbatches: int = 1
-    schedule: str = "gpipe"  # "gpipe" | "1f1b"
+    # None = unset: resolves to $PP_SCHEDULE, then "gpipe".  A sentinel (not
+    # a "gpipe" default) so an EXPLICIT schedule="gpipe" beats the env var.
+    schedule: Optional[str] = None  # "gpipe" | "1f1b"
 
     def __post_init__(self):
         if self.pp_size == 1 and "PP_SIZE" in os.environ:
             self.pp_size = int(os.environ["PP_SIZE"])
-        # env fallback only when the field still holds its default — an
-        # explicitly constructed schedule wins (same pattern as PP_SIZE)
-        if self.schedule == "gpipe" and "PP_SCHEDULE" in os.environ:
-            self.schedule = os.environ["PP_SCHEDULE"]
+        if self.schedule is None:
+            self.schedule = os.environ.get("PP_SCHEDULE", "gpipe")
         if self.schedule not in ("gpipe", "1f1b"):
             raise ValueError(
                 f"unknown pipeline schedule {self.schedule!r}; use 'gpipe' or '1f1b'"

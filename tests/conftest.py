@@ -16,6 +16,17 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compilation cache: the suite is compile-bound (hundreds of
+# jit programs over identical tiny shapes), and the cache works on the CPU
+# backend too — measured 2× on a warm rerun.  Env vars (not config.update)
+# so subprocess-launched scripts (launcher/example tests) inherit it.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.environ.get("ACCELERATE_TPU_TEST_CACHE", "/tmp/accelerate_tpu_jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402

@@ -93,15 +93,65 @@ def test_ignore_index_parity():
     labels[:, -3:] = -100  # padded tail
     ln_w, ln_b = jnp.ones((c,)), jnp.zeros((c,))
     head_w = jnp.asarray(rng.normal(size=(v, c)), jnp.float32)
-    got = float(
-        _pure_lm_head_loss(h, jnp.asarray(labels), (ln_w, ln_b, head_w), eps=1e-5)
+    lsum, w = _pure_lm_head_loss(
+        h, jnp.asarray(labels), (ln_w, ln_b, head_w), eps=1e-5
     )
+    got = float(lsum) / float(w)
     # reference: the tape-path math on the same arrays
     from accelerate_tpu.models.gpt import _pure_layernorm
 
     logits = Tensor(_pure_layernorm(h, ln_w, ln_b, 1e-5) @ head_w.T)
     want = float(lm_shift_loss(logits, jnp.asarray(labels), v).data)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_padded_label_parity_between_schedules():
+    """UNEVEN -100 padding across microbatches: the fused loss must still be
+    the global token mean, not a mean of per-microbatch means (which would
+    over-weight heavily-padded microbatches)."""
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 1024, (32, 32)).astype(np.int32)
+    labels = ids.copy()
+    # ragged padding: rows get anywhere from 0 to 24 trailing -100s
+    for i in range(32):
+        pad = int(rng.integers(0, 25))
+        if pad:
+            labels[i, -pad:] = -100
+
+    def run(schedule):
+        Accelerator._reset_state()
+        nn.manual_seed(0)
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(pp_size=2),
+            pp_plugin=PipelineParallelPlugin(
+                pp_size=2, num_microbatches=8, schedule=schedule
+            ),
+            mixed_precision="no",
+        )
+        model = PipelinedGPTLMHeadModel(GPTConfig.tiny(), num_microbatches=8)
+        opt = optim.SGD(model.parameters(), lr=0.1)
+        model, opt = acc.prepare(model, opt)
+
+        def step_fn(x, y):
+            opt.zero_grad()
+            out = model(x, labels=y)
+            acc.backward(out["loss"])
+            opt.step()
+            return out["loss"]
+
+        step = acc.compile_step(step_fn)
+        x = batch_to_global_array(jnp.asarray(ids), mesh=acc.mesh)
+        y = batch_to_global_array(jnp.asarray(labels), mesh=acc.mesh)
+        losses = [float(step(x, y)) for _ in range(2)]
+        return losses, {n: np.asarray(p.data) for n, p in model.named_parameters()}
+
+    l_g, p_g = run("gpipe")
+    l_f, p_f = run("1f1b")
+    np.testing.assert_allclose(l_f, l_g, rtol=2e-5, atol=2e-5)
+    for name in p_g:
+        np.testing.assert_allclose(
+            p_f[name], p_g[name], rtol=3e-4, atol=3e-5, err_msg=name
+        )
 
 
 def test_1f1b_loss_decreases():

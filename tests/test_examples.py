@@ -13,6 +13,7 @@ import sys
 
 import pytest
 
+from accelerate_tpu.test_utils.testing import slow
 from accelerate_tpu.test_utils.examples import (
     examples_dir,
     feature_additions,
@@ -75,16 +76,24 @@ def test_feature_scripts_parse():
         COMPLETE,
         os.path.join(EXAMPLES, "cv_example.py"),
         os.path.join(EXAMPLES, "complete_cv_example.py"),
+        os.path.join(EXAMPLES, "llama_finetune_example.py"),
     ]
     assert len(scripts) >= 10
     for script in scripts:
         py_compile.compile(script, doraise=True)
 
 
+@slow
 @pytest.mark.parametrize("script", ["checkpointing.py"])
 def test_example_smoke_train_save_resume(tmp_path, script):
     """Run the checkpointing example end-to-end on tiny synthetic data, then
-    resume from its epoch checkpoint."""
+    resume from its epoch checkpoint.
+
+    RUN_SLOW-gated (~4 min: two cold BERT subprocesses): the save→resume
+    semantics it exercises are covered every run by
+    test_external_scripts.py::test_checkpointing_script and
+    tests/test_sharded_checkpoint.py; this adds only the example-script
+    CLI surface."""
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(
         os.environ,
@@ -125,8 +134,12 @@ def test_example_smoke_train_save_resume(tmp_path, script):
     assert os.path.isdir(os.path.join(out_dir, "epoch_1"))
 
 
+@slow
 def test_complete_cv_train_ckpt_resume(tmp_path):
-    """complete_cv_example end-to-end: train+ckpt, then mid-training resume."""
+    """complete_cv_example end-to-end: train+ckpt, then mid-training resume.
+
+    RUN_SLOW-gated (~1 min cold subprocess); cv_example coverage stays via
+    test_feature_scripts_parse + the conv-layer unit tests."""
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(
         os.environ,

@@ -4,7 +4,7 @@
 # occasional probes over a long window can catch the backend coming back.
 while true; do
   ts=$(date +%s)
-  full=$(timeout 120 python -c "
+  full=$(timeout -k 10 120 python -c "
 import jax
 ds = jax.devices()
 print('PROBE_OK', ds[0].platform, len(ds))
