@@ -38,12 +38,16 @@ BATCH = int(os.environ.get("BENCH_BATCH", 12))
 SEQ = int(os.environ.get("BENCH_SEQ", 1024))
 STEPS = int(os.environ.get("BENCH_STEPS", 50))
 WARMUP = int(os.environ.get("BENCH_WARMUP", 5))
-INIT_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", 3))
+# 5 spaced attempts (~11 min worst case incl. backoff): the observed outage
+# mode is hang-then-UNAVAILABLE with occasional recovery, so a longer probe
+# window materially raises the odds of catching the backend up (round-2
+# verdict recommendation); still bounded well inside BENCH_TOTAL_TIMEOUT
+INIT_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", 5))
 INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT", 120))
 # whole-run deadline: a wedged remote compile service can hang AFTER the
 # init probe succeeded (observed: device probe healthy, first big compile
 # never returns) — emit the fail-soft artifact instead of dying rc!=0
-TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT", 1500))
+TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT", 1800))
 
 
 _PRIMARY_RESULT: dict = {}
@@ -132,7 +136,7 @@ def _init_backend() -> dict:
         if ok:
             return diag
         if attempt < INIT_ATTEMPTS - 1:
-            time.sleep(min(15.0, 2.0 * (attempt + 1)))
+            time.sleep(min(30.0, 5.0 * (attempt + 1)))
     # fall back to CPU so the round still records a benchmark artifact
     os.environ["JAX_PLATFORMS"] = "cpu"
     diag["fallback"] = "cpu"

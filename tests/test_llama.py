@@ -106,6 +106,19 @@ def test_fsdp_training_loss_decreases():
     assert losses[-1] < losses[0], losses
 
 
+def test_unsupported_config_fields_rejected():
+    """Configs whose math we'd silently get wrong must refuse to load."""
+    from accelerate_tpu.utils.hf import llama_config_from_hf
+
+    base = {"hidden_size": 128, "num_attention_heads": 4, "vocab_size": 1024}
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        llama_config_from_hf({**base, "rope_scaling": {"rope_type": "llama3"}})
+    with pytest.raises(NotImplementedError, match="attention_bias"):
+        llama_config_from_hf({**base, "attention_bias": True})
+    with pytest.raises(NotImplementedError, match="mlp_bias"):
+        llama_config_from_hf({**base, "mlp_bias": True})
+
+
 def test_from_pretrained_roundtrip(tmp_path, hf_pair):
     """HF save_pretrained directory → utils/hf.from_pretrained parity."""
     hf, ours = hf_pair

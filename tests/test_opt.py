@@ -79,6 +79,15 @@ def test_shard_for_inference_generate():
     assert out.shape == (1, 12)
 
 
+def test_unsupported_config_fields_rejected():
+    from accelerate_tpu.utils.hf import opt_config_from_hf
+
+    with pytest.raises(NotImplementedError, match="activation_function"):
+        opt_config_from_hf({"activation_function": "gelu"})
+    with pytest.raises(NotImplementedError, match="word_embed_proj_dim"):
+        opt_config_from_hf({"hidden_size": 1024, "word_embed_proj_dim": 512})
+
+
 def test_from_pretrained_roundtrip(tmp_path, hf_pair):
     hf, ours = hf_pair
     hf.save_pretrained(tmp_path / "opt")

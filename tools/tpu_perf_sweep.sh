@@ -1,0 +1,66 @@
+#!/bin/bash
+# One-shot perf sweep for when the TPU tunnel is up: batch sizes × flash
+# backward block sizes on the flagship workload, the full BENCH_FULL run,
+# and a jax.profiler trace.  Each line of output is one bench.py JSON result.
+# Usage: bash tools/tpu_perf_sweep.sh [outdir]
+set -u
+OUT=$(realpath -m "${1:-/tmp/tpu_sweep}")
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== batch sweep ==" | tee "$OUT/sweep.log"
+for B in 8 12 16 24; do
+  BENCH_BATCH=$B BENCH_INIT_ATTEMPTS=2 timeout 1900 python bench.py \
+    2>"$OUT/err_b$B.log" | tee -a "$OUT/sweep.log"
+done
+
+# defaults are block 1024 at batch 12 (already measured above) — sweep the
+# NON-default backward tiles only
+echo "== flash bwd block sweep ==" | tee -a "$OUT/sweep.log"
+for BK in 256 512; do
+  ACCELERATE_TPU_FLASH_BWD_BLOCK_Q=$BK ACCELERATE_TPU_FLASH_BWD_BLOCK_K=$BK \
+    BENCH_INIT_ATTEMPTS=2 timeout 1900 python bench.py \
+    2>"$OUT/err_fb$BK.log" | tee -a "$OUT/sweep.log"
+done
+
+echo "== full workloads ==" | tee -a "$OUT/sweep.log"
+BENCH_FULL=1 BENCH_INIT_ATTEMPTS=2 BENCH_TOTAL_TIMEOUT=3000 timeout 3100 \
+  python bench.py 2>"$OUT/err_full.log" | tee -a "$OUT/sweep.log"
+
+echo "== profiler trace (10 steady-state steps) ==" | tee -a "$OUT/sweep.log"
+timeout 1200 python - "$OUT" <<'EOF' 2>"$OUT/err_profile.log" | tee -a "$OUT/sweep.log"
+import sys, os
+sys.path.insert(0, os.getcwd())
+out = sys.argv[1]
+import jax, jax.numpy as jnp, numpy as np
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator
+from accelerate_tpu.data_loader import batch_to_global_array
+from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+nn.manual_seed(0)
+acc = Accelerator(mixed_precision="bf16")
+model = GPTLMHeadModel(GPTConfig.small())
+opt = optim.AdamW(model.parameters(), lr=3e-4)
+model, opt = acc.prepare(model, opt)
+
+def fn(ids):
+    opt.zero_grad(); o = model(ids, labels=ids); acc.backward(o["loss"]); opt.step(); return o["loss"]
+
+step = acc.compile_step(fn)
+ids = batch_to_global_array(
+    jnp.asarray(np.random.default_rng(0).integers(0, 50304, (12, 1024)), jnp.int32),
+    mesh=acc.mesh)
+for _ in range(5):
+    step(ids)
+float(step(ids))
+jax.profiler.start_trace(os.path.join(out, "trace"))
+for _ in range(10):
+    loss = step(ids)
+float(loss)
+jax.profiler.stop_trace()
+print({"profile": os.path.join(out, "trace"), "final_loss": round(float(loss), 3)})
+EOF
+
+echo "sweep done; results in $OUT/sweep.log"
