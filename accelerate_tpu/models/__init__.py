@@ -1,5 +1,7 @@
 from .bert import BertConfig, BertForSequenceClassification, BertModel
 from .gpt import GPTConfig, GPTLMHeadModel, PipelinedGPTLMHeadModel
+from .gptj import GPTJConfig, GPTJForCausalLM
+from .gptneox import GPTNeoXConfig, GPTNeoXForCausalLM
 from .llama import LlamaConfig, LlamaForCausalLM
 from .opt import OPTConfig, OPTForCausalLM
 
@@ -16,4 +18,8 @@ MODEL_REGISTRY = {
     "opt-tiny": lambda: OPTForCausalLM(OPTConfig.tiny()),
     "opt-125m": lambda: OPTForCausalLM(OPTConfig.opt_125m()),
     "opt-6.7b": lambda: OPTForCausalLM(OPTConfig.opt_6_7b()),
+    "gptj-tiny": lambda: GPTJForCausalLM(GPTJConfig.tiny()),
+    "gptj-6b": lambda: GPTJForCausalLM(GPTJConfig.gptj_6b()),
+    "gptneox-tiny": lambda: GPTNeoXForCausalLM(GPTNeoXConfig.tiny()),
+    "gptneox-20b": lambda: GPTNeoXForCausalLM(GPTNeoXConfig.neox_20b()),
 }

@@ -1,0 +1,286 @@
+"""GPT-J family decoder — the reference's headline big-model-inference
+benchmark family (GPT-J-6B, reference
+benchmarks/big_model_inference/README.md:31-32).
+
+Parallel-residual decoder: attention AND MLP both read the same
+pre-norm ``ln_1(x)`` and add into the residual together
+(``x + attn(h) + mlp(h)``), rotary position embeddings in the
+*interleaved* (rotate-every-two) GPT-J convention on the first
+``rotary_dim`` head dims, untied LM head WITH bias.  Same one-math
+structure as models/llama.py: each layer's forward is a single
+``tape_op`` over the pure per-layer pair the KV-cache decode engine
+(models/generation.py) scans over.  Parameter naming mirrors HF
+(``h.N.attn.q_proj`` …) for key-mapped checkpoint ingestion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import Tensor
+from .gpt import _pure_layernorm, lm_shift_loss
+
+
+@dataclasses.dataclass
+class GPTJConfig:
+    vocab_size: int = 50400
+    n_positions: int = 2048
+    n_embd: int = 4096
+    n_layer: int = 28
+    n_head: int = 16
+    rotary_dim: int = 64
+    n_inner: int = 16384
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+
+    @classmethod
+    def tiny(cls) -> "GPTJConfig":
+        return cls(
+            vocab_size=1024, n_positions=256, n_embd=128, n_layer=2, n_head=4,
+            rotary_dim=16, n_inner=256,
+        )
+
+    @classmethod
+    def gptj_6b(cls) -> "GPTJConfig":
+        return cls()  # the defaults are GPT-J-6B
+
+
+# ---------------------------------------------------------------------------
+# Pure per-layer math.  Keys: ln1_w, ln1_b, q_w, k_w, v_w, o_w,
+# fcin_w, fcin_b, fcout_w, fcout_b (projections are bias-free except MLP).
+# ---------------------------------------------------------------------------
+_LAYER_KEYS = (
+    "ln1_w", "ln1_b", "q_w", "k_w", "v_w", "o_w",
+    "fcin_w", "fcin_b", "fcout_w", "fcout_b",
+)
+
+
+def _rope_interleaved(x, positions, rotary_dim: int):
+    """GPT-J rotary: rotate-every-two on the first ``rotary_dim`` dims.
+
+    HF convention (transformers GPTJAttention): fp32 sincos duplicated
+    per-pair, ``x1 = x[..., ::2]; x2 = x[..., 1::2]`` rotated and
+    re-interleaved; dims past ``rotary_dim`` pass through unchanged.
+    """
+    rot, pas = x[..., :rotary_dim], x[..., rotary_dim:]
+    inv = 1.0 / (
+        10000.0 ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim)
+    )
+    freqs = positions.astype(jnp.float32)[:, None] * inv[None, :]  # (s, r/2)
+    sin = jnp.repeat(jnp.sin(freqs), 2, axis=-1).astype(x.dtype)[None, None]
+    cos = jnp.repeat(jnp.cos(freqs), 2, axis=-1).astype(x.dtype)[None, None]
+    x1 = rot[..., ::2]
+    x2 = rot[..., 1::2]
+    rotated = jnp.stack([-x2, x1], axis=-1).reshape(rot.shape)
+    return jnp.concatenate([rot * cos + rotated * sin, pas], axis=-1)
+
+
+def gptj_attn_in(l, x, positions, *, n_head: int, rotary_dim: int, eps: float):
+    b, s, c = x.shape
+    d = c // n_head
+    h = _pure_layernorm(x, l["ln1_w"], l["ln1_b"], eps)
+
+    def heads(t):
+        return t.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
+
+    q = _rope_interleaved(heads(h @ l["q_w"].T), positions, rotary_dim)
+    k = _rope_interleaved(heads(h @ l["k_w"].T), positions, rotary_dim)
+    v = heads(h @ l["v_w"].T)
+    return q, k, v
+
+
+def gptj_attn_out(l, x, att, *, eps: float):
+    """Parallel residual: out_proj(att) + mlp(ln_1(x)) + x — the MLP reads
+    the SAME normed input as attention (GPT-J block shape)."""
+    b, s, c = x.shape
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, c)
+    h = _pure_layernorm(x, l["ln1_w"], l["ln1_b"], eps)
+    ff = jax.nn.gelu(h @ l["fcin_w"].T + l["fcin_b"], approximate=True)
+    return x + att @ l["o_w"].T + ff @ l["fcout_w"].T + l["fcout_b"]
+
+
+class GPTJBlock(nn.Module):
+    def __init__(self, config: GPTJConfig):
+        super().__init__()
+        self.config = config
+        c = config.n_embd
+        self.ln_1 = nn.LayerNorm(c, eps=config.layer_norm_eps)
+
+        class _Attn(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.q_proj = nn.Linear(c, c, bias=False)
+                self.k_proj = nn.Linear(c, c, bias=False)
+                self.v_proj = nn.Linear(c, c, bias=False)
+                self.out_proj = nn.Linear(c, c, bias=False)
+
+        class _MLP(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc_in = nn.Linear(c, config.n_inner)
+                self.fc_out = nn.Linear(config.n_inner, c)
+
+        self.attn = _Attn()
+        self.mlp = _MLP()
+
+    def param_tensors(self):
+        a, m = self.attn, self.mlp
+        return [  # order == _LAYER_KEYS
+            self.ln_1.weight, self.ln_1.bias,
+            a.q_proj.weight, a.k_proj.weight, a.v_proj.weight, a.out_proj.weight,
+            m.fc_in.weight, m.fc_in.bias, m.fc_out.weight, m.fc_out.bias,
+        ]
+
+    def forward(self, x):
+        cfg = self.config
+        positions = jnp.arange(x.shape[1])
+
+        def fn(xv, *flat):
+            from ..ops.attention import sdpa_tpu
+
+            l = dict(zip(_LAYER_KEYS, flat))
+            q, k, v = gptj_attn_in(
+                l, xv, positions,
+                n_head=cfg.n_head, rotary_dim=cfg.rotary_dim,
+                eps=cfg.layer_norm_eps,
+            )
+            att = sdpa_tpu(q, k, v, is_causal=True)
+            return gptj_attn_out(l, xv, att, eps=cfg.layer_norm_eps)
+
+        return nn.tape_op(fn, x, *self.param_tensors())
+
+
+class GPTJForCausalLM(nn.Module):
+    _no_split_modules = ["GPTJBlock"]
+    tp_plan = {
+        r".*\.(q_proj|k_proj|v_proj|fc_in)\.weight": ("tp", None),
+        r".*\.fc_in\.bias": ("tp",),
+        r".*\.(out_proj|fc_out)\.weight": (None, "tp"),
+        r"wte\.weight": ("tp", None),
+        r"lm_head\.weight": ("tp", None),
+        r"lm_head\.bias": ("tp",),
+    }
+
+    def __init__(self, config: GPTJConfig):
+        super().__init__()
+        self.config = config
+        self.wte = nn.Embedding(config.vocab_size, config.n_embd)
+        self.h = nn.ModuleList([GPTJBlock(config) for _ in range(config.n_layer)])
+        self.ln_f = nn.LayerNorm(config.n_embd, eps=config.layer_norm_eps)
+        self.lm_head = nn.Linear(config.n_embd, config.vocab_size)  # untied, biased
+        from ..nn import random as nn_random
+        from ..nn.meta import is_meta
+
+        std = config.initializer_range
+        for name, p in self.named_parameters():
+            if is_meta(p.data):
+                continue
+            if p.ndim >= 2:
+                p.data = std * jax.random.normal(nn_random.next_key(), p.shape, p.dtype)
+            elif name.endswith("bias"):
+                p.data = jnp.zeros_like(p.data)
+
+    def forward(self, input_ids, labels=None):
+        from ..parallel.sharding import constrain_activation
+
+        ids = jnp.asarray(input_ids.data if isinstance(input_ids, Tensor) else input_ids)
+        x = self.wte(ids)
+        x = constrain_activation(x)
+        for block in self.h:
+            x = constrain_activation(block(x))
+        x = self.ln_f(x)
+        logits = self.lm_head(x)
+        if labels is not None:
+            loss = lm_shift_loss(logits, labels, self.config.vocab_size)
+            return {"loss": loss, "logits": logits}
+        return {"logits": logits}
+
+    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0, rng=None):
+        from .generation import generate
+
+        return generate(self, input_ids, max_new_tokens, temperature, rng)
+
+    @property
+    def num_flops_per_token(self) -> float:
+        n = self.num_parameters
+        c = self.config
+        return 6 * n + 12 * c.n_layer * c.n_embd * c.n_positions
+
+    def _decoder_spec(self):
+        from .generation import DecoderSpec
+
+        cfg = self.config
+        return DecoderSpec(
+            family=GPTJ_DECODER,
+            cfg=_GPTJDecodeCfg(
+                n_head=cfg.n_head,
+                n_kv_head=cfg.n_head,
+                head_dim=cfg.n_embd // cfg.n_head,
+                rotary_dim=cfg.rotary_dim,
+                eps=cfg.layer_norm_eps,
+            ),
+            max_len=cfg.n_positions,
+            stack=self._stack_decoder_params,
+        )
+
+    def _stack_decoder_params(self) -> tuple[dict, dict]:
+        stacks = [b.param_tensors() for b in self.h]
+        layers = {
+            key: jnp.stack([ts[i].data for ts in stacks])
+            for i, key in enumerate(_LAYER_KEYS)
+        }
+        g = {
+            "wte": self.wte.weight.data,
+            "ln_f_w": self.ln_f.weight.data,
+            "ln_f_b": self.ln_f.bias.data,
+            "head_w": self.lm_head.weight.data,
+            "head_b": self.lm_head.bias.data,
+        }
+        return g, layers
+
+
+@dataclasses.dataclass(frozen=True)
+class _GPTJDecodeCfg:
+    n_head: int
+    n_kv_head: int
+    head_dim: int
+    rotary_dim: int
+    eps: float
+
+
+def _dec_embed(g, ids, positions, cfg):
+    return g["wte"][ids]
+
+
+def _dec_attn_in(l, x, positions, cfg):
+    return gptj_attn_in(
+        l, x, positions,
+        n_head=cfg.n_head, rotary_dim=cfg.rotary_dim, eps=cfg.eps,
+    )
+
+
+def _dec_attn_out(l, x, att, cfg):
+    return gptj_attn_out(l, x, att, eps=cfg.eps)
+
+
+def _dec_finalize(g, x, cfg):
+    x = _pure_layernorm(x[:, -1], g["ln_f_w"], g["ln_f_b"], cfg.eps)
+    return x @ g["head_w"].T + g["head_b"]
+
+
+def _make_decoder():
+    from .generation import DecoderFamily
+
+    return DecoderFamily(
+        embed=_dec_embed,
+        attn_in=_dec_attn_in,
+        attn_out=_dec_attn_out,
+        finalize=_dec_finalize,
+    )
+
+
+GPTJ_DECODER = _make_decoder()

@@ -1,0 +1,303 @@
+"""GPT-NeoX family decoder — the reference's largest benchmark family
+(GPT-Neo-X-20B, reference benchmarks/big_model_inference/README.md:33-34).
+
+Parallel-residual decoder with TWO layer norms per block
+(``x + attn(ln_attn(x)) + mlp(ln_mlp(x))`` when ``use_parallel_residual``,
+the 20B default), fused per-head-interleaved qkv projection with bias,
+rotate-half rotary on the first ``rotary_pct`` of head dims, exact (erf)
+GELU, untied bias-free LM head.  Same one-math structure as
+models/llama.py; parameter naming mirrors HF
+(``layers.N.attention.query_key_value`` …).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import Tensor
+from .gpt import _pure_layernorm, lm_shift_loss
+
+
+@dataclasses.dataclass
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 6144
+    num_hidden_layers: int = 44
+    num_attention_heads: int = 64
+    intermediate_size: int = 24576
+    max_position_embeddings: int = 2048
+    rotary_pct: float = 0.25
+    rotary_emb_base: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    use_parallel_residual: bool = True
+    initializer_range: float = 0.02
+
+    @classmethod
+    def tiny(cls) -> "GPTNeoXConfig":
+        return cls(
+            vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=256,
+            max_position_embeddings=256,
+        )
+
+    @classmethod
+    def neox_20b(cls) -> "GPTNeoXConfig":
+        return cls()  # the defaults are GPT-NeoX-20B
+
+    def __post_init__(self):
+        if not self.use_parallel_residual:
+            raise NotImplementedError(
+                "use_parallel_residual=False NeoX variants (pythia-70m-v0 era) "
+                "are not supported; every standard NeoX size is parallel"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Pure per-layer math.  Keys: ln1_{w,b} (input_layernorm),
+# qkv_{w,b} (fused, PER-HEAD interleaved [q|k|v] like HF), o_{w,b},
+# ln2_{w,b} (post_attention_layernorm), fcin_{w,b}, fcout_{w,b}.
+# ---------------------------------------------------------------------------
+_LAYER_KEYS = (
+    "ln1_w", "ln1_b", "qkv_w", "qkv_b", "o_w", "o_b",
+    "ln2_w", "ln2_b", "fcin_w", "fcin_b", "fcout_w", "fcout_b",
+)
+
+
+def _rope_half(x, positions, rotary_ndims: int, base: float):
+    """Rotate-half rotary on the first ``rotary_ndims`` dims (NeoX/Llama
+    convention), the rest pass through."""
+    rot, pas = x[..., :rotary_ndims], x[..., rotary_ndims:]
+    inv = 1.0 / (
+        base ** (jnp.arange(0, rotary_ndims, 2, dtype=jnp.float32) / rotary_ndims)
+    )
+    freqs = positions.astype(jnp.float32)[:, None] * inv[None, :]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    cos = jnp.cos(emb).astype(x.dtype)[None, None]
+    sin = jnp.sin(emb).astype(x.dtype)[None, None]
+    r1, r2 = rot[..., : rotary_ndims // 2], rot[..., rotary_ndims // 2 :]
+    rotated = jnp.concatenate([-r2, r1], axis=-1)
+    return jnp.concatenate([rot * cos + rotated * sin, pas], axis=-1)
+
+
+def neox_attn_in(l, x, positions, *, n_head: int, rotary_ndims: int, base: float, eps: float):
+    b, s, c = x.shape
+    d = c // n_head
+    h = _pure_layernorm(x, l["ln1_w"], l["ln1_b"], eps)
+    qkv = h @ l["qkv_w"].T + l["qkv_b"]
+    # HF NeoX fused layout: (b, s, H, 3*d) with [q|k|v] per head
+    qkv = qkv.reshape(b, s, n_head, 3 * d)
+    q = qkv[..., :d].transpose(0, 2, 1, 3)
+    k = qkv[..., d : 2 * d].transpose(0, 2, 1, 3)
+    v = qkv[..., 2 * d :].transpose(0, 2, 1, 3)
+    q = _rope_half(q, positions, rotary_ndims, base)
+    k = _rope_half(k, positions, rotary_ndims, base)
+    return q, k, v
+
+
+def neox_attn_out(l, x, att, *, eps: float):
+    """Parallel residual with separate norms: x + dense(att) + mlp(ln2(x))."""
+    b, s, c = x.shape
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, c)
+    h2 = _pure_layernorm(x, l["ln2_w"], l["ln2_b"], eps)
+    ff = jax.nn.gelu(h2 @ l["fcin_w"].T + l["fcin_b"], approximate=False)
+    return x + (att @ l["o_w"].T + l["o_b"]) + (ff @ l["fcout_w"].T + l["fcout_b"])
+
+
+class GPTNeoXLayer(nn.Module):
+    def __init__(self, config: GPTNeoXConfig):
+        super().__init__()
+        self.config = config
+        c = config.hidden_size
+        self.input_layernorm = nn.LayerNorm(c, eps=config.layer_norm_eps)
+        self.post_attention_layernorm = nn.LayerNorm(c, eps=config.layer_norm_eps)
+
+        class _Attn(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.query_key_value = nn.Linear(c, 3 * c)
+                self.dense = nn.Linear(c, c)
+
+        class _MLP(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.dense_h_to_4h = nn.Linear(c, config.intermediate_size)
+                self.dense_4h_to_h = nn.Linear(config.intermediate_size, c)
+
+        self.attention = _Attn()
+        self.mlp = _MLP()
+
+    def param_tensors(self):
+        a, m = self.attention, self.mlp
+        return [  # order == _LAYER_KEYS
+            self.input_layernorm.weight, self.input_layernorm.bias,
+            a.query_key_value.weight, a.query_key_value.bias,
+            a.dense.weight, a.dense.bias,
+            self.post_attention_layernorm.weight, self.post_attention_layernorm.bias,
+            m.dense_h_to_4h.weight, m.dense_h_to_4h.bias,
+            m.dense_4h_to_h.weight, m.dense_4h_to_h.bias,
+        ]
+
+    def forward(self, x):
+        cfg = self.config
+        positions = jnp.arange(x.shape[1])
+        d = cfg.hidden_size // cfg.num_attention_heads
+        rotary_ndims = int(d * cfg.rotary_pct)
+
+        def fn(xv, *flat):
+            from ..ops.attention import sdpa_tpu
+
+            l = dict(zip(_LAYER_KEYS, flat))
+            q, k, v = neox_attn_in(
+                l, xv, positions,
+                n_head=cfg.num_attention_heads, rotary_ndims=rotary_ndims,
+                base=cfg.rotary_emb_base, eps=cfg.layer_norm_eps,
+            )
+            att = sdpa_tpu(q, k, v, is_causal=True)
+            return neox_attn_out(l, xv, att, eps=cfg.layer_norm_eps)
+
+        return nn.tape_op(fn, x, *self.param_tensors())
+
+
+class GPTNeoXForCausalLM(nn.Module):
+    _no_split_modules = ["GPTNeoXLayer"]
+    tp_plan = {
+        r".*\.query_key_value\.weight": ("tp", None),
+        r".*\.query_key_value\.bias": ("tp",),
+        r".*\.dense\.weight": (None, "tp"),
+        r".*\.dense_h_to_4h\.weight": ("tp", None),
+        r".*\.dense_h_to_4h\.bias": ("tp",),
+        r".*\.dense_4h_to_h\.weight": (None, "tp"),
+        r"embed_in\.weight": ("tp", None),
+        r"embed_out\.weight": ("tp", None),
+    }
+
+    def __init__(self, config: GPTNeoXConfig):
+        super().__init__()
+        self.config = config
+        self.embed_in = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.ModuleList(
+            [GPTNeoXLayer(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.final_layer_norm = nn.LayerNorm(
+            config.hidden_size, eps=config.layer_norm_eps
+        )
+        self.embed_out = nn.Linear(config.hidden_size, config.vocab_size, bias=False)
+        from ..nn import random as nn_random
+        from ..nn.meta import is_meta
+
+        std = config.initializer_range
+        for name, p in self.named_parameters():
+            if is_meta(p.data):
+                continue
+            if p.ndim >= 2:
+                p.data = std * jax.random.normal(nn_random.next_key(), p.shape, p.dtype)
+            elif name.endswith("bias"):
+                p.data = jnp.zeros_like(p.data)
+
+    def forward(self, input_ids, labels=None):
+        from ..parallel.sharding import constrain_activation
+
+        ids = jnp.asarray(input_ids.data if isinstance(input_ids, Tensor) else input_ids)
+        x = self.embed_in(ids)
+        x = constrain_activation(x)
+        for layer in self.layers:
+            x = constrain_activation(layer(x))
+        x = self.final_layer_norm(x)
+        logits = self.embed_out(x)
+        if labels is not None:
+            loss = lm_shift_loss(logits, labels, self.config.vocab_size)
+            return {"loss": loss, "logits": logits}
+        return {"logits": logits}
+
+    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0, rng=None):
+        from .generation import generate
+
+        return generate(self, input_ids, max_new_tokens, temperature, rng)
+
+    @property
+    def num_flops_per_token(self) -> float:
+        n = self.num_parameters
+        c = self.config
+        return 6 * n + 12 * c.num_hidden_layers * c.hidden_size * c.max_position_embeddings
+
+    def _decoder_spec(self):
+        from .generation import DecoderSpec
+
+        cfg = self.config
+        d = cfg.hidden_size // cfg.num_attention_heads
+        return DecoderSpec(
+            family=NEOX_DECODER,
+            cfg=_NeoXDecodeCfg(
+                n_head=cfg.num_attention_heads,
+                n_kv_head=cfg.num_attention_heads,
+                head_dim=d,
+                rotary_ndims=int(d * cfg.rotary_pct),
+                base=cfg.rotary_emb_base,
+                eps=cfg.layer_norm_eps,
+            ),
+            max_len=cfg.max_position_embeddings,
+            stack=self._stack_decoder_params,
+        )
+
+    def _stack_decoder_params(self) -> tuple[dict, dict]:
+        stacks = [b.param_tensors() for b in self.layers]
+        layers = {
+            key: jnp.stack([ts[i].data for ts in stacks])
+            for i, key in enumerate(_LAYER_KEYS)
+        }
+        g = {
+            "wte": self.embed_in.weight.data,
+            "ln_f_w": self.final_layer_norm.weight.data,
+            "ln_f_b": self.final_layer_norm.bias.data,
+            "head_w": self.embed_out.weight.data,
+        }
+        return g, layers
+
+
+@dataclasses.dataclass(frozen=True)
+class _NeoXDecodeCfg:
+    n_head: int
+    n_kv_head: int
+    head_dim: int
+    rotary_ndims: int
+    base: float
+    eps: float
+
+
+def _dec_embed(g, ids, positions, cfg):
+    return g["wte"][ids]
+
+
+def _dec_attn_in(l, x, positions, cfg):
+    return neox_attn_in(
+        l, x, positions,
+        n_head=cfg.n_head, rotary_ndims=cfg.rotary_ndims,
+        base=cfg.base, eps=cfg.eps,
+    )
+
+
+def _dec_attn_out(l, x, att, cfg):
+    return neox_attn_out(l, x, att, eps=cfg.eps)
+
+
+def _dec_finalize(g, x, cfg):
+    x = _pure_layernorm(x[:, -1], g["ln_f_w"], g["ln_f_b"], cfg.eps)
+    return x @ g["head_w"].T
+
+
+def _make_decoder():
+    from .generation import DecoderFamily
+
+    return DecoderFamily(
+        embed=_dec_embed,
+        attn_in=_dec_attn_in,
+        attn_out=_dec_attn_out,
+        finalize=_dec_finalize,
+    )
+
+
+NEOX_DECODER = _make_decoder()

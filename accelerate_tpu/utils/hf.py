@@ -138,6 +138,26 @@ def map_llama_key(hf_key: str) -> Optional[str]:
     return key
 
 
+def map_gptj_key(hf_key: str) -> Optional[str]:
+    """HF GPTJForCausalLM key → models/gptj.py key (prefix strip)."""
+    if re.search(r"\.attn\.(bias|masked_bias)$", hf_key):
+        return None  # causal-mask buffers
+    key = hf_key
+    if key.startswith("transformer."):
+        key = key[len("transformer."):]
+    return key
+
+
+def map_gptneox_key(hf_key: str) -> Optional[str]:
+    """HF GPTNeoXForCausalLM key → models/gptneox.py key (prefix strip)."""
+    if re.search(r"(rotary_emb\.|attention\.(bias|masked_bias)$)", hf_key):
+        return None  # computed rotary tables / mask buffers
+    key = hf_key
+    if key.startswith("gpt_neox."):
+        key = key[len("gpt_neox."):]
+    return key
+
+
 def map_opt_key(hf_key: str) -> Optional[str]:
     """HF OPTForCausalLM key → models/opt.py key (prefix strip + tied head)."""
     if hf_key == "lm_head.weight":
@@ -279,6 +299,58 @@ def llama_config_from_hf(cfg: dict):
     )
 
 
+def gptj_config_from_hf(cfg: dict):
+    from ..models.gptj import GPTJConfig
+
+    act = cfg.get("activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise NotImplementedError(
+            f"activation_function={act!r} is not supported; models/gptj.py "
+            "implements gelu_new (tanh approx), GPT-J's standard activation"
+        )
+    n_embd = cfg.get("n_embd", 4096)
+    return GPTJConfig(
+        vocab_size=cfg.get("vocab_size", 50400),
+        n_positions=cfg.get("n_positions", 2048),
+        n_embd=n_embd,
+        n_layer=cfg.get("n_layer", 28),
+        n_head=cfg.get("n_head", 16),
+        # HF semantics: rotary_dim=None means FULL per-head rotary, i.e.
+        # head_dim — which n_embd // n_head is
+        rotary_dim=cfg.get("rotary_dim") or n_embd // cfg.get("n_head", 16),
+        n_inner=cfg.get("n_inner") or 4 * n_embd,
+        layer_norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def gptneox_config_from_hf(cfg: dict):
+    from ..models.gptneox import GPTNeoXConfig
+
+    if cfg.get("rope_scaling"):
+        raise NotImplementedError(
+            f"rope_scaling={cfg['rope_scaling']!r} is not supported; only "
+            "plain-base rotary embeddings are implemented in models/gptneox.py"
+        )
+    act = cfg.get("hidden_act", "gelu")
+    if act != "gelu":
+        raise NotImplementedError(
+            f"hidden_act={act!r} is not supported; models/gptneox.py "
+            "implements exact (erf) gelu, NeoX's standard activation"
+        )
+    return GPTNeoXConfig(
+        vocab_size=cfg.get("vocab_size", 50432),
+        hidden_size=cfg.get("hidden_size", 6144),
+        num_hidden_layers=cfg.get("num_hidden_layers", 44),
+        num_attention_heads=cfg.get("num_attention_heads", 64),
+        intermediate_size=cfg.get("intermediate_size", 24576),
+        max_position_embeddings=cfg.get("max_position_embeddings", 2048),
+        rotary_pct=cfg.get("rotary_pct", 0.25),
+        rotary_emb_base=cfg.get("rotary_emb_base", 10000.0),
+        layer_norm_eps=cfg.get("layer_norm_eps", 1e-5),
+        use_parallel_residual=cfg.get("use_parallel_residual", True),
+    )
+
+
 def opt_config_from_hf(cfg: dict):
     from ..models.opt import OPTConfig
 
@@ -323,12 +395,16 @@ def from_pretrained(path: str, architecture: Optional[str] = None, num_labels: i
             architecture = "gpt2"
         elif model_type == "llama" or "Llama" in archs:
             architecture = "llama"
+        elif model_type == "gptj" or "GPTJ" in archs:
+            architecture = "gptj"
+        elif model_type == "gpt_neox" or "GPTNeoX" in archs:
+            architecture = "gptneox"
         elif model_type == "opt" or "OPT" in archs:
             architecture = "opt"
         else:
             raise ValueError(
                 f"cannot infer architecture from {path}; pass "
-                "architecture='bert'|'gpt2'|'llama'|'opt'"
+                "architecture='bert'|'gpt2'|'llama'|'gptj'|'gptneox'|'opt'"
             )
     state = load_hf_state_dict(path)
     if architecture == "bert":
@@ -371,5 +447,21 @@ def from_pretrained(path: str, architecture: Optional[str] = None, num_labels: i
         missing = [m for m in missing if "lm_head" not in m]
         if missing:
             raise ValueError(f"OPT load left weights uninitialised: {missing[:8]}")
+        return model
+    if architecture == "gptj":
+        from ..models.gptj import GPTJForCausalLM
+
+        model = GPTJForCausalLM(gptj_config_from_hf(cfg))
+        missing, _ = load_mapped_state_dict(model, state, map_gptj_key)
+        if missing:
+            raise ValueError(f"GPT-J load left weights uninitialised: {missing[:8]}")
+        return model
+    if architecture == "gptneox":
+        from ..models.gptneox import GPTNeoXForCausalLM
+
+        model = GPTNeoXForCausalLM(gptneox_config_from_hf(cfg))
+        missing, _ = load_mapped_state_dict(model, state, map_gptneox_key)
+        if missing:
+            raise ValueError(f"GPT-NeoX load left weights uninitialised: {missing[:8]}")
         return model
     raise ValueError(f"unsupported architecture {architecture!r}")

@@ -104,10 +104,14 @@ def _convert_transformers(tm):
     from .hf import (
         bert_config_from_hf,
         gpt2_config_from_hf,
+        gptj_config_from_hf,
+        gptneox_config_from_hf,
         llama_config_from_hf,
         load_mapped_state_dict,
         map_bert_key,
         map_gpt2_key,
+        map_gptj_key,
+        map_gptneox_key,
         map_llama_key,
         map_opt_key,
         opt_config_from_hf,
@@ -157,6 +161,26 @@ def _convert_transformers(tm):
         missing = [m for m in missing if "lm_head" not in m]  # tied to wte
         if missing:
             raise ValueError(f"OPT conversion left weights uninitialised: {missing[:4]}")
+        return model
+    if cls_name == "GPTJForCausalLM":
+        from ..models.gptj import GPTJForCausalLM
+
+        model = GPTJForCausalLM(gptj_config_from_hf(cfg))
+        missing, _ = load_mapped_state_dict(model, state, map_gptj_key)
+        if missing:  # untied biased head must come from the checkpoint
+            raise ValueError(
+                f"GPT-J conversion left weights uninitialised: {missing[:4]}"
+            )
+        return model
+    if cls_name == "GPTNeoXForCausalLM":
+        from ..models.gptneox import GPTNeoXForCausalLM
+
+        model = GPTNeoXForCausalLM(gptneox_config_from_hf(cfg))
+        missing, _ = load_mapped_state_dict(model, state, map_gptneox_key)
+        if missing:
+            raise ValueError(
+                f"GPT-NeoX conversion left weights uninitialised: {missing[:4]}"
+            )
         return model
     return None
 

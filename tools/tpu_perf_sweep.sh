@@ -9,9 +9,19 @@ mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
 echo "== batch sweep ==" | tee "$OUT/sweep.log"
+first=1
 for B in 8 12 16 24; do
   BENCH_BATCH=$B BENCH_INIT_ATTEMPTS=2 timeout 1900 python bench.py \
     2>"$OUT/err_b$B.log" | tee -a "$OUT/sweep.log"
+  if [ "$first" = 1 ]; then
+    first=0
+    # tunnel down → every further run would burn its full timeout on the
+    # same CPU fallback; stop and let the operator retry later
+    if grep -q '"fallback": "cpu"' "$OUT/sweep.log"; then
+      echo "backend unavailable (CPU fallback) — aborting sweep" | tee -a "$OUT/sweep.log"
+      exit 1
+    fi
+  fi
 done
 
 # defaults are block 1024 at batch 12 (already measured above) — sweep the
