@@ -4,6 +4,7 @@ from .gptj import GPTJConfig, GPTJForCausalLM
 from .gptneox import GPTNeoXConfig, GPTNeoXForCausalLM
 from .llama import LlamaConfig, LlamaForCausalLM
 from .opt import OPTConfig, OPTForCausalLM
+from .t5 import T5Config, T5ForConditionalGeneration
 
 # name → zero-arg builder; used by `accelerate-tpu estimate-memory` and tests
 MODEL_REGISTRY = {
@@ -22,4 +23,7 @@ MODEL_REGISTRY = {
     "gptj-6b": lambda: GPTJForCausalLM(GPTJConfig.gptj_6b()),
     "gptneox-tiny": lambda: GPTNeoXForCausalLM(GPTNeoXConfig.tiny()),
     "gptneox-20b": lambda: GPTNeoXForCausalLM(GPTNeoXConfig.neox_20b()),
+    "t5-tiny": lambda: T5ForConditionalGeneration(T5Config.tiny()),
+    "t5-small": lambda: T5ForConditionalGeneration(T5Config.t5_small()),
+    "t0pp-11b": lambda: T5ForConditionalGeneration(T5Config.t0pp_geometry()),
 }

@@ -1,0 +1,580 @@
+"""T5 encoder-decoder family — the reference's T0pp-11B benchmark family
+(reference benchmarks/big_model_inference/README.md:35).
+
+T5 specifics honoured for exact HF parity (all numerically tested):
+no-mean RMS layer norm, UNscaled attention scores (1/√d is baked into the
+initialisation) plus a shared relative-position bias computed by each
+stack's FIRST block, relu or gated-gelu FFN (v1.1/T0pp), and the
+``d_model**-0.5`` logits scaling when the head is tied (v1.0).
+
+Structure follows the house one-math pattern: module classes carry
+HF-shaped parameter names for key-mapped checkpoint ingestion, every
+block's forward is one ``tape_op`` over pure per-layer functions, and the
+same pure functions drive the jitted encoder-once + cached-decoder
+``generate`` (cross-attention K/V precomputed, self-attention cache updated
+with ``dynamic_update_slice`` inside one ``lax.scan``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import Tensor
+
+
+@dataclasses.dataclass
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: int = 6
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"  # "relu" | "gated-gelu"
+    tie_word_embeddings: bool = True
+    decoder_start_token_id: int = 0
+    pad_token_id: int = 0
+    initializer_factor: float = 1.0
+
+    @classmethod
+    def tiny(cls) -> "T5Config":
+        return cls(
+            vocab_size=256, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+            num_decoder_layers=2, num_heads=4, relative_attention_num_buckets=8,
+        )
+
+    @classmethod
+    def t5_small(cls) -> "T5Config":
+        return cls()
+
+    @classmethod
+    def t0pp_geometry(cls) -> "T5Config":
+        # T0pp == T5-v1.1-xxl finetune: 11B, gated-gelu, untied head
+        return cls(
+            d_model=4096, d_kv=64, d_ff=10240, num_layers=24,
+            num_decoder_layers=24, num_heads=64,
+            feed_forward_proj="gated-gelu", tie_word_embeddings=False,
+        )
+
+    def __post_init__(self):
+        if self.feed_forward_proj not in ("relu", "gated-gelu"):
+            raise NotImplementedError(
+                f"feed_forward_proj={self.feed_forward_proj!r} unsupported; "
+                "T5 v1.0 uses 'relu', v1.1/T0pp 'gated-gelu'"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Pure math
+# ---------------------------------------------------------------------------
+def _t5_norm(x, w, eps):
+    # T5LayerNorm: RMS WITHOUT mean subtraction, fp32 variance
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return w * (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+
+
+def _rel_bucket(rel_pos, *, bidirectional: bool, num_buckets: int, max_distance: int):
+    """HF T5 _relative_position_bucket, pure jnp (rel_pos = key - query)."""
+    ret = jnp.zeros_like(rel_pos)
+    n = rel_pos
+    if bidirectional:
+        num_buckets = num_buckets // 2
+        ret = ret + (n > 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = -jnp.minimum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-9)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, large)
+
+
+def position_bias(table, q_pos, k_pos, *, bidirectional: bool, num_buckets: int, max_distance: int):
+    """(q, k) relative-attention bias from the bucket embedding ``table``
+    ((num_buckets, n_heads)) → (1, H, q, k)."""
+    rel = k_pos[None, :] - q_pos[:, None]  # (q, k)
+    buckets = _rel_bucket(
+        rel, bidirectional=bidirectional, num_buckets=num_buckets,
+        max_distance=max_distance,
+    )
+    return table[buckets].transpose(2, 0, 1)[None]  # (1, H, q, k)
+
+
+def t5_attention(q, k, v, bias):
+    """UNscaled attention + additive bias, fp32 softmax.
+
+    ``q: (b, H, s, d)``; ``k, v: (b, H, T, d)``; ``bias: (1, H, s, T)``
+    (carries the causal/visibility mask as -inf entries).
+    """
+    scores = jnp.einsum("bhsd,bhTd->bhsT", q, k, preferred_element_type=jnp.float32)
+    scores = scores + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhsT,bhTd->bhsd", probs, v)
+
+
+def _heads(t, n_head, d):
+    b, s, _ = t.shape
+    return t.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
+
+
+def _merge(t):
+    b, h, s, d = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+def t5_self_attn(l, x, bias, *, n_head: int, d_kv: int, eps: float, prefix: str = "sa"):
+    """layer_norm → q/k/v → biased attention → o-proj + residual."""
+    h = _t5_norm(x, l[f"{prefix}_ln"], eps)
+    q = _heads(h @ l[f"{prefix}_q"].T, n_head, d_kv)
+    k = _heads(h @ l[f"{prefix}_k"].T, n_head, d_kv)
+    v = _heads(h @ l[f"{prefix}_v"].T, n_head, d_kv)
+    att = t5_attention(q, k, v, bias)
+    return x + _merge(att) @ l[f"{prefix}_o"].T
+
+
+def t5_cross_attn(l, x, enc_k, enc_v, *, n_head: int, d_kv: int, eps: float):
+    """Cross-attention against precomputed encoder K/V (zero bias)."""
+    h = _t5_norm(x, l["ca_ln"], eps)
+    q = _heads(h @ l["ca_q"].T, n_head, d_kv)
+    bias = jnp.zeros((1, 1, q.shape[2], enc_k.shape[2]), x.dtype)
+    att = t5_attention(q, enc_k, enc_v, bias)
+    return x + _merge(att) @ l["ca_o"].T
+
+
+def t5_ff(l, x, *, eps: float, gated: bool):
+    h = _t5_norm(x, l["ff_ln"], eps)
+    if gated:
+        ff = jax.nn.gelu(h @ l["wi0"].T, approximate=True) * (h @ l["wi1"].T)
+    else:
+        ff = jnp.maximum(h @ l["wi"].T, 0.0)
+    return x + ff @ l["wo"].T
+
+
+# ---------------------------------------------------------------------------
+# Modules (HF-shaped names: encoder.block.N.layer.0.SelfAttention.q ...)
+# ---------------------------------------------------------------------------
+class T5Attention(nn.Module):
+    def __init__(self, config: T5Config, has_rel_bias: bool):
+        super().__init__()
+        inner = config.num_heads * config.d_kv
+        self.q = nn.Linear(config.d_model, inner, bias=False)
+        self.k = nn.Linear(config.d_model, inner, bias=False)
+        self.v = nn.Linear(config.d_model, inner, bias=False)
+        self.o = nn.Linear(inner, config.d_model, bias=False)
+        if has_rel_bias:
+            self.relative_attention_bias = nn.Embedding(
+                config.relative_attention_num_buckets, config.num_heads
+            )
+
+
+class _SelfLayer(nn.Module):
+    def __init__(self, config: T5Config, has_rel_bias: bool):
+        super().__init__()
+        self.SelfAttention = T5Attention(config, has_rel_bias)
+        self.layer_norm = nn.RMSNorm(config.d_model, eps=config.layer_norm_epsilon)
+
+
+class _CrossLayer(nn.Module):
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.EncDecAttention = T5Attention(config, has_rel_bias=False)
+        self.layer_norm = nn.RMSNorm(config.d_model, eps=config.layer_norm_epsilon)
+
+
+class _FFLayer(nn.Module):
+    def __init__(self, config: T5Config):
+        super().__init__()
+
+        class _Dense(nn.Module):
+            def __init__(self):
+                super().__init__()
+                if config.feed_forward_proj == "gated-gelu":
+                    self.wi_0 = nn.Linear(config.d_model, config.d_ff, bias=False)
+                    self.wi_1 = nn.Linear(config.d_model, config.d_ff, bias=False)
+                else:
+                    self.wi = nn.Linear(config.d_model, config.d_ff, bias=False)
+                self.wo = nn.Linear(config.d_ff, config.d_model, bias=False)
+
+        self.DenseReluDense = _Dense()
+        self.layer_norm = nn.RMSNorm(config.d_model, eps=config.layer_norm_epsilon)
+
+
+class T5Block(nn.Module):
+    def __init__(self, config: T5Config, is_decoder: bool, has_rel_bias: bool):
+        super().__init__()
+        self.config = config
+        self.is_decoder = is_decoder
+        layers = [_SelfLayer(config, has_rel_bias)]
+        if is_decoder:
+            layers.append(_CrossLayer(config))
+        layers.append(_FFLayer(config))
+        self.layer = nn.ModuleList(layers)
+
+    def _self_params(self):
+        sa = self.layer[0].SelfAttention
+        return {
+            "sa_ln": self.layer[0].layer_norm.weight,
+            "sa_q": sa.q.weight, "sa_k": sa.k.weight,
+            "sa_v": sa.v.weight, "sa_o": sa.o.weight,
+        }
+
+    def _cross_params(self):
+        ca = self.layer[1].EncDecAttention
+        return {
+            "ca_ln": self.layer[1].layer_norm.weight,
+            "ca_q": ca.q.weight, "ca_k": ca.k.weight,
+            "ca_v": ca.v.weight, "ca_o": ca.o.weight,
+        }
+
+    def _ff_params(self):
+        ff = self.layer[-1]
+        d = ff.DenseReluDense
+        out = {"ff_ln": ff.layer_norm.weight, "wo": d.wo.weight}
+        if self.config.feed_forward_proj == "gated-gelu":
+            out.update({"wi0": d.wi_0.weight, "wi1": d.wi_1.weight})
+        else:
+            out["wi"] = d.wi.weight
+        return out
+
+
+class _Stack(nn.Module):
+    """Encoder or decoder stack; block 0 owns the shared position-bias table."""
+
+    def __init__(self, config: T5Config, is_decoder: bool, n_layers: int):
+        super().__init__()
+        self.config = config
+        self.is_decoder = is_decoder
+        self.block = nn.ModuleList(
+            [T5Block(config, is_decoder, has_rel_bias=(i == 0)) for i in range(n_layers)]
+        )
+        self.final_layer_norm = nn.RMSNorm(config.d_model, eps=config.layer_norm_epsilon)
+
+    def bias_table(self):
+        return self.block[0].layer[0].SelfAttention.relative_attention_bias.weight
+
+    def run(self, x, enc=None):
+        """x: (b, s, d) Tensor; enc: encoder output Tensor for decoders."""
+        cfg = self.config
+        s = x.shape[1]
+        pos = jnp.arange(s)
+        neg = jnp.float32(-1e9)
+
+        # position bias computed ONCE per stack (HF does the same in block 0
+        # and reuses it): an O(s²·heads) tensor — per-block recompute at T0pp
+        # geometry would be 24 × (1, 64, s, s) fp32 rebuilds per forward.
+        # A tape_op over the table keeps it differentiable: every block's
+        # grads flow into this node and accumulate on the shared table.
+        def make_bias(table):
+            bias = position_bias(
+                table, pos, pos,
+                bidirectional=not self.is_decoder,
+                num_buckets=cfg.relative_attention_num_buckets,
+                max_distance=cfg.relative_attention_max_distance,
+            )
+            if self.is_decoder:
+                causal = pos[:, None] >= pos[None, :]
+                bias = jnp.where(causal[None, None], bias, neg)
+            return bias
+
+        bias_t = nn.tape_op(make_bias, self.bias_table())
+
+        for i, block in enumerate(self.block):
+            params = dict(block._self_params())
+            params.update(block._ff_params())
+            tensors = [x, bias_t]
+            if self.is_decoder:
+                params.update(block._cross_params())
+                tensors.append(enc)
+            keys = [k for k in params]
+
+            def fn(xv, bias, *rest, _keys=tuple(keys)):
+                encv = rest[0] if self.is_decoder else None
+                flat = rest[1:] if self.is_decoder else rest
+                l = dict(zip(_keys, flat))
+                h = t5_self_attn(
+                    l, xv, bias, n_head=cfg.num_heads, d_kv=cfg.d_kv,
+                    eps=cfg.layer_norm_epsilon,
+                )
+                if self.is_decoder:
+                    ek = _heads(encv @ l["ca_k"].T, cfg.num_heads, cfg.d_kv)
+                    ev = _heads(encv @ l["ca_v"].T, cfg.num_heads, cfg.d_kv)
+                    h = t5_cross_attn(
+                        l, h, ek, ev, n_head=cfg.num_heads, d_kv=cfg.d_kv,
+                        eps=cfg.layer_norm_epsilon,
+                    )
+                return t5_ff(
+                    l, h, eps=cfg.layer_norm_epsilon,
+                    gated=cfg.feed_forward_proj == "gated-gelu",
+                )
+
+            x = nn.tape_op(fn, *tensors, *params.values())
+        return x
+
+
+class T5ForConditionalGeneration(nn.Module):
+    _no_split_modules = ["T5Block"]
+    tp_plan = {
+        r".*\.(q|k|v|wi|wi_0|wi_1)\.weight": ("tp", None),
+        r".*\.(o|wo)\.weight": (None, "tp"),
+        r"shared\.weight": ("tp", None),
+        r"lm_head\.weight": ("tp", None),  # untied head (v1.1/T0pp)
+    }
+
+    def __init__(self, config: T5Config):
+        super().__init__()
+        self.config = config
+        self.shared = nn.Embedding(config.vocab_size, config.d_model)
+        self.encoder = _Stack(config, is_decoder=False, n_layers=config.num_layers)
+        self.decoder = _Stack(config, is_decoder=True, n_layers=config.num_decoder_layers)
+        from ..nn.meta import is_meta, meta_init
+
+        if config.tie_word_embeddings:
+            with meta_init():
+                self.lm_head = nn.Linear(config.d_model, config.vocab_size, bias=False)
+            self.lm_head.weight = self.shared.weight
+        else:
+            self.lm_head = nn.Linear(config.d_model, config.vocab_size, bias=False)
+        from ..nn import random as nn_random
+
+        # T5 init: factor-scaled normals (HF T5PreTrainedModel._init_weights);
+        # fan-in scaling per projection kind
+        f = config.initializer_factor
+        for name, p in self.named_parameters():
+            if is_meta(p.data) or p.ndim < 2:
+                continue
+            if "relative_attention_bias" in name or name.startswith("shared"):
+                std = f * (config.d_model**-0.5)
+            elif name.endswith((".q.weight",)):
+                std = f * ((config.d_model * config.d_kv) ** -0.5)
+            elif name.endswith((".k.weight", ".v.weight")):
+                std = f * (config.d_model**-0.5)
+            elif name.endswith(".o.weight"):
+                std = f * ((config.num_heads * config.d_kv) ** -0.5)
+            elif "wo" in name:
+                std = f * (config.d_ff**-0.5)
+            else:  # wi / wi_0 / wi_1 / untied lm_head
+                std = f * (config.d_model**-0.5)
+            p.data = std * jax.random.normal(nn_random.next_key(), p.shape, p.dtype)
+
+    def _shift_right(self, labels):
+        cfg = self.config
+        start = jnp.full((labels.shape[0], 1), cfg.decoder_start_token_id, labels.dtype)
+        shifted = jnp.concatenate([start, labels[:, :-1]], axis=1)
+        # -100 positions are not real tokens; feed pad instead
+        return jnp.where(shifted == -100, cfg.pad_token_id, shifted)
+
+    def encode(self, input_ids):
+        ids = jnp.asarray(input_ids.data if isinstance(input_ids, Tensor) else input_ids)
+        x = self.shared(ids)
+        x = self.encoder.run(x)
+        from ..nn import F
+
+        return F.rms_norm(x, self.encoder.final_layer_norm.weight,
+                          self.config.layer_norm_epsilon)
+
+    def forward(self, input_ids, decoder_input_ids=None, labels=None):
+        from ..nn import F
+
+        cfg = self.config
+        enc = self.encode(input_ids)
+        if decoder_input_ids is None:
+            if labels is None:
+                raise ValueError("need decoder_input_ids or labels")
+            lab = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
+            decoder_input_ids = self._shift_right(lab)
+        dec_ids = jnp.asarray(
+            decoder_input_ids.data
+            if isinstance(decoder_input_ids, Tensor)
+            else decoder_input_ids
+        )
+        x = self.shared(dec_ids)
+        x = self.decoder.run(x, enc=enc)
+        x = F.rms_norm(x, self.decoder.final_layer_norm.weight, cfg.layer_norm_epsilon)
+        if cfg.tie_word_embeddings:
+            x = x * (cfg.d_model**-0.5)  # HF tied-head scaling
+        logits = self.lm_head(x)
+        if labels is not None:
+            lab = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
+            loss = F.cross_entropy(
+                logits.reshape(-1, cfg.vocab_size), lab.reshape(-1)
+            )
+            return {"loss": loss, "logits": logits}
+        return {"logits": logits}
+
+    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0, rng=None):
+        """Greedy/sampled decode: encoder once (module path), then ONE jitted
+        cached decoder loop.  Returns the (b, max_new_tokens) decoder ids."""
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        ids = jnp.asarray(
+            input_ids.data if hasattr(input_ids, "data") else input_ids, jnp.int32
+        )
+        if ids.ndim == 1:
+            ids = ids[None]
+        with nn.no_grad():
+            enc = self.encode(ids)
+        enc_arr = enc.data if isinstance(enc, Tensor) else enc
+        # memoize the stacked decoder copy per parameter identity (same
+        # contract as generation.py: `is`-comparison against live arrays, so
+        # training rebinds invalidate it) — restacking T0pp's decoder per
+        # call would copy ~half the 11B params before the first token
+        current = [p.data for _, p in self.named_parameters()]
+        cached = getattr(self, "_generation_param_cache", None)
+        if (
+            cached is not None
+            and len(cached[0]) == len(current)
+            and all(a is b for a, b in zip(cached[0], current))
+        ):
+            g, layers = cached[1]
+        else:
+            g, layers = self._stack_decoder_params()
+            self._generation_param_cache = (current, (g, layers))
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        cfg = self.config
+        return _t5_decode_jit(
+            g, layers, enc_arr, rng, ids.shape[0],
+            n_head=cfg.num_heads, d_kv=cfg.d_kv, eps=cfg.layer_norm_epsilon,
+            gated=cfg.feed_forward_proj == "gated-gelu",
+            buckets=cfg.relative_attention_num_buckets,
+            max_distance=cfg.relative_attention_max_distance,
+            start_id=cfg.decoder_start_token_id,
+            tied_scale=cfg.tie_word_embeddings,
+            d_model=cfg.d_model,
+            max_new=max_new_tokens,
+            temperature=float(temperature),
+        )
+
+    def _stack_decoder_params(self) -> tuple[dict, dict]:
+        """Globals + stacked decoder-layer params for the jitted decode."""
+        blocks = list(self.decoder.block)
+
+        def stk(get):
+            return jnp.stack([get(b).data for b in blocks])
+
+        keys_fns = {
+            "sa_ln": lambda b: b.layer[0].layer_norm.weight,
+            "sa_q": lambda b: b.layer[0].SelfAttention.q.weight,
+            "sa_k": lambda b: b.layer[0].SelfAttention.k.weight,
+            "sa_v": lambda b: b.layer[0].SelfAttention.v.weight,
+            "sa_o": lambda b: b.layer[0].SelfAttention.o.weight,
+            "ca_ln": lambda b: b.layer[1].layer_norm.weight,
+            "ca_q": lambda b: b.layer[1].EncDecAttention.q.weight,
+            "ca_k": lambda b: b.layer[1].EncDecAttention.k.weight,
+            "ca_v": lambda b: b.layer[1].EncDecAttention.v.weight,
+            "ca_o": lambda b: b.layer[1].EncDecAttention.o.weight,
+            "ff_ln": lambda b: b.layer[-1].layer_norm.weight,
+            "wo": lambda b: b.layer[-1].DenseReluDense.wo.weight,
+        }
+        if self.config.feed_forward_proj == "gated-gelu":
+            keys_fns["wi0"] = lambda b: b.layer[-1].DenseReluDense.wi_0.weight
+            keys_fns["wi1"] = lambda b: b.layer[-1].DenseReluDense.wi_1.weight
+        else:
+            keys_fns["wi"] = lambda b: b.layer[-1].DenseReluDense.wi.weight
+        layers = {k: stk(fn) for k, fn in keys_fns.items()}
+        g = {
+            "shared": self.shared.weight.data,
+            "dec_bias_table": self.decoder.bias_table().data,
+            "dec_ln_f": self.decoder.final_layer_norm.weight.data,
+            "head_w": self.lm_head.weight.data,
+        }
+        return g, layers
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "batch", "n_head", "d_kv", "eps", "gated", "buckets", "max_distance",
+        "start_id", "tied_scale", "d_model", "max_new", "temperature",
+    ),
+)
+def _t5_decode_jit(
+    g, layers, enc, rng, batch,
+    *, n_head, d_kv, eps, gated, buckets, max_distance,
+    start_id, tied_scale, d_model, max_new, temperature,
+):
+    cache_len = max_new
+    dtype = enc.dtype
+    b = batch
+
+    # precompute per-layer cross K/V from the encoder output once
+    def cross_kv(l):
+        ek = _heads(enc @ l["ca_k"].T, n_head, d_kv)
+        ev = _heads(enc @ l["ca_v"].T, n_head, d_kv)
+        return ek, ev
+
+    enc_k, enc_v = jax.lax.map(lambda l: cross_kv(l), layers)
+
+    n_layers = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    k_cache = jnp.zeros((n_layers, b, n_head, cache_len, d_kv), dtype)
+    v_cache = jnp.zeros((n_layers, b, n_head, cache_len, d_kv), dtype)
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        k_cache, v_cache, tok, position, rng = carry
+        x = g["shared"][tok][:, None, :]  # (b, 1, d)
+        t_pos = jnp.arange(cache_len)
+
+        def layer(x, packed):
+            l, kc, vc, ek, ev = packed
+            h = _t5_norm(x, l["sa_ln"], eps)
+            q = _heads(h @ l["sa_q"].T, n_head, d_kv)
+            k = _heads(h @ l["sa_k"].T, n_head, d_kv)
+            v = _heads(h @ l["sa_v"].T, n_head, d_kv)
+            kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, position, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, position, 0))
+            bias = position_bias(
+                l["__dec_table"], position[None], t_pos,
+                bidirectional=False, num_buckets=buckets,
+                max_distance=max_distance,
+            )
+            bias = jnp.where(
+                (t_pos[None, None, None, :] <= position), bias, jnp.float32(-1e9)
+            )
+            att = t5_attention(q, kc, vc, bias)
+            x = x + _merge(att) @ l["sa_o"].T
+            x = t5_cross_attn(l, x, ek, ev, n_head=n_head, d_kv=d_kv, eps=eps)
+            x = t5_ff(l, x, eps=eps, gated=gated)
+            return x, (kc, vc)
+
+        layers_b = dict(layers)
+        layers_b["__dec_table"] = jnp.broadcast_to(
+            g["dec_bias_table"], (n_layers,) + g["dec_bias_table"].shape
+        )
+        x, (k_cache, v_cache) = jax.lax.scan(
+            layer, x, (layers_b, k_cache, v_cache, enc_k, enc_v)
+        )
+        x = _t5_norm(x[:, -1], g["dec_ln_f"], eps)
+        if tied_scale:
+            x = x * (d_model**-0.5)
+        logits = x @ g["head_w"].T
+        rng, key = jax.random.split(rng)
+        nxt = sample(logits, key)
+        return (k_cache, v_cache, nxt, position + 1, rng), nxt
+
+    tok0 = jnp.full((b,), start_id, jnp.int32)
+    (_, _, _, _, _), toks = jax.lax.scan(
+        step, (k_cache, v_cache, tok0, jnp.int32(0), rng), None, length=max_new
+    )
+    return toks.T  # (b, max_new)
+
+

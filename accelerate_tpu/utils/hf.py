@@ -158,6 +158,15 @@ def map_gptneox_key(hf_key: str) -> Optional[str]:
     return key
 
 
+def map_t5_key(hf_key: str, tied: bool = True) -> Optional[str]:
+    """HF T5ForConditionalGeneration key → models/t5.py key (near identity)."""
+    if hf_key in ("encoder.embed_tokens.weight", "decoder.embed_tokens.weight"):
+        return None  # views of shared.weight
+    if tied and hf_key == "lm_head.weight":
+        return None  # tied to shared
+    return hf_key
+
+
 def map_opt_key(hf_key: str) -> Optional[str]:
     """HF OPTForCausalLM key → models/opt.py key (prefix strip + tied head)."""
     if hf_key == "lm_head.weight":
@@ -351,6 +360,35 @@ def gptneox_config_from_hf(cfg: dict):
     )
 
 
+def t5_config_from_hf(cfg: dict):
+    from ..models.t5 import T5Config
+
+    ff = cfg.get("feed_forward_proj", "relu")
+    if ff not in ("relu", "gated-gelu"):
+        raise NotImplementedError(
+            f"feed_forward_proj={ff!r} unsupported; T5 v1.0 uses 'relu', "
+            "v1.1/T0pp 'gated-gelu' (models/t5.py implements both)"
+        )
+    num_layers = cfg.get("num_layers", 6)
+    return T5Config(
+        vocab_size=cfg.get("vocab_size", 32128),
+        d_model=cfg.get("d_model", 512),
+        d_kv=cfg.get("d_kv", 64),
+        d_ff=cfg.get("d_ff", 2048),
+        num_layers=num_layers,
+        num_decoder_layers=cfg.get("num_decoder_layers") or num_layers,
+        num_heads=cfg.get("num_heads", 8),
+        relative_attention_num_buckets=cfg.get("relative_attention_num_buckets", 32),
+        relative_attention_max_distance=cfg.get("relative_attention_max_distance", 128),
+        layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-6),
+        feed_forward_proj=ff,
+        tie_word_embeddings=cfg.get("tie_word_embeddings", True),
+        # HF config dicts often carry an explicit None for these
+        decoder_start_token_id=cfg.get("decoder_start_token_id") or 0,
+        pad_token_id=cfg.get("pad_token_id") or 0,
+    )
+
+
 def opt_config_from_hf(cfg: dict):
     from ..models.opt import OPTConfig
 
@@ -399,12 +437,14 @@ def from_pretrained(path: str, architecture: Optional[str] = None, num_labels: i
             architecture = "gptj"
         elif model_type == "gpt_neox" or "GPTNeoX" in archs:
             architecture = "gptneox"
+        elif model_type == "t5" or "T5" in archs:
+            architecture = "t5"
         elif model_type == "opt" or "OPT" in archs:
             architecture = "opt"
         else:
             raise ValueError(
                 f"cannot infer architecture from {path}; pass "
-                "architecture='bert'|'gpt2'|'llama'|'gptj'|'gptneox'|'opt'"
+                "architecture='bert'|'gpt2'|'llama'|'gptj'|'gptneox'|'opt'|'t5'"
             )
     state = load_hf_state_dict(path)
     if architecture == "bert":
@@ -463,5 +503,20 @@ def from_pretrained(path: str, architecture: Optional[str] = None, num_labels: i
         missing, _ = load_mapped_state_dict(model, state, map_gptneox_key)
         if missing:
             raise ValueError(f"GPT-NeoX load left weights uninitialised: {missing[:8]}")
+        return model
+    if architecture == "t5":
+        from functools import partial
+
+        from ..models.t5 import T5ForConditionalGeneration
+
+        config = t5_config_from_hf(cfg)
+        model = T5ForConditionalGeneration(config)
+        missing, _ = load_mapped_state_dict(
+            model, state, partial(map_t5_key, tied=config.tie_word_embeddings)
+        )
+        if config.tie_word_embeddings:
+            missing = [m for m in missing if "lm_head" not in m]
+        if missing:
+            raise ValueError(f"T5 load left weights uninitialised: {missing[:8]}")
         return model
     raise ValueError(f"unsupported architecture {architecture!r}")

@@ -114,7 +114,9 @@ def _convert_transformers(tm):
         map_gptneox_key,
         map_llama_key,
         map_opt_key,
+        map_t5_key,
         opt_config_from_hf,
+        t5_config_from_hf,
     )
 
     cls_name = type(tm).__name__
@@ -180,6 +182,23 @@ def _convert_transformers(tm):
         if missing:
             raise ValueError(
                 f"GPT-NeoX conversion left weights uninitialised: {missing[:4]}"
+            )
+        return model
+    if cls_name == "T5ForConditionalGeneration":
+        from functools import partial as _partial
+
+        from ..models.t5 import T5ForConditionalGeneration
+
+        t5cfg = t5_config_from_hf(cfg)
+        model = T5ForConditionalGeneration(t5cfg)
+        missing, _ = load_mapped_state_dict(
+            model, state, _partial(map_t5_key, tied=t5cfg.tie_word_embeddings)
+        )
+        if t5cfg.tie_word_embeddings:
+            missing = [m for m in missing if "lm_head" not in m]
+        if missing:
+            raise ValueError(
+                f"T5 conversion left weights uninitialised: {missing[:4]}"
             )
         return model
     return None

@@ -1,0 +1,145 @@
+"""T5 encoder-decoder tests: HF parity, cached decode, training smoke.
+
+The family completes coverage of the reference's benchmark table (T0pp-11B,
+reference benchmarks/big_model_inference/README.md:35).  Parity is asserted
+numerically against transformers' CPU T5.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+
+
+def _hf_pair(**overrides):
+    from transformers import T5Config as HFConfig, T5ForConditionalGeneration as HFT5
+
+    from accelerate_tpu.utils.torch_bridge import convert_torch_module
+
+    torch.manual_seed(0)
+    kw = dict(
+        vocab_size=256, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_heads=4, relative_attention_num_buckets=8, dropout_rate=0.0,
+    )
+    kw.update(overrides)
+    hf = HFT5(HFConfig(**kw)).eval()
+    return hf, convert_torch_module(hf)
+
+
+@pytest.fixture(scope="module")
+def hf_pair():
+    return _hf_pair()
+
+
+def test_forward_parity_vs_transformers(hf_pair):
+    hf, ours = hf_pair
+    ids = np.random.default_rng(0).integers(0, 256, (2, 12), dtype=np.int64)
+    dec = np.random.default_rng(1).integers(0, 256, (2, 7), dtype=np.int64)
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.tensor(ids), decoder_input_ids=torch.tensor(dec)
+        ).logits.numpy()
+    got = np.asarray(
+        ours(jnp.asarray(ids, jnp.int32), decoder_input_ids=jnp.asarray(dec, jnp.int32))[
+            "logits"
+        ].data
+    )
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_gated_gelu_untied_parity():
+    """T5 v1.1 / T0pp geometry: gated-gelu FFN + untied head."""
+    hf, ours = _hf_pair(feed_forward_proj="gated-gelu", tie_word_embeddings=False)
+    ids = np.random.default_rng(0).integers(0, 256, (2, 10), dtype=np.int64)
+    dec = np.random.default_rng(1).integers(0, 256, (2, 5), dtype=np.int64)
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.tensor(ids), decoder_input_ids=torch.tensor(dec)
+        ).logits.numpy()
+    got = np.asarray(
+        ours(jnp.asarray(ids, jnp.int32), decoder_input_ids=jnp.asarray(dec, jnp.int32))[
+            "logits"
+        ].data
+    )
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_sampled_decode_matches_full_forward(hf_pair):
+    """Cached decode vs per-step full forward, on a DIVERSE token sequence
+    (temperature sampling with a fixed key — greedy on a random-init tiny
+    model collapses to one token, which would leave the cache untested)."""
+    _, ours = hf_pair
+    ids = np.random.default_rng(0).integers(0, 256, (2, 12), dtype=np.int32)
+    rng = jax.random.PRNGKey(7)
+    got = np.asarray(ours.generate(ids, max_new_tokens=6, temperature=1.0, rng=rng))
+
+    # replicate the engine's sampling loop with full forwards (no cache)
+    cur = np.zeros((2, 1), dtype=np.int32)  # decoder_start_token_id
+    r = jax.random.PRNGKey(7)
+    for _ in range(6):
+        logits = ours(
+            jnp.asarray(ids, jnp.int32), decoder_input_ids=jnp.asarray(cur)
+        )["logits"].data
+        r, key = jax.random.split(r)
+        nxt = np.asarray(
+            jax.random.categorical(key, logits[:, -1] / 1.0, axis=-1)
+        ).astype(np.int32)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, cur[:, 1:])
+
+
+def test_train_step_with_labels(hf_pair):
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import batch_to_global_array
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(mixed_precision="no")
+    model = T5ForConditionalGeneration(T5Config.tiny())
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(src, tgt):
+        opt.zero_grad()
+        out = model(src, labels=tgt)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    rng = np.random.default_rng(0)
+    src = batch_to_global_array(
+        jnp.asarray(rng.integers(0, 256, (8, 16)), jnp.int32), mesh=acc.mesh
+    )
+    tgt = batch_to_global_array(
+        jnp.asarray(rng.integers(0, 256, (8, 8)), jnp.int32), mesh=acc.mesh
+    )
+    losses = [float(step(src, tgt)) for _ in range(3)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
+
+
+def test_from_pretrained_roundtrip(tmp_path, hf_pair):
+    hf, ours = hf_pair
+    hf.save_pretrained(tmp_path / "t5")
+    from accelerate_tpu.utils.hf import from_pretrained
+
+    loaded = from_pretrained(str(tmp_path / "t5"))
+    ids = np.random.default_rng(2).integers(0, 256, (1, 10), dtype=np.int32)
+    dec = np.random.default_rng(3).integers(0, 256, (1, 4), dtype=np.int32)
+    a = np.asarray(ours(jnp.asarray(ids), decoder_input_ids=jnp.asarray(dec))["logits"].data)
+    b = np.asarray(loaded(jnp.asarray(ids), decoder_input_ids=jnp.asarray(dec))["logits"].data)
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_unsupported_ffn_rejected():
+    from accelerate_tpu.utils.hf import t5_config_from_hf
+
+    with pytest.raises(NotImplementedError, match="feed_forward_proj"):
+        t5_config_from_hf({"feed_forward_proj": "gated-silu"})
