@@ -203,21 +203,18 @@ def _shard_mapped_attention(
 
         mesh = AcceleratorState().mesh
     if mesh.shape.get(axis_name, 1) == 1:
-        from .attention import sdpa_tpu
-
         return None, mesh, scale  # caller runs the single-device path
     batch_spec = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
     spec = P(batch_spec, None, axis_name, None)
-    from jax.experimental.shard_map import shard_map
+    from ..parallel.mesh import shard_map_compat
 
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(
             local_fn, axis_name=axis_name, is_causal=is_causal, scale=scale
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
     )
     return fn, mesh, scale
 

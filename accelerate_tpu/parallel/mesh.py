@@ -21,6 +21,30 @@ from jax.sharding import Mesh
 from ..utils.constants import ALL_MESH_AXES
 
 
+# API detection ONCE at import (not per-call exception probing, which would
+# mask genuine caller errors by silently retrying on the legacy path)
+_HAS_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check: bool = False):
+    """``jax.shard_map`` across the 0.8 API move.
+
+    jax>=0.8 exposes keyword-only ``jax.shard_map`` with ``check_vma``;
+    the old ``jax.experimental.shard_map`` used ``check_rep``.  One shim so
+    every caller (pipeline schedules, ring attention, tests) follows the
+    same path and the deprecation never prints.
+    """
+    if _HAS_NEW_SHARD_MAP:
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check
+        )
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    return _legacy(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check
+    )
+
+
 def make_mesh(
     axis_sizes: dict[str, int],
     devices: Optional[Sequence[jax.Device]] = None,

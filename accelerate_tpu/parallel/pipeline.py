@@ -392,7 +392,7 @@ def pipeline_train_1f1b(
         allow_trivial_mesh=False,
     )
 
-    from jax.experimental.shard_map import shard_map
+    from .mesh import shard_map_compat
 
     extra_specs = jax.tree_util.tree_map(lambda _: P(), extra_params)
     x_spec = data_spec(x)
@@ -400,7 +400,7 @@ def pipeline_train_1f1b(
 
     batch_axes_present = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
 
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(
             _one_f_one_b_local,
             stage_fn=stage_fn,
@@ -413,7 +413,6 @@ def pipeline_train_1f1b(
         mesh=mesh,
         in_specs=(param_specs, x_spec, lbl_spec, extra_specs),
         out_specs=(P(), param_specs, x_spec, extra_specs),
-        check_rep=False,
     )
     return fn(stacked_params, x, labels, extra_params)
 
@@ -502,7 +501,7 @@ def gpipe(
         out, _ = jax.lax.scan(body, x, stacked_params)
         return out
 
-    from jax.experimental.shard_map import shard_map
+    from .mesh import shard_map_compat
 
     # microbatching happens per-device inside the body: the in_spec matches
     # the loader/constraint layout exactly, so entering the pipeline moves
@@ -510,7 +509,7 @@ def gpipe(
     x_spec = data_spec(x)
     out_spec = x_spec
 
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(
             _gpipe_local,
             stage_fn=stage_fn,
@@ -521,6 +520,5 @@ def gpipe(
         mesh=mesh,
         in_specs=(param_specs, x_spec),
         out_specs=out_spec,
-        check_rep=False,
     )
     return fn(stacked_params, x)

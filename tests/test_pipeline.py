@@ -94,7 +94,7 @@ def test_pipelined_gpt_matches_plain_trunk():
         _pipelined_block, n_head=2, eps=cfg.layer_norm_eps, seq_axis="sp"
     )
 
-    from jax.experimental.shard_map import shard_map
+    from accelerate_tpu.parallel.mesh import shard_map_compat
     from jax.sharding import Mesh, PartitionSpec as P
 
     from accelerate_tpu.utils.constants import ALL_MESH_AXES
@@ -111,7 +111,7 @@ def test_pipelined_gpt_matches_plain_trunk():
         return h
 
     ref = np.asarray(
-        shard_map(seq_apply, mesh=mesh1, in_specs=(P(),), out_specs=P(), check_rep=False)(x)
+        shard_map_compat(seq_apply, mesh=mesh1, in_specs=(P(),), out_specs=P())(x)
     )
 
     # pp2 × sp2 × dp2: layers span stages (2 per stage), seq rides the ring
