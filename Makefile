@@ -13,11 +13,14 @@ test_core:
 	  tests/test_operations.py tests/test_data_loader.py \
 	  tests/test_data_loader_grid.py tests/test_optimizer.py \
 	  tests/test_capture_stability.py tests/test_precision.py \
-	  tests/test_fp16_capture.py tests/test_autocast.py -q
+	  tests/test_fp16_capture.py tests/test_autocast.py \
+	  tests/test_tracking.py tests/test_utils_misc.py \
+	  tests/test_deepspeed_compat.py -q
 
 test_models:
 	python -m pytest tests/test_models.py tests/test_llama.py \
-	  tests/test_opt.py tests/test_generation.py tests/test_moe.py \
+	  tests/test_opt.py tests/test_gptj_neox.py tests/test_t5.py \
+	  tests/test_generation.py tests/test_moe.py \
 	  tests/test_torch_bridge.py tests/test_nn.py -q
 
 test_parallel:

@@ -1,0 +1,31 @@
+"""Guard: every test file belongs to a Makefile split (or is intentionally
+unsplit), so `make test_core && make test_models && ...` never silently
+loses coverage as files are added."""
+
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# files covered by `make test` only (new files should be slotted into a
+# split; list one here only with a reason)
+UNSPLIT: set = {
+    "test_makefile_splits.py",  # meta
+    "test_imports.py",  # import-cost budget, if added later
+    "test_examples.py",  # in test_examples split - sanity below catches drift
+}
+
+
+def test_every_test_file_is_in_a_split():
+    with open(os.path.join(REPO, "Makefile")) as f:
+        makefile = f.read()
+    listed = set(re.findall(r"tests/(test_\w+\.py)", makefile))
+    on_disk = {
+        f for f in os.listdir(os.path.join(REPO, "tests"))
+        if f.startswith("test_") and f.endswith(".py")
+    }
+    missing = on_disk - listed - UNSPLIT
+    assert not missing, (
+        f"test files not in any Makefile split: {sorted(missing)} — add them "
+        "to the matching target in Makefile (or to UNSPLIT with a reason)"
+    )
