@@ -10,9 +10,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # files covered by `make test` only (new files should be slotted into a
 # split; list one here only with a reason)
 UNSPLIT: set = {
-    "test_makefile_splits.py",  # meta
-    "test_imports.py",  # import-cost budget, if added later
-    "test_examples.py",  # in test_examples split - sanity below catches drift
+    "test_makefile_splits.py",  # meta: the guard itself
 }
 
 
