@@ -59,14 +59,20 @@ def _dataset(n):
     return [{"x": np.float32(i), "y": np.float32(2 * i + 1)} for i in range(n)]
 
 
+def _collect_seen(acc, dl) -> list[int]:
+    """Iterate a loader, gather across shards, return the flat index list."""
+    seen: list[int] = []
+    for batch in dl:
+        x = np.asarray(acc.gather(batch["x"]))
+        seen.extend(int(v) for v in x.ravel())
+    return seen
+
+
 def test_dataloader_coverage():
     acc = Accelerator()
     n, bs = 22, 4  # uneven tail: 22 % (4*shards) != 0 for any shard count >1
     dl = prepare_data_loader(dataset=_dataset(n), batch_size=bs)
-    seen = []
-    for batch in dl:
-        x = np.asarray(acc.gather(batch["x"]))
-        seen.extend(int(v) for v in x.ravel())
+    seen = _collect_seen(acc, dl)
     # even_batches loops back to fill final batch: every index appears >= 1×
     assert set(seen) == set(range(n)), f"coverage broken: {sorted(set(seen))[:10]}..."
     assert len(seen) >= n
@@ -78,14 +84,25 @@ def test_dataloader_even_batches_off():
     shards = max(1, acc.num_devices)
     n, bs = 22, 4
     dl = prepare_data_loader(dataset=_dataset(n), batch_size=bs, even_batches=False)
-    seen = []
-    for batch in dl:
-        x = np.asarray(acc.gather(batch["x"]))
-        seen.extend(int(v) for v in x.ravel())
+    seen = _collect_seen(acc, dl)
     # nothing is duplicated when even_batches is off
     assert len(seen) == len(set(seen)), "even_batches=False must not duplicate"
     assert set(seen) <= set(range(n))
     print("dataloader even_batches=False ok")
+
+
+def test_dispatch_loader():
+    """Dispatch mode: rank 0 reads, peers receive the global batch via
+    broadcast (reference DataLoaderDispatcher, data_loader.py:696) — must
+    cover the dataset exactly once at any device/process count (n is sized
+    to divide the global batch so no even_batches loop-back occurs)."""
+    acc = Accelerator()
+    bs = 4
+    n = 2 * bs * max(1, acc.num_devices)
+    dl = prepare_data_loader(dataset=_dataset(n), batch_size=bs, dispatch_batches=True)
+    seen = _collect_seen(acc, dl)
+    assert sorted(seen) == list(range(n)), f"dispatch coverage broken: {sorted(seen)}"
+    print("dispatch loader ok")
 
 
 def test_skip_first_batches():
@@ -223,6 +240,7 @@ def main():
     test_rng_sync()
     test_dataloader_coverage()
     test_dataloader_even_batches_off()
+    test_dispatch_loader()
     test_skip_first_batches()
     test_gather_for_metrics()
     mock_training()
