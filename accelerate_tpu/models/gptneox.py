@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import Tensor
-from .gpt import _pure_layernorm, lm_shift_loss
+from .gpt import _pure_layernorm, lm_shift_loss, maybe_remat
 
 
 @dataclasses.dataclass
@@ -159,7 +159,7 @@ class GPTNeoXLayer(nn.Module):
             att = sdpa_tpu(q, k, v, is_causal=True)
             return neox_attn_out(l, xv, att, eps=cfg.layer_norm_eps)
 
-        return nn.tape_op(fn, x, *self.param_tensors())
+        return nn.tape_op(maybe_remat(fn), x, *self.param_tensors())
 
 
 class GPTNeoXForCausalLM(nn.Module):

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import Tensor
-from .gpt import lm_shift_loss
+from .gpt import lm_shift_loss, maybe_remat
 
 
 @dataclasses.dataclass
@@ -194,7 +194,7 @@ class LlamaDecoderLayer(nn.Module):
                 eps=cfg.rms_norm_eps, theta=cfg.rope_theta,
             )
 
-        return nn.tape_op(fn, x, *self.param_tensors())
+        return nn.tape_op(maybe_remat(fn), x, *self.param_tensors())
 
 
 class LlamaForCausalLM(nn.Module):

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import Tensor
-from .gpt import _pure_layernorm, lm_shift_loss
+from .gpt import _pure_layernorm, lm_shift_loss, maybe_remat
 
 
 @dataclasses.dataclass
@@ -165,7 +165,7 @@ class OPTDecoderLayer(nn.Module):
                 n_head=cfg.num_attention_heads, eps=cfg.layer_norm_eps,
             )
 
-        return nn.tape_op(fn, x, *self.param_tensors())
+        return nn.tape_op(maybe_remat(fn), x, *self.param_tensors())
 
 
 class OPTForCausalLM(nn.Module):

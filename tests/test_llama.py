@@ -106,6 +106,42 @@ def test_fsdp_training_loss_decreases():
     assert losses[-1] < losses[0], losses
 
 
+def test_remat_numerics_identical(monkeypatch):
+    """ACCELERATE_TPU_REMAT=1 must change memory, not math: one SGD step
+    with and without per-layer checkpointing yields identical params."""
+    import accelerate_tpu.optim as optim_mod
+
+    def one_step(remat: bool):
+        if remat:
+            monkeypatch.setenv("ACCELERATE_TPU_REMAT", "1")
+        else:
+            monkeypatch.delenv("ACCELERATE_TPU_REMAT", raising=False)
+        Accelerator._reset_state()
+        nn.manual_seed(0)
+        acc = Accelerator(mixed_precision="no")
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        opt = optim_mod.SGD(model.parameters(), lr=0.1)
+        model, opt = acc.prepare(model, opt)
+        ids = batch_to_global_array(
+            jnp.asarray(
+                np.random.default_rng(0).integers(0, 1024, (8, 32)), jnp.int32
+            ),
+            mesh=acc.mesh,
+        )
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return {n: np.asarray(p.data) for n, p in model.named_parameters()}
+
+    from accelerate_tpu.data_loader import batch_to_global_array
+
+    base = one_step(False)
+    remat = one_step(True)
+    for name in base:
+        np.testing.assert_allclose(remat[name], base[name], rtol=1e-6, atol=1e-7, err_msg=name)
+
+
 def test_unsupported_config_fields_rejected():
     """Configs whose math we'd silently get wrong must refuse to load."""
     from accelerate_tpu.utils.hf import llama_config_from_hf
