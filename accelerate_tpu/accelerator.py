@@ -354,6 +354,16 @@ class Accelerator:
     def prepare_model(self, model: Module, device_placement: Optional[bool] = None, evaluation_mode: bool = False) -> Module:
         from .parallel.sharding import shard_module_params
 
+        if self.num_devices > 1 and self.verify_device_map(model):
+            # reference accelerator.py:1338-1349: an offload-dispatched model
+            # carries align/offload hooks that fight mesh sharding at forward
+            # time — refuse loudly instead of silently producing both
+            raise ValueError(
+                "you can't prepare a model dispatched with a multi-device "
+                "device_map for distributed training; load it without "
+                "device_map (shard_for_inference / ParallelismConfig handles "
+                "multi-chip placement) or train on one device"
+            )
         if device_placement is None:
             device_placement = self.device_placement
         # precision policy: params in compute dtype, master fp32 kept by optim
@@ -464,6 +474,31 @@ class Accelerator:
             yield
         finally:
             self.gradient_state._set_sync_gradients(prev)
+
+    def verify_device_map(self, model: Module) -> bool:
+        """True when ``model`` was dispatched with a multi-device device_map
+        (reference accelerator.py:3720 checks ``hf_device_map``; our
+        dispatch path records ``atpu_device_map``, big_modeling.py).  Used
+        to refuse distributed prepare() of an offload-dispatched model."""
+        for m in model.modules():
+            dmap = getattr(m, "atpu_device_map", None) or getattr(m, "hf_device_map", None)
+            if dmap and len(set(map(str, dict(dmap).values()))) > 1:
+                return True
+        return False
+
+    def lomo_backward(self, loss, learning_rate: float) -> None:
+        """Reference API for LOMO's fused backward (accelerator.py:3731).
+
+        Unsupported here: LOMO fuses the parameter update into torch's
+        backward hooks, which has no counterpart in the traced-step model —
+        under capture the optimizer update is already fused into the same
+        XLA program as the backward, so LOMO's memory win is the default.
+        """
+        raise NotImplementedError(
+            "lomo_backward is torch-hook-specific; under accelerate_tpu the "
+            "captured step already fuses backward+update into one XLA program "
+            "(use compile_step with any optim.* optimizer)."
+        )
 
     @contextlib.contextmanager
     def join_uneven_inputs(self, joinables, even_batches=None):

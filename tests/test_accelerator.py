@@ -251,3 +251,38 @@ def test_save_and_load_state_roundtrip(tmp_path):
     np.testing.assert_allclose(np.asarray(model.weight.data), w_before, rtol=1e-6)
     # sharding preserved after load
     assert len(model.weight.data.sharding.device_set) == 8
+
+
+def test_verify_device_map_and_lomo_parity():
+    """Reference-API parity: verify_device_map flags dispatched models;
+    lomo_backward explains why it has no traced-step counterpart."""
+    import pytest
+
+    import accelerate_tpu.nn as nn
+    from accelerate_tpu import Accelerator
+
+    Accelerator._reset_state()
+    acc = Accelerator()
+    model = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    assert acc.verify_device_map(model) is False
+    model.atpu_device_map = {"0": "tpu:0", "1": "cpu"}
+    assert acc.verify_device_map(model) is True
+    with pytest.raises(NotImplementedError, match="captured step"):
+        acc.lomo_backward(None, 1e-3)
+
+
+def test_prepare_refuses_device_mapped_model():
+    """Reference accelerator.py:1338: offload-dispatched models cannot be
+    prepared for distributed training."""
+    import pytest
+
+    import accelerate_tpu.nn as nn
+    from accelerate_tpu import Accelerator
+
+    Accelerator._reset_state()
+    acc = Accelerator()
+    model = nn.Sequential(nn.Linear(4, 4))
+    model.atpu_device_map = {"0": "tpu:0", "1": "cpu"}
+    if acc.num_devices > 1:
+        with pytest.raises(ValueError, match="device_map"):
+            acc.prepare(model)
