@@ -184,7 +184,6 @@ def test_save_load_roundtrip():
     """Multi-process checkpoint: save (rank-gated writes + per-process RNG),
     perturb, load, assert exact restoration on every process."""
     import shutil
-    import tempfile
 
     acc = Accelerator()
     model = RegressionModel()
@@ -200,13 +199,9 @@ def test_save_load_roundtrip():
     opt.step()
     saved_a = float(np.asarray(model.a.data))
 
-    # every process must resolve the SAME directory: derive from the
-    # coordinator address (unique per launch, shared across its processes);
-    # single-process launches have no coordinator, so key on the pid to keep
-    # concurrent runs on one machine from racing on the same dir
-    tag = os.environ.get("ACCELERATE_COORDINATOR_ADDRESS") or f"pid{os.getpid()}"
-    tag = tag.replace(":", "_").replace(".", "_")
-    ckpt = os.path.join(tempfile.gettempdir(), f"acc_tpu_ckpt_{tag}")
+    from accelerate_tpu.test_utils.testing import launch_scoped_tmpdir
+
+    ckpt = launch_scoped_tmpdir("acc_tpu_ckpt")
     try:
         acc.save_state(ckpt)
         model.a.data = model.a.data * 0.0 + 123.0  # clobber

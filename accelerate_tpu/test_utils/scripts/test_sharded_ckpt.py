@@ -1,0 +1,111 @@
+"""Multi-process sharded checkpoint: each process writes ITS shard files.
+
+Run under ``debug_launcher(num_processes=2)``: the fsdp axis spans the two
+processes, so ``save_state`` must produce one ``*.shard-0000R-of-00002``
+file per rank for the model AND the optimizer, and ``load_state`` must
+reassemble only each process's local blocks.  This is the true multihost
+exercise of the round-3 sharded-checkpoint path (single-process tests can
+only simulate it with explicit rank arguments).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+
+import numpy as np
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.data_loader import batch_to_global_array
+from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+from accelerate_tpu.utils.constants import MODEL_NAME, OPTIMIZER_NAME
+
+
+def main():
+    import jax.numpy as jnp
+
+    from accelerate_tpu import PartialState
+
+    # the rendezvous must happen BEFORE any jax.devices() query initialises
+    # the backend non-distributed (same ordering rule as test_launcher.py)
+    PartialState()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(fsdp_size=acc_devices()),
+        mixed_precision="no",
+    )
+    model = GPTLMHeadModel(GPTConfig.tiny())
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    # batch rows must divide over the full batch-sharding (dp×fsdp) size —
+    # under pytest each worker inherits the 8-device XLA flag, so the global
+    # device count is workers × 8, not workers
+    rows = max(8, 2 * acc_devices())
+    ids = batch_to_global_array(
+        jnp.asarray(np.random.default_rng(0).integers(0, 1024, (rows, 32)), jnp.int32),
+        mesh=acc.mesh,
+    )
+    float(step(ids))
+
+    # verify restoration of a GENUINELY fsdp-sharded tensor: wte is
+    # fsdp-exempt (replicated), so it would pass even if cross-process
+    # reassembly were broken — an attention weight is sharded for real.
+    target = model.h[0].attn.c_attn.weight
+
+    def local_sum(p) -> float:
+        return float(
+            sum(np.asarray(sh.data).sum() for sh in p.data.addressable_shards)
+        )
+
+    before = local_sum(target)
+
+    from ..testing import launch_scoped_tmpdir
+
+    ckpt = launch_scoped_tmpdir("acc_tpu_shckpt")
+    try:
+        acc.save_state(ckpt)
+        world = acc.num_processes
+        if world > 1:
+            # every rank wrote its own shard file for model AND optimizer
+            for name in (MODEL_NAME, OPTIMIZER_NAME):
+                files = sorted(
+                    glob.glob(os.path.join(ckpt, f"{name}.shard-*-of-{world:05d}.safetensors"))
+                )
+                assert len(files) == world, (name, files)
+            print(f"rank{acc.process_index}: per-rank shard files ok")
+        # clobber the sharded tensor and restore
+        target.data = target.data * 0.0
+        assert abs(local_sum(target)) < 1e-6
+        acc.load_state(ckpt)
+        after = local_sum(target)
+        assert abs(after - before) < 1e-4 * max(1.0, abs(before)), (after, before)
+        # training continues from the restored state
+        loss = float(step(ids))
+        assert np.isfinite(loss)
+        print(f"rank{acc.process_index}: sharded save/load + resume ok (loss {loss:.4f})")
+    finally:
+        acc.wait_for_everyone()
+        if acc.is_main_process:
+            shutil.rmtree(ckpt, ignore_errors=True)
+
+
+def acc_devices() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+if __name__ == "__main__":
+    main()

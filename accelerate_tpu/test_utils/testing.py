@@ -214,6 +214,21 @@ def execute_subprocess(cmd: list[str], env=None, timeout: int = 600) -> str:
     return result.stdout + result.stderr
 
 
+def launch_scoped_tmpdir(prefix: str) -> str:
+    """A tmp path every process of THIS launch resolves identically.
+
+    Derived from the coordinator address (set by debug_launcher/the env
+    protocol, unique per launch and shared across its processes); a
+    single-process run has no coordinator, so the pid keeps concurrent runs
+    on one machine from racing on the same directory.
+    """
+    import tempfile
+
+    tag = os.environ.get("ACCELERATE_COORDINATOR_ADDRESS") or f"pid{os.getpid()}"
+    tag = tag.replace(":", "_").replace(".", "_")
+    return os.path.join(tempfile.gettempdir(), f"{prefix}_{tag}")
+
+
 def launch_test_script(
     script_path: str,
     script_args: Optional[list[str]] = None,

@@ -46,6 +46,18 @@ def test_debug_launcher_multiprocess():
     debug_launcher(_check_world, num_processes=2, timeout=240)
 
 
+def test_debug_launcher_sharded_checkpoint_two_processes():
+    """Sharded checkpointing under REAL multi-process: the fsdp axis spans
+    two processes, each writes its own model+optimizer shard files, and
+    load_state reassembles per-process local blocks (the multihost half of
+    tests/test_sharded_checkpoint.py, which is single-process)."""
+    import accelerate_tpu.test_utils.scripts.test_sharded_ckpt as script
+
+    from accelerate_tpu.launchers import debug_launcher
+
+    debug_launcher(script.main, num_processes=2, timeout=600)
+
+
 def test_debug_launcher_full_script_two_processes():
     """The FULL correctness suite under real 2-process rendezvous: this is
     the round-2 verdict's Missing #5 — the multihost branches of
