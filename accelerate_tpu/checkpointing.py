@@ -59,8 +59,9 @@ def _gather_numpy(value) -> np.ndarray:
 def _write_weight_arrays(arrays: dict, directory: str, safe_serialization: bool, name: str) -> str:
     os.makedirs(directory, exist_ok=True)
     if safe_serialization:
-        from safetensors.numpy import save_file
+        from .native.st import pick_save_file
 
+        save_file = pick_save_file()
         path = os.path.join(directory, f"{name}.safetensors")
         save_file(arrays, path)
     else:
@@ -88,9 +89,9 @@ def load_model_weights(directory_or_file: str, name: str = MODEL_NAME) -> dict:
     else:
         path = directory_or_file
     if path.endswith(".safetensors"):
-        from safetensors.numpy import load_file
+        from .native.st import pick_load_file
 
-        return load_file(path)
+        return pick_load_file()(path)
     data = np.load(path)
     return {k: data[k] for k in data.files}
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import jax
@@ -430,6 +431,72 @@ class IterableDatasetShard:
                 yield current_batch[i]
 
 
+class TokenDataset:
+    """Fixed-length LM pretraining rows over a flat token buffer.
+
+    The reference's pretraining input path is a torch Dataset whose per-sample
+    ``__getitem__`` runs in C++ DataLoader workers; the TPU-native equivalent
+    keeps the tokens in one contiguous (usually ``np.memmap``) buffer and
+    assembles whole batches with a single fused native gather
+    (``native.gather_rows``) — no per-sample Python, nothing but the gathered
+    rows ever paged in.  Works as a plain map-style dataset too (len/getitem),
+    so it composes with every sampler/loader in this module.
+
+    ``tokens`` may be a path to a raw token file (dtype ``token_dtype``), or a
+    1-D/2-D array.  1-D input is viewed as ``[n // seq_len, seq_len]`` rows
+    (remainder tokens dropped).
+    """
+
+    def __init__(self, tokens, seq_len: Optional[int] = None, token_dtype=np.int32):
+        if isinstance(tokens, (str, os.PathLike)):
+            tokens = np.memmap(tokens, dtype=token_dtype, mode="r")
+        tokens = np.asarray(tokens) if not isinstance(tokens, np.memmap) else tokens
+        if tokens.ndim == 1:
+            if seq_len is None:
+                raise ValueError("seq_len is required for flat token input")
+            n_rows = tokens.shape[0] // seq_len
+            tokens = tokens[: n_rows * seq_len].reshape(n_rows, seq_len)
+        elif tokens.ndim != 2:
+            raise ValueError("tokens must be 1-D or 2-D")
+        self.rows = tokens
+        self.seq_len = tokens.shape[1]
+
+    def __len__(self) -> int:
+        return self.rows.shape[0]
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        return np.asarray(self.rows[i])
+
+    def batch(self, indices, out: Optional[np.ndarray] = None) -> np.ndarray:
+        """Gather a whole [len(indices), seq_len] batch in one native call.
+
+        Validation happens here, before the native/numpy branch, so behavior
+        is identical whether or not the native library built on this host.
+        """
+        from . import native
+
+        indices = np.asarray(indices, dtype=np.int64)
+        if indices.ndim != 1:
+            raise ValueError(f"indices must be 1-D, got shape {indices.shape}")
+        # normalize negatives so native and numpy paths agree with __getitem__
+        indices = np.where(indices < 0, indices + self.rows.shape[0], indices)
+        if indices.size and (indices.min() < 0 or indices.max() >= self.rows.shape[0]):
+            raise IndexError("batch index out of range")
+        expect = (indices.shape[0], self.seq_len)
+        if out is not None and (
+            out.shape != expect or out.dtype != self.rows.dtype
+            or not out.flags.c_contiguous
+        ):
+            raise ValueError(f"out must be C-contiguous {expect} {self.rows.dtype}")
+        if native.available() and self.rows.flags.c_contiguous:
+            return native.gather_rows(self.rows, indices, out=out)
+        gathered = self.rows[indices]
+        if out is not None:
+            out[...] = gathered
+            return out
+        return np.asarray(gathered)
+
+
 # ---------------------------------------------------------------------------
 # Collation
 # ---------------------------------------------------------------------------
@@ -444,13 +511,37 @@ def _to_numpy(x):
 
 
 def default_collate(samples: Sequence[Any]):
-    """Stack a list of samples into batched numpy arrays (torch parity)."""
+    """Stack a list of samples into batched numpy arrays (torch parity).
+
+    Homogeneous contiguous numpy samples take the native stack path
+    (``native.stack_rows``: the reference gets this loop from torch's C++
+    collate); everything else falls back to np.stack.
+    """
     first = samples[0]
     if isinstance(first, dict):
         return {k: default_collate([s[k] for s in samples]) for k in first}
     if isinstance(first, (tuple, list)) and not isinstance(first, str):
         return type(first)(default_collate(list(col)) for col in zip(*samples))
-    return np.stack([_to_numpy(s) for s in samples])
+    arrs = [_to_numpy(s) for s in samples]
+    a0 = arrs[0]
+    # np.stack's copy loop is already native; the threaded stack only pays
+    # for itself when there are worker threads to split a big batch across
+    # (measured: parity at 1 thread on large samples, slower on small ones
+    # from per-sample pointer marshalling).
+    if (
+        len(arrs) > 1
+        and a0.ndim > 0
+        and a0.nbytes * len(arrs) > (1 << 20)
+        and all(
+            a.shape == a0.shape and a.dtype == a0.dtype and a.flags.c_contiguous
+            for a in arrs
+        )
+    ):
+        from . import native
+
+        if native.available() and native._threads_default() > 1:
+            return native.stack_rows(arrs)
+    return np.stack(arrs)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,263 @@
+// Native host-side runtime core for accelerate_tpu.
+//
+// The reference framework gets its host input-pipeline and checkpoint-IO
+// performance from vendored native code: torch's C++ DataLoader worker pool
+// and pinned-memory collate (reference: src/accelerate/data_loader.py drives
+// torch.utils.data.DataLoader, whose hot loops are ATen C++), and torch
+// native serialization behind save/load.  This file is the tpu-native
+// equivalent: the host-side hot loops that feed HBM — batch assembly
+// (gather / stack / pad-stack over sample rows) and checkpoint shard IO
+// (chunked parallel pread/pwrite) — as a small C++17 library driven from
+// Python via ctypes (no pybind11 in this image).
+//
+// Design notes:
+//  * All entry points take an explicit `threads` count and split the work
+//    contiguously over a thread team spawned per call (no persistent pool —
+//    the Python wrappers cap `threads` so each thread moves >=1 MiB, keeping
+//    spawn+join cost negligible next to the copy).  On a 1-core host they
+//    degrade to the fused single-thread loop, which still beats Python-level
+//    per-sample slicing + np.stack by removing interpreter overhead from the
+//    per-row path.
+//  * Row copies are memcpy over caller-provided contiguous buffers: the
+//    Python wrapper keeps ownership (numpy arrays), so there is no
+//    allocation, GIL interaction, or lifetime management here.
+//  * IO uses pread/pwrite with per-thread offsets — one open fd, no seek
+//    races, works on any POSIX filesystem.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+
+namespace {
+
+// Run fn(i) for i in [0, n) over `threads` workers; contiguous block split
+// so each worker touches a contiguous dst region (streams well).
+template <typename Fn>
+void parallel_rows(int64_t n, int threads, Fn fn) {
+  if (threads <= 1 || n <= 1) {
+    for (int64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  if (threads > n) threads = static_cast<int>(n);
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  const int64_t chunk = (n + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back([lo, hi, &fn] {
+      for (int64_t i = lo; i < hi; ++i) fn(i);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather rows by index from a contiguous 2-D source into a contiguous batch:
+//   dst[i, :] = src[idx[i], :]    (row_bytes per row)
+// Bounds are the caller's contract (indices validated Python-side against the
+// dataset length); src is typically a memory-mapped token array, so this is
+// the "dataset[i] for i in batch_indices" inner loop of a DataLoader worker
+// fused into one call.
+void at_gather_rows(const void* src, const int64_t* idx, void* dst,
+                    int64_t n_rows, int64_t row_bytes, int threads) {
+  const char* s = static_cast<const char*>(src);
+  char* d = static_cast<char*>(dst);
+  parallel_rows(n_rows, threads, [&](int64_t i) {
+    std::memcpy(d + i * row_bytes, s + idx[i] * row_bytes, row_bytes);
+  });
+}
+
+// Collate-stack: dst[i, :] = *srcs[i] for n equally-sized sample buffers.
+// This is default_collate's np.stack with the per-sample Python iteration
+// removed.
+void at_stack_rows(const void* const* srcs, void* dst, int64_t n,
+                   int64_t row_bytes, int threads) {
+  char* d = static_cast<char*>(dst);
+  parallel_rows(n, threads, [&](int64_t i) {
+    std::memcpy(d + i * row_bytes, srcs[i], row_bytes);
+  });
+}
+
+// Pad-stack for ragged rows of `elem` bytes per element:
+//   dst[i, :lens[i]] = srcs[i];  dst[i, lens[i]:max_len] = pad pattern.
+// The pad pattern is one element (elem bytes) replicated — covers int32 pad
+// ids, float masks, etc.  dst rows are max_len elements.
+void at_pad_stack(const void* const* srcs, const int64_t* lens, void* dst,
+                  int64_t n, int64_t max_len, int64_t elem, const void* pad,
+                  int threads) {
+  char* d = static_cast<char*>(dst);
+  const char* p = static_cast<const char*>(pad);
+  const int64_t row_bytes = max_len * elem;
+  // All-same-byte patterns (0, -1, 0xFF…) take memset; otherwise seed one
+  // element and double the filled region with self-memcpy (log passes).
+  bool uniform = true;
+  for (int64_t i = 1; i < elem; ++i)
+    if (p[i] != p[0]) { uniform = false; break; }
+  parallel_rows(n, threads, [&](int64_t i) {
+    char* row = d + i * row_bytes;
+    const int64_t nb = lens[i] * elem;
+    std::memcpy(row, srcs[i], nb);
+    const int64_t tail = row_bytes - nb;
+    if (tail <= 0) return;
+    if (uniform) {
+      std::memset(row + nb, p[0], tail);
+    } else {
+      std::memcpy(row + nb, p, elem);
+      int64_t filled = elem;
+      while (filled < tail) {
+        const int64_t take = filled < tail - filled ? filled : tail - filled;
+        std::memcpy(row + nb + filled, row + nb, take);
+        filled += take;
+      }
+    }
+  });
+}
+
+// Chunked parallel write: creates/truncates `path`, then pwrites `nbytes`
+// from buf in `threads` contiguous chunks.  Returns 0 on success, else
+// -errno.  Used for checkpoint shard payloads (safetensors body / raw
+// weight blobs) where a single write() serializes the page-cache fill.
+int at_write_file(const char* path, const void* buf, int64_t nbytes,
+                  int threads) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -errno;
+  // Pre-extend so parallel pwrite never races file growth.
+  if (nbytes > 0 && ::ftruncate(fd, nbytes) != 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  const char* b = static_cast<const char*>(buf);
+  std::vector<int> errs(threads > 0 ? threads : 1, 0);
+  if (threads <= 1) {
+    int64_t off = 0;
+    while (off < nbytes) {
+      ssize_t w = ::pwrite(fd, b + off, nbytes - off, off);
+      if (w < 0) { errs[0] = errno; break; }
+      off += w;
+    }
+  } else {
+    const int64_t chunk = (nbytes + threads - 1) / threads;
+    std::vector<std::thread> pool;
+    for (int t = 0; t < threads; ++t) {
+      const int64_t lo = t * chunk;
+      int64_t hi = lo + chunk;
+      if (hi > nbytes) hi = nbytes;
+      if (lo >= hi) break;
+      pool.emplace_back([fd, b, lo, hi, t, &errs] {
+        int64_t off = lo;
+        while (off < hi) {
+          ssize_t w = ::pwrite(fd, b + off, hi - off, off);
+          if (w < 0) { errs[t] = errno; return; }
+          off += w;
+        }
+      });
+    }
+    for (auto& th : pool) th.join();
+  }
+  if (::close(fd) != 0 && errs[0] == 0) errs[0] = errno;
+  for (int e : errs)
+    if (e != 0) return -e;
+  return 0;
+}
+
+// Write `nbytes` from buf at `offset` into an EXISTING file (no truncate) —
+// the building block for container formats (safetensors): the Python side
+// writes the header and pre-sizes the file, then streams each tensor body to
+// its offset with chunked parallel pwrite.  Returns 0 or -errno.
+int at_write_region(const char* path, const void* buf, int64_t nbytes,
+                    int64_t offset, int threads) {
+  int fd = ::open(path, O_WRONLY);
+  if (fd < 0) return -errno;
+  const char* b = static_cast<const char*>(buf);
+  std::vector<int> errs(threads > 0 ? threads : 1, 0);
+  if (threads <= 1) {
+    int64_t off = 0;
+    while (off < nbytes) {
+      ssize_t w = ::pwrite(fd, b + off, nbytes - off, offset + off);
+      if (w < 0) { errs[0] = errno; break; }
+      off += w;
+    }
+  } else {
+    const int64_t chunk = (nbytes + threads - 1) / threads;
+    std::vector<std::thread> pool;
+    for (int t = 0; t < threads; ++t) {
+      const int64_t lo = t * chunk;
+      int64_t hi = lo + chunk;
+      if (hi > nbytes) hi = nbytes;
+      if (lo >= hi) break;
+      pool.emplace_back([fd, b, lo, hi, offset, t, &errs] {
+        int64_t off = lo;
+        while (off < hi) {
+          ssize_t w = ::pwrite(fd, b + off, hi - off, offset + off);
+          if (w < 0) { errs[t] = errno; return; }
+          off += w;
+        }
+      });
+    }
+    for (auto& th : pool) th.join();
+  }
+  if (::close(fd) != 0 && errs[0] == 0) errs[0] = errno;
+  for (int e : errs)
+    if (e != 0) return -e;
+  return 0;
+}
+
+// Chunked parallel read of exactly `nbytes` from `path` at `offset` into
+// buf.  Returns 0 on success, -errno on open/IO failure, -EIO on short read.
+int at_read_file(const char* path, void* buf, int64_t nbytes, int64_t offset,
+                 int threads) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  char* b = static_cast<char*>(buf);
+  std::vector<int> errs(threads > 0 ? threads : 1, 0);
+  if (threads <= 1) {
+    int64_t off = 0;
+    while (off < nbytes) {
+      ssize_t r = ::pread(fd, b + off, nbytes - off, offset + off);
+      if (r < 0) { errs[0] = errno; break; }
+      if (r == 0) { errs[0] = EIO; break; }  // short file
+      off += r;
+    }
+  } else {
+    const int64_t chunk = (nbytes + threads - 1) / threads;
+    std::vector<std::thread> pool;
+    for (int t = 0; t < threads; ++t) {
+      const int64_t lo = t * chunk;
+      int64_t hi = lo + chunk;
+      if (hi > nbytes) hi = nbytes;
+      if (lo >= hi) break;
+      pool.emplace_back([fd, b, lo, hi, offset, t, &errs] {
+        int64_t off = lo;
+        while (off < hi) {
+          ssize_t r = ::pread(fd, b + off, hi - off, offset + off);
+          if (r < 0) { errs[t] = errno; return; }
+          if (r == 0) { errs[t] = EIO; return; }
+          off += r;
+        }
+      });
+    }
+    for (auto& th : pool) th.join();
+  }
+  ::close(fd);
+  for (int e : errs)
+    if (e != 0) return -e;
+  return 0;
+}
+
+// ABI/version probe so the Python wrapper can reject a stale cached .so.
+int at_abi_version(void) { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,183 @@
+"""Native safetensors-compatible writer/reader.
+
+The reference's checkpoint bodies are written by vendored native
+serialization (torch save / safetensors' Rust core behind
+``safetensors.numpy``).  This module is the tpu-native equivalent: it speaks
+the same on-disk format — 8-byte LE header length, JSON header mapping tensor
+name → {dtype, shape, data_offsets}, then raw little-endian tensor bodies —
+but streams each body with the chunked parallel pwrite/pread in
+``fastloader.cc``, so checkpoint shards never funnel through a single
+serialized write() and large reads fill preallocated buffers in parallel.
+
+Files written here load with ``safetensors.numpy.load_file`` / ``safe_open``
+and vice versa (round-trip covered by tests/test_native.py).  Callers should
+guard with ``native.available()`` and fall back to the safetensors package —
+both save paths in utils/fsdp_utils.py do.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+from . import available, read_into, write_region
+
+_NP_TO_ST = {
+    "float64": "F64",
+    "float32": "F32",
+    "float16": "F16",
+    "int64": "I64",
+    "int32": "I32",
+    "int16": "I16",
+    "int8": "I8",
+    "uint8": "U8",
+    "uint16": "U16",
+    "uint32": "U32",
+    "uint64": "U64",
+    "bool": "BOOL",
+    "bfloat16": "BF16",  # ml_dtypes
+}
+_ST_TO_NP = {v: k for k, v in _NP_TO_ST.items()}
+
+
+def _np_dtype(st_name: str) -> np.dtype:
+    if st_name == "BF16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(_ST_TO_NP[st_name])
+
+
+def save_file(tensors: dict[str, np.ndarray], path: str,
+              metadata: dict[str, str] | None = None) -> None:
+    """Write a safetensors file with parallel native body IO."""
+    path = os.fspath(path)
+    header: dict = {}
+    if metadata:
+        header["__metadata__"] = dict(metadata)
+    bodies: list[tuple[str, np.ndarray, int, int]] = []
+    offset = 0
+    for name, arr in tensors.items():
+        # ascontiguousarray promotes 0-d to (1,) — restore the true shape so
+        # scalar parameters round-trip intact
+        arr = np.ascontiguousarray(arr).reshape(np.shape(arr))
+        dt = _NP_TO_ST.get(arr.dtype.name)
+        if dt is None:
+            raise TypeError(f"unsupported dtype for safetensors: {arr.dtype}")
+        end = offset + arr.nbytes
+        header[name] = {
+            "dtype": dt,
+            "shape": list(arr.shape),
+            "data_offsets": [offset, end],
+        }
+        bodies.append((name, arr, offset, end))
+        offset = end
+    raw_header = json.dumps(header, separators=(",", ":")).encode()
+    # 8-byte alignment of the first body keeps mmap'd readers happy
+    pad = (8 - (len(raw_header) % 8)) % 8
+    raw_header += b" " * pad
+    base = 8 + len(raw_header)
+    # Bodies are laid out contiguously in dict order, so stream small tensors
+    # through the buffered Python fd (a 300-entry state dict must not pay 300
+    # opens + thread spawns) and hand only large bodies to the parallel
+    # region writer.
+    big_cutoff = 4 << 20
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(raw_header)))
+        f.write(raw_header)
+        f.truncate(base + offset)
+        for _, arr, lo, _ in bodies:
+            if 0 < arr.nbytes <= big_cutoff:
+                f.seek(base + lo)
+                # tobytes, not memoryview: custom dtypes (ml_dtypes bf16)
+                # don't support the buffer protocol; tensors here are small
+                f.write(arr.tobytes())
+    for _, arr, lo, _ in bodies:
+        if arr.nbytes > big_cutoff:
+            write_region(path, arr, base + lo)
+
+
+def _read_header(path: str) -> tuple[dict, int]:
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen))
+    return header, 8 + hlen
+
+
+def load_file(path: str, writable: bool = True) -> dict[str, np.ndarray]:
+    """Read the whole body in ONE parallel pread, then split per tensor.
+
+    Default (``writable=True``) returns independent writable arrays — the
+    same contract as ``safetensors.numpy.load_file``, so programs behave
+    identically whether or not the native library built.  ``writable=False``
+    skips the per-tensor copy and returns READ-ONLY zero-copy views over the
+    shared body buffer (in-place writes raise) — for internal hot paths that
+    only read, e.g. the sharded-checkpoint merge.
+    """
+    path = os.fspath(path)
+    header, base = _read_header(path)
+    entries = [(k, m) for k, m in header.items() if k != "__metadata__"]
+    total = max((m["data_offsets"][1] for _, m in entries), default=0)
+    body = np.empty(total, np.uint8)
+    if total:
+        read_into(path, body, offset=base)
+    out: dict[str, np.ndarray] = {}
+    for name, meta in entries:
+        lo, hi = meta["data_offsets"]
+        arr = body[lo:hi].view(_np_dtype(meta["dtype"])).reshape(meta["shape"])
+        if writable:
+            arr = arr.copy()
+        else:
+            # an in-place write would silently corrupt the sibling tensors
+            # sharing the body buffer — force callers to copy instead
+            arr.flags.writeable = False
+        out[name] = arr
+    return out
+
+
+def load_tensor(path: str, name: str) -> np.ndarray:
+    """Read a single tensor body without touching the rest of the file."""
+    path = os.fspath(path)
+    header, base = _read_header(path)
+    meta = header[name]
+    lo, hi = meta["data_offsets"]
+    arr = np.empty(meta["shape"], dtype=_np_dtype(meta["dtype"]))
+    if hi > lo:
+        read_into(path, arr, offset=base + lo)
+    return arr
+
+
+def pick_save_file():
+    """Native ``save_file`` when the library is up, else the safetensors one.
+
+    Single source for the fallback choice so call sites (fsdp_utils save /
+    load / merge) cannot drift.
+    """
+    if available():
+        return save_file
+    from safetensors.numpy import save_file as pkg_save
+
+    return pkg_save
+
+
+def pick_load_file():
+    """Native ``load_file`` when the library is up, else the safetensors one.
+
+    Both return independent writable arrays (native defaults to
+    ``writable=True``), so behavior is machine-independent.  Internal
+    read-only hot paths that want the zero-copy views call
+    ``load_file(path, writable=False)`` explicitly instead of going through
+    this picker.
+    """
+    if available():
+        return load_file
+    from safetensors.numpy import load_file as pkg_load
+
+    return pkg_load
+
+
+__all__ = ["save_file", "load_file", "load_tensor", "available",
+           "pick_save_file", "pick_load_file"]

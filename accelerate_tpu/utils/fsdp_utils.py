@@ -126,8 +126,10 @@ def save_sharded_model_state(
     in host memory, so it scales to models larger than one host's RAM.
     """
     import jax
-    from safetensors.numpy import save_file
 
+    from ..native.st import pick_save_file
+
+    save_file = pick_save_file()  # parallel native body IO when available
     rank = jax.process_index() if process_index is None else process_index
     world = jax.process_count() if num_processes is None else num_processes
     os.makedirs(output_dir, exist_ok=True)
@@ -160,7 +162,16 @@ def save_sharded_model_state(
 
 
 def _load_all_shard_files(directory: str, name: str) -> dict[str, np.ndarray]:
-    from safetensors.numpy import load_file
+    from ..native import available as _native_ok
+    from ..native.st import load_file as _native_load
+
+    if _native_ok():
+        # zero-copy read-only views: this merge path only reads the shard
+        # arrays (slices are copied into fresh outputs downstream)
+        def load_file(p):
+            return _native_load(p, writable=False)
+    else:
+        from safetensors.numpy import load_file
 
     out: dict[str, np.ndarray] = {}
     found = False
@@ -230,8 +241,9 @@ def merge_sharded_weights(
             input_dir, f"{name}.safetensors" if safe_serialization else f"{name}.npz"
         )
     if safe_serialization:
-        from safetensors.numpy import save_file
+        from ..native.st import pick_save_file
 
+        save_file = pick_save_file()
         bf16 = _bf16_np()
         meta = {
             "format": "accelerate_tpu",

@@ -51,9 +51,9 @@ def load_hf_state_dict(path: str) -> dict[str, np.ndarray]:
     state: dict[str, np.ndarray] = {}
     for f in files:
         if f.endswith(".safetensors"):
-            from safetensors.numpy import load_file
+            from ..native.st import pick_load_file
 
-            state.update(load_file(f))
+            state.update(pick_load_file()(f))
         else:
             import torch
 

@@ -507,9 +507,9 @@ def check_device_map(model, device_map: dict) -> None:
 
 def _load_state_dict_file(path: str) -> dict:
     if path.endswith(".safetensors"):
-        from safetensors.numpy import load_file
+        from ..native.st import pick_load_file
 
-        return load_file(path)
+        return pick_load_file()(path)
     if path.endswith(".npz"):
         with np.load(path) as z:
             return {k: z[k] for k in z.files}

@@ -59,9 +59,9 @@ def save(obj: Any, f, save_on_each_node: bool = False, safe_serialization: bool 
         and all(isinstance(v, (jax.Array, np.ndarray)) for v in obj.values())
     )
     if safe_serialization and tensor_dict:
-        from safetensors.numpy import save_file
+        from ..native.st import pick_save_file
 
-        save_file(clean_state_dict_for_safetensors(obj), f)
+        pick_save_file()(clean_state_dict_for_safetensors(obj), f)
     else:
         obj = jax.tree_util.tree_map(
             lambda x: np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x,
@@ -75,17 +75,17 @@ def load(f, map_location=None) -> Any:
     """Load a file written by :func:`save` (reference other.py:155)."""
     f = os.fspath(f)
     if f.endswith(".safetensors"):
-        from safetensors.numpy import load_file
+        from ..native.st import pick_load_file
 
-        return load_file(f)
+        return pick_load_file()(f)
     with open(f, "rb") as fh:
         head = fh.read(9)
     # safetensors layout: u64 LE header length, then the JSON header ("{...")
     if len(head) == 9 and head[8:9] == b"{":
-        from safetensors.numpy import load_file
+        from ..native.st import pick_load_file
 
         try:
-            return load_file(f)
+            return pick_load_file()(f)
         except Exception:
             pass
     with open(f, "rb") as fh:

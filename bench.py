@@ -539,26 +539,27 @@ def main() -> None:
     # secondary BASELINE.md workloads, gated so the default driver run stays
     # inside its time budget (each adds a multi-minute cold compile)
     if os.environ.get("BENCH_FULL", "") == "1":
-        try:
-            result.update(_bert_mrpc_workload(on_accel))
-        except Exception as exc:  # fail-soft: keep the primary metric
-            result["bert_error"] = f"{type(exc).__name__}: {exc}"[:300]
-        try:
-            result.update(_big_model_inference_workload(on_accel))
-        except Exception as exc:
-            result["bigmodel_error"] = f"{type(exc).__name__}: {exc}"[:300]
-        try:
-            result.update(_llama_fsdp_workload(on_accel))
-        except Exception as exc:
-            result["llama_error"] = f"{type(exc).__name__}: {exc}"[:300]
-        try:
-            result.update(_opt_inference_workload(on_accel))
-        except Exception as exc:
-            result["opt_error"] = f"{type(exc).__name__}: {exc}"[:300]
-        try:
-            result.update(_long_context_workload(on_accel))
-        except Exception as exc:
-            result["longctx_error"] = f"{type(exc).__name__}: {exc}"[:300]
+        # stderr progress marks: when the deadline watchdog cuts the extras,
+        # the log shows which workload ate the time (each also reports its
+        # own *_compile_s in the JSON when it completes)
+        extras = [
+            ("bert", _bert_mrpc_workload),
+            ("bigmodel", _big_model_inference_workload),
+            ("llama", _llama_fsdp_workload),
+            ("opt", _opt_inference_workload),
+            ("longctx", _long_context_workload),
+        ]
+        for label, workload in extras:
+            t_extra = time.perf_counter()
+            print(f"[bench] extra '{label}' start", file=sys.stderr, flush=True)
+            try:
+                result.update(workload(on_accel))
+            except Exception as exc:  # fail-soft: keep the primary metric
+                result[f"{label}_error"] = f"{type(exc).__name__}: {exc}"[:300]
+            print(
+                f"[bench] extra '{label}' done in {time.perf_counter() - t_extra:.1f}s",
+                file=sys.stderr, flush=True,
+            )
     _emit_once(result)
 
 

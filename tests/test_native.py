@@ -1,0 +1,278 @@
+"""Native host-runtime library: batch assembly + checkpoint IO.
+
+Covers the C++ fastloader core (gather/stack/pad-stack/file IO), the
+safetensors-compatible container (round-trip both ways against the
+safetensors package), the data_loader integrations (default_collate fast
+path, TokenDataset.batch), and the numpy-fallback kill switch.
+Reference behavior being mirrored: torch's C++ DataLoader collate and
+native checkpoint serialization (see accelerate_tpu/native/__init__.py).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from accelerate_tpu import native
+from accelerate_tpu.data_loader import TokenDataset, default_collate
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native lib unavailable: {native.load_error()}"
+)
+
+
+def test_gather_rows_matches_fancy_indexing():
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, 1000, (64, 17), dtype=np.int32)
+    idx = rng.integers(0, 64, 33)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def test_gather_rows_3d_and_out_buffer():
+    src = np.random.default_rng(1).random((10, 3, 5)).astype(np.float32)
+    idx = np.array([9, 0, 4])
+    out = np.empty((3, 3, 5), np.float32)
+    got = native.gather_rows(src, idx, out=out)
+    assert got is out
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_gather_rows_bounds_check():
+    src = np.zeros((4, 2), np.int32)
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([0, 4]))
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([-1]))
+
+
+def test_stack_rows_matches_np_stack():
+    rows = [np.random.default_rng(i).random((6, 4)).astype(np.float32) for i in range(9)]
+    np.testing.assert_array_equal(native.stack_rows(rows), np.stack(rows))
+
+
+def test_stack_rows_rejects_ragged():
+    with pytest.raises(ValueError):
+        native.stack_rows([np.zeros(3, np.float32), np.zeros(4, np.float32)])
+
+
+def test_stack_rows_validates_out():
+    rows = [np.zeros((4,), np.float32)] * 3
+    with pytest.raises(ValueError):
+        native.stack_rows(rows, out=np.empty((2, 4), np.float32))  # too small
+    with pytest.raises(ValueError):
+        native.stack_rows(rows, out=np.empty((3, 4), np.float64))  # wrong dtype
+    out = np.empty((3, 4), np.float32)
+    assert native.stack_rows(rows, out=out) is out
+
+
+def test_pad_stack():
+    rows = [np.array([1, 2, 3], np.int32), np.array([7], np.int32),
+            np.array([4, 5], np.int32)]
+    got = native.pad_stack(rows, pad_value=-100)
+    np.testing.assert_array_equal(
+        got, np.array([[1, 2, 3], [7, -100, -100], [4, 5, -100]], np.int32)
+    )
+
+
+def test_pad_stack_float_and_max_len():
+    rows = [np.array([1.5], np.float32)]
+    got = native.pad_stack(rows, max_len=4, pad_value=0.25)
+    np.testing.assert_array_equal(got, np.array([[1.5, 0.25, 0.25, 0.25]], np.float32))
+    with pytest.raises(ValueError):
+        native.pad_stack([np.zeros(5, np.float32)], max_len=3)
+
+
+def test_file_roundtrip_and_offset(tmp_path):
+    path = str(tmp_path / "blob.bin")
+    x = np.random.default_rng(3).random((257, 33)).astype(np.float64)
+    native.write_file(path, x)
+    np.testing.assert_array_equal(native.read_into(path, np.empty_like(x)), x)
+    # offset read of row 5
+    row = native.read_into(path, np.empty(33, np.float64), offset=5 * 33 * 8)
+    np.testing.assert_array_equal(row, x[5])
+
+
+def test_write_region(tmp_path):
+    path = str(tmp_path / "region.bin")
+    native.write_file(path, np.zeros(16, np.uint8))
+    native.write_region(path, np.arange(4, dtype=np.uint8), offset=6)
+    got = native.read_into(path, np.empty(16, np.uint8))
+    expect = np.zeros(16, np.uint8)
+    expect[6:10] = [0, 1, 2, 3]
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_read_short_file_errors(tmp_path):
+    path = str(tmp_path / "short.bin")
+    native.write_file(path, np.zeros(8, np.uint8))
+    with pytest.raises(OSError):
+        native.read_into(path, np.empty(64, np.uint8))
+
+
+def test_missing_file_errors(tmp_path):
+    with pytest.raises(OSError):
+        native.read_into(str(tmp_path / "nope.bin"), np.empty(4, np.uint8))
+
+
+# --- safetensors-compatible container -------------------------------------
+def _sample_tensors():
+    rng = np.random.default_rng(7)
+    return {
+        "w": rng.random((33, 9)).astype(np.float32),
+        "b": rng.integers(-5, 5, (9,), dtype=np.int64),
+        "flag": np.array(True),
+        "u16view": rng.integers(0, 2**16, (4, 4)).astype(np.uint16),
+        "empty": np.zeros((0, 3), np.float32),
+        # >4MB: exercises the parallel region-writer path, not the buffered one
+        "big": rng.random((1100, 1024)).astype(np.float32),
+    }
+
+
+def test_st_roundtrip_native(tmp_path):
+    from accelerate_tpu.native import st
+
+    path = str(tmp_path / "m.safetensors")
+    tensors = _sample_tensors()
+    st.save_file(tensors, path, metadata={"format": "accelerate_tpu-sharded"})
+    back = st.load_file(path)
+    assert set(back) == set(tensors)
+    for k in tensors:
+        # strict shape check: assert_array_equal broadcasts, which would let
+        # a 0-d -> (1,) regression slip through (it did once)
+        assert back[k].shape == tensors[k].shape, k
+        np.testing.assert_array_equal(back[k], tensors[k])
+    np.testing.assert_array_equal(st.load_tensor(path, "b"), tensors["b"])
+
+
+def test_st_native_write_safetensors_read(tmp_path):
+    """Files we write load with the safetensors package (format parity)."""
+    from safetensors.numpy import load_file as st_load
+
+    from accelerate_tpu.native import st
+
+    path = str(tmp_path / "m.safetensors")
+    tensors = _sample_tensors()
+    st.save_file(tensors, path)
+    back = st_load(path)
+    for k in tensors:
+        assert back[k].shape == tensors[k].shape, k
+        np.testing.assert_array_equal(back[k], tensors[k])
+
+
+def test_st_safetensors_write_native_read(tmp_path):
+    """Files safetensors writes load through the native reader."""
+    from safetensors.numpy import save_file as st_save
+
+    from accelerate_tpu.native import st
+
+    path = str(tmp_path / "m.safetensors")
+    tensors = _sample_tensors()
+    st_save(tensors, path)
+    back = st.load_file(path)
+    for k in tensors:
+        assert back[k].shape == tensors[k].shape, k
+        np.testing.assert_array_equal(back[k], tensors[k])
+
+
+def test_st_pathlike_and_writable_contract(tmp_path):
+    """PathLike paths work, and default loads are writable (package parity);
+    writable=False gives read-only zero-copy views."""
+    from accelerate_tpu.native import st
+
+    path = tmp_path / "m.safetensors"  # a PosixPath, not str
+    tensors = _sample_tensors()
+    st.save_file(tensors, path)
+    back = st.load_file(path)
+    back["w"] += 1  # must NOT raise: independent writable array
+    np.testing.assert_array_equal(back["w"], tensors["w"] + 1)
+    ro = st.load_file(path, writable=False)
+    with pytest.raises(ValueError):
+        ro["w"] += 1
+    np.testing.assert_array_equal(st.load_tensor(path, "b"), tensors["b"])
+
+
+def test_st_bf16(tmp_path):
+    import ml_dtypes
+
+    from accelerate_tpu.native import st
+
+    path = str(tmp_path / "bf16.safetensors")
+    x = np.random.default_rng(9).random((8, 8)).astype(ml_dtypes.bfloat16)
+    st.save_file({"x": x}, path)
+    np.testing.assert_array_equal(st.load_file(path)["x"], x)
+
+
+# --- integrations ----------------------------------------------------------
+def test_default_collate_uses_native_and_matches():
+    samples = [np.full((3, 2), i, np.float32) for i in range(8)]
+    np.testing.assert_array_equal(default_collate(samples), np.stack(samples))
+
+
+def test_token_dataset_memmap_batch(tmp_path):
+    tokens = np.arange(100, dtype=np.int32)
+    path = str(tmp_path / "tokens.bin")
+    tokens.tofile(path)
+    ds = TokenDataset(path, seq_len=8)
+    assert len(ds) == 12 and ds.seq_len == 8
+    np.testing.assert_array_equal(ds[3], np.arange(24, 32, dtype=np.int32))
+    batch = ds.batch([11, 0, 5])
+    np.testing.assert_array_equal(batch, ds.rows[np.array([11, 0, 5])])
+    # negative indices normalize identically on native and numpy paths
+    np.testing.assert_array_equal(ds.batch([-1, -12]), ds.rows[np.array([11, 0])])
+
+
+def test_token_dataset_2d_and_errors():
+    ds = TokenDataset(np.zeros((4, 16), np.int32))
+    assert len(ds) == 4
+    with pytest.raises(ValueError):
+        TokenDataset(np.zeros(64, np.int32))  # flat needs seq_len
+    with pytest.raises(ValueError):
+        TokenDataset(np.zeros((2, 2, 2), np.int32))
+
+
+def test_token_dataset_batch_validation_uniform():
+    """batch() validates identically on native and numpy paths."""
+    ds = TokenDataset(np.arange(64, dtype=np.int32).reshape(4, 16))
+    with pytest.raises(ValueError):
+        ds.batch(np.array([[0, 1]]))  # non-1-D
+    with pytest.raises(IndexError):
+        ds.batch([4])
+    with pytest.raises(ValueError):
+        ds.batch([0, 1], out=np.empty((2, 16), np.float32))  # wrong dtype
+    out = np.empty((2, 16), np.int32)
+    assert ds.batch([1, 3], out=out) is out
+
+
+def test_sharded_checkpoint_files_still_compatible(tmp_path):
+    """save_sharded_model_state (now native-IO) stays safe_open-readable."""
+    from safetensors import safe_open
+
+    from accelerate_tpu.utils.fsdp_utils import save_sharded_model_state
+
+    state = {"layer.w": np.random.default_rng(5).random((6, 4)).astype(np.float32)}
+    save_sharded_model_state(state, str(tmp_path), process_index=0, num_processes=1)
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".safetensors")]
+    assert len(files) == 1
+    with safe_open(str(tmp_path / files[0]), framework="numpy") as f:
+        keys = list(f.keys())
+        assert len(keys) == 1
+        np.testing.assert_array_equal(f.get_tensor(keys[0]), state["layer.w"])
+
+
+def test_kill_switch_subprocess():
+    """ACCELERATE_TPU_NO_NATIVE=1 disables the library; collate still works."""
+    code = (
+        "import numpy as np;"
+        "from accelerate_tpu import native;"
+        "from accelerate_tpu.data_loader import default_collate;"
+        "assert not native.available();"
+        "assert 'disabled' in native.load_error();"
+        "out = default_collate([np.ones(3, np.float32)] * 4);"
+        "assert out.shape == (4, 3)"
+    )
+    env = dict(os.environ, ACCELERATE_TPU_NO_NATIVE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]

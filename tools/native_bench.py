@@ -1,0 +1,101 @@
+"""Micro-benchmark: native host-runtime vs pure-Python/numpy equivalents.
+
+Prints one JSON line per workload. These are HOST-side paths (batch assembly
+feeding HBM, checkpoint shard IO) — the TPU is not involved; run anywhere.
+Usage: python tools/native_bench.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from accelerate_tpu import native  # noqa: E402
+
+
+def timeit(fn, reps=5):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def emit(name, python_s, native_s, note=""):
+    print(json.dumps({
+        "workload": name,
+        "python_ms": round(python_s * 1e3, 2),
+        "native_ms": round(native_s * 1e3, 2),
+        "speedup": round(python_s / native_s, 2),
+        "threads": native._threads_default(),
+        "note": note,
+    }))
+
+
+def main():
+    assert native.available(), native.load_error()
+    rng = np.random.default_rng(0)
+
+    # 1. LM batch assembly: gather 512 rows of 1024 int32 tokens from a
+    # memmapped 200M-token buffer (the TokenDataset pretraining path).
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "tokens.bin")
+        rows, seq = 200_000, 1024
+        np.asarray(rng.integers(0, 50000, (rows, seq)), np.int32).tofile(path)
+        mm = np.memmap(path, dtype=np.int32, mode="r", shape=(rows, seq))
+        idx = rng.integers(0, rows, 512)
+        # per-sample python loop + stack — what a generic Dataset/DataLoader does
+        emit("token_batch_gather 512x1024 i32",
+             timeit(lambda: np.stack([np.asarray(mm[i]) for i in idx])),
+             timeit(lambda: native.gather_rows(mm, idx)),
+             "memmap source")
+
+    # 2. Collate: stack 256 float32 image-ish samples.  np.stack's copy loop
+    # is already C, so the native win here comes only from threads>1 splitting
+    # the batch; default_collate gates on that (data_loader.py).
+    samples = [rng.random((3, 224, 224)).astype(np.float32) for _ in range(256)]
+    emit("collate_stack 256x3x224x224 f32",
+         timeit(lambda: np.stack(samples)),
+         timeit(lambda: native.stack_rows(samples)),
+         "wins only with threads>1")
+
+    # 3. Ragged pad-stack: 512 variable-length token rows.
+    ragged = [np.asarray(rng.integers(0, 50000, rng.integers(200, 1024)), np.int32)
+              for _ in range(512)]
+
+    def py_pad():
+        ml = max(len(r) for r in ragged)
+        out = np.full((len(ragged), ml), -100, np.int32)
+        for i, r in enumerate(ragged):
+            out[i, : len(r)] = r
+        return out
+
+    emit("pad_stack 512 ragged i32",
+         timeit(py_pad),
+         timeit(lambda: native.pad_stack(ragged, pad_value=-100)))
+
+    # 4. Checkpoint shard write+read: 512 MB safetensors body.
+    with tempfile.TemporaryDirectory() as d:
+        from accelerate_tpu.native import st
+        from safetensors.numpy import load_file as st_load
+        from safetensors.numpy import save_file as st_save
+
+        tensors = {f"w{i}": rng.random((1024, 1024)).astype(np.float32)
+                   for i in range(128)}
+        p_native = os.path.join(d, "n.safetensors")
+        p_pkg = os.path.join(d, "p.safetensors")
+        emit("safetensors_save 512MB",
+             timeit(lambda: st_save(tensors, p_pkg), reps=3),
+             timeit(lambda: st.save_file(tensors, p_native), reps=3))
+        emit("safetensors_load 512MB",
+             timeit(lambda: st_load(p_pkg), reps=3),
+             timeit(lambda: st.load_file(p_native), reps=3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Sequential single-variable perf experiments on the flagship bench.
+# Each line of $OUT/exp.log: experiment tag + the bench JSON line.
+# Usage: bash tools/tpu_flag_experiments.sh [outdir]
+set -u
+OUT=$(realpath -m "${1:-/tmp/tpu_exp}")
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+run() {
+  tag="$1"; shift
+  echo "== $tag ==" | tee -a "$OUT/exp.log"
+  env "$@" BENCH_INIT_ATTEMPTS=2 timeout 600 python bench.py \
+    2>"$OUT/err_$tag.log" | tee -a "$OUT/exp.log"
+}
+
+# tighter timing baseline for today's chip state
+run steps100 BENCH_STEPS=100
+# scoped-vmem headroom for the Mosaic flash kernels
+run vmem32m XLA_FLAGS=--xla_tpu_scoped_vmem_limit_kib=32768
+run vmem64m XLA_FLAGS=--xla_tpu_scoped_vmem_limit_kib=65536
+# FORWARD flash blocks (only backward was swept)
+run fwdblk512 ACCELERATE_TPU_FLASH_BLOCK_Q=512 ACCELERATE_TPU_FLASH_BLOCK_K=512
+run fwdblk256 ACCELERATE_TPU_FLASH_BLOCK_Q=256 ACCELERATE_TPU_FLASH_BLOCK_K=256
+echo "experiments done" | tee -a "$OUT/exp.log"
