@@ -11,7 +11,8 @@ test:
 test_core:
 	python -m pytest tests/test_accelerator.py tests/test_state.py \
 	  tests/test_operations.py tests/test_data_loader.py tests/test_native.py \
-	  tests/test_data_loader_grid.py tests/test_optimizer.py \
+	  tests/test_data_loader_grid.py tests/test_num_workers.py \
+	  tests/test_optimizer.py \
 	  tests/test_capture_stability.py tests/test_precision.py \
 	  tests/test_fp16_capture.py tests/test_autocast.py \
 	  tests/test_tracking.py tests/test_utils_misc.py \

@@ -593,6 +593,85 @@ def batch_to_global_array(batch, mesh=None, sharding=None):
 # ---------------------------------------------------------------------------
 # DataLoaders
 # ---------------------------------------------------------------------------
+class _BackgroundPrefetcher:
+    """Run a host-batch generator in a producer thread behind a bounded queue.
+
+    The reference's DataLoader gets host/compute overlap from C++ worker
+    processes (torch ``num_workers``); under SPMD one producer THREAD is the
+    right shape — collate is numpy/native code that releases the GIL, the
+    queue bound applies backpressure, and single-producer order keeps
+    synchronized-RNG sampling deterministic.  Exceptions propagate to the
+    consumer; ``close()`` (or garbage collection of the consumer) stops the
+    producer promptly even when the queue is full.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, gen_factory: Callable[[], Iterator], depth: int):
+        import queue as _queue
+        import threading as _threading
+
+        self._queue: Any = _queue.Queue(maxsize=max(1, depth))
+        self._stop = _threading.Event()
+        self._done = False  # sticky exhaustion (consumer side)
+        self._gen_factory = gen_factory
+        self._thread = _threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _put_retrying(self, entry) -> bool:
+        """Put with stop-aware retries; never gives up while the consumer
+        lives (a bounded timeout here would drop terminal sentinels — and
+        with them a dataset exception — whenever the queue stayed full)."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(entry, timeout=0.1)
+                return True
+            except Exception:  # queue.Full
+                continue
+        return False
+
+    def _produce(self):
+        try:
+            for item in self._gen_factory():
+                if not self._put_retrying((item, None)):
+                    return
+            self._put_retrying((self._SENTINEL, None))
+        except BaseException as exc:  # noqa: BLE001 — propagate to consumer
+            self._put_retrying((self._SENTINEL, exc))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            # sticky: match the plain-generator contract instead of blocking
+            # on a queue that will never be fed again
+            raise StopIteration
+        item, exc = self._queue.get()
+        if item is self._SENTINEL:
+            self._done = True
+            if exc is not None:
+                raise exc
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._stop.set()
+        self._done = True
+        # drain-and-join: a blocked put wakes, sees the stop flag, and the
+        # thread exits BEFORE we return — a stale producer advancing the
+        # shared sampler concurrently with the next epoch would corrupt
+        # remainder bookkeeping (and, in dispatch mode, emit an unpaired
+        # collective)
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except Exception:
+                pass
+            self._thread.join(timeout=0.2)
+
+
 class DataLoaderStateMixin:
     """Tracks end-of-iteration + remainder in GradientState (reference :407)."""
 
@@ -625,6 +704,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         synchronized_generator=None,
         skip_batches: int = 0,
         _drop_last: bool = False,
+        num_workers: int = 0,
         **kwargs,
     ):
         self.dataset = dataset
@@ -633,6 +713,10 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.device_placement = device_placement
         self.mesh = mesh
         self.prefetch_size = max(1, prefetch_size)
+        # torch-parity knob: 0 = assemble host batches inline; >=1 = one
+        # background producer thread (+ a fetch pool for sample reads when >1)
+        self.num_workers = max(0, int(num_workers))
+        self._fetch_pool = None
         self.rng_types = rng_types
         self.synchronized_generator = synchronized_generator
         self.skip_batches = skip_batches
@@ -714,15 +798,32 @@ class DataLoaderShard(DataLoaderStateMixin):
 
     def _collate_group(self, group: list[list[int]]):
         flat_indices = list(itertools.chain.from_iterable(group))
-        samples = [self.dataset[i] for i in flat_indices]
+        if self.num_workers > 1:
+            # parallel sample fetches (torch worker parity): pays off when
+            # dataset[i] does real work (decode, disk read); plain numpy rows
+            # are better off on the single producer thread
+            from concurrent.futures import ThreadPoolExecutor
+
+            if self._fetch_pool is None:
+                self._fetch_pool = ThreadPoolExecutor(max_workers=self.num_workers)
+            samples = list(self._fetch_pool.map(self.dataset.__getitem__, flat_indices))
+        else:
+            samples = [self.dataset[i] for i in flat_indices]
         return self.collate_fn(samples)
 
     def __iter__(self):
         self.begin()
         self.set_epoch(self.epoch)
         self._iteration = self.skip_batches  # in-epoch position (for resume)
+        prefetcher = None
         try:
-            batches = self._host_batches()
+            if self.num_workers > 0:
+                prefetcher = _BackgroundPrefetcher(
+                    self._host_batches, depth=self.prefetch_size
+                )
+                batches: Iterator = iter(prefetcher)
+            else:
+                batches = self._host_batches()
             # skip for mid-epoch resume
             for _ in range(self.skip_batches):
                 next(batches, None)
@@ -752,6 +853,11 @@ class DataLoaderShard(DataLoaderStateMixin):
                 yield batch
                 self._iteration += 1
         finally:
+            if prefetcher is not None:
+                prefetcher.close()  # joins the producer — pool is idle after
+            if self._fetch_pool is not None:
+                self._fetch_pool.shutdown(wait=False)
+                self._fetch_pool = None
             self.skip_batches = 0
             self.end()
         # epoch completed in full: advance and reset the in-epoch position
@@ -830,6 +936,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             prefetch_size=dataloader.prefetch_size,
             skip_batches=num_batches,
             _drop_last=dataloader._stream_drop_last,
+            num_workers=dataloader.num_workers,
             stream_global_batch=dataloader._stream_global_batch,
         )
         new.epoch = dataloader.epoch
@@ -847,8 +954,8 @@ def skip_first_batches(dataloader, num_batches: int = 0):
 # prepare_data_loader
 # ---------------------------------------------------------------------------
 def _extract_torch_dataloader(dataloader):
-    """Pull (dataset, batch_size, shuffle, collate_fn, drop_last) out of a
-    torch DataLoader without importing torch at module scope."""
+    """Pull (dataset, batch_size, shuffle, collate_fn, drop_last, num_workers)
+    out of a torch DataLoader without importing torch at module scope."""
     dataset = dataloader.dataset
     batch_size = dataloader.batch_size
     drop_last = getattr(dataloader, "drop_last", False)
@@ -858,7 +965,8 @@ def _extract_torch_dataloader(dataloader):
     # torch default_collate produces torch tensors; replace with ours unless custom
     if collate is not None and getattr(collate, "__module__", "").startswith("torch"):
         collate = None
-    return dataset, batch_size, shuffle, collate, drop_last
+    num_workers = getattr(dataloader, "num_workers", 0) or 0
+    return dataset, batch_size, shuffle, collate, drop_last, num_workers
 
 
 def prepare_data_loader(
@@ -884,6 +992,7 @@ def prepare_data_loader(
     drop_last: bool = False,
     mesh=None,
     prefetch_size: int = 2,
+    num_workers: Optional[int] = None,
 ) -> DataLoaderShard:
     """Build the SPMD loader from a torch DataLoader, our kwargs, or both.
 
@@ -904,15 +1013,18 @@ def prepare_data_loader(
         if isinstance(dataloader, DataLoaderShard):
             return dataloader
         if hasattr(dataloader, "dataset"):  # torch DataLoader or similar
-            dataset, batch_size, shuffle, collate_fn, drop_last = _extract_torch_dataloader(
-                dataloader
-            )
+            (dataset, batch_size, shuffle, collate_fn, drop_last,
+             extracted_workers) = _extract_torch_dataloader(dataloader)
+            if num_workers is None:  # unset -> inherit; explicit 0 stays 0
+                num_workers = extracted_workers
         else:
             dataset = dataloader
             batch_size = batch_size or 1
 
     if dataset is None:
         raise ValueError("prepare_data_loader needs a dataloader or a dataset")
+    if num_workers is None:
+        num_workers = 0
     if batch_size is None:
         batch_size = 1
 
@@ -929,6 +1041,7 @@ def prepare_data_loader(
             prefetch_size=prefetch_size,
             rng_types=rng_types,
             _drop_last=drop_last,
+            num_workers=num_workers,
             stream_global_batch=global_batch,
         )
 
@@ -959,4 +1072,5 @@ def prepare_data_loader(
         mesh=mesh,
         prefetch_size=prefetch_size,
         rng_types=rng_types,
+        num_workers=num_workers,
     )

@@ -1,0 +1,99 @@
+"""Background-prefetch loader (`num_workers`, reference torch DataLoader
+worker parity — see data_loader._BackgroundPrefetcher)."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.data_loader import prepare_data_loader
+
+
+class _Rows:
+    def __init__(self, n=24, fail_at=None):
+        self.rows = np.arange(n * 4, dtype=np.int32).reshape(n, 4)
+        self.fail_at = fail_at
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i):
+        if self.fail_at is not None and i == self.fail_at:
+            raise RuntimeError("boom at sample %d" % i)
+        return self.rows[i]
+
+
+def _collect(loader):
+    return [np.asarray(b) for b in loader]
+
+
+@pytest.mark.parametrize("workers", [1, 2])
+def test_worker_parity_with_inline(workers):
+    """Same batches, same order, whether assembled inline or in background."""
+    kw = dict(batch_size=4, shuffle=True, data_seed=7, put_on_device=False)
+    inline = prepare_data_loader(dataset=_Rows(), num_workers=0, **kw)
+    threaded = prepare_data_loader(dataset=_Rows(), num_workers=workers, **kw)
+    for epoch in range(2):  # second epoch: set_epoch reshuffle must also agree
+        a, b = _collect(inline), _collect(threaded)
+        assert len(a) == len(b) > 0
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_early_break_does_not_hang():
+    loader = prepare_data_loader(
+        dataset=_Rows(n=64), batch_size=4, num_workers=1, put_on_device=False
+    )
+    for i, _ in enumerate(loader):
+        if i == 1:
+            break
+    # a fresh full iteration afterwards still works
+    assert len(_collect(loader)) == len(loader)
+
+
+def test_worker_exception_propagates():
+    loader = prepare_data_loader(
+        dataset=_Rows(fail_at=9), batch_size=4, num_workers=1, put_on_device=False
+    )
+    with pytest.raises(RuntimeError, match="boom"):
+        _collect(loader)
+
+
+def test_skip_past_epoch_end_does_not_hang():
+    """skip_batches beyond the epoch must terminate (sticky StopIteration in
+    the background iterator, matching the inline-generator contract)."""
+    loader = prepare_data_loader(
+        dataset=_Rows(n=8), batch_size=4, num_workers=1, put_on_device=False
+    )
+    loader.skip_batches = len(loader) + 3  # stale resume count
+    assert _collect(loader) == []
+
+
+def test_resume_preserves_num_workers():
+    from accelerate_tpu.data_loader import skip_first_batches
+
+    loader = prepare_data_loader(
+        dataset=_Rows(n=16), batch_size=4, num_workers=2, put_on_device=False
+    )
+    resumed = skip_first_batches(loader, 1)
+    assert resumed.num_workers == 2
+    assert len(_collect(resumed)) == len(loader) - 1
+
+
+def test_torch_dataloader_num_workers_extracted():
+    torch = pytest.importorskip("torch")
+
+    class TorchRows(torch.utils.data.Dataset):
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            return np.full(3, i, np.int32)
+
+    tdl = torch.utils.data.DataLoader(TorchRows(), batch_size=3, num_workers=2)
+    loader = prepare_data_loader(tdl, put_on_device=False)
+    assert loader.num_workers == 2
+    batches = _collect(loader)
+    assert batches[0].shape == (3, 3)
+    np.testing.assert_array_equal(batches[0][1], np.full(3, 1, np.int32))
+    # an explicit 0 must win over the wrapped loader's setting (debug escape)
+    forced = prepare_data_loader(tdl, put_on_device=False, num_workers=0)
+    assert forced.num_workers == 0
