@@ -163,7 +163,10 @@ class DistributedDataParallelKwargs(KwargsHandler):
 
     Under SPMD there is no DDP wrapper; gradient bucketing/overlap is the XLA
     scheduler's job.  ``gradient_as_bucket_view`` etc. are accepted and
-    ignored; ``comm_hook`` maps to gradient-compression config.
+    ignored; ``comm_hook`` ("fp16"/"bf16") compresses synced gradients at
+    the backward boundary — half-width grad buffers and downstream
+    consumers; see Accelerator._apply_comm_hook for exactly what this does
+    and does not change about XLA's collective dtypes.
     """
 
     bucket_cap_mb: int = 25

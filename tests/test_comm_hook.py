@@ -1,0 +1,91 @@
+"""DDP comm-hook analog: gradient compression at the backward boundary
+(reference DistributedDataParallelKwargs.comm_hook / register_comm_hook)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs
+
+
+def _setup(comm_hook):
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    handlers = []
+    if comm_hook is not None:
+        handlers.append(DistributedDataParallelKwargs(comm_hook=comm_hook))
+    acc = Accelerator(kwargs_handlers=handlers)
+    model = nn.Linear(8, 4)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+    return acc, model, opt
+
+
+def test_comm_hook_compresses_grads():
+    acc, model, opt = _setup("bf16")
+    x = nn.Tensor(jnp.ones((2, 8), jnp.float32))
+    loss = model(x).sum()
+    acc.backward(loss)
+    for p in model.parameters():
+        assert p.grad is not None and p.grad.dtype == jnp.bfloat16
+
+
+def test_no_hook_keeps_dtype():
+    acc, model, opt = _setup(None)
+    x = nn.Tensor(jnp.ones((2, 8), jnp.float32))
+    acc.backward(model(x).sum())
+    for p in model.parameters():
+        assert p.grad is not None and p.grad.dtype == jnp.float32
+
+
+def test_comm_hook_training_still_converges():
+    acc, model, opt = _setup("bf16")
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+
+    def fn(xb, yb):
+        opt.zero_grad()
+        pred = model(xb)
+        loss = ((pred - yb) ** 2).mean()
+        acc.backward(loss)
+        opt.step()
+        return loss
+
+    step = acc.compile_step(fn)
+    losses = [float(step(nn.Tensor(x), nn.Tensor(y))) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_invalid_comm_hook_raises_at_construction():
+    Accelerator._reset_state()
+    with pytest.raises(ValueError, match="comm_hook"):
+        Accelerator(
+            kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="powersgd")]
+        )
+
+
+def test_accumulation_compresses_only_at_sync():
+    """Non-sync micro-steps must keep the running sum in fp32 — re-quantizing
+    per micro-step would round away small grads (review finding)."""
+    from accelerate_tpu.utils.dataclasses import GradientAccumulationPlugin
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="bf16")],
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=2),
+    )
+    model = nn.Linear(8, 4)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+    x = nn.Tensor(jnp.ones((2, 8), jnp.float32))
+    with acc.accumulate(model):  # micro-step 1 of 2: no sync
+        acc.backward(model(x).sum())
+    assert all(p.grad.dtype == jnp.float32 for p in model.parameters())
+    with acc.accumulate(model):  # micro-step 2 of 2: sync boundary
+        acc.backward(model(x).sum())
+    assert all(p.grad.dtype == jnp.bfloat16 for p in model.parameters())
