@@ -15,7 +15,7 @@ test_core:
 	  tests/test_optimizer.py \
 	  tests/test_capture_stability.py tests/test_precision.py \
 	  tests/test_fp16_capture.py tests/test_autocast.py \
-	  tests/test_comm_hook.py \
+	  tests/test_comm_hook.py tests/test_config_knobs.py \
 	  tests/test_tracking.py tests/test_utils_misc.py \
 	  tests/test_deepspeed_compat.py -q
 

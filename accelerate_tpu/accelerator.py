@@ -377,15 +377,23 @@ class Accelerator:
         if device_placement is None:
             device_placement = self.device_placement
         # precision policy: params in compute dtype, master fp32 kept by optim
-        if self.state.mixed_precision in ("bf16", "fp16"):
-            model.to(self.compute_dtype)
-        elif self.state.mixed_precision == "fp8":
-            # swap Linears for fp8-matmul layers, activations/params bf16
+        fsdp = self.state.fsdp_plugin
+        param_dtype = fsdp.resolved_dtype("param_dtype") if fsdp is not None else None
+        if self.state.mixed_precision == "fp8":
+            # swap Linears for fp8-matmul layers FIRST — an fsdp param_dtype
+            # must tune the residual dtype, not silently disable fp8
             # (reference fp8 backends convert + autocast, SURVEY.md §2.4)
             from .utils.fp8 import convert_to_float8_training
 
             convert_to_float8_training(model, self.fp8_recipe_handler)
-            model.to(jnp.bfloat16)
+            model.to(param_dtype or jnp.bfloat16)
+        elif param_dtype is not None:
+            # FSDP MixedPrecisionPolicy.param_dtype (reference
+            # dataclasses.py:1449): explicit per-plugin compute dtype wins
+            # over the global mixed_precision default
+            model.to(param_dtype)
+        elif self.state.mixed_precision in ("bf16", "fp16"):
+            model.to(self.compute_dtype)
         if device_placement:
             shard_module_params(
                 model,
@@ -470,11 +478,16 @@ class Accelerator:
         that follows the compute dtype (bf16 mixed precision already reduces
         in bf16), and a cast placed after the reduce cannot legally be hoisted
         above it.  The optimizer upcasts to fp32 masters at apply time."""
-        if self.ddp_handler is None or self.ddp_handler.comm_hook is None:
+        dtype = None
+        if self.ddp_handler is not None and self.ddp_handler.comm_hook is not None:
+            dtype = jnp.float16 if str(
+                self.ddp_handler.comm_hook
+            ).lower() == "fp16" else jnp.bfloat16
+        elif self.state.fsdp_plugin is not None:
+            # FSDP MixedPrecisionPolicy.reduce_dtype rides the same boundary
+            dtype = self.state.fsdp_plugin.resolved_dtype("reduce_dtype")
+        if dtype is None:
             return
-        dtype = jnp.float16 if str(
-            self.ddp_handler.comm_hook
-        ).lower() == "fp16" else jnp.bfloat16
         for model in self._models:
             for p in model.parameters():
                 if p.grad is not None and p.grad.dtype != dtype:
@@ -822,18 +835,37 @@ class Accelerator:
 
     @contextlib.contextmanager
     def profile(self, profile_handler: Optional[ProfileKwargs] = None):
-        """jax.profiler trace (reference accelerator.py:3614 torch.profiler)."""
+        """jax.profiler trace (reference accelerator.py:3614 torch.profiler).
+
+        Handler fields map onto ``jax.profiler.ProfileOptions``:
+        ``host_tracer_level``/``python_tracer_level`` pass through directly;
+        ``with_flops`` turns on HLO-proto capture (FLOPs are derivable from
+        the HLO in TensorBoard's op profile); ``profile_memory`` additionally
+        writes a device-memory profile next to the trace.
+        ``device_tracer_level`` and ``record_shapes`` have no jax.profiler
+        equivalent (device tracing is always on for TPU; shapes live in the
+        HLO) and are accepted for reference API parity.
+        """
         handler = profile_handler or self.profile_handler or ProfileKwargs()
         trace_dir = handler.output_trace_dir
         if trace_dir is None:
             yield None
             return
         os.makedirs(trace_dir, exist_ok=True)
-        jax.profiler.start_trace(trace_dir)
+        options = jax.profiler.ProfileOptions()
+        options.host_tracer_level = handler.host_tracer_level
+        options.python_tracer_level = handler.python_tracer_level
+        if handler.with_flops:
+            options.enable_hlo_proto = True
+        jax.profiler.start_trace(trace_dir, profiler_options=options)
         try:
             yield None
         finally:
             jax.profiler.stop_trace()
+            if handler.profile_memory:
+                jax.profiler.save_device_memory_profile(
+                    os.path.join(trace_dir, "memory.prof")
+                )
             if handler.on_trace_ready is not None:
                 handler.on_trace_ready(trace_dir)
 

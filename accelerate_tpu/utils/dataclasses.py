@@ -246,9 +246,30 @@ class FullyShardedDataParallelPlugin:
     cpu_offload: bool = False
     state_dict_type: str = "SHARDED_STATE_DICT"  # or FULL_STATE_DICT
     use_orig_params: bool = True  # parity; always true functionally
+    # MixedPrecisionPolicy analog (reference dataclasses.py:1449):
+    # param_dtype = per-plugin compute dtype for sharded params ("bf16"/
+    # "fp16"/"fp32"); reduce_dtype = synced-gradient dtype, applied through
+    # the same boundary as DistributedDataParallelKwargs.comm_hook
     param_dtype: Optional[str] = None
     reduce_dtype: Optional[str] = None
     activation_checkpointing: bool = False
+
+    _DTYPES = {"bf16": "bfloat16", "fp16": "float16", "fp32": "float32",
+               "bfloat16": "bfloat16", "float16": "float16", "float32": "float32"}
+
+    def resolved_dtype(self, field_name: str):
+        """jnp dtype for param_dtype/reduce_dtype, or None when unset."""
+        value = getattr(self, field_name)
+        if value is None:
+            return None
+        import jax.numpy as jnp
+
+        key = self._DTYPES.get(str(value).lower())
+        if key is None:
+            raise ValueError(
+                f"{field_name}={value!r}: use one of bf16/fp16/fp32"
+            )
+        return jnp.dtype(key)
 
     def __post_init__(self):
         env = os.environ
@@ -271,6 +292,9 @@ class FullyShardedDataParallelPlugin:
             self.activation_checkpointing = bool(
                 str_to_bool(env["FSDP_ACTIVATION_CHECKPOINTING"])
             )
+        # fail on dtype typos at construction, not at the first sync backward
+        self.resolved_dtype("param_dtype")
+        self.resolved_dtype("reduce_dtype")
 
 
 @dataclass

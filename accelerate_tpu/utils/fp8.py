@@ -171,7 +171,19 @@ class FP8Linear(Module):
         w_amax = None
         if self._delayed:
             hist = self.amax_history.data
-            w_amax = jnp.max(hist)  # _quant falls back to live amax while 0
+            # TE DelayedScaling.amax_compute_algo: "max" over the history
+            # window, or "most_recent" (the newest entry — hist[-1] after the
+            # rolling append below ran last step); _quant falls back to the
+            # live amax while the history is unseeded (0)
+            algo = getattr(self.recipe, "amax_compute_algo", "max")
+            if algo == "max":
+                w_amax = jnp.max(hist)
+            elif algo == "most_recent":
+                w_amax = hist[-1]
+            else:
+                raise ValueError(
+                    f"amax_compute_algo={algo!r}: use 'max' or 'most_recent'"
+                )
             w = self.weight.data if isinstance(self.weight, Tensor) else self.weight
             self.amax_history.data = jnp.concatenate(
                 [hist[1:], jnp.max(jnp.abs(w)).reshape(1)]
