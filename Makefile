@@ -28,7 +28,7 @@ test_models:
 test_parallel:
 	python -m pytest tests/test_sharding_plan.py tests/test_zero_sharding.py \
 	  tests/test_pipeline.py tests/test_1f1b.py tests/test_ring_attention.py \
-	  tests/test_flash_attention.py -q
+	  tests/test_flash_attention.py tests/test_sliding_window.py -q
 
 test_cli:
 	python -m pytest tests/test_cli.py tests/test_menu.py tests/test_launcher.py -q

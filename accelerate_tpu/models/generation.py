@@ -80,7 +80,10 @@ def cached_attention(q, k, v, q_pos, cfg):
     ``T`` is visible to query ``s`` iff ``T <= q_pos[s]`` — causal prefill
     (``q_pos = arange(P)``) and single-token decode (``q_pos = [t]``) are
     the same formula, so there is exactly one attention implementation.
-    Softmax accumulates in fp32.
+    A family config with ``sliding_window > 0`` (Mistral-style) narrows
+    visibility to the band ``q_pos - window < T`` — keeping decode logits
+    identical to the training forward for windowed configs.  Softmax
+    accumulates in fp32.
     """
     b, n_head, s, d = q.shape
     n_kv = k.shape[1]
@@ -91,6 +94,9 @@ def cached_attention(q, k, v, q_pos, cfg):
     ) * (d**-0.5)
     t_pos = jnp.arange(k.shape[2])
     mask = t_pos[None, :] <= q_pos[:, None]  # (s, T)
+    window = getattr(cfg, "sliding_window", 0) or 0
+    if window > 0:
+        mask = jnp.logical_and(mask, q_pos[:, None] - t_pos[None, :] < window)
     scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     att = jnp.einsum("bkgsT,bkTd->bkgsd", probs, v)

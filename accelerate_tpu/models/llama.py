@@ -43,6 +43,11 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
+    # Mistral-style sliding-window attention: 0 = full causal; >0 = each
+    # position attends to the previous `sliding_window` positions only (the
+    # flash FORWARD visits only in-band k-tiles — cost scales with window;
+    # backward gates MXU work per tile, see ops/flash_attention.py)
+    sliding_window: int = 0
 
     @classmethod
     def tiny(cls) -> "LlamaConfig":
@@ -56,6 +61,17 @@ class LlamaConfig:
     @classmethod
     def llama2_7b(cls) -> "LlamaConfig":
         return cls()  # the defaults are Llama-2-7B
+
+    @classmethod
+    def mistral_7b(cls) -> "LlamaConfig":
+        """Mistral-7B-v0.1: Llama architecture + GQA 4:1 + 4096-token
+        sliding window (arXiv:2310.06825)."""
+        return cls(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, max_position_embeddings=32768,
+            rope_theta=10000.0, sliding_window=4096,
+        )
 
     @classmethod
     def llama2_7b_proxy(cls) -> "LlamaConfig":
@@ -121,8 +137,9 @@ def llama_attn_out(l, x, att, *, eps: float):
 _LAYER_KEYS = ("ln1_w", "q_w", "k_w", "v_w", "o_w", "ln2_w", "gate_w", "up_w", "down_w")
 
 
-def _llama_block(l, x, positions, *, n_head, n_kv_head, eps, theta):
-    """Full-causal training block: the pure pair around flash attention."""
+def _llama_block(l, x, positions, *, n_head, n_kv_head, eps, theta, window=0):
+    """Causal (optionally sliding-window) training block: the pure pair
+    around flash attention."""
     from ..ops.attention import sdpa_tpu
 
     q, k, v = llama_attn_in(
@@ -132,7 +149,7 @@ def _llama_block(l, x, positions, *, n_head, n_kv_head, eps, theta):
     if group > 1:  # flash kernel wants matched head counts
         k = jnp.repeat(k, group, axis=1)
         v = jnp.repeat(v, group, axis=1)
-    att = sdpa_tpu(q, k, v, is_causal=True)
+    att = sdpa_tpu(q, k, v, is_causal=True, window=window)
     return llama_attn_out(l, x, att, eps=eps)
 
 
@@ -192,6 +209,7 @@ class LlamaDecoderLayer(nn.Module):
                 n_head=cfg.num_attention_heads,
                 n_kv_head=cfg.num_key_value_heads,
                 eps=cfg.rms_norm_eps, theta=cfg.rope_theta,
+                window=cfg.sliding_window,
             )
 
         return nn.tape_op(maybe_remat(fn), x, *self.param_tensors())

@@ -26,7 +26,10 @@ def sdpa_reference(
     mask: Optional[jax.Array] = None,
     is_causal: bool = False,
     scale: Optional[float] = None,
+    window: int = 0,
 ) -> jax.Array:
+    if window > 0 and not is_causal:
+        raise ValueError("sliding window requires is_causal=True")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     # accumulate logits/softmax in fp32 regardless of input dtype
@@ -36,6 +39,11 @@ def sdpa_reference(
     if is_causal:
         q_len, k_len = logits.shape[-2], logits.shape[-1]
         causal = jnp.tril(jnp.ones((q_len, k_len), dtype=bool), k_len - q_len)
+        if window > 0:
+            # sliding band: query i sees keys (i-window, i]
+            causal &= ~jnp.tril(
+                jnp.ones((q_len, k_len), dtype=bool), k_len - q_len - window
+            )
         logits = jnp.where(causal, logits, _NEG_INF)
     if mask is not None:
         if mask.dtype == jnp.bool_:
@@ -66,6 +74,7 @@ def sdpa_tpu(
     mask: Optional[jax.Array] = None,
     is_causal: bool = False,
     scale: Optional[float] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Dispatch: Pallas flash kernel on TPU for MXU-tileable shapes.
 
@@ -80,7 +89,9 @@ def sdpa_tpu(
     seq_q, seq_k, head_dim = q.shape[-2], k.shape[-2], q.shape[-1]
     force = os.environ.get("ACCELERATE_TPU_FLASH", "").strip()
     if force == "0":
-        return sdpa_reference(q, k, v, mask=mask, is_causal=is_causal, scale=scale)
+        return sdpa_reference(
+            q, k, v, mask=mask, is_causal=is_causal, scale=scale, window=window
+        )
     tileable = (
         mask is None
         and seq_q % 128 == 0
@@ -99,8 +110,12 @@ def sdpa_tpu(
         except ImportError:
             _warn_no_flash_once()
         else:
-            return flash_attention(q, k, v, is_causal=is_causal, scale=scale)
-    return sdpa_reference(q, k, v, mask=mask, is_causal=is_causal, scale=scale)
+            return flash_attention(
+                q, k, v, is_causal=is_causal, scale=scale, window=window
+            )
+    return sdpa_reference(
+        q, k, v, mask=mask, is_causal=is_causal, scale=scale, window=window
+    )
 
 
 _warned_no_flash = False

@@ -102,12 +102,17 @@ def _fit_block(block: int, seq: int) -> int:
     return block
 
 
-def _causal_mask(s, qi, ki, block_q, block_k, q_off=0, k_off=0):
+def _causal_mask(s, qi, ki, block_q, block_k, q_off=0, k_off=0, window=0):
     """Causal mask on GLOBAL positions: local tile indices plus the chunk
-    offsets a ring-attention hop supplies (0 for plain self-attention)."""
+    offsets a ring-attention hop supplies (0 for plain self-attention).
+    ``window`` > 0 adds a sliding-window band (Mistral-style): position i
+    attends to [i-window+1, i]."""
     q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = k_off + ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    keep = q_pos >= k_pos
+    if window > 0:
+        keep = jnp.logical_and(keep, q_pos - k_pos < window)
+    return jnp.where(keep, s, _NEG_INF)
 
 
 # ---------------------------------------------------------------------------
@@ -128,10 +133,23 @@ def _flash_kernel(
     is_causal: bool,
     block_q: int,
     block_k: int,
+    window: int = 0,
+    window_tiles: int = 0,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     num_k = pl.num_programs(2)
+
+    # narrowed k-grid (window_tiles > 0): ki is window-RELATIVE; the global
+    # k-tile is qi - (window_tiles-1) + ki, clamped to 0 by the index map —
+    # clamped duplicates are invalidated so tile 0 is counted once
+    if window_tiles > 0:
+        raw = qi - (window_tiles - 1) + ki
+        kg = jnp.maximum(raw, 0)
+        valid = raw >= 0
+    else:
+        kg = ki
+        valid = True
 
     @pl.when(ki == 0)
     def _init():
@@ -140,11 +158,18 @@ def _flash_kernel(
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
     # causal: skip blocks strictly above the (offset-aware) diagonal — a
-    # dynamic scalar predicate, so ring hops skip real MXU work, not a select
-    should_compute = True
+    # dynamic scalar predicate, so ring hops skip real MXU work, not a select;
+    # a sliding window additionally skips blocks wholly BELOW the band
+    should_compute = valid
     if is_causal:
         q_off, k_off = off_ref[0], off_ref[1]
-        should_compute = q_off + qi * block_q + block_q - 1 >= k_off + ki * block_k
+        causal_ok = q_off + qi * block_q + block_q - 1 >= k_off + kg * block_k
+        should_compute = jnp.logical_and(should_compute, causal_ok)
+        if window > 0:
+            in_band = (
+                q_off + qi * block_q - (k_off + kg * block_k + block_k - 1) < window
+            )
+            should_compute = jnp.logical_and(should_compute, in_band)
 
     @pl.when(should_compute)
     def _compute():
@@ -159,7 +184,9 @@ def _flash_kernel(
         )
         s = s * scale
         if is_causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k, off_ref[0], off_ref[1])
+            s = _causal_mask(
+                s, qi, kg, block_q, block_k, off_ref[0], off_ref[1], window
+            )
 
         m_prev = m_scratch[:, 0:1]
         l_prev = l_scratch[:, 0:1]
@@ -212,6 +239,7 @@ def _flash_forward(
     return_lse: bool = False,
     q_offset=0,
     k_offset=0,
+    window: int = 0,
 ):
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -227,7 +255,30 @@ def _flash_forward(
             f"q_seq={sq} (block {block_q}), k_seq={sk} (block {block_k}); "
             "rows beyond the last full block would be silently dropped"
         )
-    grid = (bh, sq // block_q, sk // block_k)
+    # Narrowed k-grid for sliding windows: only the <= window_tiles k-tiles
+    # that can intersect each q-tile's band are visited (and DMA'd) at all,
+    # so long-seq cost scales with the window.  Needs equal tiles and static
+    # zero offsets (ring hops pass traced offsets the index map cannot see).
+    window_tiles = 0
+    if (
+        window > 0
+        and is_causal
+        and block_q == block_k
+        and isinstance(q_offset, int) and q_offset == 0
+        and isinstance(k_offset, int) and k_offset == 0
+    ):
+        window_tiles = min(sk // block_k, (window - 1) // block_k + 2)
+    if window_tiles > 0:
+        grid = (bh, sq // block_q, window_tiles)
+
+        def _k_index(bh_, qi, ki):
+            return (bh_, jnp.maximum(qi - (window_tiles - 1) + ki, 0), 0)
+
+    else:
+        grid = (bh, sq // block_q, sk // block_k)
+
+        def _k_index(bh_, qi, ki):
+            return (bh_, ki, 0)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -235,6 +286,8 @@ def _flash_forward(
         is_causal=is_causal,
         block_q=block_q,
         block_k=block_k,
+        window=window,
+        window_tiles=window_tiles,
     )
     out_shapes = [jax.ShapeDtypeStruct((bh, sq, d), q.dtype)]
     out_specs = [
@@ -262,12 +315,8 @@ def _flash_forward(
             pl.BlockSpec(
                 (1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0), memory_space=pltpu.VMEM
             ),
-            pl.BlockSpec(
-                (1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0), memory_space=pltpu.VMEM
-            ),
+            pl.BlockSpec((1, block_k, d), _k_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), _k_index, memory_space=pltpu.VMEM),
         ],
         out_specs=out_specs if return_lse else out_specs[0],
         out_shape=out_shapes if return_lse else out_shapes[0],
@@ -311,6 +360,7 @@ def _flash_bwd_kernel(
     is_causal: bool,
     block_q: int,
     block_k: int,
+    window: int = 0,
 ):
     """Grid (bh, k-block, q-block).  Per tile the probability block ``p`` is
     recomputed ONCE and contracted into all three gradients — the split
@@ -343,6 +393,11 @@ def _flash_bwd_kernel(
     if is_causal:
         q_off, k_off = off_ref[0], off_ref[1]
         should_compute = q_off + qi * block_q + block_q - 1 >= k_off + ki * block_k
+        if window > 0:
+            in_band = (
+                q_off + qi * block_q - (k_off + ki * block_k + block_k - 1) < window
+            )
+            should_compute = jnp.logical_and(should_compute, in_band)
 
     @pl.when(should_compute)
     def _compute():
@@ -360,7 +415,9 @@ def _flash_bwd_kernel(
         )
         s = s * scale
         if is_causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k, off_ref[0], off_ref[1])
+            s = _causal_mask(
+                s, qi, ki, block_q, block_k, off_ref[0], off_ref[1], window
+            )
         p = jnp.exp(s - lse)  # forward softmax tile; masked entries exp(-inf)=0
         # dv += pᵀ · dO
         dv_scratch[:] += jax.lax.dot_general(
@@ -417,6 +474,7 @@ def _flash_backward(
     q_offset=0,
     k_offset=0,
     delta_adjust=None,
+    window: int = 0,
 ):
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -460,6 +518,7 @@ def _flash_backward(
         is_causal=is_causal,
         block_q=block_q,
         block_k=block_k,
+        window=window,
     )
     offs = _offsets_arr(q_offset, k_offset)
     dq3, dk3, dv3 = pl.pallas_call(
@@ -503,38 +562,56 @@ def _flash_backward(
 # ---------------------------------------------------------------------------
 # custom_vjp wiring
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     is_causal: bool = False,
     scale: Optional[float] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Flash attention, (batch, heads, seq, head_dim) layout.
 
     Requires seq divisible by 128 and head_dim in the MXU-friendly set; the
     dispatcher in ops/attention.py enforces this and falls back otherwise.
+    ``window`` > 0 = causal sliding-window attention (Mistral-style band,
+    position i attends to [i-window+1, i]).  Forward visits only the k-tiles
+    that can intersect each q-tile's band (narrowed grid when
+    block_q == block_k, the default) — both MXU work and k/v HBM streaming
+    scale with the window.  Backward keeps the full grid and gates the MXU
+    work per tile: out-of-band tiles skip compute but are still DMA'd, so
+    its memory traffic remains O(seq²/block) — acceptable while the bwd
+    dq-scratch design wants the full k sweep; revisit if long-window
+    backward becomes the bottleneck.  Requires ``is_causal=True``.
     """
+    if window > 0 and not is_causal:
+        raise ValueError("sliding window requires is_causal=True")
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash_forward(q, k, v, scale, is_causal)
+    return _flash_forward(q, k, v, scale, is_causal, window=window)
 
 
-def _fwd(q, k, v, is_causal, scale):
+def _fwd(q, k, v, is_causal, scale, window):
+    if window > 0 and not is_causal:
+        raise ValueError("sliding window requires is_causal=True")
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    out, lse = _flash_forward(q, k, v, scale, is_causal, return_lse=True)
+    out, lse = _flash_forward(
+        q, k, v, scale, is_causal, return_lse=True, window=window
+    )
     # squeeze the kernel's single-lane (bh, sq, 1) output to the compact
     # (bh, sq) residual held across the whole forward
     return out, (q, k, v, out, lse[..., 0])
 
 
-def _bwd(is_causal, scale, residuals, g):
+def _bwd(is_causal, scale, window, residuals, g):
     q, k, v, out, lse = residuals
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash_backward(q, k, v, out, lse, g, scale, is_causal)
+    return _flash_backward(
+        q, k, v, out, lse, g, scale, is_causal, window=window
+    )
 
 
 flash_attention.defvjp(_fwd, _bwd)

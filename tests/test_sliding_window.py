@@ -1,0 +1,168 @@
+"""Sliding-window (Mistral-style) attention: flash-kernel parity with the
+reference band mask, gradients, tile skipping, and the Llama family knob.
+Kernels run in interpret mode on CPU (same block schedule as TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.ops.flash_attention as fa
+from accelerate_tpu.ops.attention import sdpa_reference
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+
+
+def _rand_qkv(b=1, h=2, s=256, d=64, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(kq, (b, h, s, d), jnp.float32),
+        jax.random.normal(kk, (b, h, s, d), jnp.float32),
+        jax.random.normal(kv, (b, h, s, d), jnp.float32),
+    )
+
+
+def test_reference_band_mask_semantics():
+    """Row i of the reference band softmax spans exactly (i-w, i]."""
+    s, w = 8, 3
+    q = jnp.zeros((1, 1, s, 4))
+    k = jnp.zeros((1, 1, s, 4))
+    v = jnp.eye(s)[None, None, :, :4]  # value j one-hot → probs readable
+    out = sdpa_reference(q, k, v, is_causal=True, window=w)
+    probs_row = np.asarray(out[0, 0])  # uniform over the band
+    for i in range(s):
+        lo = max(0, i - w + 1)
+        width = i - lo + 1
+        expect = np.zeros(4)
+        for j in range(lo, min(i + 1, 4)):
+            expect[j] = 1.0 / width
+        np.testing.assert_allclose(probs_row[i][:4], expect[:4], atol=1e-6)
+
+
+@pytest.mark.parametrize("window", [128, 256, 384])
+def test_forward_matches_reference(window):
+    q, k, v = _rand_qkv(s=512)
+    out = fa.flash_attention(q, k, v, True, None, window)
+    ref = sdpa_reference(q, k, v, is_causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_window_not_multiple_of_block():
+    """Bands that cut through tiles (not block-aligned) still mask exactly."""
+    q, k, v = _rand_qkv(s=256)
+    out = fa.flash_attention(q, k, v, True, None, 200)
+    ref = sdpa_reference(q, k, v, is_causal=True, window=200)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("window", [64, 200, 256])
+def test_narrowed_grid_multi_tile_parity(window):
+    """128-tile grid at seq 512 → the narrowed k-grid path (window_tiles>0)
+    runs with real clamped-duplicate visits; parity must hold exactly."""
+    q, k, v = _rand_qkv(s=512)
+    out = fa._flash_forward(
+        q, k, v, q.shape[-1] ** -0.5, True, block_q=128, block_k=128,
+        window=window,
+    )
+    ref = sdpa_reference(q, k, v, is_causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_narrowed_grid_only_without_offsets():
+    """Ring hops (traced offsets) must keep the full k-grid — offsets are
+    invisible to the static index map."""
+    q, k, v = _rand_qkv(s=256)
+    # static zero offsets → narrowed; same call with traced offsets must
+    # still be correct (falls back to full grid + predicate)
+    out = fa._flash_forward(
+        q, k, v, q.shape[-1] ** -0.5, True, block_q=128, block_k=128,
+        window=128, q_offset=jnp.asarray(0), k_offset=jnp.asarray(0),
+    )
+    ref = sdpa_reference(q, k, v, is_causal=True, window=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_decode_matches_forward_for_windowed_config():
+    """Windowed configs: cached decode logits == training forward logits for
+    the same prefix (the drift the review caught)."""
+    import accelerate_tpu.nn as nn
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    nn.manual_seed(0)
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+        max_position_embeddings=64, sliding_window=16,
+    )
+    model = LlamaForCausalLM(cfg)
+    ids = np.random.default_rng(1).integers(0, 256, (1, 48)).astype(np.int32)
+    fwd_logits = np.asarray(model(nn.Tensor(jnp.asarray(ids)))["logits"].data)
+
+    from accelerate_tpu.models.generation import generate
+
+    # greedy decode's first token == argmax of the training-forward logits
+    # at the last prefix position; with window 16 << 48 any full-causal
+    # prefill would disagree (verified: removing the decode window breaks it)
+    out = np.asarray(generate(model, jnp.asarray(ids), max_new_tokens=1))
+    assert out.shape[1] == 49
+    assert out[0, -1] == int(fwd_logits[0, -1].argmax())
+
+
+def test_backward_matches_reference():
+    q, k, v = _rand_qkv(s=512)
+    w = 256
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, True, None, w)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = sdpa_reference(q, k, v, is_causal=True, window=w)
+        return jnp.sum(o * jnp.cos(o))
+
+    gq, gk, gv = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(rq), atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), atol=2e-4, rtol=2e-4)
+
+
+def test_window_requires_causal():
+    q, k, v = _rand_qkv(s=128)
+    with pytest.raises(ValueError, match="sliding window"):
+        fa.flash_attention(q, k, v, False, None, 64)
+    with pytest.raises(ValueError, match="sliding window"):
+        sdpa_reference(q, k, v, is_causal=False, window=64)
+
+
+def test_llama_sliding_window_config():
+    """sliding_window changes the model output vs full causal, and matches a
+    reference-path run of the same model."""
+    import os
+
+    import accelerate_tpu.nn as nn
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg_kw = dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+        max_position_embeddings=256,
+    )
+    ids = nn.Tensor(jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (1, 256)), jnp.int32
+    ))
+
+    def logits_for(**extra):
+        nn.manual_seed(0)
+        model = LlamaForCausalLM(LlamaConfig(**cfg_kw, **extra))
+        return np.asarray(model(ids)["logits"].data)
+
+    full = logits_for()
+    windowed = logits_for(sliding_window=128)
+    assert not np.allclose(full, windowed)  # the band actually applies
+    # early positions (inside the window) agree; late positions differ
+    np.testing.assert_allclose(full[:, :64], windowed[:, :64], atol=1e-4)
+    assert not np.allclose(full[:, -1], windowed[:, -1])
