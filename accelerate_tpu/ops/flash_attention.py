@@ -264,6 +264,8 @@ def _flash_forward(
         window > 0
         and is_causal
         and block_q == block_k
+        and sq == sk  # kg = f(qi) indexes k-tiles; cross-length grids would
+        # clamp out-of-range tiles to 0 and mislabel their positions
         and isinstance(q_offset, int) and q_offset == 0
         and isinstance(k_offset, int) and k_offset == 0
     ):

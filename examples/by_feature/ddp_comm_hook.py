@@ -1,0 +1,86 @@
+"""Feature: DDP comm-hook gradient compression.
+
+Counterpart of /root/reference/examples/by_feature/ddp_comm_hook.py: the
+reference registers an fp16/bf16 compression hook on the DDP gradient
+all-reduce; here the SPMD analog is
+``DistributedDataParallelKwargs(comm_hook=...)`` — synced gradients are cast
+to the compression dtype at the backward boundary (half-width grad buffers
+and downstream consumers; see Accelerator._apply_comm_hook for exactly what
+this does and does not change about XLA's collective dtypes).  Lines marked
+`# New Code #` are what this feature adds to nlp_example.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from nlp_example import get_dataloaders  # noqa: E402
+
+import accelerate_tpu.nn as nn  # noqa: E402
+import accelerate_tpu.optim as optim  # noqa: E402
+from accelerate_tpu import Accelerator  # noqa: E402
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification  # noqa: E402
+
+# New Code #
+from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs  # noqa: E402
+
+
+def training_function(args):
+    # New Code #
+    # comm_hook="bf16"|"fp16" compresses synced grads; "no" disables
+    handlers = []
+    if args.comm_hook != "no":
+        handlers.append(DistributedDataParallelKwargs(comm_hook=args.comm_hook))
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision, kwargs_handlers=handlers
+    )
+    nn.manual_seed(args.seed)
+    train_dl, val_dl, vocab = get_dataloaders(accelerator, args.batch_size, args.seed)
+
+    cfg = BertConfig.small() if args.small else BertConfig.base()
+    cfg.vocab_size = max(cfg.vocab_size, vocab)
+    model = BertForSequenceClassification(cfg)
+    optimizer = optim.AdamW(model.parameters(), lr=args.lr)
+    scheduler = optim.get_linear_schedule_with_warmup(
+        optimizer, 100, len(train_dl) * args.num_epochs * accelerator.num_devices
+    )
+    model, optimizer, train_dl, val_dl, scheduler = accelerator.prepare(
+        model, optimizer, train_dl, val_dl, scheduler
+    )
+
+    for epoch in range(args.num_epochs):
+        model.train()
+        for step, batch in enumerate(train_dl):
+            out = model(
+                batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                labels=batch["labels"],
+            )
+            accelerator.backward(out["loss"])
+            optimizer.step()
+            scheduler.step()
+            optimizer.zero_grad()
+        accelerator.print(f"epoch {epoch}: loss={float(out['loss'].item()):.4f}")
+    return model
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=2e-5)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--small", action="store_true")
+    # New Code #
+    parser.add_argument("--comm_hook", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,36 @@ def test_narrowed_grid_multi_tile_parity(window):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_cross_length_windowed_matches_reference():
+    """sq != sk must NOT take the narrowed grid (clamped tiles would be
+    mislabeled — review catch, reproduced): full-grid fallback stays exact.
+
+    The kernel's cross-length causal convention is START-aligned global
+    positions (q_pos = i, k_pos = j — the ring-hop contract), so compare
+    against a start-aligned band reference, with window large enough that
+    every q row keeps at least one visible key.
+    """
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(kq, (1, 2, 512, 64), jnp.float32)
+    k = jax.random.normal(kk, (1, 2, 256, 64), jnp.float32)
+    v = jax.random.normal(kv, (1, 2, 256, 64), jnp.float32)
+    window = 384  # > 511 - 255: no fully-masked q rows
+    out = fa._flash_forward(
+        q, k, v, 64 ** -0.5, True, block_q=128, block_k=128, window=window
+    )
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * (
+        64 ** -0.5
+    )
+    i = jnp.arange(512)[:, None]
+    j = jnp.arange(256)[None, :]
+    keep = (i >= j) & (i - j < window)
+    logits = jnp.where(keep[None, None], logits, -0.7 * float(jnp.finfo(jnp.float32).max))
+    ref = jnp.einsum(
+        "bhqk,bhkd->bhqd", jax.nn.softmax(logits, axis=-1).astype(v.dtype), v
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_narrowed_grid_only_without_offsets():
     """Ring hops (traced offsets) must keep the full k-grid — offsets are
     invisible to the static index map."""
