@@ -294,6 +294,14 @@ def llama_config_from_hf(cfg: dict):
             "silently drop the bias tensors)"
         )
     heads = cfg.get("num_attention_heads", 32)
+    explicit_hd = cfg.get("head_dim")
+    if explicit_hd and explicit_hd != cfg.get("hidden_size", 4096) // heads:
+        raise NotImplementedError(
+            f"head_dim={explicit_hd} != hidden_size/num_attention_heads "
+            f"({cfg.get('hidden_size', 4096)}//{heads}) — decoupled head_dim "
+            "variants (e.g. Mistral-Nemo) are not supported; models/llama.py "
+            "derives head_dim from hidden_size"
+        )
     return LlamaConfig(
         vocab_size=cfg.get("vocab_size", 32000),
         hidden_size=cfg.get("hidden_size", 4096),
@@ -305,7 +313,16 @@ def llama_config_from_hf(cfg: dict):
         rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
         rope_theta=cfg.get("rope_theta", 10000.0),
         tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+        # Mistral configs carry sliding_window (null for Llama); 0 = full
+        sliding_window=cfg.get("sliding_window") or 0,
     )
+
+
+def mistral_config_from_hf(cfg: dict):
+    """Mistral = Llama architecture + GQA + sliding window; transformers'
+    MistralConfig names its fields identically to LlamaConfig, so the Llama
+    mapping applies verbatim (sliding_window included)."""
+    return llama_config_from_hf(cfg)
 
 
 def gptj_config_from_hf(cfg: dict):
@@ -433,6 +450,8 @@ def from_pretrained(path: str, architecture: Optional[str] = None, num_labels: i
             architecture = "gpt2"
         elif model_type == "llama" or "Llama" in archs:
             architecture = "llama"
+        elif model_type == "mistral" or "Mistral" in archs:
+            architecture = "mistral"
         elif model_type == "gptj" or "GPTJ" in archs:
             architecture = "gptj"
         elif model_type == "gpt_neox" or "GPTNeoX" in archs:
@@ -444,7 +463,8 @@ def from_pretrained(path: str, architecture: Optional[str] = None, num_labels: i
         else:
             raise ValueError(
                 f"cannot infer architecture from {path}; pass "
-                "architecture='bert'|'gpt2'|'llama'|'gptj'|'gptneox'|'opt'|'t5'"
+                "architecture='bert'|'gpt2'|'llama'|'mistral'|'gptj'|"
+                "'gptneox'|'opt'|'t5'"
             )
     state = load_hf_state_dict(path)
     if architecture == "bert":
@@ -469,10 +489,12 @@ def from_pretrained(path: str, architecture: Optional[str] = None, num_labels: i
         if missing:
             raise ValueError(f"GPT-2 load left weights uninitialised: {missing[:8]}")
         return model
-    if architecture == "llama":
+    if architecture in ("llama", "mistral"):
         from ..models.llama import LlamaForCausalLM
 
-        model = LlamaForCausalLM(llama_config_from_hf(cfg))
+        model = LlamaForCausalLM(mistral_config_from_hf(cfg)
+                                 if architecture == "mistral"
+                                 else llama_config_from_hf(cfg))
         missing, _ = load_mapped_state_dict(model, state, map_llama_key)
         if model.config.tie_word_embeddings:
             missing = [m for m in missing if "lm_head" not in m]

@@ -5,7 +5,7 @@ accelerator.py:1421).  A JAX rebuild cannot run arbitrary torch forwards, but
 the two cases that cover the reference's own test/bench surface convert
 exactly:
 
-1. **Known transformers architectures** (Bert* / GPT2* / Llama* / OPT*):
+1. **Known transformers architectures** (Bert* / GPT2* / Llama*/Mistral* / OPT* / GPT-J / GPT-NeoX / T5):
    rebuilt as the native ``models/`` classes with the torch state dict
    name-mapped in (``utils/hf.py``) — the native forward reproduces the HF
    forward (parity-tested in tests/test_torch_bridge.py, tests/test_llama.py,
@@ -140,7 +140,11 @@ def _convert_transformers(tm):
         model = GPTLMHeadModel(gcfg)
         load_mapped_state_dict(model, state, map_gpt2_key, pad_vocab_to=gcfg.vocab_size)
         return model
-    if cls_name in ("LlamaForCausalLM", "LlamaModel"):
+    if cls_name in ("LlamaForCausalLM", "LlamaModel",
+                    "MistralForCausalLM", "MistralModel"):
+        # Mistral is the Llama architecture with GQA + sliding window; the
+        # HF state-dict layout and key names are identical, and
+        # llama_config_from_hf picks up cfg["sliding_window"]
         from ..models.llama import LlamaForCausalLM
 
         model = LlamaForCausalLM(llama_config_from_hf(cfg))
@@ -151,8 +155,9 @@ def _convert_transformers(tm):
             # a bare LlamaModel has no (untied) lm_head: converting it would
             # silently leave a randomly-initialised head producing garbage
             raise ValueError(
-                f"Llama conversion left weights uninitialised: {missing[:4]} — "
-                "pass a LlamaForCausalLM (the bare LlamaModel carries no LM head)"
+                f"{cls_name} conversion left weights uninitialised: "
+                f"{missing[:4]} — pass the ForCausalLM class (the bare "
+                "backbone model carries no LM head)"
             )
         return model
     if cls_name in ("OPTForCausalLM", "OPTModel"):
