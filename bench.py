@@ -46,8 +46,15 @@ INIT_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", 5))
 INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT", 120))
 # whole-run deadline: a wedged remote compile service can hang AFTER the
 # init probe succeeded (observed: device probe healthy, first big compile
-# never returns) — emit the fail-soft artifact instead of dying rc!=0
-TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT", 1800))
+# never returns) — emit the fail-soft artifact instead of dying rc!=0.
+# BENCH_FULL runs carry ~6 extra workloads with multi-minute cold compiles
+# (the window A/B alone compiles an 8-layer Llama at seq 8192 twice), so
+# their default budget is larger; the plain driver run keeps 1800.
+TOTAL_TIMEOUT_S = float(
+    os.environ.get(
+        "BENCH_TOTAL_TIMEOUT", 4800 if os.environ.get("BENCH_FULL") == "1" else 1800
+    )
+)
 
 
 _PRIMARY_RESULT: dict = {}
@@ -441,6 +448,83 @@ def _long_context_workload(on_accel: bool) -> dict:
     }
 
 
+def _sliding_window_workload(on_accel: bool) -> dict:
+    """Sliding-window long-context row: Llama geometry, same model full-causal
+    vs windowed — the narrowed flash k-grid visits only in-band tiles, so the
+    windowed step should beat full causal at long seq (ops/flash_attention.py)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_accel:
+        base = dict(
+            vocab_size=32000, hidden_size=512, intermediate_size=1408,
+            num_hidden_layers=8, num_attention_heads=8, num_key_value_heads=8,
+            max_position_embeddings=8192,
+        )
+        batch, seq, steps, window = 1, 8192, 8, 1024
+    else:
+        base = dict(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+            max_position_embeddings=256,
+        )
+        batch, seq, steps, window = 1, 256, 2, 64
+
+    def measure(sliding_window: int) -> float:
+        Accelerator._reset_state()
+        nn.manual_seed(0)
+        acc = Accelerator(mixed_precision="bf16")
+        model = LlamaForCausalLM(LlamaConfig(**base, sliding_window=sliding_window))
+        opt = optim.AdamW(model.parameters(), lr=1e-4)
+        model, opt = acc.prepare(model, opt)
+
+        def step_fn(ids):
+            opt.zero_grad()
+            out = model(ids, labels=ids)
+            acc.backward(out["loss"])
+            opt.step()
+            return out["loss"]
+
+        step = acc.compile_step(step_fn)
+        n_dev = len(jax.devices())
+        ids = batch_to_global_array(
+            jnp.asarray(
+                np.random.default_rng(0).integers(0, base["vocab_size"], (batch * n_dev, seq)),
+                jnp.int32,
+            ),
+            mesh=acc.mesh,
+        )
+        t0 = _time.perf_counter()
+        float(step(ids))  # compile
+        compile_s = _time.perf_counter() - t0
+        float(step(ids))  # warm
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            loss = step(ids)
+        float(loss)
+        return batch * seq * steps / (_time.perf_counter() - t0), compile_s
+
+    full, full_compile_s = measure(0)
+    windowed, win_compile_s = measure(window)
+    return {
+        "window_seq": seq,
+        "window_size": window,
+        "window_full_tokens_per_sec": round(full, 1),
+        "window_banded_tokens_per_sec": round(windowed, 1),
+        "window_speedup": round(windowed / full, 3),
+        "window_compile_s": round(full_compile_s + win_compile_s, 1),
+    }
+
+
 def main() -> None:
     _arm_deadline()
     diag = _init_backend()
@@ -548,6 +632,7 @@ def main() -> None:
             ("llama", _llama_fsdp_workload),
             ("opt", _opt_inference_workload),
             ("longctx", _long_context_workload),
+            ("window", _sliding_window_workload),
         ]
         for label, workload in extras:
             t_extra = time.perf_counter()

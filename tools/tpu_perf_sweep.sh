@@ -34,7 +34,7 @@ for BK in 256 512; do
 done
 
 echo "== full workloads ==" | tee -a "$OUT/sweep.log"
-BENCH_FULL=1 BENCH_INIT_ATTEMPTS=2 BENCH_TOTAL_TIMEOUT=3000 timeout 3100 \
+BENCH_FULL=1 BENCH_INIT_ATTEMPTS=2 BENCH_TOTAL_TIMEOUT=4800 timeout 4900 \
   python bench.py 2>"$OUT/err_full.log" | tee -a "$OUT/sweep.log"
 
 echo "== profiler trace (10 steady-state steps) ==" | tee -a "$OUT/sweep.log"
