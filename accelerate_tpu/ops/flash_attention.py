@@ -102,6 +102,13 @@ def _fit_block(block: int, seq: int) -> int:
     return block
 
 
+def _window_tiles(window: int, block: int, num_tiles: int) -> int:
+    """Tiles a band of ``window`` positions can span from a tile's edge —
+    the ONE formula both the forward k-walk and the backward dq/dkv walks
+    use, so their band geometries cannot drift."""
+    return min(num_tiles, (window - 1) // block + 2)
+
+
 def _causal_mask(s, qi, ki, block_q, block_k, q_off=0, k_off=0, window=0):
     """Causal mask on GLOBAL positions: local tile indices plus the chunk
     offsets a ring-attention hop supplies (0 for plain self-attention).
@@ -269,7 +276,7 @@ def _flash_forward(
         and isinstance(q_offset, int) and q_offset == 0
         and isinstance(k_offset, int) and k_offset == 0
     ):
-        window_tiles = min(sk // block_k, (window - 1) // block_k + 2)
+        window_tiles = _window_tiles(window, block_k, sk // block_k)
     if window_tiles > 0:
         grid = (bh, sq // block_q, window_tiles)
 
@@ -462,6 +469,179 @@ def _flash_bwd_kernel(
         dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
 
 
+def _bwd_tile_ds(q, k, v, do, lse, delta, scale, qg, kg, block_q, block_k,
+                 window):
+    """Shared backward tile math: recompute p, return (p, ds) for one
+    (q-tile qg, k-tile kg) pair under the causal+band mask (offsets 0 —
+    the narrowed kernels never run for ring hops)."""
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = _causal_mask(s, qg, kg, block_q, block_k, 0, 0, window)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return p, (p * (dp - delta) * scale).astype(q.dtype)
+
+
+def _flash_bwd_dkv_window_kernel(
+    off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_scratch, dv_scratch, *,
+    scale: float, block_q: int, block_k: int, window: int,
+    window_tiles: int, num_q: int,
+):
+    """Windowed dk/dv: grid (bh, k-tile, q-slot) where the q dimension spans
+    only the ``window_tiles`` q-tiles that can see k-tile ``ki`` (qg = ki+qr,
+    clamped at the top; clamped duplicates invalidated)."""
+    ki = pl.program_id(1)
+    qr = pl.program_id(2)
+    raw = ki + qr
+    qg = jnp.minimum(raw, num_q - 1)
+    valid = raw <= num_q - 1
+
+    @pl.when(qr == 0)
+    def _zero():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    # qg >= ki always (causal tile test trivially true); band lower edge:
+    in_band = qg * block_q - (ki * block_k + block_k - 1) < window
+
+    @pl.when(jnp.logical_and(valid, in_band))
+    def _compute():
+        p, ds = _bwd_tile_ds(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0], delta_ref[0],
+            scale, qg, ki, block_q, block_k, window,
+        )
+        dv_scratch[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_scratch[:] += jax.lax.dot_general(
+            ds, q_ref[0], dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qr == window_tiles - 1)
+    def _finalize():
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_window_kernel(
+    off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_scratch, *,
+    scale: float, block_q: int, block_k: int, window: int,
+    window_tiles: int,
+):
+    """Windowed dq: mirrors the forward narrowed grid (kg = qi-(Wt-1)+kr,
+    clamped at 0; duplicates invalidated); dq accumulates in a block-local
+    fp32 scratch — the inner k dimension is consecutive per q-tile, so no
+    full-length accumulator is needed."""
+    qi = pl.program_id(1)
+    kr = pl.program_id(2)
+    raw = qi - (window_tiles - 1) + kr
+    kg = jnp.maximum(raw, 0)
+    valid = raw >= 0
+
+    @pl.when(kr == 0)
+    def _zero():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    # kg <= qi always (kr <= Wt-1), so the causal tile test is trivially
+    # true — only the band's lower edge can exclude a visited tile
+    in_band = qi * block_q - (kg * block_k + block_k - 1) < window
+
+    @pl.when(jnp.logical_and(valid, in_band))
+    def _compute():
+        _, ds = _bwd_tile_ds(
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0], delta_ref[0],
+            scale, qi, kg, block_q, block_k, window,
+        )
+        dq_scratch[:] += jax.lax.dot_general(
+            ds, k_ref[0], dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kr == window_tiles - 1)
+    def _finalize():
+        dq_ref[0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _flash_backward_window(q3, k3, v3, do3, lse3, delta3, scale, block,
+                           window, dtype_q, dtype_k, dtype_v):
+    """Narrowed-grid backward pair: dq mirrors the forward band walk, dk/dv
+    walk the transpose — both visit (and DMA) only in-band tiles, so
+    backward cost scales with the window too.  Recomputes p twice (once per
+    kernel) over O(S·window) tiles, which beats the fused kernel's single
+    recompute over O(S²/2) tiles whenever window < seq/2."""
+    bh, sq, d = q3.shape
+    num_q = sq // block
+    window_tiles = _window_tiles(window, block, num_q)
+    offs = _offsets_arr(0, 0)
+
+    def q_side(bh_, ki, qr):  # dkv grid: q specs follow the clamped q-slot
+        return (bh_, jnp.minimum(ki + qr, num_q - 1), 0)
+
+    def k_side_dq(bh_, qi, kr):  # dq grid: k specs follow the clamped k-slot
+        return (bh_, jnp.maximum(qi - (window_tiles - 1) + kr, 0), 0)
+
+    kv_fixed = pl.BlockSpec((1, block, d), lambda bh_, ki, qr: (bh_, ki, 0),
+                            memory_space=pltpu.VMEM)
+    q_follow = pl.BlockSpec((1, block, d), q_side, memory_space=pltpu.VMEM)
+    row_follow = pl.BlockSpec((1, block, 1), q_side, memory_space=pltpu.VMEM)
+
+    dk3, dv3 = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_window_kernel, scale=scale, block_q=block,
+            block_k=block, window=window, window_tiles=window_tiles,
+            num_q=num_q,
+        ),
+        grid=(bh, sq // block, window_tiles),
+        in_specs=[_off_spec(), q_follow, kv_fixed, kv_fixed, q_follow,
+                  row_follow, row_follow],
+        out_specs=[kv_fixed, kv_fixed],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), dtype_k),
+            jax.ShapeDtypeStruct((bh, sq, d), dtype_v),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, d), jnp.float32),
+            pltpu.VMEM((block, d), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+        # ki carries no loop state here (scratch re-zeroed at qr==0, one
+        # output write per ki) — parallel is safe and pipelines
+        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
+    )(offs, q3, k3, v3, do3, lse3, delta3)
+
+    q_fixed = pl.BlockSpec((1, block, d), lambda bh_, qi, kr: (bh_, qi, 0),
+                           memory_space=pltpu.VMEM)
+    row_fixed = pl.BlockSpec((1, block, 1), lambda bh_, qi, kr: (bh_, qi, 0),
+                             memory_space=pltpu.VMEM)
+    kv_follow = pl.BlockSpec((1, block, d), k_side_dq, memory_space=pltpu.VMEM)
+
+    dq3 = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_window_kernel, scale=scale, block_q=block,
+            block_k=block, window=window, window_tiles=window_tiles,
+        ),
+        grid=(bh, sq // block, window_tiles),
+        in_specs=[_off_spec(), q_fixed, kv_follow, kv_follow, q_fixed,
+                  row_fixed, row_fixed],
+        out_specs=q_fixed,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), dtype_q),
+        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
+        interpret=_INTERPRET,
+        compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
+    )(offs, q3, k3, v3, do3, lse3, delta3)
+    return dq3, dk3, dv3
+
+
 def _flash_backward(
     q: jax.Array,
     k: jax.Array,
@@ -503,6 +683,25 @@ def _flash_backward(
         # ds += p·g_lse, equivalent to delta' = delta - g_lse
         delta = delta + delta_adjust.astype(jnp.float32)
     delta3 = delta[..., None]
+
+    if (
+        window > 0
+        and is_causal
+        and block_q == block_k
+        and sq == sk
+        and delta_adjust is None
+        and isinstance(q_offset, int) and q_offset == 0
+        and isinstance(k_offset, int) and k_offset == 0
+    ):
+        dq3, dk3, dv3 = _flash_backward_window(
+            q3, k3, v3, do3, lse3, delta3, scale, block_q, window,
+            q.dtype, k.dtype, v.dtype,
+        )
+        return (
+            dq3.reshape(b, h, sq, d),
+            dk3.reshape(b, h, sk, d),
+            dv3.reshape(b, h, sk, d),
+        )
 
     q_spec = pl.BlockSpec(
         (1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0), memory_space=pltpu.VMEM
@@ -578,14 +777,11 @@ def flash_attention(
     Requires seq divisible by 128 and head_dim in the MXU-friendly set; the
     dispatcher in ops/attention.py enforces this and falls back otherwise.
     ``window`` > 0 = causal sliding-window attention (Mistral-style band,
-    position i attends to [i-window+1, i]).  Forward visits only the k-tiles
-    that can intersect each q-tile's band (narrowed grid when
-    block_q == block_k, the default) — both MXU work and k/v HBM streaming
-    scale with the window.  Backward keeps the full grid and gates the MXU
-    work per tile: out-of-band tiles skip compute but are still DMA'd, so
-    its memory traffic remains O(seq²/block) — acceptable while the bwd
-    dq-scratch design wants the full k sweep; revisit if long-window
-    backward becomes the bottleneck.  Requires ``is_causal=True``.
+    position i attends to [i-window+1, i]).  Both directions visit (and DMA)
+    only in-band tiles when block_q == block_k (the default): the forward
+    narrows its k-grid per q-tile, and the backward runs a narrowed dq/dkv
+    kernel pair (_flash_backward_window) — total cost scales with the
+    window, not seq².  Requires ``is_causal=True``.
     """
     if window > 0 and not is_causal:
         raise ValueError("sliding window requires is_causal=True")
