@@ -818,7 +818,7 @@ flash_attention.defvjp(_fwd, _bwd)
 # ---------------------------------------------------------------------------
 # hop-level API for ring attention: per-(q-chunk, kv-chunk) partial attention
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def flash_attention_hop(
     q: jax.Array,
     k: jax.Array,
@@ -827,6 +827,7 @@ def flash_attention_hop(
     k_offset,
     is_causal: bool = True,
     scale: Optional[float] = None,
+    window: int = 0,
 ):
     """One ring-attention hop: q attends to ONE k/v chunk, masked on global
     positions (q_offset/k_offset are traced scalars from ``axis_index``).
@@ -840,19 +841,21 @@ def flash_attention_hop(
         scale = q.shape[-1] ** -0.5
     out, lse = _flash_forward(
         q, k, v, scale, is_causal, return_lse=True,
-        q_offset=q_offset, k_offset=k_offset,
+        q_offset=q_offset, k_offset=k_offset, window=window,
     )
     return out, lse[..., 0].reshape(q.shape[0], q.shape[1], q.shape[2])
 
 
-def _hop_fwd(q, k, v, q_offset, k_offset, is_causal, scale):
+def _hop_fwd(q, k, v, q_offset, k_offset, is_causal, scale, window):
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    out, lse = flash_attention_hop(q, k, v, q_offset, k_offset, is_causal, scale)
+    out, lse = flash_attention_hop(
+        q, k, v, q_offset, k_offset, is_causal, scale, window
+    )
     return (out, lse), (q, k, v, out, lse, q_offset, k_offset)
 
 
-def _hop_bwd(is_causal, scale, residuals, g):
+def _hop_bwd(is_causal, scale, window, residuals, g):
     q, k, v, out, lse, q_offset, k_offset = residuals
     b, h, sq, _ = q.shape
     g_out, g_lse = g
@@ -870,6 +873,7 @@ def _hop_bwd(is_causal, scale, residuals, g):
         q, k, v, out, lse_flat, g_out, scale, is_causal,
         q_offset=q_offset, k_offset=k_offset,
         delta_adjust=(-g_lse.reshape(b * h, sq) if g_lse is not None else None),
+        window=window,
     )
     return dq, dk, dv, None, None
 

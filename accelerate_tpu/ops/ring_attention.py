@@ -36,14 +36,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _block_update(q, k, v, m, l, acc, q_offset, k_offset, scale, is_causal):
+def _block_update(q, k, v, m, l, acc, q_offset, k_offset, scale, is_causal,
+                  window=0):
     """One online-softmax accumulation of q against a k/v chunk."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if is_causal:
         sq, sk = s.shape[-2], s.shape[-1]
         q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        keep = q_pos >= k_pos
+        if window > 0:
+            keep = jnp.logical_and(keep, q_pos - k_pos < window)
+        s = jnp.where(keep, s, _NEG_INF)
     m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m, m_cur)
     p = jnp.exp(s - m_new)
@@ -67,7 +71,8 @@ def _use_flash_hops(chunk: int, d: int) -> bool:
     return _on_tpu(None) and chunk % 128 == 0 and d in _MXU_HEAD_DIMS
 
 
-def _ring_hops(k, v, carry0, do_step, *, axis_name: str, is_causal: bool, chunk: int):
+def _ring_hops(k, v, carry0, do_step, *, axis_name: str, is_causal: bool,
+               chunk: int, window: int = 0):
     """Shared ring skeleton: rotate k/v with ``ppermute``, apply ``do_step``
     per hop, skip fully-masked hops under causal masking.
 
@@ -89,10 +94,15 @@ def _ring_hops(k, v, carry0, do_step, *, axis_name: str, is_causal: bool, chunk:
         k_offset = k_idx * chunk
         update = functools.partial(do_step, k_cur, v_cur, q_offset, k_offset)
         if is_causal:
-            # whole chunk strictly in the future → nothing to accumulate
-            return jax.lax.cond(
-                k_offset > q_offset + chunk - 1, lambda args: args, update, inner
-            )
+            # whole chunk strictly in the future — or, with a sliding
+            # window, entirely beyond the band in the past — contributes
+            # nothing: skip the hop's compute (a real HLO branch)
+            fully_masked = k_offset > q_offset + chunk - 1
+            if window > 0:
+                fully_masked = jnp.logical_or(
+                    fully_masked, q_offset - (k_offset + chunk - 1) >= window
+                )
+            return jax.lax.cond(fully_masked, lambda args: args, update, inner)
         return update(inner)
 
     def body(step, carry):
@@ -106,7 +116,8 @@ def _ring_hops(k, v, carry0, do_step, *, axis_name: str, is_causal: bool, chunk:
     return hop(n - 1, k_last, v_last, inner)
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, is_causal: bool, scale: float):
+def _ring_attention_local(q, k, v, *, axis_name: str, is_causal: bool,
+                          scale: float, window: int = 0):
     """Per-device body under shard_map: q stays, k/v ride the ring.
 
     Two inner-block engines on the shared ``_ring_hops`` skeleton:
@@ -129,7 +140,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, is_causal: bool, scale: fl
         def do_step(k_cur, v_cur, q_offset, k_offset, inner):
             out, lse = inner
             o_hop, lse_hop = flash_attention_hop(
-                q, k_cur, v_cur, q_offset, k_offset, is_causal, scale
+                q, k_cur, v_cur, q_offset, k_offset, is_causal, scale, window
             )
             lse_new = jnp.logaddexp(lse, lse_hop)
             w_old = jnp.exp(lse - lse_new)[..., None]
@@ -141,7 +152,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, is_causal: bool, scale: fl
             jnp.full((b, h, sq), _NEG_INF, dtype=jnp.float32),
         )
         out, _ = _ring_hops(
-            k, v, carry0, do_step, axis_name=axis_name, is_causal=is_causal, chunk=chunk
+            k, v, carry0, do_step, axis_name=axis_name, is_causal=is_causal,
+            chunk=chunk, window=window,
         )
         return out.astype(q.dtype)
 
@@ -151,7 +163,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, is_causal: bool, scale: fl
         m, l, acc = inner
         return _block_update(
             q32, k_cur.astype(jnp.float32), v_cur, m, l, acc,
-            q_offset, k_offset, scale, is_causal,
+            q_offset, k_offset, scale, is_causal, window,
         )
 
     carry0 = (
@@ -160,14 +172,15 @@ def _ring_attention_local(q, k, v, *, axis_name: str, is_causal: bool, scale: fl
         jnp.zeros((b, h, sq, d), dtype=jnp.float32),
     )
     m, l, acc = _ring_hops(
-        k, v, carry0, do_step, axis_name=axis_name, is_causal=is_causal, chunk=chunk
+        k, v, carry0, do_step, axis_name=axis_name, is_causal=is_causal,
+        chunk=chunk, window=window,
     )
     l = jnp.where(l == 0.0, 1.0, l)
     return (acc / l).astype(q.dtype)
 
 
 def _ulysses_attention_local(
-    q, k, v, *, axis_name: str, is_causal: bool, scale: float
+    q, k, v, *, axis_name: str, is_causal: bool, scale: float, window: int = 0
 ):
     """Per-device body of Ulysses-style (all-to-all) sequence parallelism.
 
@@ -187,15 +200,21 @@ def _ulysses_attention_local(
     qkv = jax.lax.all_to_all(qkv, axis_name, split_axis=2, concat_axis=3, tiled=True)
     from .attention import sdpa_tpu
 
-    out = sdpa_tpu(qkv[0], qkv[1], qkv[2], is_causal=is_causal, scale=scale)
+    out = sdpa_tpu(qkv[0], qkv[1], qkv[2], is_causal=is_causal, scale=scale,
+                   window=window)
     # seq -> devices, heads gathered back
     return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
 
 def _shard_mapped_attention(
-    local_fn, q, k, v, mesh, is_causal, scale, axis_name, batch_axes
+    local_fn, q, k, v, mesh, is_causal, scale, axis_name, batch_axes, window=0
 ):
     """Shared wrapper: resolve mesh/scale, sp=1 fast path, shard_map setup."""
+    if window > 0 and not is_causal:
+        # validate HERE so sp>1 meshes fail like sp=1 does (the per-device
+        # bodies only band-mask under is_causal — silently ignoring the
+        # window on one mesh shape and raising on another is worse)
+        raise ValueError("sliding window requires is_causal=True")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if mesh is None:
@@ -210,7 +229,8 @@ def _shard_mapped_attention(
 
     fn = shard_map_compat(
         functools.partial(
-            local_fn, axis_name=axis_name, is_causal=is_causal, scale=scale
+            local_fn, axis_name=axis_name, is_causal=is_causal, scale=scale,
+            window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -228,6 +248,7 @@ def ulysses_attention(
     scale: Optional[float] = None,
     axis_name: str = "sp",
     batch_axes: tuple = ("dp", "fsdp"),
+    window: int = 0,
 ) -> jax.Array:
     """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention.
 
@@ -238,15 +259,16 @@ def ulysses_attention(
     otherwise.  Select per model via ``SequenceParallelPlugin(mode=...)``.
     """
     fn, mesh, scale = _shard_mapped_attention(
-        _ulysses_attention_local, q, k, v, mesh, is_causal, scale, axis_name, batch_axes
+        _ulysses_attention_local, q, k, v, mesh, is_causal, scale, axis_name,
+        batch_axes, window,
     )
     if fn is None:
         from .attention import sdpa_tpu
 
-        return sdpa_tpu(q, k, v, is_causal=is_causal, scale=scale)
+        return sdpa_tpu(q, k, v, is_causal=is_causal, scale=scale, window=window)
     if q.shape[1] % mesh.shape[axis_name] != 0:
         return ring_attention(
-            q, k, v, mesh, is_causal, scale, axis_name, batch_axes
+            q, k, v, mesh, is_causal, scale, axis_name, batch_axes, window
         )
     return fn(q, k, v)
 
@@ -264,12 +286,13 @@ def sequence_parallel_attention(
     axis_name: str = "sp",
     batch_axes: tuple = ("dp", "fsdp"),
     mode: str = "ring",
+    window: int = 0,
 ):
     """Dispatch on ``SequenceParallelPlugin.mode``: "ring" | "all_to_all"."""
     if mode not in _SP_MODES:
         raise ValueError(f"unknown sequence-parallel mode {mode!r}; use one of {_SP_MODES}")
     impl = ulysses_attention if mode == "all_to_all" else ring_attention
-    return impl(q, k, v, mesh, is_causal, scale, axis_name, batch_axes)
+    return impl(q, k, v, mesh, is_causal, scale, axis_name, batch_axes, window)
 
 
 def ring_attention(
@@ -281,19 +304,24 @@ def ring_attention(
     scale: Optional[float] = None,
     axis_name: str = "sp",
     batch_axes: tuple = ("dp", "fsdp"),
+    window: int = 0,
 ) -> jax.Array:
     """Sequence-parallel attention over (batch, heads, seq, head_dim) arrays
     whose seq dimension is sharded on the ``axis_name`` mesh axis.
 
     Differentiable (pure jnp + collectives inside shard_map — JAX transposes
     ppermute automatically), jit-compatible, composes with dp/fsdp batch
-    sharding.
+    sharding.  ``window`` > 0 (causal sliding band): ring hops whose chunk
+    lies entirely beyond the band are skipped as whole branches — with
+    window <= chunk each device runs at most TWO hops regardless of ring
+    size, so windowed long-context cost stops growing with sp.
     """
     fn, mesh, scale = _shard_mapped_attention(
-        _ring_attention_local, q, k, v, mesh, is_causal, scale, axis_name, batch_axes
+        _ring_attention_local, q, k, v, mesh, is_causal, scale, axis_name,
+        batch_axes, window,
     )
     if fn is None:
         from .attention import sdpa_tpu
 
-        return sdpa_tpu(q, k, v, is_causal=is_causal, scale=scale)
+        return sdpa_tpu(q, k, v, is_causal=is_causal, scale=scale, window=window)
     return fn(q, k, v)
