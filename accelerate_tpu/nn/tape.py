@@ -124,6 +124,21 @@ class Tensor:
     def __len__(self):
         return self.data.shape[0]
 
+    def __array__(self, dtype=None, copy=None):
+        """np/jnp.asarray(tensor) → the data, NOT gradient-tracked.
+
+        Without this hook the array constructors walk the Tensor as a
+        nested Python sequence — one ``__getitem__`` tape op per element,
+        minutes for a modest batch (found via a hung BERT forward whose
+        input_ids were wrapped in a Tensor).  Deliberately NOT
+        ``__jax_array__``: that hook additionally changes jax.Array binary-
+        op dispatch so ``raw_jnp <op> tensor`` unwraps instead of deferring
+        to the Tensor's reflected op — silently detaching the tape
+        (verified; reflected-op dispatch is covered by tests).
+        """
+        arr = np.asarray(jax.device_get(self.data))
+        return arr.astype(dtype) if dtype is not None else arr
+
     def __repr__(self):
         grad_str = ", requires_grad=True" if self.requires_grad else ""
         return f"Tensor({self.data!r}{grad_str})"

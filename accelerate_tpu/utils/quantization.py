@@ -18,6 +18,7 @@ never resident (mirrors bnb's meta→quantized load path).
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -47,12 +48,22 @@ class QuantizationConfig:
     compute_dtype: Any = jnp.bfloat16
     skip_modules: Optional[list[str]] = None  # names kept in high precision
     keep_in_fp32_modules: list[str] = field(default_factory=list)
+    # "dequant" (W8A16, default — weights stream at 1 byte/param and widen
+    # inside the matmul fusion) or "int8" (W8A8: activations dynamically
+    # quantized per row, int8xint8->int32 dot — rides the MXU's int8 path,
+    # 2x bf16 peak on v5e, at the cost of activation-quantization error;
+    # int4 weights always use dequant compute)
+    compute: str = "dequant"
 
     def __post_init__(self):
         if self.load_in_8bit and self.load_in_4bit:
             raise ValueError("load_in_8bit and load_in_4bit are mutually exclusive")
         if not (self.load_in_8bit or self.load_in_4bit):
             raise ValueError("pass load_in_8bit=True or load_in_4bit=True")
+        if self.compute not in ("dequant", "int8"):
+            raise ValueError(f"compute={self.compute!r}: use 'dequant' or 'int8'")
+        if self.compute == "int8" and self.load_in_4bit:
+            raise ValueError("compute='int8' requires load_in_8bit (int4 packs nibbles)")
 
     @property
     def bits(self) -> int:
@@ -87,6 +98,45 @@ def dequantize_weight(q, scale, bits: int = 8, dtype=jnp.float32):
     return w.astype(dtype) * scale[:, None].astype(dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _int8_matmul_ste(v, q, scale, cdtype):
+    """y = dyn-quant(v) @ int8-weightsᵀ, rescaled; STE backward (see
+    QuantizedLinear.forward)."""
+    lead = v.shape[:-1]
+    v2 = v.reshape(-1, v.shape[-1])
+    amax = jnp.max(jnp.abs(v2), axis=-1, keepdims=True)
+    a_scale = jnp.maximum(amax.astype(jnp.float32), 1e-8) / 127.0
+    a_q = jnp.clip(
+        jnp.round(v2.astype(jnp.float32) / a_scale), -127, 127
+    ).astype(jnp.int8)
+    y32 = jax.lax.dot_general(
+        a_q, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    y = y32.astype(jnp.float32) * a_scale * scale[None, :]
+    return y.reshape(*lead, -1)
+
+
+def _int8_ste_fwd(v, q, scale, cdtype):
+    # residuals must be JAX types: a zero-size array carries the primal
+    # dtype (a raw np.dtype is rejected by the tracer)
+    dtype_token = jnp.zeros((0,), v.dtype)
+    return _int8_matmul_ste(v, q, scale, cdtype), (v.shape, dtype_token, q, scale)
+
+
+def _int8_ste_bwd(cdtype, residuals, g):
+    v_shape, dtype_token, q, scale = residuals
+    v_dtype = dtype_token.dtype
+    w = dequantize_weight(q, scale, 8, cdtype)  # (out, in)
+    g2 = g.reshape(-1, g.shape[-1]).astype(cdtype)
+    # cotangent must come back in the primal's dtype — a hardcoded fp32
+    # crashes the vjp when upstream tape nodes run in bf16
+    dv = (g2 @ w).reshape(v_shape).astype(v_dtype)
+    return dv, None, None
+
+
+_int8_matmul_ste.defvjp(_int8_ste_fwd, _int8_ste_bwd)
+
+
 class QuantizedLinear(Module):
     """Linear whose weight lives as int8/packed-int4 + per-channel scales.
 
@@ -101,12 +151,16 @@ class QuantizedLinear(Module):
         bias: bool = True,
         bits: int = 8,
         compute_dtype=jnp.bfloat16,
+        compute: str = "dequant",
     ):
         super().__init__()
+        if compute == "int8" and bits != 8:
+            raise ValueError("compute='int8' requires bits=8")
         self.in_features = in_features
         self.out_features = out_features
         self.bits = bits
         self.compute_dtype = compute_dtype
+        self.compute = compute
         packed_in = in_features // 2 if bits == 4 else in_features
         qdtype = jnp.uint8 if bits == 4 else jnp.int8
         self.qweight = Buffer(jnp.zeros((out_features, packed_in), dtype=qdtype))
@@ -118,7 +172,8 @@ class QuantizedLinear(Module):
 
     @classmethod
     def from_weight(
-        cls, weight, bias=None, bits: int = 8, compute_dtype=jnp.bfloat16
+        cls, weight, bias=None, bits: int = 8, compute_dtype=jnp.bfloat16,
+        compute: str = "dequant",
     ) -> "QuantizedLinear":
         w = np.asarray(weight.data if isinstance(weight, Tensor) else weight)
         out_features, in_features = w.shape
@@ -128,6 +183,7 @@ class QuantizedLinear(Module):
             bias=bias is not None,
             bits=bits,
             compute_dtype=compute_dtype,
+            compute=compute,
         )
         q, scale = quantize_weight(w, bits)
         new.qweight.data = jnp.asarray(q)
@@ -141,12 +197,30 @@ class QuantizedLinear(Module):
         bits, cdtype = self.bits, self.compute_dtype
         q, s = self.qweight.data, self.scales.data
 
-        def _fwd(v, *rest):
-            w = dequantize_weight(q, s, bits, cdtype)
-            y = jnp.dot(v.astype(cdtype), w.T, preferred_element_type=jnp.float32)
-            if rest:
-                y = y + rest[0]
-            return y.astype(v.dtype)
+        if self.compute == "int8":
+            # W8A8: per-row dynamic activation quantization, int8 dot with
+            # int32 accumulation (the MXU's native int8 path — 2x bf16
+            # peak), rescale by act_scale x weight_scale.  Leading dims
+            # flatten so 3-D (b, s, c) activations take one dot.  The
+            # backward is a straight-through estimator: round/clip have zero
+            # derivative, so the vjp contracts the cotangent against the
+            # DEQUANTIZED weights (exact for the W8A16 linearization) —
+            # without it tape backward through this layer is silently dead.
+            cdt = cdtype
+
+            def _fwd(v, *rest):
+                y = _int8_matmul_ste(v, q, s, cdt)
+                if rest:
+                    y = y + rest[0]
+                return y.astype(v.dtype)
+
+        else:
+            def _fwd(v, *rest):
+                w = dequantize_weight(q, s, bits, cdtype)
+                y = jnp.dot(v.astype(cdtype), w.T, preferred_element_type=jnp.float32)
+                if rest:
+                    y = y + rest[0]
+                return y.astype(v.dtype)
 
         if self.bias is None:
             return tape_op(_fwd, x)
@@ -172,11 +246,46 @@ def replace_with_quantized_layers(
     from ..nn.layers import Linear
     from ..nn.meta import is_meta
 
+    # the fused decoder families (models/gpt.py etc.) read raw .weight
+    # arrays through param_tensors() for their single-tape_op block math —
+    # swapping their Linears would crash at forward; fail with guidance
+    # instead (reference bnb swaps torch modules whose forward() is always
+    # the execution path, so it has no such constraint)
+    fused_parents = [
+        n for n, m in model.named_modules() if hasattr(m, "param_tensors")
+    ]
     skip = set(config.skip_modules or [])
+
+    def _eligible(name, module):
+        return (
+            type(module) is Linear
+            and name not in skip
+            and not any(
+                name.endswith(k) or k in name for k in config.keep_in_fp32_modules
+            )
+        )
+
+    # conflict detection BEFORE any mutation: raising mid-loop would leave
+    # the model half-quantized, and explicitly-exempted fused linears
+    # (skip_modules / keep_in_fp32_modules) are not conflicts at all
+    def _under_fused(name):
+        # p == "" is the model root itself carrying param_tensors — every
+        # child linear is fused then
+        return any(p == "" or name.startswith(p + ".") for p in fused_parents)
+
+    for name, module in model.named_modules():
+        if _eligible(name, module) and _under_fused(name):
+            raise NotImplementedError(
+                f"cannot quantize {name}: its parent block computes through "
+                "fused per-layer math (param_tensors) that reads raw weight "
+                "arrays. Quantized load supports module-composed models "
+                "(BERT, bridge-converted Sequentials); exempt the fused "
+                "trunk via skip_modules/keep_in_fp32_modules, or use "
+                "shard_for_inference / offload for the decoder families."
+            )
+
     for name, module in list(model.named_modules()):
-        if type(module) is not Linear or name in skip:
-            continue
-        if any(name.endswith(k) or k in name for k in config.keep_in_fp32_modules):
+        if not _eligible(name, module):
             continue
         parent, _, leaf = name.rpartition(".")
         parent_mod = model.get_submodule(parent) if parent else model
@@ -198,7 +307,8 @@ def replace_with_quantized_layers(
             parent_mod,
             leaf,
             QuantizedLinear.from_weight(
-                w, b, bits=config.bits, compute_dtype=config.compute_dtype
+                w, b, bits=config.bits, compute_dtype=config.compute_dtype,
+                compute=config.compute,
             ),
         )
     return model

@@ -135,6 +135,146 @@ def test_quantized_linear_forward(bits):
     assert np.abs(out - ref).max() < tol
 
 
+def test_quantized_linear_int8_compute():
+    """W8A8 mode: int8xint8->int32 dot with dynamic activation scales stays
+    close to the fp32 reference and handles 3-D activations."""
+    nn.manual_seed(0)
+    lin = nn.Linear(64, 16)
+    qlin = QuantizedLinear.from_weight(lin.weight, lin.bias, compute="int8")
+    rng = np.random.default_rng(2)
+    for shape in [(4, 64), (2, 5, 64)]:
+        x = Tensor(rng.normal(size=shape).astype(np.float32))
+        with nn.no_grad():
+            ref = np.asarray(lin(x).data)
+            out = np.asarray(qlin(x).data)
+        assert out.shape == ref.shape
+        # two quantisation sources (weight + activation) → looser tolerance
+        assert np.abs(out - ref).max() < 0.1, np.abs(out - ref).max()
+    # int4 cannot ride the int8 path
+    with pytest.raises(ValueError, match="int8"):
+        QuantizedLinear.from_weight(lin.weight, None, bits=4, compute="int8")
+
+
+def test_quantization_config_int8_compute_validation():
+    cfg = QuantizationConfig(load_in_8bit=True, compute="int8")
+    assert cfg.compute == "int8"
+    with pytest.raises(ValueError, match="compute"):
+        QuantizationConfig(load_in_8bit=True, compute="fp4")
+    with pytest.raises(ValueError, match="int8"):
+        QuantizationConfig(load_in_4bit=True, compute="int8")
+
+
+def test_int8_backward_bf16_upstream():
+    """STE cotangent returns in the primal dtype: a bf16 upstream node must
+    not crash the vjp (review finding: hardcoded fp32 did)."""
+    import jax.numpy as jnp
+
+    nn.manual_seed(0)
+    lin = nn.Linear(16, 8)
+    qlin = QuantizedLinear.from_weight(lin.weight, lin.bias, compute="int8")
+    x = Tensor(jnp.ones((2, 16), jnp.bfloat16), requires_grad=True)
+    h = x * 2.0  # upstream bf16 tape node
+    (qlin(h) ** 2).sum().backward()
+    assert x.grad is not None and np.isfinite(np.asarray(x.grad, np.float32)).all()
+
+
+def test_quantize_root_fused_module_guarded():
+    """A fused block passed AS the model root still triggers the guard
+    (review finding: startswith(p + '.') never matched the root '')."""
+    from accelerate_tpu.models.opt import OPTConfig, OPTDecoderLayer
+    from accelerate_tpu.utils.quantization import replace_with_quantized_layers
+
+    nn.manual_seed(0)
+    layer = OPTDecoderLayer(OPTConfig.tiny())
+    with pytest.raises(NotImplementedError, match="param_tensors"):
+        replace_with_quantized_layers(layer, QuantizationConfig(load_in_8bit=True))
+
+
+def test_jnp_left_operand_keeps_tape():
+    """raw jnp array on the LEFT of a Tensor still defers to the reflected
+    op and stays gradient-tracked (regression: __jax_array__ broke this)."""
+    import jax.numpy as jnp
+
+    x = Tensor(jnp.ones((3,)), requires_grad=True)
+    y = jnp.ones((3,)) + x
+    assert isinstance(y, Tensor)
+    y.sum().backward()
+    np.testing.assert_array_equal(np.asarray(x.grad), np.ones(3))
+
+
+def test_int8_compute_backward_not_dead():
+    """STE backward: gradients flow through the int8 dot to upstream layers
+    and match the dequant-path gradients closely (review finding: the naive
+    round/clip vjp was silently zero)."""
+    nn.manual_seed(0)
+    lin = nn.Linear(32, 8)
+    q_int8 = QuantizedLinear.from_weight(lin.weight, lin.bias, compute="int8")
+    q_deq = QuantizedLinear.from_weight(lin.weight, lin.bias)
+    x_np = np.random.default_rng(4).normal(size=(4, 32)).astype(np.float32)
+
+    def grad_through(layer):
+        x = Tensor(jnp.asarray(x_np))
+        x.requires_grad = True
+        (layer(x) ** 2).sum().backward()
+        return np.asarray(x.grad)
+
+    g8 = grad_through(q_int8)
+    gd = grad_through(q_deq)
+    assert np.abs(g8).max() > 0.1  # not dead
+    # same weight linearization up to activation-quant noise in the cotangent
+    assert np.abs(g8 - gd).max() / (np.abs(gd).max() + 1e-9) < 0.15
+
+
+def test_quantize_fused_family_exemption_and_atomic_failure():
+    """keep_in_fp32_modules exempting the fused trunk lets non-fused linears
+    quantize; a conflicting call fails BEFORE mutating anything."""
+    from accelerate_tpu.models import OPTConfig, OPTForCausalLM
+    from accelerate_tpu.nn.layers import Linear
+    from accelerate_tpu.utils.quantization import replace_with_quantized_layers
+
+    nn.manual_seed(0)
+    model = OPTForCausalLM(OPTConfig.tiny())
+    with pytest.raises(NotImplementedError, match="param_tensors"):
+        replace_with_quantized_layers(model, QuantizationConfig(load_in_8bit=True))
+    # atomic: nothing was swapped by the failed call
+    assert not any(isinstance(m, QuantizedLinear) for m in model.modules())
+    # exempting the fused trunk succeeds and quantizes only NON-fused
+    # linears (OPT-tiny's lm_head-adjacent projections)
+    replace_with_quantized_layers(
+        model,
+        QuantizationConfig(load_in_8bit=True, keep_in_fp32_modules=["layers"]),
+    )
+    quantized = [
+        n for n, m in model.named_modules() if isinstance(m, QuantizedLinear)
+    ]
+    assert quantized, "non-fused linears should quantize under the exemption"
+    assert not any(".layers." in n or n.startswith("layers") for n in quantized)
+
+
+def test_replace_layers_int8_compute_mode():
+    """int8-compute model ≈ dequant-compute model: the int8 dot adds only
+    activation-quantization noise on top of the shared weight quantization."""
+    from accelerate_tpu.utils.quantization import replace_with_quantized_layers
+
+    def build(compute):
+        nn.manual_seed(0)
+        model = nn.Sequential(nn.Linear(16, 16), nn.ReLU(), nn.Linear(16, 8))
+        replace_with_quantized_layers(
+            model, QuantizationConfig(load_in_8bit=True, compute=compute)
+        )
+        return model
+
+    m8, md = build("int8"), build("dequant")
+    quant = [m for m in m8.modules() if isinstance(m, QuantizedLinear)]
+    assert quant and all(m.compute == "int8" for m in quant)
+    x = Tensor(np.random.default_rng(3).normal(size=(2, 16)).astype(np.float32))
+    with nn.no_grad():
+        out8 = np.asarray(m8(x).data)
+        outd = np.asarray(md(x).data)
+    assert np.isfinite(out8).all()
+    assert np.abs(out8 - outd).max() < 0.05
+
+
 def test_int4_memory_is_halved():
     lin_w = np.zeros((16, 32), dtype=np.float32)
     q8, _ = quantize_weight(lin_w, 8)
