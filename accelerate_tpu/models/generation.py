@@ -32,6 +32,10 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -103,9 +107,65 @@ def cached_attention(q, k, v, q_pos, cfg):
     return att.reshape(b, n_head, s, d)
 
 
+def _quantize_stacked_layers(layers: dict, bits: int) -> tuple[dict, dict, dict]:
+    """Split stacked per-layer params into (plain, int8 q, scales).
+
+    Matmul weights — ndim-3 ``(L, out, in)`` stacks — quantize per
+    (layer, out-channel) symmetric int8 (int4 packs two per byte along
+    ``in``); norm weights/biases (ndim <= 2) stay as-is.  Decode is
+    memory-bound, so streaming weights at 1 (or 0.5) byte/param is the
+    whole win (reference counterpart: the bnb int8 big-model-inference
+    benchmark, /root/reference/benchmarks/big_model_inference).
+
+    Quantization runs ON DEVICE with jnp ops, never gathering to host:
+    eager ops on committed sharded arrays compute where the data lives, so
+    GSPMD layouts from ``shard_for_inference`` survive into q/scales (the
+    module's composition contract, and a host gather of a sharded 30B
+    model would OOM the host).  The stacked-3-D math intentionally differs
+    from utils/quantization.quantize_weight (numpy, 2-D, load-time); the
+    per-step DEQUANT below reuses that module's exact kernel.
+    """
+    plain, qd, sd = {}, {}, {}
+    qmax = 127.0 if bits == 8 else 7.0
+    for key, arr in layers.items():
+        if arr.ndim != 3:
+            plain[key] = arr
+            continue
+        if bits == 4 and arr.shape[-1] % 2:
+            logger.warning(
+                "quantize_weights=4: %s inner dim %d is odd — kept in full "
+                "precision", key, arr.shape[-1],
+            )
+            plain[key] = arr
+            continue
+        amax = jnp.maximum(jnp.max(jnp.abs(arr), axis=-1, keepdims=True), 1e-12)
+        scale = (amax / qmax).astype(jnp.float32)
+        q = jnp.clip(jnp.round(arr / scale), -qmax - 1, qmax).astype(jnp.int8)
+        if bits == 4:
+            nib = (q + 8).astype(jnp.uint8)
+            q = (nib[..., 0::2] << 4 | nib[..., 1::2]).astype(jnp.uint8)
+        qd[key] = q
+        sd[key] = scale[..., 0]  # (L, out)
+    return plain, qd, sd
+
+
+def _dequant_layer(plain_l: dict, q_l: dict, s_l: dict, bits: int, dtype) -> dict:
+    """Rebuild one scan step's layer dict, widening int8/int4 entries to the
+    activation dtype INSIDE the step — only one layer's weights are ever
+    resident at full width.  The widening is utils/quantization's
+    dequantize_weight (one shared bit-packing implementation)."""
+    from ..utils.quantization import dequantize_weight
+
+    l = dict(plain_l)
+    for key, q in q_l.items():
+        l[key] = dequantize_weight(q, s_l[key], bits, dtype)
+    return l
+
+
 @partial(
     jax.jit,
-    static_argnames=("family", "cfg", "max_new", "cache_len", "temperature"),
+    static_argnames=("family", "cfg", "max_new", "cache_len", "temperature",
+                     "qbits"),
 )
 def _generate_jit(
     g,
@@ -118,13 +178,16 @@ def _generate_jit(
     max_new: int,
     cache_len: int,
     temperature: float,
+    qbits: int = 0,
 ):
     b, prompt_len = ids.shape
+    plain_layers, q_layers, s_layers = layers
 
     # ---- prefill: full prompt through a scan over stacked layers ----------
     positions = jnp.arange(prompt_len)
 
-    def prefill_layer(x, l):
+    def prefill_layer(x, layer_in):
+        l = _dequant_layer(*layer_in, qbits, x.dtype)
         q, k, v = family.attn_in(l, x, positions, cfg)
         # attend over the unpadded prompt keys (no wasted MXU work on the
         # not-yet-written cache region), then pad out to the decode length
@@ -133,7 +196,9 @@ def _generate_jit(
         return family.attn_out(l, x, att, cfg), (jnp.pad(k, pad), jnp.pad(v, pad))
 
     x = family.embed(g, ids, positions, cfg)
-    x, (k_cache, v_cache) = jax.lax.scan(prefill_layer, x, layers)
+    x, (k_cache, v_cache) = jax.lax.scan(
+        prefill_layer, x, (plain_layers, q_layers, s_layers)
+    )
     logits = family.finalize(g, x, cfg)
 
     def sample(logits, key):
@@ -153,14 +218,17 @@ def _generate_jit(
         x = family.embed(g, tok[:, None], q_pos, cfg)
 
         def layer(x, layer_in):
-            l, kc, vc = layer_in
+            l_parts, kc, vc = layer_in
+            l = _dequant_layer(*l_parts, qbits, x.dtype)
             q, k, v = family.attn_in(l, x, q_pos, cfg)
             kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, position, 0))
             vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, position, 0))
             att = cached_attention(q, kc, vc, q_pos, cfg)
             return family.attn_out(l, x, att, cfg), (kc, vc)
 
-        x, (k_cache, v_cache) = jax.lax.scan(layer, x, (layers, k_cache, v_cache))
+        x, (k_cache, v_cache) = jax.lax.scan(
+            layer, x, ((plain_layers, q_layers, s_layers), k_cache, v_cache)
+        )
         logits = family.finalize(g, x, cfg)
         rng, key = jax.random.split(rng)
         nxt = sample(logits, key)
@@ -182,6 +250,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    quantize_weights: Optional[int] = None,
 ):
     """Greedy (``temperature=0``) or sampled decode with a KV cache.
 
@@ -209,22 +278,37 @@ def generate(
     # with `is` — an id()-tuple key can silently match recycled object ids
     # after training rebinds p.data, serving stale weights.  Cost: at most
     # one superseded param set stays alive until the next generate().
+    if quantize_weights not in (None, 4, 8):
+        raise ValueError(
+            f"quantize_weights={quantize_weights!r}: use None, 8 or 4"
+        )
+    qbits = quantize_weights or 0
     current = [p.data for _, p in model.named_parameters()]
     cached = getattr(model, "_generation_param_cache", None)
-    if (
+    if not (
         cached is not None
         and len(cached[0]) == len(current)
         and all(a is b for a, b in zip(cached[0], current))
     ):
-        g, layers = cached[1]
-    else:
-        g, layers = spec.stack()
-        model._generation_param_cache = (current, (g, layers))
+        cached = (current, {})  # params changed: drop every mode
+        model._generation_param_cache = cached
+    by_mode: dict = cached[1]
+    if qbits not in by_mode:
+        # per-mode slots: alternating full/quantized generates (the A/B
+        # comparison benchmarks do) must not restack per call
+        if 0 in by_mode:
+            g, (layers, _, _) = by_mode[0]
+        else:
+            g, layers = spec.stack()
+            by_mode[0] = (g, (layers, {}, {}))  # never restack twice
+        if qbits:
+            by_mode[qbits] = (g, _quantize_stacked_layers(layers, qbits))
+    g, layer_parts = by_mode[qbits]
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_jit(
         g,
-        layers,
+        layer_parts,
         ids,
         rng,
         family=spec.family,
@@ -232,4 +316,5 @@ def generate(
         max_new=max_new_tokens,
         cache_len=cache_len,
         temperature=float(temperature),
+        qbits=qbits,
     )

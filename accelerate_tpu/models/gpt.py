@@ -224,11 +224,13 @@ class GPTLMHeadModel(nn.Module):
             return {"loss": loss, "logits": logits}
         return {"logits": logits}
 
-    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0, rng=None):
+    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0,
+                 rng=None, quantize_weights=None):
         """KV-cache greedy/sampled decode — see models/generation.py."""
         from .generation import generate
 
-        return generate(self, input_ids, max_new_tokens, temperature, rng)
+        return generate(self, input_ids, max_new_tokens, temperature, rng,
+                        quantize_weights=quantize_weights)
 
     def _decoder_spec(self):
         """Hooks for the generic KV-cache engine (models/generation.py) —

@@ -265,10 +265,12 @@ class LlamaForCausalLM(nn.Module):
             return {"loss": loss, "logits": logits}
         return {"logits": logits}
 
-    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0, rng=None):
+    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0,
+                 rng=None, quantize_weights=None):
         from .generation import generate
 
-        return generate(self, input_ids, max_new_tokens, temperature, rng)
+        return generate(self, input_ids, max_new_tokens, temperature, rng,
+                        quantize_weights=quantize_weights)
 
     @property
     def num_flops_per_token(self) -> float:

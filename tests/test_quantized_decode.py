@@ -1,0 +1,85 @@
+"""int8/int4 weight-quantized KV-cache decode for the fused decoder families
+(reference counterpart: the bnb int8 big-model-inference benchmark,
+/root/reference/benchmarks/big_model_inference). Weights stream through the
+decode scan at 1 (or 0.5) byte/param and widen per layer inside the step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+from accelerate_tpu.models import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    OPTConfig,
+    OPTForCausalLM,
+)
+
+
+def _snap_params_to_int8_grid(model):
+    """Round every 2-D matmul weight onto its own int8 quantization grid so
+    quantize→dequantize is EXACT — quantized decode must then match the
+    full-precision decode token for token."""
+    for name, p in model.named_parameters():
+        w = np.asarray(p.data)
+        if w.ndim != 2:
+            continue
+        amax = np.maximum(np.abs(w).max(axis=-1, keepdims=True), 1e-12)
+        scale = (amax / 127.0).astype(np.float32)
+        p.data = jnp.asarray(np.round(w / scale) * scale)
+
+
+@pytest.mark.parametrize("family", ["llama", "opt"])
+def test_int8_decode_exact_on_grid(family):
+    nn.manual_seed(0)
+    if family == "llama":
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        vocab = model.config.vocab_size
+    else:
+        model = OPTForCausalLM(OPTConfig.tiny())
+        vocab = model.config.vocab_size
+    _snap_params_to_int8_grid(model)
+    ids = np.random.default_rng(0).integers(0, vocab, (2, 9)).astype(np.int32)
+    full = np.asarray(model.generate(ids, max_new_tokens=6))
+    quant = np.asarray(model.generate(ids, max_new_tokens=6, quantize_weights=8))
+    np.testing.assert_array_equal(quant, full)
+
+
+def test_int8_decode_caches_int8_stacks():
+    """The cached stacked layers really are int8 + fp32 scales (the memory
+    win), and the cache keys on the bits so modes don't cross-serve."""
+    nn.manual_seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    ids = np.zeros((1, 4), np.int32)
+    model.generate(ids, max_new_tokens=2, quantize_weights=8)
+    _, by_mode = model._generation_param_cache
+    g, (plain, qd, sd) = by_mode[8]
+    assert qd and all(v.dtype == jnp.int8 for v in qd.values())
+    assert all(v.dtype == jnp.float32 for v in sd.values())
+    assert all(v.ndim != 3 for v in plain.values())  # matmul stacks all quantized
+    # both modes stay cached side by side (A/B runs must not restack)
+    model.generate(ids, max_new_tokens=2)
+    assert set(model._generation_param_cache[1]) == {0, 8}
+
+
+def test_int4_decode_runs_and_packs():
+    nn.manual_seed(0)
+    model = OPTForCausalLM(OPTConfig.tiny())
+    ids = np.random.default_rng(1).integers(0, model.config.vocab_size, (1, 8)).astype(np.int32)
+    out = np.asarray(model.generate(ids, max_new_tokens=4, quantize_weights=4))
+    assert out.shape == (1, 12)
+    _, by_mode = model._generation_param_cache
+    g, (plain, qd, sd) = by_mode[4]
+    assert qd and all(v.dtype == jnp.uint8 for v in qd.values())
+    # packed: stored inner dim is half the logical one
+    hidden = model.config.hidden_size
+    assert any(v.shape[-1] == hidden // 2 for v in qd.values())
+    assert (out[:, :8] == ids).all()
+
+
+def test_invalid_bits_raises():
+    nn.manual_seed(0)
+    model = OPTForCausalLM(OPTConfig.tiny())
+    with pytest.raises(ValueError, match="quantize_weights"):
+        model.generate(np.zeros((1, 4), np.int32), max_new_tokens=2, quantize_weights=2)
