@@ -371,10 +371,17 @@ def _opt_inference_workload(on_accel: bool) -> dict:
     out = model.generate(prompt, max_new_tokens=new)
     _ = np.asarray(out)
     gen_s = _time.perf_counter() - t0
+    # int8-weight decode A/B: decode is memory-bound, so 1-byte weight
+    # streaming should cut per-token latency (bnb int8 benchmark analog)
+    _ = np.asarray(model.generate(prompt, max_new_tokens=new, quantize_weights=8))
+    t0 = _time.perf_counter()
+    _ = np.asarray(model.generate(prompt, max_new_tokens=new, quantize_weights=8))
+    gen8_s = _time.perf_counter() - t0
     return {
         "opt_params_m": round(model.num_parameters / 1e6, 1),
         "opt_load_s": round(load_s, 2),
         "opt_generate_s_per_token": round(gen_s / new, 4),
+        "opt_generate_int8_s_per_token": round(gen8_s / new, 4),
         "opt_generate_compile_s": round(compile_s, 1),
     }
 
