@@ -73,6 +73,20 @@ def launch_command_parser(subparsers: Optional[argparse._SubParsersAction] = Non
         action="store_true",
         help="Multihost on ONE machine (CPU simulation): spawn all ranks locally",
     )
+    hw.add_argument(
+        "--max_restarts",
+        type=int,
+        default=0,
+        help="Gang restarts after a worker failure (torchrun elastic-agent "
+        "parity; SPMD restarts the WHOLE gang — partial restarts cannot "
+        "rejoin a compiled collective program)",
+    )
+    hw.add_argument(
+        "--monitor_interval",
+        type=float,
+        default=0.2,
+        help="Seconds between worker liveness polls (torchrun parity)",
+    )
     # mesh layout
     mesh = parser.add_argument_group("Mesh layout (SPMD parallelism axes)")
     for axis, doc in (
@@ -180,13 +194,71 @@ def _merge_config_defaults(args) -> None:
                 setattr(args, attr, v)
 
 
+def _supervise(run_once, max_restarts: int, cmd, what: str) -> None:
+    """Elastic gang supervision (torchrun-agent parity): re-run ``run_once``
+    after failures, up to ``max_restarts`` times, with exponential backoff so
+    an import-time crash cannot burn every restart in milliseconds.
+    Startup-time RuntimeErrors (e.g. a coordinator port still draining from
+    the killed gang) count as retryable failures, not aborts."""
+    restarts_left = max(0, max_restarts or 0)
+    attempt = 0
+    while True:
+        failure: object
+        try:
+            rc = run_once()
+            if rc == 0:
+                return
+            failure = rc
+        except RuntimeError as exc:
+            failure = exc
+        if restarts_left <= 0:
+            if isinstance(failure, BaseException):
+                raise failure
+            raise subprocess.CalledProcessError(failure, cmd)
+        restarts_left -= 1
+        attempt += 1
+        delay = min(5.0, 0.5 * (2 ** (attempt - 1)))
+        print(
+            f"[accelerate-tpu launch] {what} failed ({failure}); restarting "
+            f"in {delay:.1f}s ({restarts_left} restart(s) left)",
+            file=sys.stderr,
+        )
+        time.sleep(delay)
+
+
+def _is_multi_machine(args) -> bool:
+    return bool(
+        (getattr(args, "num_machines", None) or 1) > 1
+        or getattr(args, "main_process_ip", None)
+        not in (None, "", "127.0.0.1", "localhost")
+    )
+
+
 def simple_launcher(args) -> None:
-    """Single process on this host (reference simple_launcher launch.py:773)."""
+    """Single process on this host (reference simple_launcher launch.py:773),
+    re-launched up to ``--max_restarts`` times on failure.
+
+    Restarts apply only to SINGLE-machine jobs: one member of a multi-host
+    ``jax.distributed`` gang cannot rejoin a coordinator that still holds
+    its dead slot, so a host-local restart would hang — pod-level gang
+    restarts live in tpu_pod_launcher (the whole SSH fan-out reruns)."""
     cmd, env = prepare_simple_launcher_cmd_env(args)
-    process = subprocess.Popen(cmd, env=env)
-    process.wait()
-    if process.returncode != 0:
-        raise subprocess.CalledProcessError(process.returncode, cmd)
+    max_restarts = getattr(args, "max_restarts", 0) or 0
+    if max_restarts and _is_multi_machine(args):
+        print(
+            "[accelerate-tpu launch] --max_restarts ignored for a multi-host "
+            "member: a lone restarted worker cannot rejoin the gang (use the "
+            "pod launcher's gang restart)",
+            file=sys.stderr,
+        )
+        max_restarts = 0
+
+    def run_once() -> int:
+        process = subprocess.Popen(cmd, env=env)
+        process.wait()
+        return process.returncode
+
+    _supervise(run_once, max_restarts, cmd, "worker")
 
 
 def _wait_port_free(port: int, host: str = "127.0.0.1") -> None:
@@ -210,7 +282,6 @@ def multihost_launcher(args) -> None:
     """
     num_processes = args.num_processes
     port = args.main_process_port or 29500
-    _wait_port_free(port)
     coordinator = f"127.0.0.1:{port}"
 
     cmd = []
@@ -221,28 +292,40 @@ def multihost_launcher(args) -> None:
     cmd.append(args.training_script)
     cmd.extend(args.training_script_args or [])
 
-    processes = []
-    for rank in range(num_processes):
-        env = prepare_multihost_worker_env(args, rank, num_processes, coordinator)
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        processes.append(subprocess.Popen(cmd, env=env))
-    failed = []
-    try:
-        while processes:
-            time.sleep(0.2)
-            for p in list(processes):
-                rc = p.poll()
-                if rc is None:
-                    continue
-                processes.remove(p)
-                if rc != 0:
-                    failed.append((p, rc))
-                    raise subprocess.CalledProcessError(rc, cmd)
-    finally:
-        for p in processes:
-            p.terminate()
-        for p in processes:
-            p.wait()
+    interval = getattr(args, "monitor_interval", None)
+    # 0 is a legitimate explicit value (tightest poll) — clamp, don't default
+    interval = 0.2 if interval is None else max(0.01, interval)
+    restarts_left = max(0, getattr(args, "max_restarts", 0) or 0)
+
+    def run_gang() -> int:
+        """Spawn the full rank gang; 0 on success, else the first bad rc.
+        Any failure kills the remaining ranks — a compiled SPMD program
+        cannot make progress (or be rejoined) with a member missing, so
+        gang-restart is the only sound elastic unit."""
+        _wait_port_free(port)
+        processes = []
+        for rank in range(num_processes):
+            env = prepare_multihost_worker_env(args, rank, num_processes, coordinator)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            processes.append(subprocess.Popen(cmd, env=env))
+        try:
+            while processes:
+                time.sleep(interval)
+                for p in list(processes):
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    processes.remove(p)
+                    if rc != 0:
+                        return rc
+            return 0
+        finally:
+            for p in processes:
+                p.terminate()
+            for p in processes:
+                p.wait()
+
+    _supervise(run_gang, restarts_left, cmd, "gang")
 
 
 def tpu_pod_launcher(args) -> None:
@@ -276,7 +359,18 @@ def tpu_pod_launcher(args) -> None:
     if args.tpu_zone:
         gcloud_cmd.insert(5, f"--zone={args.tpu_zone}")
     print(f"Running: {' '.join(gcloud_cmd)}")
-    subprocess.run(gcloud_cmd, check=True)
+    # gang restart = rerun the WHOLE fan-out: every worker restarts together
+    # so the jax.distributed coordinator comes up fresh.  --max_restarts is
+    # deliberately NOT forwarded to the inner per-worker launches — a lone
+    # worker restarting inside a live gang could never rejoin (see
+    # simple_launcher).
+
+    def run_once() -> int:
+        return subprocess.run(gcloud_cmd).returncode
+
+    _supervise(
+        run_once, getattr(args, "max_restarts", 0) or 0, gcloud_cmd, "pod gang"
+    )
 
 
 def launch_command(args) -> None:

@@ -83,3 +83,93 @@ def _check_world():
 
     assert jax.process_count() == 2
     state.wait_for_everyone()
+
+
+def test_gang_restart_recovers_flaky_worker(tmp_path):
+    """--max_restarts N relaunches the worker after a failure (torchrun
+    elastic-agent parity); attempt counting is observable via a state file."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "attempts"
+    script.write_text(
+        "import pathlib, sys\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "n = int(m.read_text()) if m.exists() else 0\n"
+        "m.write_text(str(n + 1))\n"
+        "sys.exit(1 if n < 2 else 0)  # fail twice, succeed third\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "launch", "--num_processes", "1", "--max_restarts", "2",
+         str(script)],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert marker.read_text() == "3"
+    assert proc.stderr.count("restarting") == 2
+
+
+def test_gang_restart_exhausted_fails(tmp_path):
+    import subprocess
+    import sys
+
+    script = tmp_path / "alwaysfail.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "launch", "--num_processes", "1", "--max_restarts", "1",
+         str(script)],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode != 0
+    assert proc.stderr.count("restarting") == 1
+
+
+def test_multihost_gang_restart(tmp_path):
+    """A failing rank kills and restarts the WHOLE gang (SPMD semantics)."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "gang.py"
+    marker = tmp_path / "attempts"
+    script.write_text(
+        "import os, pathlib, sys\n"
+        "rank = int(os.environ.get('ACCELERATE_PROCESS_INDEX', '0'))\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "if rank == 1:\n"
+        "    n = int(m.read_text()) if m.exists() else 0\n"
+        "    m.write_text(str(n + 1))\n"
+        "    sys.exit(1 if n < 1 else 0)  # rank 1 fails the first gang\n"
+        "sys.exit(0)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "launch", "--num_processes", "2", "--local_ranks",
+         "--max_restarts", "1", "--main_process_port", "29613",
+         str(script)],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert marker.read_text() == "2"  # both gang attempts reached rank 1
+    assert "gang failed" in proc.stderr and "restarting" in proc.stderr
+
+
+def test_is_multi_machine_detection():
+    """Restart gating: multi-host members must not restart solo (a lone
+    worker cannot rejoin the jax.distributed gang)."""
+    import types
+
+    from accelerate_tpu.commands.launch import _is_multi_machine
+
+    mk = lambda **kw: types.SimpleNamespace(
+        num_machines=kw.get("num_machines"), main_process_ip=kw.get("ip")
+    )
+    assert not _is_multi_machine(mk())
+    assert not _is_multi_machine(mk(ip="127.0.0.1"))
+    assert _is_multi_machine(mk(num_machines=4))
+    assert _is_multi_machine(mk(ip="10.0.0.7"))
