@@ -397,3 +397,17 @@ def test_llama_sliding_window_config():
     # early positions (inside the window) agree; late positions differ
     np.testing.assert_allclose(full[:, :64], windowed[:, :64], atol=1e-4)
     assert not np.allclose(full[:, -1], windowed[:, -1])
+
+
+def test_window_tiles_formula():
+    """The ONE band-geometry formula all three narrowed walks share: covers
+    exactly the tiles a band can touch (never under, at most one spare)."""
+    for block in (128, 256, 512):
+        for window in (1, 127, 128, 129, 200, 511, 512, 513, 1024):
+            num_tiles = 4096 // block
+            wt = fa._window_tiles(window, block, num_tiles)
+            # exact requirement: a q row at tile edge reaches back window-1
+            # positions → floor((window + block - 2) / block) + 1 tiles
+            needed = min(num_tiles, (window + block - 2) // block + 1)
+            assert needed <= wt <= needed + 1, (block, window, wt, needed)
+            assert wt <= num_tiles
