@@ -37,7 +37,7 @@ from .big_modeling import (
 )
 from .state import AcceleratorState, GradientState, PartialState
 from .logging import get_logger
-from .data_loader import prepare_data_loader, skip_first_batches
+from .data_loader import PaddingCollate, prepare_data_loader, skip_first_batches
 from .utils.memory import find_executable_batch_size
 from .utils.modeling import (
     find_tied_parameters,

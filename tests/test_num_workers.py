@@ -1,6 +1,8 @@
 """Background-prefetch loader (`num_workers`, reference torch DataLoader
 worker parity — see data_loader._BackgroundPrefetcher)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -97,3 +99,78 @@ def test_torch_dataloader_num_workers_extracted():
     # an explicit 0 must win over the wrapped loader's setting (debug escape)
     forced = prepare_data_loader(tdl, put_on_device=False, num_workers=0)
     assert forced.num_workers == 0
+
+
+class _RaggedTokens:
+    def __init__(self, n=20, seed=0):
+        rng = np.random.default_rng(seed)
+        self.rows = [
+            {"input_ids": rng.integers(0, 500, rng.integers(5, 40)).astype(np.int32)}
+            for _ in range(n)
+        ]
+        # ragged labels too (seq2seq-style): a shorter slice of the inputs
+        for r in self.rows:
+            r["labels"] = r["input_ids"][: max(1, len(r["input_ids"]) // 2)]
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i):
+        return self.rows[i]
+
+
+def test_padding_collate_dict_and_buckets():
+    from accelerate_tpu import PaddingCollate
+
+    ds = _RaggedTokens()
+    collate = PaddingCollate(pad_value=0, pad_to_multiple_of=16,
+                             pad_values={"labels": -100})
+    batch = collate([ds[i] for i in range(4)])
+    ids, labels = batch["input_ids"], batch["labels"]
+    assert ids.shape[0] == 4 and ids.shape[1] % 16 == 0
+    assert labels.shape[1] % 16 == 0
+    longest = max(len(ds[i]["input_ids"]) for i in range(4))
+    assert ids.shape[1] - longest < 16  # padded to the NEXT bucket only
+    # right-padding with the per-key pad ids
+    row0 = ds[0]["input_ids"]
+    np.testing.assert_array_equal(ids[0, : len(row0)], row0)
+    assert (ids[0, len(row0):] == 0).all()
+    lab0 = ds[0]["labels"]
+    assert (labels[0, len(lab0):] == -100).all()
+
+
+def test_padding_collate_mixed_dtype_raises():
+    from accelerate_tpu import PaddingCollate
+
+    with pytest.raises(ValueError, match="mixed row dtypes"):
+        PaddingCollate()([np.array([1], np.int32), np.array([2], np.int64)])
+
+
+def test_padding_collate_through_loader():
+    """Ragged dataset + PaddingCollate through prepare_data_loader (with a
+    background worker): bucketed shapes, parity with the numpy fallback."""
+    from accelerate_tpu import PaddingCollate
+
+    ds = _RaggedTokens(n=16, seed=3)
+    loader = prepare_data_loader(
+        dataset=ds, batch_size=4, collate_fn=PaddingCollate(pad_to_multiple_of=8),
+        put_on_device=False, num_workers=1,
+    )
+    shapes = {np.asarray(b["input_ids"]).shape[1] for b in loader}
+    assert all(s % 8 == 0 for s in shapes)
+
+    import subprocess
+    import sys
+
+    code = (
+        "import numpy as np;"
+        "from accelerate_tpu import PaddingCollate, native;"
+        "assert not native.available();"
+        "c = PaddingCollate(pad_to_multiple_of=4);"
+        "out = c([np.array([1,2,3], np.int32), np.array([9], np.int32)]);"
+        "assert out.shape == (2, 4) and out[1,1] == 0"
+    )
+    env = dict(os.environ, ACCELERATE_TPU_NO_NATIVE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1500:]
