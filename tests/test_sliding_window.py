@@ -411,3 +411,20 @@ def test_window_tiles_formula():
             needed = min(num_tiles, (window + block - 2) // block + 1)
             assert needed <= wt <= needed + 1, (block, window, wt, needed)
             assert wt <= num_tiles
+
+
+def test_dispatcher_forced_paths_honor_window(monkeypatch):
+    """ACCELERATE_TPU_FLASH=0 (XLA path) and =1 (Pallas path) both apply the
+    band — insurance on the sdpa_tpu plumbing either side of the fork."""
+    from accelerate_tpu.ops.attention import sdpa_tpu
+
+    q, k, v = _rand_qkv(s=256)
+    ref = sdpa_reference(q, k, v, is_causal=True, window=96)
+    monkeypatch.setenv("ACCELERATE_TPU_FLASH", "0")
+    out_xla = sdpa_tpu(q, k, v, is_causal=True, window=96)
+    np.testing.assert_allclose(np.asarray(out_xla), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    monkeypatch.setenv("ACCELERATE_TPU_FLASH", "1")
+    out_pallas = sdpa_tpu(q, k, v, is_causal=True, window=96)
+    np.testing.assert_allclose(np.asarray(out_pallas), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
