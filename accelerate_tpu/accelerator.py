@@ -92,6 +92,7 @@ class Accelerator:
         self.autocast_handler = None
         self.fp8_recipe_handler = None
         self.ddp_handler = None
+        self._comm_hook = None  # normalized "fp16"/"bf16"/None, set below
         from .utils.dataclasses import FP8RecipeKwargs
 
         from .utils.dataclasses import AutocastKwargs, DistributedDataParallelKwargs
@@ -109,13 +110,22 @@ class Accelerator:
                 self.fp8_recipe_handler = handler
             elif isinstance(handler, DistributedDataParallelKwargs):
                 self.ddp_handler = handler
-                if handler.comm_hook is not None and str(
-                    handler.comm_hook
-                ).lower() not in ("fp16", "bf16"):
-                    # fail at configuration time, not mid-first-train-step
-                    raise ValueError(
-                        f"unsupported comm_hook {handler.comm_hook!r}; use 'fp16' or 'bf16'"
-                    )
+                if handler.comm_hook is not None:
+                    hook = str(handler.comm_hook).lower()
+                    # accept both the bare value and its enum stringification
+                    # (DDPCommunicationHookType.NO prints as "ddpcommunicationhooktype.no")
+                    hook = hook.rsplit(".", 1)[-1]
+                    if hook in ("no", "none"):
+                        # the reference's NO hook is a valid no-op default —
+                        # run uncompressed rather than failing construction
+                        hook = None
+                    elif hook not in ("fp16", "bf16"):
+                        # fail at configuration time, not mid-first-train-step
+                        raise ValueError(
+                            f"unsupported comm_hook {handler.comm_hook!r}; use 'fp16' or 'bf16'"
+                        )
+                    # normalized copy — the caller-owned handler stays untouched
+                    self._comm_hook = hook
 
         if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "false").lower() in ("1", "true"):
             fsdp_plugin = FullyShardedDataParallelPlugin()
@@ -479,10 +489,8 @@ class Accelerator:
         in bf16), and a cast placed after the reduce cannot legally be hoisted
         above it.  The optimizer upcasts to fp32 masters at apply time."""
         dtype = None
-        if self.ddp_handler is not None and self.ddp_handler.comm_hook is not None:
-            dtype = jnp.float16 if str(
-                self.ddp_handler.comm_hook
-            ).lower() == "fp16" else jnp.bfloat16
+        if self._comm_hook is not None:
+            dtype = jnp.float16 if self._comm_hook == "fp16" else jnp.bfloat16
         elif self.state.fsdp_plugin is not None:
             # FSDP MixedPrecisionPolicy.reduce_dtype rides the same boundary
             dtype = self.state.fsdp_plugin.resolved_dtype("reduce_dtype")

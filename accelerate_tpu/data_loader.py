@@ -26,6 +26,7 @@ from __future__ import annotations
 import itertools
 import math
 import os
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import jax
@@ -669,7 +670,12 @@ class _BackgroundPrefetcher:
 
     _SENTINEL = object()
 
-    def __init__(self, gen_factory: Callable[[], Iterator], depth: int):
+    def __init__(
+        self,
+        gen_factory: Callable[[Callable[[], bool]], Iterator],
+        depth: int,
+        unbounded_close: bool = False,
+    ):
         import queue as _queue
         import threading as _threading
 
@@ -677,6 +683,10 @@ class _BackgroundPrefetcher:
         self._stop = _threading.Event()
         self._done = False  # sticky exhaustion (consumer side)
         self._gen_factory = gen_factory
+        # dispatch-mode multi-process producers run *collectives*; abandoning
+        # one mid-collective would let a stale thread race the next epoch's
+        # broadcasts (silent corruption) — a loud hang is strictly better there
+        self._unbounded_close = unbounded_close
         self._thread = _threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
 
@@ -694,7 +704,13 @@ class _BackgroundPrefetcher:
 
     def _produce(self):
         try:
-            for item in self._gen_factory():
+            # hand the generator our stop flag so it can bail between
+            # *element* pulls, not just at put boundaries — the streaming
+            # path fetches a whole global batch between puts, and an
+            # abandoned producer must not keep draining a shared iterable
+            # dataset into the void (round-4 review finding)
+            gen = self._gen_factory(self._stop.is_set)
+            for item in gen:
                 if not self._put_retrying((item, None)):
                     return
             self._put_retrying((self._SENTINEL, None))
@@ -724,7 +740,11 @@ class _BackgroundPrefetcher:
         # thread exits BEFORE we return — a stale producer advancing the
         # shared sampler concurrently with the next epoch would corrupt
         # remainder bookkeeping (and, in dispatch mode, emit an unpaired
-        # collective)
+        # collective).  Bounded: a __getitem__ stuck on network/disk can
+        # never finish its current item, and hanging the whole training
+        # process in a finally block is worse than abandoning the daemon
+        # thread (it can no longer touch the sampler once _stop is set).
+        deadline = time.monotonic() + 5.0
         while self._thread.is_alive():
             try:
                 while True:
@@ -732,6 +752,16 @@ class _BackgroundPrefetcher:
             except Exception:
                 pass
             self._thread.join(timeout=0.2)
+            if (
+                not self._unbounded_close
+                and time.monotonic() > deadline
+                and self._thread.is_alive()
+            ):
+                logger.warning(
+                    "prefetch worker did not exit within 5s (dataset "
+                    "__getitem__ appears blocked); abandoning daemon thread"
+                )
+                break
 
 
 class DataLoaderStateMixin:
@@ -815,10 +845,20 @@ class DataLoaderShard(DataLoaderStateMixin):
         return self.global_batch_sampler
 
     # -- iteration ----------------------------------------------------------
-    def _host_batches(self) -> Iterator[tuple[Any, int]]:
-        """Yield (collated numpy global batch, remainder_if_final_else_0)."""
+    def _producer_runs_collectives(self) -> bool:
+        """Whether _host_batches issues collectives (dispatch mode, >1 proc):
+        such a producer must never be abandoned mid-collective."""
+        return False
+
+    def _host_batches(self, should_stop=None) -> Iterator[tuple[Any, int]]:
+        """Yield (collated numpy global batch, remainder_if_final_else_0).
+
+        ``should_stop`` (a nullary callable) comes from the background
+        prefetcher's stop flag; the sampler path only advances the shared
+        sampler on generator resume, so the put-boundary check suffices
+        there, but the streaming path checks it per element."""
         if self.global_batch_sampler is None:
-            yield from self._iterable_host_batches()
+            yield from self._iterable_host_batches(should_stop)
             return
         sampler_iter = iter(self.global_batch_sampler)
         prev_group = None
@@ -829,7 +869,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         if prev_group is not None:
             yield self._collate_group(prev_group), self.global_batch_sampler.remainder
 
-    def _iterable_host_batches(self) -> Iterator[tuple[Any, int]]:
+    def _iterable_host_batches(self, should_stop=None) -> Iterator[tuple[Any, int]]:
         """Streaming path: batch an iterable dataset into global batches,
         looping the tail back to the first samples (IterableDatasetShard
         semantics, reference data_loader.py:265)."""
@@ -839,6 +879,8 @@ class DataLoaderShard(DataLoaderStateMixin):
         pending: Optional[list] = None
         pending_remainder = 0
         for element in self.dataset:
+            if should_stop is not None and should_stop():
+                return
             current.append(element)
             if len(current) == size:
                 if pending is not None:
@@ -881,7 +923,9 @@ class DataLoaderShard(DataLoaderStateMixin):
         try:
             if self.num_workers > 0:
                 prefetcher = _BackgroundPrefetcher(
-                    self._host_batches, depth=self.prefetch_size
+                    self._host_batches,
+                    depth=self.prefetch_size,
+                    unbounded_close=self._producer_runs_collectives(),
                 )
                 batches: Iterator = iter(prefetcher)
             else:
@@ -943,18 +987,26 @@ class DataLoaderDispatcher(DataLoaderShard):
     (useful when the dataset lives only on host 0).
     """
 
-    def _host_batches(self):
+    def _producer_runs_collectives(self) -> bool:
+        return PartialState().num_processes > 1
+
+    def _host_batches(self, should_stop=None):
         state = PartialState()
         if state.num_processes == 1:
-            yield from super()._host_batches()
+            yield from super()._host_batches(should_stop)
             return
         from .utils import operations as ops
 
         if state.is_main_process:
-            for host_batch, remainder in super()._host_batches():
+            for host_batch, remainder in super()._host_batches(should_stop):
                 skeleton = ops.get_data_structure(host_batch)
                 ops.broadcast_object_list([("batch", remainder, skeleton)])
                 yield ops.broadcast(host_batch), remainder
+            if should_stop is not None and should_stop():
+                # aborted mid-stream by close(): peers are tearing down too —
+                # emitting the terminal broadcast here would race the next
+                # epoch's collectives from a dying thread
+                return
             ops.broadcast_object_list([("stop", 0, None)])
         else:
             while True:

@@ -100,11 +100,42 @@ def save_file(tensors: dict[str, np.ndarray], path: str,
             write_region(path, arr, base + lo)
 
 
-def _read_header(path: str) -> tuple[dict, int]:
+# safetensors' own Rust core rejects headers above 100 MB; mirror that so a
+# corrupt/hostile u64 length can't drive a multi-GB read
+_MAX_HEADER = 100 << 20
+
+
+def _read_header(path: str) -> tuple[dict, int, int]:
+    fsize = os.path.getsize(path)
     with open(path, "rb") as f:
         (hlen,) = struct.unpack("<Q", f.read(8))
+        if hlen > min(fsize - 8, _MAX_HEADER):
+            raise ValueError(
+                f"corrupt safetensors header in {path}: declared length {hlen} "
+                f"exceeds file size {fsize} (cap {_MAX_HEADER})"
+            )
         header = json.loads(f.read(hlen))
-    return header, 8 + hlen
+    return header, 8 + hlen, fsize
+
+
+def _check_entry(path: str, name: str, meta: dict, base: int, fsize: int) -> tuple[int, int]:
+    """Validate one header entry's offsets against its shape/dtype and the file."""
+    try:
+        lo, hi = meta["data_offsets"]
+        expect = int(np.prod(meta["shape"], dtype=np.int64)) * _np_dtype(meta["dtype"]).itemsize
+    except (KeyError, TypeError, OverflowError) as exc:
+        # unknown dtype / non-numeric shape must honor the same loud-ValueError
+        # contract callers catch for corrupt checkpoints
+        raise ValueError(
+            f"corrupt safetensors entry {name!r} in {path}: {exc!r}"
+        ) from exc
+    if lo < 0 or hi < lo or hi - lo != expect or base + hi > fsize:
+        raise ValueError(
+            f"corrupt safetensors entry {name!r} in {path}: data_offsets "
+            f"[{lo}, {hi}) do not match shape {meta['shape']} × {meta['dtype']} "
+            f"({expect} bytes) within file of {fsize} bytes"
+        )
+    return lo, hi
 
 
 def load_file(path: str, writable: bool = True) -> dict[str, np.ndarray]:
@@ -118,8 +149,10 @@ def load_file(path: str, writable: bool = True) -> dict[str, np.ndarray]:
     only read, e.g. the sharded-checkpoint merge.
     """
     path = os.fspath(path)
-    header, base = _read_header(path)
+    header, base, fsize = _read_header(path)
     entries = [(k, m) for k, m in header.items() if k != "__metadata__"]
+    for name, meta in entries:
+        _check_entry(path, name, meta, base, fsize)
     total = max((m["data_offsets"][1] for _, m in entries), default=0)
     body = np.empty(total, np.uint8)
     if total:
@@ -141,9 +174,9 @@ def load_file(path: str, writable: bool = True) -> dict[str, np.ndarray]:
 def load_tensor(path: str, name: str) -> np.ndarray:
     """Read a single tensor body without touching the rest of the file."""
     path = os.fspath(path)
-    header, base = _read_header(path)
+    header, base, fsize = _read_header(path)
     meta = header[name]
-    lo, hi = meta["data_offsets"]
+    lo, hi = _check_entry(path, name, meta, base, fsize)
     arr = np.empty(meta["shape"], dtype=_np_dtype(meta["dtype"]))
     if hi > lo:
         read_into(path, arr, offset=base + lo)

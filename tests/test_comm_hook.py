@@ -68,6 +68,30 @@ def test_invalid_comm_hook_raises_at_construction():
         )
 
 
+def test_no_comm_hook_value_is_noop():
+    """The reference's DDPCommunicationHookType.NO is a valid no-op default —
+    code passing the explicit NO value (or its enum stringification) must run
+    uncompressed rather than fail at construction (ADVICE r3)."""
+    for value in ("no", "NO", "DDPCommunicationHookType.NO"):
+        acc, model, opt = _setup(value)
+        # caller-owned handler is never mutated
+        assert acc.ddp_handler.comm_hook == value
+        x = nn.Tensor(jnp.ones((2, 8), jnp.float32))
+        acc.backward(model(x).sum())
+        for p in model.parameters():
+            assert p.grad is not None and p.grad.dtype == jnp.float32
+
+
+def test_enum_stringified_fp16_hook_compresses_fp16():
+    """An enum-stringified FP16 value must compress to fp16, not silently
+    fall through to bf16 (round-4 review finding)."""
+    acc, model, opt = _setup("DDPCommunicationHookType.FP16")
+    x = nn.Tensor(jnp.ones((2, 8), jnp.float32))
+    acc.backward(model(x).sum())
+    for p in model.parameters():
+        assert p.grad is not None and p.grad.dtype == jnp.float16
+
+
 def test_accumulation_compresses_only_at_sync():
     """Non-sync micro-steps must keep the running sum in fp32 — re-quantizing
     per micro-step would round away small grads (review finding)."""

@@ -193,6 +193,44 @@ def test_st_pathlike_and_writable_contract(tmp_path):
     np.testing.assert_array_equal(st.load_tensor(path, "b"), tensors["b"])
 
 
+def test_st_rejects_corrupt_header(tmp_path):
+    """A hostile/corrupt header must fail loudly, not drive a huge read
+    (reference: safetensors' Rust core validates both; see ADVICE r3)."""
+    import struct
+
+    from accelerate_tpu.native import st
+
+    path = str(tmp_path / "m.safetensors")
+    tensors = _sample_tensors()
+    st.save_file(tensors, path)
+
+    # header length pointing past the file
+    bogus = str(tmp_path / "hlen.safetensors")
+    with open(path, "rb") as f:
+        raw = f.read()
+    with open(bogus, "wb") as f:
+        f.write(struct.pack("<Q", 1 << 40) + raw[8:])
+    with pytest.raises(ValueError, match="header"):
+        st.load_file(bogus)
+
+    # offsets that disagree with shape x dtype
+    (hlen,) = struct.unpack("<Q", raw[:8])
+    import json
+
+    header = json.loads(raw[8 : 8 + hlen])
+    name = next(k for k in header if k != "__metadata__")
+    header[name]["data_offsets"][1] += 16
+    bad_hdr = json.dumps(header, separators=(",", ":")).encode()
+    bad_hdr += b" " * ((8 - len(bad_hdr) % 8) % 8)
+    bad = str(tmp_path / "offsets.safetensors")
+    with open(bad, "wb") as f:
+        f.write(struct.pack("<Q", len(bad_hdr)) + bad_hdr + raw[8 + hlen :])
+    with pytest.raises(ValueError, match="data_offsets"):
+        st.load_file(bad)
+    with pytest.raises(ValueError, match="data_offsets"):
+        st.load_tensor(bad, name)
+
+
 def test_st_bf16(tmp_path):
     import ml_dtypes
 
