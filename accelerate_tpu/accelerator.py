@@ -503,13 +503,19 @@ class Accelerator:
 
     @contextlib.contextmanager
     def accumulate(self, *models):
-        """Reference accelerator.py:1116: flip sync_gradients on schedule."""
+        """Reference accelerator.py:1116: flip sync_gradients on schedule.
+
+        Works both eagerly and *inside* a ``compile_step`` body: under
+        capture, the owning CapturedStep advances the schedule host-side
+        before every replay (one compiled variant per sync_gradients value —
+        the micro-step program skips optimizer/scheduler work at trace time
+        exactly as the eager path skips it at run time), so the reference's
+        canonical ``with accelerator.accumulate(model):`` loop captures
+        without restructuring."""
         if self._capture_ctx is not None:
-            raise RuntimeError(
-                "accelerator.accumulate() cannot run inside a compile_step "
-                "body; put the `with accelerator.accumulate(...):` block "
-                "around the captured call instead."
-            )
+            self._capture_ctx.on_accumulate(self)
+            yield
+            return
         self._do_sync()
         yield
 

@@ -40,13 +40,31 @@ def current_capture() -> Optional["CaptureContext"]:
 
 
 class CaptureContext:
-    """Book-keeping for one trace: deferred scheduler steps."""
+    """Book-keeping for one trace: deferred scheduler steps, accumulate use."""
 
-    def __init__(self):
+    def __init__(self, owner_advances_accumulate: bool = False):
         self.deferred_scheduler_steps: list[tuple[Any, tuple, dict]] = []
+        # `with accelerator.accumulate(model):` inside the captured body —
+        # legal: the owning CapturedStep advances the schedule host-side once
+        # per replay, so the trace-time flag is already the replay-time flag
+        self.used_accumulate = False
+        self.owner_advances_accumulate = owner_advances_accumulate
+        self._schedule_advanced = False  # sticky: a re-trace must not re-advance
 
     def defer_scheduler(self, scheduler, args, kwargs) -> None:
         self.deferred_scheduler_steps.append((scheduler, args, kwargs))
+
+    def on_accumulate(self, accelerator) -> None:
+        """Called by ``accelerator.accumulate()`` at trace time.
+
+        Only the very first trace of a CapturedStep advances the schedule
+        here (the step's variant wasn't known yet when ``__call__`` computed
+        its cache key); afterwards the CapturedStep owns the advance and
+        trace-time accumulate() is purely a marker."""
+        self.used_accumulate = True
+        if not self.owner_advances_accumulate and not self._schedule_advanced:
+            accelerator._do_sync()
+            self._schedule_advanced = True
 
 
 def _unwrap_tree(tree):
@@ -64,6 +82,10 @@ class CapturedStep:
         self.accelerator = accelerator
         self.fn = fn
         self._cache: dict = {}
+        # None until the first trace reveals whether the body contains
+        # `with accelerator.accumulate(...):`; True → __call__ advances the
+        # accumulation schedule host-side before each replay
+        self._uses_accumulate: Optional[bool] = None
 
     # -- state threading -----------------------------------------------------
     def _collect_state(self) -> dict:
@@ -120,6 +142,12 @@ class CapturedStep:
     # -- call ----------------------------------------------------------------
     def __call__(self, *args):
         acc = self.accelerator
+        if self._uses_accumulate:
+            # body contains `with accelerator.accumulate(...)`: advance the
+            # micro-step schedule here, host-side, so the sync_gradients flag
+            # in the cache key below already selects the right compiled
+            # variant (trace-time accumulate() is then a no-op marker)
+            acc._do_sync()
         args = _unwrap_tree(args)
         flat_args, args_treedef = jax.tree_util.tree_flatten(args)
         import numpy as _np
@@ -137,18 +165,43 @@ class CapturedStep:
         state = self._collect_state()
         if entry is None:
             entry = self._build(key, state, args)
-        jitted, sched_steps, out_is_tensor = entry
+        jitted, ctx = entry
         new_state, out = jitted(state, *flat_args)
         self._writeback(new_state)
+        if self._uses_accumulate is None:
+            # first ever call: the trace just revealed whether the body
+            # accumulates.  If it advanced the schedule mid-trace, the key
+            # computed above used the stale flag — re-file the entry under
+            # the flag the program was actually traced with.
+            self._uses_accumulate = ctx.used_accumulate
+            if ctx.used_accumulate:
+                ctx.owner_advances_accumulate = True
+                new_key = (key[0], key[1], acc.gradient_state.sync_gradients, key[3])
+                if new_key != key:
+                    self._cache[new_key] = entry
+                    self._cache.pop(key, None)
+        elif ctx.used_accumulate != self._uses_accumulate:
+            # a later variant disagrees with the first trace (e.g. the body
+            # enters `accumulate()` only when model.training) — the schedule
+            # advance would silently skip or double-count; fail loudly
+            raise RuntimeError(
+                "compile_step body uses accelerator.accumulate() in some "
+                "trace variants but not others (e.g. behind a training-mode "
+                "or warmup branch); the accumulation schedule cannot track "
+                "such a step. Call accumulate() unconditionally inside the "
+                "body, or move it outside the captured call."
+            )
         # deferred scheduler steps run for real, python-side, every replay
-        for scheduler, s_args, s_kwargs in sched_steps:
+        for scheduler, s_args, s_kwargs in ctx.deferred_scheduler_steps:
             scheduler.step(*s_args, _from_capture_replay=True, **s_kwargs)
         return out
 
     def _build(self, key, state_template, args_template):
         acc = self.accelerator
         _, args_treedef = jax.tree_util.tree_flatten(args_template)
-        captured_ctx = CaptureContext()
+        captured_ctx = CaptureContext(
+            owner_advances_accumulate=bool(self._uses_accumulate)
+        )
 
         # Pin the carried state's layout to the layout it arrives with.
         # jax.jit caches on input *shardings* as well as shapes: left alone,
@@ -203,7 +256,7 @@ class CapturedStep:
                 nn_random.default_rng.set_state(prev_rng_state)
 
         jitted = jax.jit(traced, donate_argnums=(0,))
-        entry = (jitted, captured_ctx.deferred_scheduler_steps, None)
+        entry = (jitted, captured_ctx)
         self._cache[key] = entry
         return entry
 

@@ -197,6 +197,120 @@ def test_compile_step_with_scheduler():
     assert lr_after_2 == pytest.approx(1.0 / 17)
 
 
+def _run_accum_loop(accum_steps, micro, n_samples, capture, with_scheduler=False):
+    """Drive the reference's canonical accumulate loop, optionally captured."""
+    data_x = np.random.default_rng(0).normal(size=(n_samples, 4)).astype(np.float32)
+    data_y = np.random.default_rng(1).normal(size=(n_samples,)).astype(np.float32)
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(gradient_accumulation_steps=accum_steps)
+    model = nn.Linear(4, 1)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    sched = optim.LambdaLR(opt, lambda s: 1.0 / (s + 1)) if with_scheduler else None
+    if sched is not None:
+        model, opt, sched = acc.prepare(model, opt, sched)
+    else:
+        model, opt = acc.prepare(model, opt)
+
+    def step_fn(xb, yb):
+        # the reference's UNMODIFIED canonical loop body (accelerator.py:1116)
+        with acc.accumulate(model):
+            pred = model(Tensor(xb)).squeeze(-1)
+            loss = F.mse_loss(pred, Tensor(yb))
+            acc.backward(loss)
+            opt.step()
+            if sched is not None:
+                sched.step()
+            opt.zero_grad()
+        return loss
+
+    step = acc.compile_step(step_fn) if capture else step_fn
+    losses = []
+    for i in range(n_samples // micro):
+        xb = jnp.asarray(data_x[i * micro : (i + 1) * micro])
+        yb = jnp.asarray(data_y[i * micro : (i + 1) * micro])
+        losses.append(float(step(xb, yb)))
+    return losses, np.asarray(model.weight.data), float(opt.optimizer.lr)
+
+
+def test_accumulate_inside_compile_step_matches_eager():
+    """`with accelerator.accumulate(model):` INSIDE the captured body must
+    reproduce the eager loop exactly — including the trailing half-finished
+    accumulation window (7 micro-steps, num_steps=3: two updates + one
+    pending micro-grad)."""
+    eager = _run_accum_loop(3, 2, 14, capture=False)
+    captured = _run_accum_loop(3, 2, 14, capture=True)
+    np.testing.assert_allclose(captured[0], eager[0], rtol=1e-4)
+    np.testing.assert_allclose(captured[1], eager[1], rtol=1e-4)
+
+
+def test_accumulate_inside_compile_step_scheduler_parity():
+    """Scheduler inside the captured accumulate body steps only at sync
+    boundaries, same as eager."""
+    eager = _run_accum_loop(2, 2, 8, capture=False, with_scheduler=True)
+    captured = _run_accum_loop(2, 2, 8, capture=True, with_scheduler=True)
+    assert captured[2] == pytest.approx(eager[2])
+    np.testing.assert_allclose(captured[1], eager[1], rtol=1e-4)
+
+
+def test_accumulate_outside_captured_call_still_works():
+    """The previously-documented pattern (accumulate wrapping the captured
+    call) must behave identically to putting it inside."""
+    data_x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    data_y = np.random.default_rng(1).normal(size=(8,)).astype(np.float32)
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(gradient_accumulation_steps=2)
+    model = nn.Linear(4, 1)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(xb, yb):
+        pred = model(Tensor(xb)).squeeze(-1)
+        loss = F.mse_loss(pred, Tensor(yb))
+        acc.backward(loss)
+        opt.step()
+        opt.zero_grad()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    for i in range(4):
+        with acc.accumulate(model):
+            step(jnp.asarray(data_x[i * 2 : (i + 1) * 2]), jnp.asarray(data_y[i * 2 : (i + 1) * 2]))
+    w_outside = np.asarray(model.weight.data)
+    inside = _run_accum_loop(2, 2, 8, capture=True)
+    np.testing.assert_allclose(w_outside, inside[1], rtol=1e-4)
+
+
+def test_accumulate_variant_disagreement_raises():
+    """A body that accumulates only in SOME trace variants (e.g. behind a
+    training-mode branch) must fail loudly, not silently corrupt the
+    micro-step schedule (round-4 review finding)."""
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(gradient_accumulation_steps=2)
+    model = nn.Linear(4, 1)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(xb):
+        if model.training:
+            with acc.accumulate(model):
+                loss = model(Tensor(xb)).sum()
+                acc.backward(loss)
+                opt.step()
+                opt.zero_grad()
+            return loss
+        return model(Tensor(xb)).sum()
+
+    step = acc.compile_step(step_fn)
+    model.eval()
+    step(jnp.ones((2, 4)))  # first trace: no accumulate
+    model.train()
+    with pytest.raises(RuntimeError, match="accumulate"):
+        step(jnp.ones((2, 4)))
+
+
 def test_gather_for_metrics_truncates_remainder():
     import accelerate_tpu
 
