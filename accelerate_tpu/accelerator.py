@@ -617,7 +617,8 @@ class Accelerator:
             all_tensors = True
         except TypeError:
             all_tensors = False
-        if use_gather_object or not all_tensors:
+        used_object_path = use_gather_object or not all_tensors
+        if used_object_path:
             data = ops.gather_object(input_data)
         else:
             data = self.gather(
@@ -625,6 +626,11 @@ class Accelerator:
             )
         if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
             remainder = self.gradient_state.remainder
+            if used_object_path:
+                # the flattened object list carries the sample count in its
+                # own length (reference accelerator.py:2659 slices the list
+                # itself when use_gather_object)
+                return data[: len(data) - remainder]
 
             def _truncate(t):
                 if getattr(t, "ndim", 0) == 0:
@@ -649,7 +655,7 @@ class Accelerator:
         self.flag_tensor = 1
 
     def check_trigger(self) -> bool:
-        flags = ops.gather_object(self.flag_tensor or 0)
+        flags = ops.gather_object([self.flag_tensor or 0])
         if any(bool(f) for f in flags):
             self.flag_tensor = None
             return True

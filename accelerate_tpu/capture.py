@@ -50,9 +50,15 @@ class CaptureContext:
         self.used_accumulate = False
         self.owner_advances_accumulate = owner_advances_accumulate
         self._schedule_advanced = False  # sticky: a re-trace must not re-advance
+        self._accumulate_calls_in_trace = 0
 
     def defer_scheduler(self, scheduler, args, kwargs) -> None:
         self.deferred_scheduler_steps.append((scheduler, args, kwargs))
+
+    def begin_trace(self) -> None:
+        """Reset per-trace bookkeeping (a re-trace must not double-count)."""
+        self.deferred_scheduler_steps.clear()
+        self._accumulate_calls_in_trace = 0
 
     def on_accumulate(self, accelerator) -> None:
         """Called by ``accelerator.accumulate()`` at trace time.
@@ -61,6 +67,18 @@ class CaptureContext:
         here (the step's variant wasn't known yet when ``__call__`` computed
         its cache key); afterwards the CapturedStep owns the advance and
         trace-time accumulate() is purely a marker."""
+        self._accumulate_calls_in_trace += 1
+        if self._accumulate_calls_in_trace > 1:
+            # eager would advance the schedule once per block; a compiled
+            # program advances once per CALL and bakes a single
+            # sync_gradients value into the trace — silently different math
+            raise RuntimeError(
+                "compile_step body enters accelerator.accumulate() more than "
+                "once; the captured program can only advance the "
+                "accumulation schedule once per call. Process one "
+                "micro-batch per captured call (loop outside), or capture a "
+                "step without accumulate() and drive no_sync() manually."
+            )
         self.used_accumulate = True
         if not self.owner_advances_accumulate and not self._schedule_advanced:
             accelerator._do_sync()
@@ -242,7 +260,7 @@ class CapturedStep:
             acc._capture_ctx = captured_ctx
             # re-traces (e.g. after an input-layout change) must not double-
             # count python side effects recorded during a previous trace
-            captured_ctx.deferred_scheduler_steps.clear()
+            captured_ctx.begin_trace()
             try:
                 self._bind_state(state)
                 nn_random.default_rng.set_key(state["rng"])

@@ -35,9 +35,9 @@ def main():
     out = np.asarray(broadcast(value))
     assert np.allclose(out, 0.0), f"broadcast failed: {out}"
 
-    # gather_object returns one entry per process
+    # gather_object flattens the per-process lists (reference semantics)
     objs = gather_object([state.process_index])
-    assert [0] in objs and len(objs) == state.num_processes
+    assert 0 in objs and len(objs) == state.num_processes
 
     # pad_across_processes makes ragged dims uniform
     ragged = np.ones((2 + state.process_index % 2, 3), dtype=np.float32)

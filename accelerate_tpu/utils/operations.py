@@ -274,10 +274,14 @@ def gather(tensor):
 
 
 def gather_object(object: Any):
-    """Gather arbitrary picklable objects from all processes into a list
-    (reference :445)."""
+    """Gather picklable objects from all processes (reference :445).
+
+    Reference semantics exactly: each process passes a *list* and receives
+    the flattened concatenation over processes (`[x for y in out for x in y]`,
+    reference operations.py:436-441); a single process gets its object back
+    unchanged (reference :460)."""
     if _num_processes() == 1:
-        return [object]
+        return object
     from jax.experimental import multihost_utils
 
     payload = np.frombuffer(pickle.dumps(object), dtype=np.uint8)
@@ -287,10 +291,11 @@ def gather_object(object: Any):
     padded = np.zeros(max_size, dtype=np.uint8)
     padded[: payload.size] = payload
     gathered = multihost_utils.process_allgather(padded)
-    return [
+    per_process = [
         pickle.loads(gathered[i, : int(all_sizes[i, 0])].tobytes())
         for i in range(gathered.shape[0])
     ]
+    return [x for y in per_process for x in y]
 
 
 @verify_operation

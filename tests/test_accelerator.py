@@ -311,6 +311,50 @@ def test_accumulate_variant_disagreement_raises():
         step(jnp.ones((2, 4)))
 
 
+def test_double_accumulate_in_captured_body_raises():
+    """Two accumulate blocks in one captured body would bake a single
+    sync_gradients value into a program eager advances twice — loud error
+    (round-4 review finding)."""
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(gradient_accumulation_steps=2)
+    model = nn.Linear(4, 1)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(xa, xb):
+        for xv in (xa, xb):
+            with acc.accumulate(model):
+                loss = model(Tensor(xv)).sum()
+                acc.backward(loss)
+                opt.step()
+                opt.zero_grad()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    with pytest.raises(RuntimeError, match="more than"):
+        step(jnp.ones((2, 4)), jnp.zeros((2, 4)))
+
+
+def test_gather_for_metrics_object_path_truncates_remainder():
+    """The object-list path must slice the flattened list itself (reference
+    accelerator.py:2659); per-leaf truncation is a no-op on strings."""
+    Accelerator._reset_state()
+    acc = Accelerator()
+
+    class _TailDL:  # duck-typed loader at its uneven tail
+        end_of_dataloader = True
+        remainder = 2
+
+    tail = _TailDL()
+    acc.gradient_state._add_dataloader(tail)
+    try:
+        out = acc.gather_for_metrics(["a", "b", "c", "d"], use_gather_object=True)
+        assert out == ["a", "b"]
+    finally:
+        acc.gradient_state._remove_dataloader(tail)
+
+
 def test_gather_for_metrics_truncates_remainder():
     import accelerate_tpu
 

@@ -67,7 +67,8 @@ def test_single_process_collectives_are_identity():
     x = {"t": jnp.arange(4)}
     np.testing.assert_array_equal(ops.gather(x)["t"], np.arange(4))
     np.testing.assert_array_equal(ops.broadcast(x)["t"], np.arange(4))
-    assert ops.gather_object(["obj"]) == [["obj"]]
+    # reference semantics: single process returns the object unchanged
+    assert ops.gather_object(["obj"]) == ["obj"]
     lst = [1, 2]
     assert ops.broadcast_object_list(lst) == [1, 2]
 
