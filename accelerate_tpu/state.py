@@ -418,6 +418,14 @@ class AcceleratorState:
         axis_sizes = parallelism_config.axis_sizes(self._partial.num_devices)
         self.mesh = make_mesh(axis_sizes)
 
+    def __repr__(self) -> str:
+        """Reference AcceleratorState.__repr__ (state.py:995): the PartialState
+        report plus precision — and, TPU-side, the resolved device mesh."""
+        out = self._partial.__repr__() + f"Mixed precision type: {self.mixed_precision}\n"
+        if self.initialized:
+            out += f"Mesh: {dict(self.mesh.shape)}\n"
+        return out
+
     # Everything PartialState exposes is reachable here too.
     def __getattr__(self, name: str):
         partial = self.__dict__.get("_partial")

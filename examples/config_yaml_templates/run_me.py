@@ -1,0 +1,21 @@
+"""Print how the launched environment resolved (reference
+/root/reference/examples/config_yaml_templates/run_me.py:1): every template
+in this folder can be driven through this script to see the mesh, precision,
+and process topology it produces."""
+
+import os
+import sys
+
+sys.path.append(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+from accelerate_tpu import Accelerator  # noqa: E402
+
+accelerator = Accelerator()
+accelerator.print(
+    f"Accelerator state from the current environment:\n{accelerator.state}"
+)
+if accelerator.fp8_recipe_handler is not None:
+    accelerator.print(f"FP8 config:\n{accelerator.fp8_recipe_handler}")
+accelerator.end_training()
