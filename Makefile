@@ -12,7 +12,7 @@ test_core:
 	python -m pytest tests/test_accelerator.py tests/test_state.py \
 	  tests/test_operations.py tests/test_data_loader.py tests/test_native.py \
 	  tests/test_data_loader_grid.py tests/test_num_workers.py \
-	  tests/test_optimizer.py \
+	  tests/test_optimizer.py tests/test_optimizer_offload.py \
 	  tests/test_capture_stability.py tests/test_precision.py \
 	  tests/test_fp16_capture.py tests/test_autocast.py \
 	  tests/test_comm_hook.py tests/test_config_knobs.py \
@@ -32,7 +32,8 @@ test_parallel:
 	  tests/test_flash_attention.py tests/test_sliding_window.py -q
 
 test_cli:
-	python -m pytest tests/test_cli.py tests/test_menu.py tests/test_launcher.py -q
+	python -m pytest tests/test_cli.py tests/test_menu.py tests/test_launcher.py \
+	  tests/test_config_templates.py -q
 
 test_big_modeling:
 	python -m pytest tests/test_big_modeling.py tests/test_hooks.py \
@@ -44,8 +45,10 @@ test_checkpoint:
 test_examples:
 	python -m pytest tests/test_examples.py tests/test_external_scripts.py -q
 
+# the slow split: subprocess launches + big compiles, partitioned out of
+# the default suite by the `slow` marker; CI runs both targets
 test_slow:
-	RUN_SLOW=1 python -m pytest tests/ -q
+	RUN_SLOW=1 python -m pytest tests/ -q -m slow
 
 bench:
 	python bench.py

@@ -320,8 +320,12 @@ class Accelerator:
         # params, so without this the opt state stays on the old layout and
         # ZeRO saves no memory (reference FSDP shards optimizer state too,
         # accelerator.py:1555-1679)
+        offload_opt = bool(
+            self.state.fsdp_plugin is not None
+            and getattr(self.state.fsdp_plugin, "offload_optimizer", False)
+        )
         for opt in self._optimizers:
-            opt.optimizer.relayout_for_sharded_params()
+            opt.optimizer.relayout_for_sharded_params(offload_to_host=offload_opt)
         return result[0] if len(result) == 1 else tuple(result)
 
     def _prepare_one(self, obj):

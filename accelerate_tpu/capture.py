@@ -290,5 +290,10 @@ class CapturedStep:
                 named[name].grad = g
         for o, s in zip(acc._optimizers, new_state["opt"]):
             o.optimizer.bind_capture_state(s)
+            # host-offloaded optimizer state: the compiled program's outputs
+            # land in HBM; re-pin to pinned_host so the saving is real and
+            # the next call's input placement (and thus the jit cache key)
+            # stays fixed.  No-op unless offload was requested.
+            o.optimizer.reoffload_state_to_host()
         if new_state.get("scaler") is not None and acc.scaler is not None:
             acc.scaler.bind_capture_state(new_state["scaler"])

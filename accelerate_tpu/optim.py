@@ -77,6 +77,7 @@ class Optimizer:
         if closure is not None:
             closure()
         self._ensure_master()
+        self.stage_state_on_device()
         # update math in fp32 against master weights (mixed-precision safe)
         params = [
             m if m is not None else p.data
@@ -99,39 +100,44 @@ class Optimizer:
                 p.data = new
         self._step_count += 1
 
-    def relayout_for_sharded_params(self) -> None:
-        """Move optimizer state + fp32 masters onto the params' shardings.
+    def _host_sharding(self, sharding):
+        """The same mesh layout, but resident in pinned host memory."""
+        return jax.sharding.NamedSharding(
+            sharding.mesh, sharding.spec, memory_kind="pinned_host"
+        )
 
-        ``tx.init`` runs at construction time, *before* ``Accelerator.prepare``
-        lays params out on the mesh — so the Adam moments (and any master
-        copies already created) are committed to the pre-sharding layout.  For
-        ZeRO semantics (reference FSDP optimizer-state sharding,
-        accelerator.py:1555-1679) every per-param state leaf must live on the
-        same ``fsdp``/``tp`` shards as its parameter.  Optax states keep
-        per-param leaves in the same container the params were passed in (a
-        list here), so each leaf's tree path carries a ``SequenceKey`` whose
-        index identifies the owning parameter — we match on that plus an exact
-        shape check (factored states like Adafactor's keep their own layout).
+    def stage_state_on_device(self) -> None:
+        """Move host-offloaded state into device memory for the update math.
+
+        XLA refuses mixed-memory-space operands, so the compiled (or eager)
+        update must read device-resident moments/masters; with offload on,
+        this transfer is traced into the step program (host→HBM stream
+        overlapped by XLA).  No-op without offload — device→device
+        ``device_put`` is free and works on tracers too.
         """
-        self._ensure_master()
-        shardings = [p.data.sharding for p in self.param_list]
+        if not getattr(self, "_offload_host", False):
+            return
+        to_dev = lambda t: jax.device_put(t, jax.memory.Space.Device)  # noqa: E731
+        self.master_params = [
+            to_dev(m) if m is not None else None for m in self.master_params
+        ]
+        self.opt_state = jax.tree_util.tree_map(to_dev, self.opt_state)
+
+    def _map_per_param_state(self, per_param_fn, scalar_fn=None) -> None:
+        """Apply ``per_param_fn(leaf, param_index)`` to every opt-state leaf
+        owned by a parameter, and ``scalar_fn(leaf)`` to 0-d array leaves.
+
+        The ownership rule (shared by mesh relayout and host offload): optax
+        keeps per-param leaves in the same list container the params were
+        passed in, so a leaf's tree path carries a ``SequenceKey`` whose
+        index identifies the owning parameter — matched on index plus an
+        exact shape check (factored states like Adafactor's keep their own
+        layout).  Masters are mapped with the same per-param rule.
+        """
         shapes = [tuple(p.shape) for p in self.param_list]
         for i, m in enumerate(self.master_params):
             if m is not None:
-                self.master_params[i] = jax.device_put(m, shardings[i])
-
-        # scalar leaves (step counters, hyperparams) must be *committed* too:
-        # jax.jit caches on argument placement, and an uncommitted host scalar
-        # on step 1 vs the same scalar committed by step 1's donated output
-        # re-traces the entire train step on step 2
-        replicated = None
-        for s in shardings:
-            if isinstance(s, jax.sharding.NamedSharding):
-                replicated = jax.sharding.NamedSharding(
-                    s.mesh, jax.sharding.PartitionSpec()
-                )
-                break
-
+                self.master_params[i] = per_param_fn(m, i)
         leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(self.opt_state)
         new_leaves = []
         for path, leaf in leaves_with_path:
@@ -146,17 +152,74 @@ class Optimizer:
                 and hasattr(leaf, "shape")
                 and tuple(leaf.shape) == shapes[idx]
             ):
-                leaf = jax.device_put(leaf, shardings[idx])
-            elif (
-                replicated is not None
-                and isinstance(leaf, jax.Array)
-                and leaf.ndim == 0
-            ):
-                leaf = jax.device_put(leaf, replicated)
+                leaf = per_param_fn(leaf, idx)
+            elif scalar_fn is not None and isinstance(leaf, jax.Array) and leaf.ndim == 0:
+                leaf = scalar_fn(leaf)
             new_leaves.append(leaf)
-        self.opt_state = jax.tree_util.tree_unflatten(
-            treedef, new_leaves
+        self.opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def reoffload_state_to_host(self) -> None:
+        """Re-pin per-param optimizer state + masters to pinned host memory.
+
+        Idempotent; called after every optimizer update when
+        ``offload_to_host`` was requested at relayout time — a compiled (or
+        eager) step writes its new state to device HBM, and leaving it there
+        would both lose the memory saving and flip the next call's input
+        placement (forcing a jit re-trace).  XLA streams the arrays back in
+        over PCIe/DMA at the next update.
+        """
+        if not getattr(self, "_offload_host", False):
+            return
+        shardings = [p.data.sharding for p in self.param_list]
+
+        def to_host(leaf, i):
+            if isinstance(shardings[i], jax.sharding.NamedSharding):
+                return jax.device_put(leaf, self._host_sharding(shardings[i]))
+            return leaf
+
+        self._map_per_param_state(to_host)
+
+    def relayout_for_sharded_params(self, offload_to_host: bool = False) -> None:
+        """Move optimizer state + fp32 masters onto the params' shardings.
+
+        ``tx.init`` runs at construction time, *before* ``Accelerator.prepare``
+        lays params out on the mesh — so the Adam moments (and any master
+        copies already created) are committed to the pre-sharding layout.  For
+        ZeRO semantics (reference FSDP optimizer-state sharding,
+        accelerator.py:1555-1679) every per-param state leaf must live on the
+        same ``fsdp``/``tp`` shards as its parameter.  Optax states keep
+        per-param leaves in the same container the params were passed in (a
+        list here), so each leaf's tree path carries a ``SequenceKey`` whose
+        index identifies the owning parameter — we match on that plus an exact
+        shape check (factored states like Adafactor's keep their own layout).
+        """
+        self._ensure_master()
+        self._offload_host = bool(offload_to_host)
+        shardings = [p.data.sharding for p in self.param_list]
+
+        def to_param_layout(leaf, i):
+            s = shardings[i]
+            if self._offload_host and isinstance(s, jax.sharding.NamedSharding):
+                s = self._host_sharding(s)
+            return jax.device_put(leaf, s)
+
+        # scalar leaves (step counters, hyperparams) must be *committed* too:
+        # jax.jit caches on argument placement, and an uncommitted host scalar
+        # on step 1 vs the same scalar committed by step 1's donated output
+        # re-traces the entire train step on step 2
+        replicated = None
+        for s in shardings:
+            if isinstance(s, jax.sharding.NamedSharding):
+                replicated = jax.sharding.NamedSharding(
+                    s.mesh, jax.sharding.PartitionSpec()
+                )
+                break
+        scalar_fn = (
+            (lambda leaf: jax.device_put(leaf, replicated))
+            if replicated is not None
+            else None
         )
+        self._map_per_param_state(to_param_layout, scalar_fn)
 
     # -- functional bridge (used by Accelerator's step capture) --------------
     def capture_state(self) -> dict:

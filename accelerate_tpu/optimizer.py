@@ -141,6 +141,14 @@ class AcceleratedOptimizer:
             self._step_with_scaler(closure)
         else:
             self.optimizer.step(closure)
+        from .capture import current_capture
+
+        if current_capture() is None:
+            # eager: the update left the new moments/masters in device HBM —
+            # re-pin them to host if offload was requested (a no-op
+            # otherwise).  Under capture this runs on tracers, so the
+            # CapturedStep does it after each replay instead.
+            self.optimizer.reoffload_state_to_host()
 
     def _step_with_scaler(self, closure) -> None:
         """fp16 step: finite-check, unscale, conditionally apply, update scale.
@@ -161,6 +169,10 @@ class AcceleratedOptimizer:
             finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
 
         opt._ensure_master()
+        # host-offloaded state must be device-resident BEFORE the snapshot:
+        # the jnp.where select below mixes old and new state, and XLA
+        # refuses mixed memory spaces
+        opt.stage_state_on_device()
         params_before = [p.data for p in opt.param_list]
         masters_before = list(opt.master_params)
         opt_state_before = opt.opt_state

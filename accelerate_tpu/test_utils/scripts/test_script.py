@@ -216,13 +216,175 @@ def test_save_load_roundtrip():
 
 
 def test_trigger():
+    """Trigger sync ACROSS ranks: only main raises the flag, every process
+    must see it at the check (reference test_script.py:786)."""
     acc = Accelerator()
     acc.flag_tensor = None
     assert acc.check_trigger() is False
-    acc.set_trigger()
-    assert acc.check_trigger() is True
+    if acc.is_main_process:
+        acc.set_trigger()
+    assert acc.check_trigger() is True, "trigger set on main was not seen here"
     assert acc.check_trigger() is False  # reset after firing
     print("trigger ok")
+
+
+def process_execution_check():
+    """main_process_first ordering + on_*_process decorators (reference
+    test_script.py:93-165)."""
+    import contextlib
+    import io
+    import time
+
+    import socket
+
+    from accelerate_tpu.utils import operations as ops
+
+    acc = Accelerator()
+    # the file-ordering half assumes a shared filesystem; on a real pod each
+    # host has its own disk, so gate it on every rank seeing one hostname
+    hosts = ops.gather_object([socket.gethostname()])
+    if len(set(hosts)) == 1:
+        path = os.path.join(
+            os.environ.get("ACCELERATE_TPU_LAUNCH_TMP", "."),
+            "check_main_process_first.txt",
+        )
+        with acc.main_process_first():
+            if acc.is_main_process:
+                time.sleep(0.1)  # ensure main would lose a pure race
+                with open(path, "a+") as f:
+                    f.write("Currently in the main process\n")
+            else:
+                with open(path, "a+") as f:
+                    f.write("Now on another process\n")
+        acc.wait_for_everyone()
+        if acc.is_main_process:
+            try:
+                with open(path) as f:
+                    text = f.read()
+                assert text.startswith("Currently in the main process\n"), text
+                assert text.count("Now on another process\n") == acc.num_processes - 1, text
+            finally:
+                os.unlink(path)
+        acc.wait_for_everyone()
+
+    f = io.StringIO()
+    with contextlib.redirect_stdout(f):
+        acc.on_main_process(lambda: print("from main"))()
+    assert (f.getvalue().strip() == "from main") == acc.is_main_process
+
+    f = io.StringIO()
+    with contextlib.redirect_stdout(f):
+        acc.on_last_process(lambda: print("from last"))()
+    assert (f.getvalue().strip() == "from last") == acc.is_last_process
+
+    for idx in range(acc.num_processes):
+        f = io.StringIO()
+        with contextlib.redirect_stdout(f):
+            acc.on_process(lambda: print(f"from {idx}"), process_index=idx)()
+        assert (f.getvalue().strip() == f"from {idx}") == (acc.process_index == idx)
+    print("process execution ok")
+
+
+def test_split_between_processes_list():
+    """Reference test_script.py:698: even split, and padding gives the last
+    process the extra items."""
+    import math
+
+    state = PartialState()
+    data = list(range(2 * state.num_processes))
+    with state.split_between_processes(data) as results:
+        assert len(results) == 2, f"rank {state.process_index}: {len(results)}"
+
+    data = list(range(3 * state.num_processes - 1))
+    with state.split_between_processes(data, apply_padding=True) as results:
+        if state.is_last_process:
+            per = math.ceil(len(data) / state.num_processes)
+            assert len(results) == per, f"padding broke: {len(results)} != {per}"
+    state.wait_for_everyone()
+    print("split_between_processes list ok")
+
+
+def test_split_between_processes_nested_dict():
+    """Reference test_script.py:717: dict of list/str/array splits leafwise
+    and consistently."""
+    state = PartialState()
+    n = 2 * state.num_processes
+    a = list(range(n))
+    b = [chr(ord("a") + i) for i in range(n)]
+    c = np.arange(n, dtype=np.float32)
+    with state.split_between_processes({"a": a, "b": b, "c": c}) as results:
+        lo = 2 * state.process_index
+        assert results["a"] == a[lo : lo + 2], results["a"]
+        assert results["b"] == b[lo : lo + 2], results["b"]
+        assert np.allclose(np.asarray(results["c"]), c[lo : lo + 2]), results["c"]
+    state.wait_for_everyone()
+    print("split_between_processes nested dict ok")
+
+
+def test_split_between_processes_tensor():
+    """Reference test_script.py:755: array inputs split on the batch dim."""
+    state = PartialState()
+    data = np.arange(4 * state.num_processes).reshape(state.num_processes, 4)
+    with state.split_between_processes(data) as results:
+        expect = data[state.process_index : state.process_index + 1]
+        assert np.allclose(np.asarray(results), expect), np.asarray(results)
+    state.wait_for_everyone()
+    print("split_between_processes tensor ok")
+
+
+def test_split_between_processes_evenly():
+    """Reference test_script.py:768: 17 items — the first `extras` ranks get
+    one more item each, nothing is lost."""
+    state = PartialState()
+    data = list(range(17))
+    per, extras = divmod(len(data), state.num_processes)
+    with state.split_between_processes(data) as results:
+        want = per + 1 if state.process_index < extras else per
+        assert len(results) == want, f"rank {state.process_index}: {len(results)} != {want}"
+    state.wait_for_everyone()
+    print("split_between_processes evenly ok")
+
+
+def test_print_in_order():
+    """in_order logging: every rank prints, outputs don't interleave
+    (reference print_in_order via state.print / logging in_order)."""
+    acc = Accelerator()
+    for idx in range(acc.num_processes):
+        if acc.process_index == idx:
+            print(f"rank {idx} reporting in order")
+        acc.wait_for_everyone()
+
+
+def test_uneven_tail_grid():
+    """(batch_size × even_batches × split_batches) grid under the REAL
+    launcher (reference dl_preparation_check/central grids,
+    test_script.py:192-316): coverage and duplication rules hold in every
+    cell."""
+    acc = Accelerator()
+    shards = max(1, acc.state.num_batch_shards)
+    for n in (18, 22):
+        for bs in sorted({2, 4, shards}):
+            for even_batches in (True, False):
+                for split_batches in (True, False):
+                    if split_batches and bs % shards != 0:
+                        continue  # split mode needs a divisible global batch
+                    dl = prepare_data_loader(
+                        dataset=_dataset(n),
+                        batch_size=bs,
+                        even_batches=even_batches,
+                        split_batches=split_batches,
+                    )
+                    seen = _collect_seen(acc, dl)
+                    cell = f"n={n} bs={bs} even={even_batches} split={split_batches}"
+                    if even_batches:
+                        assert set(seen) == set(range(n)), f"{cell}: coverage broken"
+                        gbs = dl.total_batch_size
+                        want = ((n + gbs - 1) // gbs) * gbs
+                        assert len(seen) == want, f"{cell}: {len(seen)} != {want}"
+                    else:
+                        assert len(seen) == len(set(seen)), f"{cell}: duplicated"
+                        assert set(seen) <= set(range(n)), f"{cell}: out of range"
+    print("uneven-tail grid ok")
 
 
 def main():
@@ -232,9 +394,16 @@ def main():
         print(f"** Testing on {state.num_devices} device(s), "
               f"{state.num_processes} process(es) **")
     test_state()
+    process_execution_check()
+    test_print_in_order()
+    test_split_between_processes_list()
+    test_split_between_processes_nested_dict()
+    test_split_between_processes_tensor()
+    test_split_between_processes_evenly()
     test_rng_sync()
     test_dataloader_coverage()
     test_dataloader_even_batches_off()
+    test_uneven_tail_grid()
     test_dispatch_loader()
     test_skip_first_batches()
     test_gather_for_metrics()

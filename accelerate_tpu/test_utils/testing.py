@@ -48,8 +48,22 @@ def _parse_flag_from_env(key: str, default: bool = False) -> bool:
 _run_slow_tests = _parse_flag_from_env("RUN_SLOW", default=False)
 
 
+def are_slow_tests_enabled() -> bool:
+    """True when RUN_SLOW=1 — for module-level ``pytestmark`` gates."""
+    return _run_slow_tests
+
+
 def slow(test_case):
-    """Skip unless RUN_SLOW=1 (reference testing.py:245)."""
+    """Skip unless RUN_SLOW=1 (reference testing.py:245).
+
+    Also tags the pytest ``slow`` marker so ``pytest -m "not slow"`` /
+    ``-m slow`` select the same split the env flag gates."""
+    try:
+        import pytest
+
+        test_case = pytest.mark.slow(test_case)
+    except ImportError:  # harness is importable without pytest
+        pass
     return unittest.skipUnless(_run_slow_tests, "test is slow")(test_case)
 
 

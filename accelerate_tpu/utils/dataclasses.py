@@ -253,6 +253,13 @@ class FullyShardedDataParallelPlugin:
     param_dtype: Optional[str] = None
     reduce_dtype: Optional[str] = None
     activation_checkpointing: bool = False
+    # host-offloaded optimizer state (reference dataclasses.py:1019
+    # offload_optimizer via DeepSpeed; torch FSDP CPUOffload): Adam moments
+    # and fp32 masters live in pinned host memory, streamed to the chip only
+    # for the update — HBM then holds params+grads+activations only.  Pays a
+    # host<->device round-trip per sync step; for models whose optimizer
+    # state doesn't fit even fsdp-sharded.
+    offload_optimizer: bool = False
 
     _DTYPES = {"bf16": "bfloat16", "fp16": "float16", "fp32": "float32",
                "bfloat16": "bfloat16", "float16": "float16", "float32": "float32"}
@@ -278,6 +285,8 @@ class FullyShardedDataParallelPlugin:
         ).upper()
         if "FSDP_OFFLOAD_PARAMS" in env:
             self.cpu_offload = bool(str_to_bool(env["FSDP_OFFLOAD_PARAMS"]))
+        if "FSDP_OFFLOAD_OPTIMIZER" in env:
+            self.offload_optimizer = bool(str_to_bool(env["FSDP_OFFLOAD_OPTIMIZER"]))
         self.state_dict_type = env.get(
             "FSDP_STATE_DICT_TYPE", self.state_dict_type
         ).upper()

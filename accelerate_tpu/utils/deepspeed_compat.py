@@ -104,15 +104,39 @@ def from_deepspeed_config(
         # and would silently override the ds_config-derived stage
         fsdp_plugin.sharding_strategy = _STAGE_TO_STRATEGY[stage]
 
-    for knob in ("offload_param.device", "offload_optimizer.device"):
-        dev = _get(cfg, f"zero_optimization.{knob}")
-        if dev in ("cpu", "nvme"):
+    opt_dev = _get(cfg, "zero_optimization.offload_optimizer.device")
+    if opt_dev in ("cpu", "nvme"):
+        # ZeRO-offload of *optimizer state* has a real TPU mechanism: the
+        # moments/masters live in pinned host memory and stream to the chip
+        # for the update (FullyShardedDataParallelPlugin.offload_optimizer).
+        # nvme maps to host too — TPU VMs have no per-chip NVMe tier.
+        if fsdp_plugin is not None:  # stage > 0: the plugin carries the stage
+            fsdp_plugin.offload_optimizer = True
+            if opt_dev == "nvme":
+                warnings.warn(
+                    "ds_config offload_optimizer.device='nvme' maps to pinned "
+                    "host memory on TPU (no per-chip NVMe tier)",
+                    stacklevel=2,
+                )
+        else:
+            # stage 0 = pure DDP: fabricating an FSDP plugin here would
+            # silently FULL_SHARD params the config never asked to shard
             warnings.warn(
-                f"ds_config requests zero_optimization.{knob}={dev!r}; TPU HBM "
-                "sharding replaces ZeRO offload — use big_modeling host/disk "
-                "offload (cpu_offload/disk_offload) for models beyond HBM",
+                "ds_config requests offload_optimizer with zero stage 0; "
+                "optimizer-state host offload rides the fsdp plugin — set "
+                "zero stage >= 1 (or pass FullyShardedDataParallelPlugin("
+                "offload_optimizer=True) with your intended strategy)",
                 stacklevel=2,
             )
+    param_dev = _get(cfg, "zero_optimization.offload_param.device")
+    if param_dev in ("cpu", "nvme"):
+        warnings.warn(
+            f"ds_config requests zero_optimization.offload_param.device="
+            f"{param_dev!r}; TPU HBM sharding replaces ZeRO param offload — "
+            "use big_modeling host/disk offload (cpu_offload/disk_offload) "
+            "for models beyond HBM",
+            stacklevel=2,
+        )
 
     if _resolve_auto(_get(cfg, "bf16.enabled"), False):
         mixed_precision = "bf16"

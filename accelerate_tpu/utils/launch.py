@@ -79,6 +79,8 @@ def prepare_launch_environment(args: Any) -> dict[str, str]:
             env["FSDP_ACTIVATION_CHECKPOINTING"] = "true"
         if g("fsdp_offload_params"):
             env["FSDP_OFFLOAD_PARAMS"] = "true"
+        if g("fsdp_offload_optimizer"):
+            env["FSDP_OFFLOAD_OPTIMIZER"] = "true"
 
     # make this accelerate_tpu importable in the child even when running from
     # a source checkout (not pip-installed)

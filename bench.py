@@ -75,7 +75,30 @@ def _emit_once(payload: dict) -> bool:
         return True
 
 
+_DEADLINE_AT: float = float("inf")
+
+
+def _remaining_s() -> float:
+    return _DEADLINE_AT - time.monotonic()
+
+
+def _persist_partial(result: dict) -> None:
+    """Write the accumulated rows after every workload: a deadline cut (or a
+    tunnel wedge mid-extra) keeps every completed row on disk (VERDICT r3
+    item 3)."""
+    path = os.environ.get("BENCH_PARTIAL_PATH", "BENCH_partial.json")
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+
+
 def _arm_deadline() -> None:
+    global _DEADLINE_AT
+    _DEADLINE_AT = time.monotonic() + TOTAL_TIMEOUT_S
+
     def _expire():
         if _PRIMARY_RESULT:
             # the primary workload finished — optional BENCH_FULL extras ran
@@ -338,6 +361,92 @@ def _llama_fsdp_workload(on_accel: bool) -> dict:
     }
 
 
+def _timed_steps(step, batches: list, steps: int, warmup: int):
+    """The one timing methodology every GPT-throughput row uses: compile on
+    batch 0, warm across rotated batches, then time `steps` rotated calls.
+    Returns (compile_s, dt, final_loss, recompiled_during_timing)."""
+    t0 = time.perf_counter()
+    loss = step(batches[0])
+    float(loss)
+    compile_s = time.perf_counter() - t0
+    for i in range(max(0, warmup - 1)):
+        loss = step(batches[(i + 1) % len(batches)])
+    float(loss)  # force full sync before timing
+    n_cached = len(step._cache)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss = step(batches[i % len(batches)])
+    final_loss = float(loss)  # device sync: everything above has completed
+    dt = time.perf_counter() - t0
+    return compile_s, dt, final_loss, len(step._cache) != n_cached
+
+
+def _fp8_ab_workload(on_accel: bool) -> dict:
+    """fp8 matmul A/B on the flagship geometry (VERDICT r3 item 2).
+
+    Same GPT config/batch/seq as the primary bf16 row, trained with
+    ``mixed_precision="fp8"`` (utils/fp8.py HYBRID recipe). The ratio row is
+    the deliverable: v5e/v4 MXUs have no fp8 datapath, so fp8 there pays
+    quantize/dequant FLOPs for bandwidth savings only — if the ratio is < 1
+    on this part, bf16 stays the default and the number documents why.
+    Convergence parity vs bf16 is asserted in
+    tests/test_precision.py::test_fp8_convergence_parity_vs_bf16 (reference
+    benchmarks/fp8/torchao/non_distributed.py pattern).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(mixed_precision="fp8")
+    n_dev = len(jax.devices())
+    cfg = GPTConfig.small() if on_accel else GPTConfig.tiny()
+    batch, seq, steps = (BATCH * n_dev, SEQ, 20) if on_accel else (2, 128, 2)
+    model = GPTLMHeadModel(cfg)
+    opt = optim.AdamW(model.parameters(), lr=3e-4, weight_decay=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    rng = np.random.default_rng(0)
+    batches = [
+        batch_to_global_array(
+            jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32),
+            mesh=acc.mesh,
+        )
+        for _ in range(4)
+    ]
+    # same methodology as the primary bf16 row (rotated batches, WARMUP,
+    # recompile detection) so the ratio is apples-to-apples
+    compile_s, dt, final_loss, recompiled = _timed_steps(
+        step, batches, steps, WARMUP if on_accel else 1
+    )
+    tokens_per_sec = batch * seq * steps / dt / n_dev
+    out = {
+        "fp8_train_tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "fp8_compile_s": round(compile_s, 1),
+        "fp8_final_loss": round(final_loss, 3),
+        "fp8_recompiled_during_timing": recompiled,
+    }
+    bf16 = _PRIMARY_RESULT.get("value")
+    if bf16:
+        out["fp8_vs_bf16_ratio"] = round(tokens_per_sec / bf16, 4)
+    return out
+
+
 def _opt_inference_workload(on_accel: bool) -> dict:
     """BASELINE.json config 5: OPT device_map='auto'-style sharded inference
     (reference benchmarks/big_model_inference/README.md:31-37 form: load
@@ -583,21 +692,7 @@ def main() -> None:
         return batch_to_global_array(jnp.asarray(ids), mesh=acc.mesh)
 
     batches = [make_batch(i) for i in range(4)]
-    t_compile0 = time.perf_counter()
-    loss = step(batches[0])  # always at least one compile+run before timing
-    float(loss)
-    compile_s = time.perf_counter() - t_compile0
-    for i in range(max(0, warmup - 1)):
-        loss = step(batches[(i + 1) % len(batches)])
-    float(loss)  # force full sync before timing
-
-    n_cached = len(step._cache)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        loss = step(batches[i % len(batches)])
-    final_loss = float(loss)  # device sync: everything above has completed
-    dt = time.perf_counter() - t0
-    recompiled = len(step._cache) != n_cached
+    compile_s, dt, final_loss, recompiled = _timed_steps(step, batches, steps, warmup)
 
     n_devices = len(jax.devices())
     # the Accelerator dp-shards the batch over every visible chip: divide the
@@ -632,16 +727,42 @@ def main() -> None:
     if os.environ.get("BENCH_FULL", "") == "1":
         # stderr progress marks: when the deadline watchdog cuts the extras,
         # the log shows which workload ate the time (each also reports its
-        # own *_compile_s in the JSON when it completes)
+        # own *_compile_s in the JSON when it completes).
+        # BENCH_EXTRAS="bert,opt" selects a subset — the lever for staggering
+        # extras across short chip windows (VERDICT r3 item 3); BERT first,
+        # it is the BASELINE.json primary metric.
         extras = [
             ("bert", _bert_mrpc_workload),
+            ("fp8", _fp8_ab_workload),
             ("bigmodel", _big_model_inference_workload),
             ("llama", _llama_fsdp_workload),
             ("opt", _opt_inference_workload),
             ("longctx", _long_context_workload),
             ("window", _sliding_window_workload),
         ]
+        selected = os.environ.get("BENCH_EXTRAS")
+        if selected:
+            wanted = {s.strip() for s in selected.split(",") if s.strip()}
+            known = {l for l, _ in extras}
+            for typo in sorted(wanted - known):
+                # a silently-dropped typo would burn the chip window the
+                # variable exists to protect — flag it in the artifact
+                result[f"extras_unknown_{typo}"] = f"not one of {sorted(known)}"
+                print(f"[bench] unknown BENCH_EXTRAS entry {typo!r}", file=sys.stderr)
+            extras = [(l, w) for l, w in extras if l in wanted]
+        # don't START an extra that can't plausibly finish: a multi-minute
+        # cold compile inside the last seconds of budget starves every
+        # later row AND loses its own
+        min_s = float(os.environ.get("BENCH_EXTRA_MIN_S", 300))
+        _persist_partial(result)
         for label, workload in extras:
+            if _remaining_s() < min_s:
+                result[f"{label}_skipped"] = (
+                    f"only {_remaining_s():.0f}s of budget left (< {min_s:.0f})"
+                )
+                _PRIMARY_RESULT.update(result)
+                _persist_partial(result)
+                continue
             t_extra = time.perf_counter()
             print(f"[bench] extra '{label}' start", file=sys.stderr, flush=True)
             try:
@@ -652,6 +773,9 @@ def main() -> None:
                 f"[bench] extra '{label}' done in {time.perf_counter() - t_extra:.1f}s",
                 file=sys.stderr, flush=True,
             )
+            # a watchdog cut after this point still reports the finished rows
+            _PRIMARY_RESULT.update(result)
+            _persist_partial(result)
     _emit_once(result)
 
 

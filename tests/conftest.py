@@ -38,6 +38,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight test (subprocess launches, big compiles); "
+        "skipped unless RUN_SLOW=1, selectable via -m slow / -m 'not slow'",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _reset_singletons():
     """Each test gets fresh Borg state (mirrors reference test hygiene)."""

@@ -202,6 +202,7 @@ def test_complete_cv_train_ckpt_resume(tmp_path):
     assert "epoch 1" in proc.stdout and "epoch 0" not in proc.stdout
 
 
+@slow
 def test_megatron_style_pretraining_pp2(tmp_path):
     """tp/pp/sp pretraining example runs on the virtual 8-device mesh."""
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

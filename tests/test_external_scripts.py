@@ -7,7 +7,16 @@ import sys
 
 import pytest
 
-from accelerate_tpu.test_utils.testing import slow
+from accelerate_tpu.test_utils.testing import are_slow_tests_enabled
+
+# every test here is a cold subprocess with full XLA recompiles (~90s of
+# suite wall-clock); the same script logic runs in-process elsewhere
+# (test_launcher.py, test_sharded_checkpoint.py), so the subprocess CLI
+# surface is RUN_SLOW-gated as one slow split (VERDICT r3 item 4)
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not are_slow_tests_enabled(), reason="test is slow"),
+]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -41,7 +50,6 @@ def test_peak_memory_script():
     assert "All peak-memory checks passed" in out
 
 
-@slow
 def test_performance_script():
     out = _run("accelerate_tpu.test_utils.scripts.external_deps.test_performance")
     assert "All performance-parity checks passed" in out

@@ -13,7 +13,7 @@ import pytest
 import accelerate_tpu.test_utils.scripts.test_ops as test_ops_script
 import accelerate_tpu.test_utils.scripts.test_script as test_script
 import accelerate_tpu.test_utils.scripts.test_sync as test_sync_script
-from accelerate_tpu.test_utils.testing import launch_test_script
+from accelerate_tpu.test_utils.testing import launch_test_script, slow
 
 
 def test_launch_test_script_via_cli():
@@ -46,6 +46,7 @@ def test_debug_launcher_multiprocess():
     debug_launcher(_check_world, num_processes=2, timeout=240)
 
 
+@slow
 def test_debug_launcher_sharded_checkpoint_two_processes():
     """Sharded checkpointing under REAL multi-process: the fsdp axis spans
     two processes, each writes its own model+optimizer shard files, and
@@ -58,6 +59,7 @@ def test_debug_launcher_sharded_checkpoint_two_processes():
     debug_launcher(script.main, num_processes=2, timeout=600)
 
 
+@slow
 def test_debug_launcher_full_script_two_processes():
     """The FULL correctness suite under real 2-process rendezvous: this is
     the round-2 verdict's Missing #5 — the multihost branches of
@@ -85,6 +87,7 @@ def _check_world():
     state.wait_for_everyone()
 
 
+@slow
 def test_gang_restart_recovers_flaky_worker(tmp_path):
     """--max_restarts N relaunches the worker after a failure (torchrun
     elastic-agent parity); attempt counting is observable via a state file."""
@@ -112,6 +115,7 @@ def test_gang_restart_recovers_flaky_worker(tmp_path):
     assert proc.stderr.count("restarting") == 2
 
 
+@slow
 def test_gang_restart_exhausted_fails(tmp_path):
     import subprocess
     import sys
@@ -129,6 +133,7 @@ def test_gang_restart_exhausted_fails(tmp_path):
     assert proc.stderr.count("restarting") == 1
 
 
+@slow
 def test_multihost_gang_restart(tmp_path):
     """A failing rank kills and restarts the WHOLE gang (SPMD semantics)."""
     import subprocess
