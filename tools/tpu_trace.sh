@@ -1,0 +1,45 @@
+#!/bin/bash
+# Standalone jax.profiler trace of the flagship step (10 steady-state steps)
+# — extracted from tpu_perf_sweep.sh so the when-up queue can run it without
+# repeating the batch/block sweeps already measured in round 3.
+# Usage: bash tools/tpu_trace.sh [outdir]
+set -u
+OUT=$(realpath -m "${1:-/tmp/tpu_trace}")
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+timeout 1200 python - "$OUT" <<'EOF' 2>"$OUT/err_profile.log"
+import sys, os
+sys.path.insert(0, os.getcwd())
+out = sys.argv[1]
+import jax, jax.numpy as jnp, numpy as np
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator
+from accelerate_tpu.data_loader import batch_to_global_array
+from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+nn.manual_seed(0)
+acc = Accelerator(mixed_precision="bf16")
+model = GPTLMHeadModel(GPTConfig.small())
+opt = optim.AdamW(model.parameters(), lr=3e-4)
+model, opt = acc.prepare(model, opt)
+
+def fn(ids):
+    opt.zero_grad(); o = model(ids, labels=ids); acc.backward(o["loss"]); opt.step(); return o["loss"]
+
+step = acc.compile_step(fn)
+ids = batch_to_global_array(
+    jnp.asarray(np.random.default_rng(0).integers(0, 50304, (12, 1024)), jnp.int32),
+    mesh=acc.mesh)
+for _ in range(5):
+    step(ids)
+float(step(ids))
+jax.profiler.start_trace(os.path.join(out, "trace"))
+for _ in range(10):
+    loss = step(ids)
+float(loss)
+jax.profiler.stop_trace()
+print({"profile": os.path.join(out, "trace"), "final_loss": round(float(loss), 3)})
+EOF
+echo "trace written under $OUT/trace"

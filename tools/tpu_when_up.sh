@@ -1,11 +1,15 @@
 #!/bin/bash
-# Watch for the TPU tunnel to return; when it does, run the queued perf work
-# ONCE and leave the artifacts in the repo root (picked up by the round-end
-# auto-commit if no one is around to commit them).
+# Watch for the TPU tunnel to return; when it does, run the round-4 queued
+# perf work ONCE, in VERDICT priority order, leaving artifacts in the repo
+# root (picked up by the round-end auto-commit if no one is around).
+#   1. plain bench.py            -> BENCH_r04_live.json  (the headline artifact)
+#   2. flag experiments          -> TPU_EXPERIMENTS_r04.log
+#   3. profiler trace            -> /tmp/tpu_sweep4/trace (+ note in log)
+#   4. BENCH_FULL staged extras  -> BENCH_FULL_r04.json (incremental partials)
 # Usage: setsid nohup bash tools/tpu_when_up.sh &
 set -u
 cd "$(dirname "$0")/.."
-MARK=/tmp/tpu_when_up.ran
+MARK=/tmp/tpu_when_up_r04.ran
 [ -e "$MARK" ] && exit 0
 while true; do
   ok=$(timeout -k 10 110 python - <<'EOF' 2>/dev/null
@@ -19,8 +23,15 @@ EOF
 done
 touch "$MARK"
 {
-  echo "== TPU returned $(date -u +%FT%TZ): flag experiments =="
-  bash tools/tpu_flag_experiments.sh /tmp/tpu_exp2 && cat /tmp/tpu_exp2/exp.log
-  echo "== BENCH_FULL =="
-  BENCH_FULL=1 BENCH_INIT_ATTEMPTS=2 timeout 4900 python bench.py 2>/dev/null
-} > TPU_EXPERIMENTS_r03.log 2>&1
+  echo "== TPU returned $(date -u +%FT%TZ) =="
+  echo "== 1. plain bench (driver-format artifact) =="
+  BENCH_INIT_ATTEMPTS=2 timeout 1800 python bench.py 2>/tmp/bench_r04_err.log \
+    | tee BENCH_r04_live.json
+  echo "== 2. flag experiments =="
+  bash tools/tpu_flag_experiments.sh /tmp/tpu_exp4 && cat /tmp/tpu_exp4/exp.log
+  echo "== 3. profiler trace =="
+  bash tools/tpu_trace.sh /tmp/tpu_sweep4 || true
+  echo "== 4. BENCH_FULL staged extras =="
+  BENCH_FULL=1 BENCH_INIT_ATTEMPTS=2 BENCH_PARTIAL_PATH=BENCH_FULL_r04.json \
+    timeout 4900 python bench.py 2>/tmp/bench_full_r04_err.log
+} > TPU_EXPERIMENTS_r04.log 2>&1
