@@ -10,11 +10,25 @@ import pytest
 
 import accelerate_tpu.nn as nn
 from accelerate_tpu.models import (
+    GPTConfig,
+    GPTJConfig,
+    GPTJForCausalLM,
+    GPTLMHeadModel,
+    GPTNeoXConfig,
+    GPTNeoXForCausalLM,
     LlamaConfig,
     LlamaForCausalLM,
     OPTConfig,
     OPTForCausalLM,
 )
+
+_FAMILIES = {
+    "llama": lambda: LlamaForCausalLM(LlamaConfig.tiny()),
+    "opt": lambda: OPTForCausalLM(OPTConfig.tiny()),
+    "gpt": lambda: GPTLMHeadModel(GPTConfig.tiny()),
+    "gptj": lambda: GPTJForCausalLM(GPTJConfig.tiny()),
+    "neox": lambda: GPTNeoXForCausalLM(GPTNeoXConfig.tiny()),
+}
 
 
 def _snap_params_to_int8_grid(model):
@@ -30,15 +44,14 @@ def _snap_params_to_int8_grid(model):
         p.data = jnp.asarray(np.round(w / scale) * scale)
 
 
-@pytest.mark.parametrize("family", ["llama", "opt"])
+@pytest.mark.parametrize("family", sorted(_FAMILIES))
 def test_int8_decode_exact_on_grid(family):
+    """EVERY fused decoder family decodes exactly under int8 when weights
+    sit on the quantization grid (the engine is family-generic via
+    DecoderSpec; round-3 session note wrongly assumed otherwise)."""
     nn.manual_seed(0)
-    if family == "llama":
-        model = LlamaForCausalLM(LlamaConfig.tiny())
-        vocab = model.config.vocab_size
-    else:
-        model = OPTForCausalLM(OPTConfig.tiny())
-        vocab = model.config.vocab_size
+    model = _FAMILIES[family]()
+    vocab = model.config.vocab_size
     _snap_params_to_int8_grid(model)
     ids = np.random.default_rng(0).integers(0, vocab, (2, 9)).astype(np.int32)
     full = np.asarray(model.generate(ids, max_new_tokens=6))
