@@ -93,6 +93,14 @@ def _unwrap_tree(tree):
     )
 
 
+def _is_offloaded(x) -> bool:
+    """True when the array lives outside default device memory (host-offloaded
+    optimizer state) — the single predicate behind both the layout-pin and
+    the donation split below."""
+    s = getattr(x, "sharding", None)
+    return getattr(s, "memory_kind", None) not in (None, "device")
+
+
 class CapturedStep:
     """Callable produced by ``accelerator.compile_step``."""
 
@@ -181,10 +189,18 @@ class CapturedStep:
         )
         entry = self._cache.get(key)
         state = self._collect_state()
+        flat_state, cur_treedef = jax.tree_util.tree_flatten(state)
+        if entry is not None and cur_treedef != entry[2]:
+            # state structure changed since this entry was built (e.g. more
+            # objects prepared): rebuild, exactly where plain jit would
+            # silently re-trace
+            entry = None
         if entry is None:
             entry = self._build(key, state, args)
-        jitted, ctx = entry
-        new_state, out = jitted(state, *flat_args)
+        jitted, ctx, _, host_mask = entry
+        dev_leaves = tuple(x for x, h in zip(flat_state, host_mask) if not h)
+        host_leaves = tuple(x for x, h in zip(flat_state, host_mask) if h)
+        new_state, out = jitted(dev_leaves, host_leaves, *flat_args)
         self._writeback(new_state)
         if self._uses_accumulate is None:
             # first ever call: the trace just revealed whether the body
@@ -233,7 +249,15 @@ class CapturedStep:
 
         def _leaf_sharding(x):
             s = getattr(x, "sharding", None)
-            return s if isinstance(s, jax.sharding.NamedSharding) else _NOPIN
+            if not isinstance(s, jax.sharding.NamedSharding):
+                return _NOPIN
+            if _is_offloaded(x):
+                # host-offloaded leaves: with_sharding_constraint cannot pin
+                # a non-default memory space on every backend — their
+                # placement is re-established eagerly after each replay
+                # (optim.reoffload_state_to_host), so leave them unpinned
+                return _NOPIN
+            return s
 
         ref_shardings = {
             k: jax.tree_util.tree_map(_leaf_sharding, state_template[k])
@@ -251,7 +275,20 @@ class CapturedStep:
                 )
             return pinned
 
-        def traced(state, *flat_args):
+        # Split the carried state by memory space: donation aliases input
+        # buffers to outputs, which is illegal across memory spaces (a
+        # pinned_host moment donated to — or passed through a micro-step
+        # variant into — a device-resident output trips XLA's memory-kind
+        # check at dispatch).  Donation is per-argument, so device leaves
+        # (params/grads/masters — the big HBM win) keep aliasing and only
+        # host-offloaded leaves ride a second, non-donated argument.
+        flat_template, state_treedef = jax.tree_util.tree_flatten(state_template)
+        host_mask = tuple(_is_offloaded(x) for x in flat_template)
+
+        def traced(dev_leaves, host_leaves, *flat_args):
+            dev_iter, host_iter = iter(dev_leaves), iter(host_leaves)
+            flat = [next(host_iter) if h else next(dev_iter) for h in host_mask]
+            state = jax.tree_util.tree_unflatten(state_treedef, flat)
             call_args = jax.tree_util.tree_unflatten(args_treedef, flat_args)
             prev_rng_state = nn_random.default_rng.get_state()
             prev_capture = _capture_state.active
@@ -274,7 +311,7 @@ class CapturedStep:
                 nn_random.default_rng.set_state(prev_rng_state)
 
         jitted = jax.jit(traced, donate_argnums=(0,))
-        entry = (jitted, captured_ctx)
+        entry = (jitted, captured_ctx, state_treedef, host_mask)
         self._cache[key] = entry
         return entry
 
