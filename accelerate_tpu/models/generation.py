@@ -149,6 +149,47 @@ def _quantize_stacked_layers(layers: dict, bits: int) -> tuple[dict, dict, dict]
     return plain, qd, sd
 
 
+def stacked_params_for_mode(model, qbits: int, stack) -> tuple[dict, tuple]:
+    """Per-mode memoized stacked decode params: ``(g, (plain, q, scales))``.
+
+    One cache contract for every family's decode engine (the causal LMs here
+    and T5's encoder-decoder loop).  Restacking is a full param-set copy per
+    call (≈1.5 GB for GPT-2-large) and would pollute per-token latency, so
+    the stack is memoized per parameter identity: the cache holds STRONG
+    references to the source arrays and compares with ``is`` — an
+    id()-tuple key can silently match recycled object ids after training
+    rebinds p.data, serving stale weights.
+
+    Retention policy: a mode's stack lives as long as the params do, so
+    alternating full/quantized generates (the A/B comparison benchmarks do)
+    never restack — but the full-precision stack is cached only when mode 0
+    was itself requested.  A quantized-only deployment therefore holds
+    module params + int8 stacks, NOT a third full-width copy (which at
+    T0pp geometry would be the difference between fitting and OOM); the
+    transient full stack built as quantizer input is dropped.
+    """
+    current = [p.data for _, p in model.named_parameters()]
+    cached = getattr(model, "_generation_param_cache", None)
+    if not (
+        cached is not None
+        and len(cached[0]) == len(current)
+        and all(a is b for a, b in zip(cached[0], current))
+    ):
+        cached = (current, {})  # params changed: drop every mode
+        model._generation_param_cache = cached
+    by_mode: dict = cached[1]
+    if qbits not in by_mode:
+        if 0 in by_mode:
+            g, (layers, _, _) = by_mode[0]
+        else:
+            g, layers = stack()
+            if qbits == 0:
+                by_mode[0] = (g, (layers, {}, {}))
+        if qbits:
+            by_mode[qbits] = (g, _quantize_stacked_layers(layers, qbits))
+    return by_mode[qbits]
+
+
 def _dequant_layer(plain_l: dict, q_l: dict, s_l: dict, bits: int, dtype) -> dict:
     """Rebuild one scan step's layer dict, widening int8/int4 entries to the
     activation dtype INSIDE the step — only one layer's weights are ever
@@ -272,38 +313,12 @@ def generate(
             f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the model's positional capacity ({spec.max_len})"
         )
-    # memoize the stacked copy: restacking is a full param-set copy per
-    # call (≈1.5 GB for GPT-2-large) and would pollute per-token latency.
-    # The cache holds STRONG references to the source arrays and compares
-    # with `is` — an id()-tuple key can silently match recycled object ids
-    # after training rebinds p.data, serving stale weights.  Cost: at most
-    # one superseded param set stays alive until the next generate().
     if quantize_weights not in (None, 4, 8):
         raise ValueError(
             f"quantize_weights={quantize_weights!r}: use None, 8 or 4"
         )
     qbits = quantize_weights or 0
-    current = [p.data for _, p in model.named_parameters()]
-    cached = getattr(model, "_generation_param_cache", None)
-    if not (
-        cached is not None
-        and len(cached[0]) == len(current)
-        and all(a is b for a, b in zip(cached[0], current))
-    ):
-        cached = (current, {})  # params changed: drop every mode
-        model._generation_param_cache = cached
-    by_mode: dict = cached[1]
-    if qbits not in by_mode:
-        # per-mode slots: alternating full/quantized generates (the A/B
-        # comparison benchmarks do) must not restack per call
-        if 0 in by_mode:
-            g, (layers, _, _) = by_mode[0]
-        else:
-            g, layers = spec.stack()
-            by_mode[0] = (g, (layers, {}, {}))  # never restack twice
-        if qbits:
-            by_mode[qbits] = (g, _quantize_stacked_layers(layers, qbits))
-    g, layer_parts = by_mode[qbits]
+    g, layer_parts = stacked_params_for_mode(model, qbits, spec.stack)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_jit(

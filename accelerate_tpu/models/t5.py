@@ -416,11 +416,24 @@ class T5ForConditionalGeneration(nn.Module):
             return {"loss": loss, "logits": logits}
         return {"logits": logits}
 
-    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0, rng=None):
+    def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0,
+                 rng=None, quantize_weights=None):
         """Greedy/sampled decode: encoder once (module path), then ONE jitted
-        cached decoder loop.  Returns the (b, max_new_tokens) decoder ids."""
+        cached decoder loop.  Returns the (b, max_new_tokens) decoder ids.
+
+        ``quantize_weights=8|4`` decodes through int8/int4 weight-only
+        quantization of the stacked decoder layers (same on-device
+        quantizer and per-layer widening as the causal-LM engine,
+        models/generation.py) — for T0pp-geometry decoding, streaming the
+        decoder at 1 (or 0.5) byte/param is the memory-bound win.
+        """
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if quantize_weights not in (None, 4, 8):
+            raise ValueError(
+                f"quantize_weights={quantize_weights!r}: use None, 8 or 4"
+            )
+        qbits = quantize_weights or 0
         ids = jnp.asarray(
             input_ids.data if hasattr(input_ids, "data") else input_ids, jnp.int32
         )
@@ -429,26 +442,19 @@ class T5ForConditionalGeneration(nn.Module):
         with nn.no_grad():
             enc = self.encode(ids)
         enc_arr = enc.data if isinstance(enc, Tensor) else enc
-        # memoize the stacked decoder copy per parameter identity (same
-        # contract as generation.py: `is`-comparison against live arrays, so
-        # training rebinds invalidate it) — restacking T0pp's decoder per
-        # call would copy ~half the 11B params before the first token
-        current = [p.data for _, p in self.named_parameters()]
-        cached = getattr(self, "_generation_param_cache", None)
-        if (
-            cached is not None
-            and len(cached[0]) == len(current)
-            and all(a is b for a, b in zip(cached[0], current))
-        ):
-            g, layers = cached[1]
-        else:
-            g, layers = self._stack_decoder_params()
-            self._generation_param_cache = (current, (g, layers))
+        # one shared per-mode cache contract with the causal-LM engine
+        # (restacking T0pp's decoder per call would copy ~half the 11B
+        # params before the first token; see stacked_params_for_mode)
+        from .generation import stacked_params_for_mode
+
+        g, layer_parts = stacked_params_for_mode(
+            self, qbits, self._stack_decoder_params
+        )
         if rng is None:
             rng = jax.random.PRNGKey(0)
         cfg = self.config
         return _t5_decode_jit(
-            g, layers, enc_arr, rng, ids.shape[0],
+            g, layer_parts, enc_arr, rng, ids.shape[0],
             n_head=cfg.num_heads, d_kv=cfg.d_kv, eps=cfg.layer_norm_epsilon,
             gated=cfg.feed_forward_proj == "gated-gelu",
             buckets=cfg.relative_attention_num_buckets,
@@ -458,6 +464,7 @@ class T5ForConditionalGeneration(nn.Module):
             d_model=cfg.d_model,
             max_new=max_new_tokens,
             temperature=float(temperature),
+            qbits=qbits,
         )
 
     def _stack_decoder_params(self) -> tuple[dict, dict]:
@@ -500,27 +507,35 @@ class T5ForConditionalGeneration(nn.Module):
     jax.jit,
     static_argnames=(
         "batch", "n_head", "d_kv", "eps", "gated", "buckets", "max_distance",
-        "start_id", "tied_scale", "d_model", "max_new", "temperature",
+        "start_id", "tied_scale", "d_model", "max_new", "temperature", "qbits",
     ),
 )
 def _t5_decode_jit(
     g, layers, enc, rng, batch,
     *, n_head, d_kv, eps, gated, buckets, max_distance,
-    start_id, tied_scale, d_model, max_new, temperature,
+    start_id, tied_scale, d_model, max_new, temperature, qbits=0,
 ):
+    from .generation import _dequant_layer
+
+    plain_layers, q_layers, s_layers = layers
     cache_len = max_new
     dtype = enc.dtype
     b = batch
 
+    def deq(layer_in):
+        pl, ql, sl = layer_in
+        return _dequant_layer(pl, ql, sl, qbits, dtype) if qbits else pl
+
     # precompute per-layer cross K/V from the encoder output once
-    def cross_kv(l):
+    def cross_kv(layer_in):
+        l = deq(layer_in)
         ek = _heads(enc @ l["ca_k"].T, n_head, d_kv)
         ev = _heads(enc @ l["ca_v"].T, n_head, d_kv)
         return ek, ev
 
-    enc_k, enc_v = jax.lax.map(lambda l: cross_kv(l), layers)
+    enc_k, enc_v = jax.lax.map(cross_kv, (plain_layers, q_layers, s_layers))
 
-    n_layers = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    n_layers = jax.tree_util.tree_leaves(plain_layers)[0].shape[0]
     k_cache = jnp.zeros((n_layers, b, n_head, cache_len, d_kv), dtype)
     v_cache = jnp.zeros((n_layers, b, n_head, cache_len, d_kv), dtype)
 
@@ -535,7 +550,8 @@ def _t5_decode_jit(
         t_pos = jnp.arange(cache_len)
 
         def layer(x, packed):
-            l, kc, vc, ek, ev = packed
+            layer_in, kc, vc, ek, ev = packed
+            l = deq(layer_in)
             h = _t5_norm(x, l["sa_ln"], eps)
             q = _heads(h @ l["sa_q"].T, n_head, d_kv)
             k = _heads(h @ l["sa_k"].T, n_head, d_kv)
@@ -556,12 +572,12 @@ def _t5_decode_jit(
             x = t5_ff(l, x, eps=eps, gated=gated)
             return x, (kc, vc)
 
-        layers_b = dict(layers)
-        layers_b["__dec_table"] = jnp.broadcast_to(
+        plain_b = dict(plain_layers)
+        plain_b["__dec_table"] = jnp.broadcast_to(
             g["dec_bias_table"], (n_layers,) + g["dec_bias_table"].shape
         )
         x, (k_cache, v_cache) = jax.lax.scan(
-            layer, x, (layers_b, k_cache, v_cache, enc_k, enc_v)
+            layer, x, ((plain_b, q_layers, s_layers), k_cache, v_cache, enc_k, enc_v)
         )
         x = _t5_norm(x[:, -1], g["dec_ln_f"], eps)
         if tied_scale:

@@ -143,3 +143,50 @@ def test_unsupported_ffn_rejected():
 
     with pytest.raises(NotImplementedError, match="feed_forward_proj"):
         t5_config_from_hf({"feed_forward_proj": "gated-silu"})
+
+
+def test_int8_decode_exact_on_grid():
+    """T5 quantized decode must match full-precision decode token for token
+    when weights sit on the int8 quantization grid (same engine contract as
+    tests/test_quantized_decode.py for the causal families; T0pp-geometry
+    decoding is the reference's big-model-inference benchmark)."""
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+    import accelerate_tpu.nn as nn
+
+    nn.manual_seed(0)
+    model = T5ForConditionalGeneration(T5Config.tiny())
+    for name, p in model.named_parameters():
+        w = np.asarray(p.data)
+        if w.ndim != 2:
+            continue
+        amax = np.maximum(np.abs(w).max(axis=-1, keepdims=True), 1e-12)
+        scale = (amax / 127.0).astype(np.float32)
+        p.data = jnp.asarray(np.round(w / scale) * scale)
+    ids = np.random.default_rng(0).integers(
+        0, model.config.vocab_size, (2, 9)
+    ).astype(np.int32)
+    rng = jax.random.PRNGKey(3)
+    full = np.asarray(model.generate(ids, max_new_tokens=5, temperature=1.0, rng=rng))
+    quant = np.asarray(
+        model.generate(ids, max_new_tokens=5, temperature=1.0, rng=rng,
+                       quantize_weights=8)
+    )
+    np.testing.assert_array_equal(quant, full)
+    # both modes cached side by side; int8 stacks really are int8
+    _, by_mode = model._generation_param_cache
+    assert set(by_mode) == {0, 8}
+    _, (plain, qd, sd) = by_mode[8]
+    assert qd and all(v.dtype == jnp.int8 for v in qd.values())
+
+
+def test_int4_decode_runs():
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+    import accelerate_tpu.nn as nn
+
+    nn.manual_seed(0)
+    model = T5ForConditionalGeneration(T5Config.tiny())
+    ids = np.zeros((1, 6), np.int32)
+    out = np.asarray(model.generate(ids, max_new_tokens=3, quantize_weights=4))
+    assert out.shape == (1, 3)
+    with pytest.raises(ValueError, match="quantize_weights"):
+        model.generate(ids, max_new_tokens=2, quantize_weights=2)
