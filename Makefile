@@ -40,7 +40,8 @@ test_big_modeling:
 	  tests/test_offload.py tests/test_modeling_utils.py -q
 
 test_checkpoint:
-	python -m pytest tests/test_sharded_checkpoint.py tests/test_fsdp_utils.py -q
+	python -m pytest tests/test_sharded_checkpoint.py tests/test_fsdp_utils.py \
+	  tests/test_async_checkpoint.py -q
 
 test_examples:
 	python -m pytest tests/test_examples.py tests/test_external_scripts.py -q

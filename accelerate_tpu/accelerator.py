@@ -710,10 +710,28 @@ class Accelerator:
         output_dir: Optional[str] = None,
         safe_serialization: bool = True,
         sharded_state: Optional[bool] = None,
+        async_save: bool = False,
         **kwargs,
     ) -> str:
+        """Checkpoint everything registered with the Accelerator.
+
+        ``async_save=True`` overlaps checkpoint serialization and file
+        writes with continued training.  The state is snapshotted at call
+        time into buffers the training loop can never invalidate (donation
+        in a captured step deletes live buffers regardless of held
+        references): unsharded saves complete a parallelized device→host
+        transfer here and hand the thread pure numpy; sharded saves take an
+        on-device copy (keeping the GSPMD layout the shard writer needs) at
+        the cost of a transient extra state copy in HBM.  Steps taken after
+        the call never leak into the checkpoint.  One save may be in flight
+        at a time; ``wait_for_checkpoint()`` blocks until it is durable
+        (``load_state``/``end_training``/the next ``save_state`` wait
+        automatically, and the writer is non-daemon so interpreter exit
+        joins it).
+        """
         from .checkpointing import save_accelerator_state
 
+        self.wait_for_checkpoint()
         if self.project_configuration.automatic_checkpoint_naming:
             output_dir = os.path.join(self.project_dir or ".", "checkpoints")
             folders = []
@@ -742,23 +760,156 @@ class Accelerator:
             sharded_state = fsdp_axis > 1 and (
                 plugin is None or plugin.state_dict_type == "SHARDED_STATE_DICT"
             )
-        save_accelerator_state(
-            output_dir,
-            models=self._models,
-            optimizers=self._optimizers,
-            schedulers=self._schedulers,
-            dataloaders=self._dataloaders,
-            custom_objects=self._custom_objects,
-            step=self.step,
-            scaler=self.scaler,
-            safe_serialization=safe_serialization,
-            sharded_state=sharded_state,
+        if async_save and self.num_processes > 1:
+            # the save path runs cross-process barriers (and, unsharded,
+            # allgathers); issuing those from a background thread would race
+            # the training loop's own collectives — same hazard as the
+            # dispatch loader's producer.  Fall back loudly.
+            logger.warning(
+                "async_save is only supported with a single host process; "
+                "saving synchronously"
+            )
+            async_save = False
+        if not async_save:
+            save_accelerator_state(
+                output_dir,
+                models=self._models,
+                optimizers=self._optimizers,
+                schedulers=self._schedulers,
+                dataloaders=self._dataloaders,
+                custom_objects=self._custom_objects,
+                step=self.step,
+                scaler=self.scaler,
+                safe_serialization=safe_serialization,
+                sharded_state=sharded_state,
+            )
+            return output_dir
+
+        import copy as _copy
+        import threading as _threading
+
+        import numpy as _np
+
+        from .checkpointing import FrozenOptimizer, FrozenState, _rng_states
+
+        # Snapshot at call time.  Holding references is NOT enough: a later
+        # captured step DONATES the live buffers and donation invalidates
+        # them regardless of outstanding Python references.  So array leaves
+        # are materialized into buffers the training loop can never touch:
+        #   - unsharded saves: host numpy, with every D2H started async
+        #     first so the call stalls for max(transfer), not sum(transfer);
+        #     the thread then only serializes and writes.
+        #   - sharded saves: an on-device copy (jnp.copy keeps the GSPMD
+        #     layout the per-shard writer needs) — a transient extra state
+        #     copy in HBM until the thread drains it.
+        # Python-side state is deep-copied before training mutates it.
+        def _snapshot_to_host(tree):
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            for leaf in leaves:
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            out = [
+                _np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x
+                for x in leaves
+            ]
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        def _snapshot_on_device(tree):
+            snap = jax.tree_util.tree_map(
+                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree
+            )
+            # the copies must be materialized before we return control to a
+            # loop that may donate the sources
+            jax.block_until_ready(
+                [x for x in jax.tree_util.tree_leaves(snap) if isinstance(x, jax.Array)]
+            )
+            return snap
+
+        snap_arrays = _snapshot_on_device if sharded_state else _snapshot_to_host
+        frozen_models = [
+            FrozenState(snap_arrays(dict(m.state_dict()))) for m in self._models
+        ]
+        if sharded_state:
+            frozen_opts = []
+            for o in self._optimizers:
+                arrays, opt_meta = o.optimizer.sharded_state_arrays()
+                frozen_opts.append(
+                    FrozenOptimizer(
+                        None, (_snapshot_on_device(arrays), _copy.deepcopy(opt_meta))
+                    )
+                )
+        else:
+            frozen_opts = [
+                FrozenOptimizer(_snapshot_to_host(o.state_dict()), None)
+                for o in self._optimizers
+            ]
+        frozen_scheds = [FrozenState(_copy.deepcopy(s.state_dict())) for s in self._schedulers]
+        frozen_dls = [
+            FrozenState(_copy.deepcopy(dl.state_dict()))
+            if hasattr(dl, "state_dict")
+            else object()
+            for dl in self._dataloaders
+        ]
+        frozen_custom = [
+            FrozenState(_copy.deepcopy(_snapshot_to_host(obj.state_dict())))
+            for obj in self._custom_objects
+        ]
+        frozen_scaler = (
+            FrozenState(_copy.deepcopy(self.scaler.state_dict()))
+            if self.scaler is not None
+            else None
         )
+        rng_snapshot = _rng_states()
+        step_snapshot = self.step
+
+        def _write():
+            save_accelerator_state(
+                output_dir,
+                models=frozen_models,
+                optimizers=frozen_opts,
+                schedulers=frozen_scheds,
+                dataloaders=frozen_dls,
+                custom_objects=frozen_custom,
+                step=step_snapshot,
+                scaler=frozen_scaler,
+                safe_serialization=safe_serialization,
+                sharded_state=sharded_state,
+                rng_states=rng_snapshot,
+            )
+
+        def _runner():
+            try:
+                _write()
+            except BaseException as exc:  # noqa: BLE001 — surfaced on wait
+                self._async_save_error = exc
+
+        self._async_save_error = None
+        # non-daemon: a normal interpreter exit joins this thread, so a
+        # script that ends right after save_state still gets a complete
+        # checkpoint instead of a silently truncated one
+        self._async_save_thread = _threading.Thread(
+            target=_runner, name="accelerate-tpu-async-save", daemon=False
+        )
+        self._async_save_thread.start()
         return output_dir
+
+    def wait_for_checkpoint(self) -> None:
+        """Block until an in-flight ``save_state(async_save=True)`` is
+        durable on disk; re-raise any error it hit."""
+        thread = getattr(self, "_async_save_thread", None)
+        if thread is None:
+            return
+        thread.join()
+        self._async_save_thread = None
+        error = getattr(self, "_async_save_error", None)
+        self._async_save_error = None
+        if error is not None:
+            raise error
 
     def load_state(self, input_dir: Optional[str] = None, **kwargs) -> None:
         from .checkpointing import load_accelerator_state
 
+        self.wait_for_checkpoint()
         if input_dir is None and self.project_configuration.automatic_checkpoint_naming:
             base = os.path.join(self.project_dir or ".", "checkpoints")
             folders = sorted(
@@ -829,6 +980,7 @@ class Accelerator:
             tracker.log(clean, step=step, **log_kwargs.get(tracker.name, {}))
 
     def end_training(self) -> None:
+        self.wait_for_checkpoint()  # an in-flight async save must land
         for tracker in self.trackers:
             tracker.finish()
         self.wait_for_everyone()

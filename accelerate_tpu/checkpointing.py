@@ -138,6 +138,32 @@ def _restore_rng_states(states: dict) -> None:
         nn_random.default_rng.set_state(states["nn_rng"])
 
 
+class FrozenState:
+    """Immutable ``state_dict()`` stand-in: lets the save path below run on a
+    snapshot taken at call time (async checkpointing) instead of live
+    objects that training keeps rebinding."""
+
+    def __init__(self, state_dict):
+        self._state_dict = state_dict
+
+    def state_dict(self):
+        return self._state_dict
+
+
+class FrozenOptimizer(FrozenState):
+    """Optimizer snapshot: full ``state_dict`` plus the pre-captured sharded
+    form (the save path calls whichever the checkpoint mode needs)."""
+
+    def __init__(self, state_dict, sharded_parts=None):
+        super().__init__(state_dict)
+        self._sharded_parts = sharded_parts
+
+    def sharded_state_arrays(self):
+        if self._sharded_parts is None:
+            raise RuntimeError("snapshot was not captured for sharded save")
+        return self._sharded_parts
+
+
 def save_accelerator_state(
     output_dir: str,
     models: list = (),
@@ -149,6 +175,7 @@ def save_accelerator_state(
     scaler=None,
     safe_serialization: bool = True,
     sharded_state: bool = False,
+    rng_states: Optional[dict] = None,
 ) -> str:
     """Reference save_accelerator_state checkpointing.py:57.
 
@@ -238,10 +265,11 @@ def save_accelerator_state(
         with open(os.path.join(output_dir, "accelerator_meta.json"), "w") as f:
             json.dump(meta, f)
 
-    # RNG state is per-process (reference checkpointing.py:143-172)
+    # RNG state is per-process (reference checkpointing.py:143-172);
+    # async saves pass the states captured at call time
     rng_file = os.path.join(output_dir, f"{RNG_STATE_NAME}_{state.process_index}.pkl")
     with open(rng_file, "wb") as f:
-        pickle.dump(_rng_states(), f)
+        pickle.dump(rng_states if rng_states is not None else _rng_states(), f)
     state.wait_for_everyone()
 
     # post-write cleanup: drop PREEXISTING artifacts this save did not
