@@ -17,7 +17,8 @@ import json
 import os
 from typing import Optional
 
-__all__ = ["estimate_command", "estimate_command_parser", "gather_data", "estimate_training_usage"]
+__all__ = ["estimate_command", "estimate_command_parser", "gather_data",
+           "estimate_training_usage", "estimate_training_usage_offloaded"]
 
 _DTYPE_BYTES = {"float32": 4, "float16": 2, "bfloat16": 2, "int8": 1, "int4": 0.5}
 
@@ -73,6 +74,13 @@ def estimate_training_usage(bytes_params: float) -> float:
     return 4 * bytes_params
 
 
+def estimate_training_usage_offloaded(bytes_params: float) -> float:
+    """Device HBM with FullyShardedDataParallelPlugin(offload_optimizer=True):
+    params + grads stay on device; Adam moments and fp32 masters live in
+    pinned host memory (docs/sharding.md)."""
+    return 2 * bytes_params
+
+
 def _fmt(num_bytes: float) -> str:
     for unit in ("B", "KB", "MB", "GB", "TB"):
         if abs(num_bytes) < 1024:
@@ -82,7 +90,8 @@ def _fmt(num_bytes: float) -> str:
 
 
 def gather_data(args) -> list[list]:
-    """Rows: [dtype, largest_layer, total_size, training_size]."""
+    """Rows: [dtype, largest_layer, total_size, training_size,
+    training_hbm_with_optimizer_offload]."""
     model = _builtin_model(args.model_name)
     if model is not None:
         total, largest = _num_params_builtin(model)
@@ -105,6 +114,7 @@ def gather_data(args) -> list[list]:
                 largest * per_param,
                 total_bytes,
                 estimate_training_usage(total_bytes),
+                estimate_training_usage_offloaded(total_bytes),
             ]
         )
     return rows
@@ -155,20 +165,23 @@ def estimate_command(args) -> None:
                         "largest_layer_bytes": r[1],
                         "total_bytes": r[2],
                         "training_bytes": r[3],
+                        "training_hbm_bytes_with_optimizer_offload": r[4],
                     }
                     for r in rows
                 ]
             )
         )
         return
-    header = ["dtype", "Largest Layer", "Total Size", "Training (Adam)"]
-    widths = [10, 16, 16, 16]
+    header = ["dtype", "Largest Layer", "Total Size", "Training (Adam)",
+              "w/ opt. offload"]
+    widths = [10, 16, 16, 18, 16]
     line = "".join(h.ljust(w) for h, w in zip(header, widths))
     print(f"Memory usage for `{args.model_name}`:\n{line}\n{'-' * len(line)}")
-    for dtype, largest, total, training in rows:
+    for dtype, largest, total, training, offloaded in rows:
         print(
             f"{dtype.ljust(widths[0])}{_fmt(largest).ljust(widths[1])}"
             f"{_fmt(total).ljust(widths[2])}{_fmt(training).ljust(widths[3])}"
+            f"{_fmt(offloaded).ljust(widths[4])}"
         )
 
 

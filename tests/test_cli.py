@@ -108,6 +108,7 @@ def test_estimate_builtin_models():
     assert fp32[0] == "float32" and bf16[0] == "bfloat16"
     assert fp32[2] == 2 * bf16[2]  # fp32 is exactly twice bf16
     assert fp32[3] == 4 * fp32[2]  # Adam training ≈ 4× weights
+    assert fp32[4] == 2 * fp32[2]  # host-offloaded optimizer: HBM = params+grads
 
 
 def test_estimate_unknown_model_raises():
