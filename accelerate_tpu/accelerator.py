@@ -175,6 +175,10 @@ class Accelerator:
         self._schedulers: list[AcceleratedScheduler] = []
         self._dataloaders: list[DataLoaderShard] = []
         self._custom_objects: list[Any] = []
+        from collections import OrderedDict
+
+        self._save_state_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._load_state_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
 
         self.step = 0
         self.flag_tensor = None
@@ -760,6 +764,17 @@ class Accelerator:
             sharded_state = fsdp_axis > 1 and (
                 plugin is None or plugin.state_dict_type == "SHARDED_STATE_DICT"
             )
+        # pre-hooks see (models, weights, output_dir) and may mutate the
+        # weights list — removing/replacing entries takes over saving for
+        # those models (reference accelerator.py:3221); whatever is left is
+        # exactly what gets written below (both sync and async paths)
+        from .checkpointing import FrozenState
+
+        weights = [dict(m.state_dict()) for m in self._models]
+        for hook in self._save_state_pre_hooks.values():
+            hook(self._models, weights, output_dir)
+        model_states = [FrozenState(w) for w in weights]
+
         if async_save and self.num_processes > 1:
             # the save path runs cross-process barriers (and, unsharded,
             # allgathers); issuing those from a background thread would race
@@ -773,7 +788,7 @@ class Accelerator:
         if not async_save:
             save_accelerator_state(
                 output_dir,
-                models=self._models,
+                models=model_states,
                 optimizers=self._optimizers,
                 schedulers=self._schedulers,
                 dataloaders=self._dataloaders,
@@ -826,9 +841,7 @@ class Accelerator:
             return snap
 
         snap_arrays = _snapshot_on_device if sharded_state else _snapshot_to_host
-        frozen_models = [
-            FrozenState(snap_arrays(dict(m.state_dict()))) for m in self._models
-        ]
+        frozen_models = [FrozenState(snap_arrays(w)) for w in weights]
         if sharded_state:
             frozen_opts = []
             for o in self._optimizers:
@@ -893,6 +906,28 @@ class Accelerator:
         self._async_save_thread.start()
         return output_dir
 
+    def register_save_state_pre_hook(self, hook):
+        """Run ``hook(models, weights, output_dir)`` before every
+        ``save_state`` write (reference accelerator.py:3074).  ``weights``
+        is the list of state dicts about to be saved; mutating it (removing
+        or replacing entries) customizes what gets written.  Returns a
+        handle whose ``remove()`` detaches the hook."""
+        from .hooks import RemovableHandle
+
+        handle = RemovableHandle(self._save_state_pre_hooks)
+        self._save_state_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_load_state_pre_hook(self, hook):
+        """Run ``hook(models, input_dir)`` before every ``load_state``
+        restore (reference accelerator.py:3241).  Removing models from the
+        list takes over loading for them.  Returns a removable handle."""
+        from .hooks import RemovableHandle
+
+        handle = RemovableHandle(self._load_state_pre_hooks)
+        self._load_state_pre_hooks[handle.id] = hook
+        return handle
+
     def wait_for_checkpoint(self) -> None:
         """Block until an in-flight ``save_state(async_save=True)`` is
         durable on disk; re-raise any error it hit."""
@@ -919,9 +954,15 @@ class Accelerator:
             if not folders:
                 raise FileNotFoundError(f"no checkpoints in {base}")
             input_dir = os.path.join(base, folders[-1])
+        # pre-hooks see (models, input_dir) and may remove entries from the
+        # list to take over loading for those models (reference
+        # accelerator.py:3365); the loader restores whatever remains
+        models = list(self._models)
+        for hook in self._load_state_pre_hooks.values():
+            hook(models, input_dir)
         override = load_accelerator_state(
             input_dir,
-            models=self._models,
+            models=models,
             optimizers=self._optimizers,
             schedulers=self._schedulers,
             dataloaders=self._dataloaders,

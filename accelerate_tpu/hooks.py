@@ -35,6 +35,22 @@ from .utils.modeling import (
 from .utils.offload import PrefixedDataset
 
 
+class RemovableHandle:
+    """Handle returned by hook registration; ``remove()`` detaches the hook
+    (reference: torch.utils.hooks.RemovableHandle, used by
+    accelerator.py:3074/3241 register_*_state_pre_hook)."""
+
+    _next_id = 0
+
+    def __init__(self, hooks_dict: dict):
+        self._hooks_dict = hooks_dict
+        self.id = RemovableHandle._next_id
+        RemovableHandle._next_id += 1
+
+    def remove(self) -> None:
+        self._hooks_dict.pop(self.id, None)
+
+
 class ModelHook:
     """Pre/post-forward protocol (reference: hooks.py:43)."""
 

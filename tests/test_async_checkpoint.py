@@ -145,3 +145,68 @@ def test_end_training_waits(tmp_path):
     acc.save_state(str(tmp_path / "ckpt"), async_save=True)
     acc.end_training()
     assert os.path.exists(tmp_path / "ckpt" / "accelerator_meta.json")
+
+
+# --------------------------------------------------------- state pre-hooks
+def test_save_load_state_pre_hooks_roundtrip_sidecar(tmp_path):
+    """Hooks save/load a sidecar config next to the checkpoint (reference
+    register_save_state_pre_hook / register_load_state_pre_hook,
+    accelerator.py:3074/3241)."""
+    acc, model, opt, step = _setup()
+    seen = {}
+
+    def save_hook(models, weights, output_dir):
+        assert len(models) == len(weights) == 1
+        with open(os.path.join(output_dir, "sidecar.txt"), "w") as f:
+            f.write("cfg-v7")
+
+    def load_hook(models, input_dir):
+        with open(os.path.join(input_dir, "sidecar.txt")) as f:
+            seen["cfg"] = f.read()
+
+    h1 = acc.register_save_state_pre_hook(save_hook)
+    h2 = acc.register_load_state_pre_hook(load_hook)
+    acc.save_state(str(tmp_path / "ckpt"))
+    acc.load_state(str(tmp_path / "ckpt"))
+    assert seen["cfg"] == "cfg-v7"
+    h1.remove()
+    h2.remove()
+    acc.save_state(str(tmp_path / "ckpt2"))
+    assert not os.path.exists(tmp_path / "ckpt2" / "sidecar.txt")  # detached
+
+
+def test_save_hook_can_override_weights(tmp_path):
+    """Mutating the weights list customizes what is written — the reference's
+    documented take-over-saving pattern."""
+    acc, model, opt, step = _setup()
+
+    def save_hook(models, weights, output_dir):
+        weights[0] = {k: v * 0 + 5.0 for k, v in weights[0].items()}
+
+    acc.register_save_state_pre_hook(save_hook)
+    acc.save_state(str(tmp_path / "ckpt"))
+    acc.load_state(str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(np.asarray(model.weight.data), 5.0)
+
+
+def test_save_hook_applies_to_async_saves(tmp_path):
+    acc, model, opt, step = _setup()
+    acc.register_save_state_pre_hook(
+        lambda models, weights, output_dir: weights.__setitem__(
+            0, {k: v * 0 + 3.0 for k, v in weights[0].items()}
+        )
+    )
+    acc.save_state(str(tmp_path / "ckpt"), async_save=True)
+    acc.wait_for_checkpoint()
+    acc.load_state(str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(np.asarray(model.weight.data), 3.0)
+
+
+def test_load_hook_can_remove_model_from_restore(tmp_path):
+    acc, model, opt, step = _setup()
+    acc.save_state(str(tmp_path / "ckpt"))
+    model.weight.data = model.weight.data * 0 + 42.0
+    acc.register_load_state_pre_hook(lambda models, input_dir: models.clear())
+    acc.load_state(str(tmp_path / "ckpt"))
+    # the hook took over model loading: nothing restored the clobber
+    np.testing.assert_allclose(np.asarray(model.weight.data), 42.0)
