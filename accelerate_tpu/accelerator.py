@@ -156,7 +156,6 @@ class Accelerator:
         self.gradient_state = GradientState(gradient_accumulation_plugin)
 
         self.device_placement = device_placement
-        self.split_batches = split_batches
         self.dataloader_config = dataloader_config or DataLoaderConfiguration(
             split_batches=split_batches
         )
@@ -274,6 +273,51 @@ class Accelerator:
             jnp.float16 if self.state.mixed_precision == "fp16" else jnp.float32
         )
 
+    @property
+    def save_iteration(self) -> int:
+        """Next automatic checkpoint index (reference accelerator.py:680)."""
+        return self.project_configuration.iteration
+
+    @property
+    def optimizer_step_was_skipped(self) -> bool:
+        """True when the last update was dropped (fp16 overflow) — the LR
+        should then not advance (reference accelerator.py:3674)."""
+        return any(opt.step_was_skipped for opt in self._optimizers)
+
+    @property
+    def deepspeed_plugin(self):
+        """Always ``None``: there is no DeepSpeed engine on TPU.  DeepSpeed
+        configs are INGESTED instead — ``utils/deepspeed_compat.py`` maps
+        ZeRO stages/offload onto fsdp mesh layouts (reference
+        accelerator.py:603 returns the active plugin)."""
+        return None
+
+    # deprecated-in-reference dataloader passthroughs, kept for drop-in
+    # parity (reference reads them off dataloader_config the same way)
+    @property
+    def split_batches(self) -> bool:
+        return self.dataloader_config.split_batches
+
+    @property
+    def dispatch_batches(self):
+        return self.dataloader_config.dispatch_batches
+
+    @property
+    def even_batches(self) -> bool:
+        return self.dataloader_config.even_batches
+
+    @property
+    def use_seedable_sampler(self) -> bool:
+        return self.dataloader_config.use_seedable_sampler
+
+    @property
+    def non_blocking(self) -> bool:
+        return self.dataloader_config.non_blocking
+
+    @property
+    def use_stateful_dataloader(self) -> bool:
+        return self.dataloader_config.use_stateful_dataloader
+
     # ------------------------------------------------------------- process ctl
     def wait_for_everyone(self) -> None:
         PartialState().wait_for_everyone()
@@ -292,6 +336,27 @@ class Accelerator:
 
     def on_last_process(self, function):
         return PartialState().on_last_process(function)
+
+    def on_local_process(self, function=None, local_process_index=None):
+        """Run only on the given LOCAL process index (reference
+        accelerator.py:908)."""
+        if function is None:
+            from functools import partial
+
+            return partial(self.on_local_process, local_process_index=local_process_index)
+        idx = local_process_index or 0
+
+        def wrapper(*args, **kwargs):
+            if PartialState().local_process_index == idx:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    def trigger_sync_in_backward(self, model=None) -> None:
+        """Force the NEXT backward/step to be a sync step after forwards ran
+        under ``no_sync`` (reference accelerator.py:1043).  Under SPMD this
+        flips the accumulation gate: ``optimizer.step`` will apply."""
+        self.gradient_state._set_sync_gradients(True)
 
     @contextlib.contextmanager
     def main_process_first(self):
@@ -586,11 +651,29 @@ class Accelerator:
             )
         yield
 
+    def unscale_gradients(self, optimizer=None) -> None:
+        """Divide the fp16 loss scale out of the gradients now (reference
+        accelerator.py:2450); a no-op in every other precision mode.  The
+        following ``optimizer.step`` will not divide again.  Normally called
+        for you by ``clip_grad_norm_`` / ``clip_grad_value_``."""
+        if optimizer is None:
+            optimizers = self._optimizers
+        elif isinstance(optimizer, (list, tuple)):
+            optimizers = optimizer
+        else:
+            optimizers = [optimizer]
+        for opt in optimizers:
+            if hasattr(opt, "unscale_grads"):
+                opt.unscale_grads()
+
     def clip_grad_norm_(self, parameters, max_norm: float, norm_type: float = 2.0):
         """Global-norm clip over ``param.grad`` (reference accelerator.py:2485).
 
         Works eagerly and under capture (pure jnp ops on the grads).
+        Under fp16 the loss scale is divided out first — clipping must see
+        true gradient magnitudes (reference clips after unscale_gradients).
         """
+        self.unscale_gradients()
         params = list(parameters)
         grads = [p.grad for p in params if p.grad is not None]
         if not grads:
@@ -608,6 +691,7 @@ class Accelerator:
         return total
 
     def clip_grad_value_(self, parameters, clip_value: float) -> None:
+        self.unscale_gradients()
         for p in parameters:
             if p.grad is not None:
                 p.grad = jnp.clip(p.grad, -clip_value, clip_value)

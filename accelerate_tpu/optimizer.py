@@ -104,6 +104,7 @@ class AcceleratedOptimizer:
         self.device_placement = device_placement
         self._is_overflow = False
         self._accelerate_step_called = False
+        self._grads_unscaled = False
 
     # pass-throughs ----------------------------------------------------------
     @property
@@ -132,6 +133,33 @@ class AcceleratedOptimizer:
     def zero_grad(self, set_to_none: bool = True) -> None:
         if self.gradient_state.sync_gradients:
             self.optimizer.zero_grad(set_to_none)
+            self._grads_unscaled = False
+
+    def unscale_grads(self) -> None:
+        """Divide the loss scale out of the grads now (reference
+        unscale_gradients via torch GradScaler.unscale_): clipping must see
+        TRUE gradient magnitudes, and the subsequent ``step`` must not
+        divide again.  No-op without an fp16 scaler; pure jnp math, so it
+        works identically eagerly and under capture.
+
+        Two precision rules (round-4 review findings): the unscaled grads
+        STAY fp32 — casting back to fp16 would flush exactly the
+        small-gradient range loss scaling exists to protect (the step path
+        upcasts anyway) — and mid-accumulation calls are no-ops: later
+        micro-steps would pile scaled grads onto unscaled ones and the sync
+        step would then apply them 1024x too large.  Unscaling only ever
+        happens on the step that will actually apply."""
+        if (
+            self.scaler is None
+            or self._grads_unscaled
+            or not self.gradient_state.sync_gradients
+        ):
+            return
+        inv = self.scaler.unscale_()
+        for p in self.optimizer.param_list:
+            if p.grad is not None:
+                p.grad = p.grad.astype(jnp.float32) * inv
+        self._grads_unscaled = True
 
     def step(self, closure=None) -> None:
         if not self.gradient_state.sync_gradients:
@@ -173,6 +201,8 @@ class AcceleratedOptimizer:
         # the jnp.where select below mixes old and new state, and XLA
         # refuses mixed memory spaces
         opt.stage_state_on_device()
+        already_unscaled = self._grads_unscaled
+        self._grads_unscaled = False
         params_before = [p.data for p in opt.param_list]
         masters_before = list(opt.master_params)
         opt_state_before = opt.opt_state
@@ -180,7 +210,10 @@ class AcceleratedOptimizer:
         for p in opt.param_list:
             if p.grad is not None:
                 p.grad = jnp.where(jnp.isfinite(p.grad), p.grad, 0.0).astype(p.grad.dtype)
-        opt.step(closure, grad_scale=self.scaler.unscale_())
+        opt.step(
+            closure,
+            grad_scale=1.0 if already_unscaled else self.scaler.unscale_(),
+        )
 
         def _sel(new, old):
             return jnp.where(finite, new, old) if hasattr(old, "dtype") else new

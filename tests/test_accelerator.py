@@ -355,6 +355,99 @@ def test_gather_for_metrics_object_path_truncates_remainder():
         acc.gradient_state._remove_dataloader(tail)
 
 
+def test_fp16_clip_unscales_first():
+    """clip_grad_norm_ under fp16 must divide the loss scale out BEFORE
+    measuring the norm (reference clips after unscale_gradients,
+    accelerator.py:2450/2485) — and step must not divide again."""
+    from accelerate_tpu.utils.dataclasses import GradScalerKwargs
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    # small init scale: grad x default 65536 would overflow fp16 itself
+    acc = Accelerator(
+        mixed_precision="fp16",
+        kwargs_handlers=[GradScalerKwargs(init_scale=1024.0)],
+    )
+    model = nn.Linear(4, 1)
+    opt = optim.SGD(model.parameters(), lr=1.0)
+    model, opt = acc.prepare(model, opt)
+    assert acc.scaler is not None and float(acc.scaler.scale) > 1.0
+
+    before = np.asarray(model.weight.data, dtype=np.float32).copy()
+    loss = model(Tensor(jnp.ones((2, 4), jnp.float16))).sum()
+    acc.backward(loss)  # grads carry the loss scale here
+    norm = float(acc.clip_grad_norm_(model.parameters(), max_norm=1e9))
+    # the measured norm is the TRUE gradient norm, not scale x norm
+    true_norm = np.sqrt(sum(
+        (np.asarray(g, dtype=np.float32) ** 2).sum()
+        for g in ([np.full((1, 4), 2.0), np.full((1,), 2.0)])
+    ))
+    assert norm == pytest.approx(true_norm, rel=1e-2), (norm, true_norm)
+    # unscaled grads stay fp32: an fp16 round-trip would flush the small
+    # gradients loss scaling exists to protect
+    assert all(p.grad.dtype == jnp.float32 for p in model.parameters())
+    opt.step()
+    after = np.asarray(model.weight.data, dtype=np.float32)
+    # SGD lr=1: delta == -grad (unscaled exactly once)
+    np.testing.assert_allclose(before - after, 2.0, rtol=1e-2)
+
+
+def test_fp16_unscale_is_noop_mid_accumulation():
+    """clip_grad_norm_ every micro-step must not corrupt the accumulation:
+    unscaling mid-window would mix scaled and unscaled grads and apply the
+    later micro-steps' contributions scale-times too large (round-4 review
+    finding)."""
+    from accelerate_tpu.utils.dataclasses import GradScalerKwargs
+
+    def run(clip_every_step):
+        Accelerator._reset_state()
+        nn.manual_seed(0)
+        acc = Accelerator(
+            mixed_precision="fp16",
+            gradient_accumulation_steps=2,
+            kwargs_handlers=[GradScalerKwargs(init_scale=1024.0)],
+        )
+        model = nn.Linear(4, 1)
+        opt = optim.SGD(model.parameters(), lr=0.1)
+        model, opt = acc.prepare(model, opt)
+        for i in range(4):
+            with acc.accumulate(model):
+                loss = model(Tensor(jnp.ones((2, 4), jnp.float16) * (i + 1))).sum()
+                acc.backward(loss)
+                if clip_every_step:
+                    acc.clip_grad_norm_(model.parameters(), max_norm=1e9)
+                opt.step()
+                opt.zero_grad()
+        return np.asarray(model.weight.data, dtype=np.float32)
+
+    # a huge max_norm never actually clips, so weights must match exactly
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-3)
+
+
+def test_reference_parity_surface():
+    """The remaining small reference Accelerator APIs all exist and behave
+    (save_iteration, optimizer_step_was_skipped, deepspeed_plugin,
+    dataloader passthroughs, on_local_process, trigger_sync_in_backward)."""
+    Accelerator._reset_state()
+    acc = Accelerator()
+    assert acc.save_iteration == 0
+    assert acc.deepspeed_plugin is None
+    assert acc.optimizer_step_was_skipped is False
+    assert acc.split_batches is False and acc.even_batches is True
+    assert acc.non_blocking is False and acc.use_stateful_dataloader is False
+    assert acc.use_seedable_sampler in (True, False)
+
+    ran = []
+    acc.on_local_process(lambda: ran.append(1), local_process_index=0)()
+    acc.on_local_process(lambda: ran.append(2), local_process_index=3)()
+    assert ran == [1]  # single local process: only index 0 fires
+
+    with acc.no_sync():
+        assert acc.sync_gradients is False
+        acc.trigger_sync_in_backward()
+        assert acc.sync_gradients is True
+
+
 def test_gather_for_metrics_truncates_remainder():
     import accelerate_tpu
 
