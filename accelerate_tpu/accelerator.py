@@ -340,17 +340,9 @@ class Accelerator:
     def on_local_process(self, function=None, local_process_index=None):
         """Run only on the given LOCAL process index (reference
         accelerator.py:908)."""
-        if function is None:
-            from functools import partial
-
-            return partial(self.on_local_process, local_process_index=local_process_index)
-        idx = local_process_index or 0
-
-        def wrapper(*args, **kwargs):
-            if PartialState().local_process_index == idx:
-                return function(*args, **kwargs)
-
-        return wrapper
+        return PartialState().on_local_process(
+            function, local_process_index=local_process_index
+        )
 
     def trigger_sync_in_backward(self, model=None) -> None:
         """Force the NEXT backward/step to be a sync step after forwards ran

@@ -844,6 +844,28 @@ class DataLoaderShard(DataLoaderStateMixin):
     def batch_sampler(self):
         return self.global_batch_sampler
 
+    @property
+    def total_dataset_length(self) -> int:
+        """Reference data_loader.py:624: length of the FULL dataset, not the
+        per-process shard."""
+        if hasattr(self.dataset, "total_length"):
+            return self.dataset.total_length
+        return len(self.dataset)
+
+    def get_sampler(self):
+        """The index sampler feeding the batch sampler (reference
+        data_loader.py:630); None for streaming datasets."""
+        inner = getattr(self.global_batch_sampler, "batch_sampler", None)
+        return getattr(inner, "sampler", None)
+
+    def set_sampler(self, sampler) -> None:
+        """Swap the index sampler between epochs (reference :633) — e.g. to
+        replace a SeedableRandomSampler after resuming."""
+        inner = getattr(self.global_batch_sampler, "batch_sampler", None)
+        if inner is None:
+            raise TypeError("streaming DataLoaderShard has no sampler to swap")
+        inner.sampler = sampler
+
     # -- iteration ----------------------------------------------------------
     def _producer_runs_collectives(self) -> bool:
         """Whether _host_batches issues collectives (dispatch mode, >1 proc):

@@ -292,6 +292,28 @@ class PartialState:
 
         return wrapper
 
+    @property
+    def default_device(self):
+        """The first visible device (reference state.py default_device: the
+        device work lands on without explicit placement)."""
+        import jax
+
+        return jax.devices()[0]
+
+    def on_local_process(self, function: Callable = None, local_process_index: int = None):
+        """Decorator: run only on the given LOCAL process index (reference
+        state.py on_local_process)."""
+        if function is None:
+            return partial(self.on_local_process, local_process_index=local_process_index)
+        index = local_process_index or 0
+
+        @wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.local_process_index == index:
+                return function(*args, **kwargs)
+
+        return wrapper
+
     def on_process(self, function: Callable = None, process_index: int = None):
         if function is None:
             return partial(self.on_process, process_index=process_index)

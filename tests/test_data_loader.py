@@ -230,3 +230,29 @@ def test_global_batch_sampler_even_false_len_matches_iter():
     for n, bs, shards in [(10, 3, 2), (12, 3, 2), (9, 3, 2), (22, 4, 8), (10, 2, 2)]:
         gs = make_global(n, bs, shards, even_batches=False)
         assert len(list(gs)) == len(gs), (n, bs, shards)
+
+
+def test_sampler_accessors_and_total_length():
+    """get_sampler/set_sampler/total_dataset_length (reference
+    data_loader.py:624-641): swapping the index sampler between epochs
+    changes the visit order."""
+    from accelerate_tpu import Accelerator
+
+    Accelerator._reset_state()
+    Accelerator()
+    ds = [{"x": np.float32(i)} for i in range(16)]
+    dl = prepare_data_loader(ds, batch_size=2)
+    assert dl.total_dataset_length == 16
+    sampler = dl.get_sampler()
+    assert sampler is not None
+
+    class Reversed:
+        def __iter__(self):
+            return iter(range(15, -1, -1))
+
+        def __len__(self):
+            return 16
+
+    dl.set_sampler(Reversed())
+    first = next(iter(dl))
+    assert float(np.asarray(first["x"]).ravel()[0]) == 15.0
