@@ -93,3 +93,49 @@ from .other import (
 from .random import set_seed, synchronize_rng_state, synchronize_rng_states
 from .tqdm import tqdm
 from .versions import compare_versions, is_jax_version
+
+# flat re-exports matching the reference's `accelerate.utils` namespace
+# (utils/__init__.py there) — migrating code does
+# `from accelerate.utils import gather_object, send_to_device, ...` and the
+# same names must resolve here
+from .operations import (
+    broadcast,
+    broadcast_object_list,
+    concatenate,
+    convert_to_fp32,
+    find_batch_size,
+    find_device,
+    gather,
+    gather_object,
+    get_data_structure,
+    honor_type,
+    initialize_tensors,
+    listify,
+    pad_across_processes,
+    pad_input_tensors,
+    recursively_apply,
+    reduce,
+    send_to_device,
+    slice_tensors,
+)
+from .modeling import (
+    calculate_maximum_sizes,
+    check_device_map,
+    compute_module_sizes,
+    convert_file_size_to_int,
+    dtype_byte_size,
+    find_tied_parameters,
+    get_balanced_memory,
+    get_max_memory,
+    infer_auto_device_map,
+    load_checkpoint_in_model,
+    named_module_tensors,
+    retie_parameters,
+    set_module_tensor_to_device,
+)
+from .offload import (
+    load_offloaded_weight,
+    offload_state_dict,
+    offload_weight,
+    save_offload_index,
+)
