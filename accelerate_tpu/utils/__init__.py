@@ -48,6 +48,8 @@ from .fsdp_utils import (
 )
 from .environment import (
     are_libraries_initialized,
+    clear_environment,
+    convert_dict_to_env_variables,
     get_int_from_env,
     parse_choice_from_env,
     parse_flag_from_env,
@@ -86,7 +88,10 @@ from .other import (
     clean_state_dict_for_safetensors,
     convert_bytes,
     extract_model_from_parallel,
+    get_pretty_name,
     load,
+    merge_dicts,
+    recursive_getattr,
     save,
     wait_for_everyone,
 )
@@ -127,6 +132,7 @@ from .modeling import (
     find_tied_parameters,
     get_balanced_memory,
     get_max_memory,
+    has_offloaded_params,
     infer_auto_device_map,
     load_checkpoint_in_model,
     named_module_tensors,

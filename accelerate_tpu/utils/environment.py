@@ -9,6 +9,7 @@ env vars).
 
 from __future__ import annotations
 
+import contextlib
 import os
 from contextlib import contextmanager
 from typing import Any
@@ -118,3 +119,28 @@ def get_cpu_affinity(local_process_index: int) -> None:
     API no-op for drop-in compatibility.
     """
     return None
+
+
+@contextlib.contextmanager
+def clear_environment():
+    """Temporarily clear os.environ; restored on exit (reference
+    environment.py:291) — even mutations made inside the block are
+    discarded."""
+    old = os.environ.copy()
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+
+
+def convert_dict_to_env_variables(current_env: dict) -> list:
+    """Render an env dict as KEY=value lines, skipping entries with
+    characters that would break an env file (reference environment.py:34)."""
+    forbidden = [";", "\n", "<", ">", " "]
+    valid = []
+    for key, value in current_env.items():
+        if all(c not in (key + value) for c in forbidden) and key and value:
+            valid.append(f"{key}={value}\n")
+    return valid

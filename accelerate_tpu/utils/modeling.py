@@ -615,3 +615,12 @@ def load_checkpoint_in_model(
             f"unexpected={unexpected[:5]}"
         )
     return missing
+
+
+def has_offloaded_params(module) -> bool:
+    """True when ``module`` carries an AlignDevicesHook with offloading on
+    (reference modeling.py:2092; our hook attaches as ``_atpu_hook``)."""
+    from ..hooks import AlignDevicesHook
+
+    hook = getattr(module, "_atpu_hook", None)
+    return isinstance(hook, AlignDevicesHook) and getattr(hook, "offload", False)

@@ -153,3 +153,25 @@ def recursive_getattr(obj, attr: str):
     for part in attr.split("."):
         obj = getattr(obj, part)
     return obj
+
+
+def get_pretty_name(obj) -> str:
+    """Readable name for checkpoint registration logs (reference other.py:268)."""
+    if not hasattr(obj, "__qualname__") and not hasattr(obj, "__name__"):
+        obj = getattr(obj, "__class__", obj)
+    if hasattr(obj, "__qualname__"):
+        return obj.__qualname__
+    if hasattr(obj, "__name__"):
+        return obj.__name__
+    return str(obj)
+
+
+def merge_dicts(source: dict, destination: dict) -> dict:
+    """Recursively merge ``source`` into ``destination`` (reference other.py:281)."""
+    for key, value in source.items():
+        if isinstance(value, dict):
+            node = destination.setdefault(key, {})
+            merge_dicts(value, node)
+        else:
+            destination[key] = value
+    return destination

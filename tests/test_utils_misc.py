@@ -141,3 +141,46 @@ def test_tqdm_passthrough():
     from accelerate_tpu.utils import tqdm
 
     assert list(tqdm(range(5))) == list(range(5))
+
+
+def test_small_parity_utils():
+    """get_pretty_name / merge_dicts / clear_environment /
+    convert_dict_to_env_variables / has_offloaded_params (reference
+    other.py:268/281, environment.py:34/291, modeling.py:2092)."""
+    import os
+
+    from accelerate_tpu.utils import (
+        clear_environment,
+        convert_dict_to_env_variables,
+        get_pretty_name,
+        has_offloaded_params,
+        merge_dicts,
+    )
+
+    class Thing:
+        pass
+
+    assert get_pretty_name(Thing) .endswith("Thing")
+    assert get_pretty_name(Thing()).endswith("Thing")
+    assert get_pretty_name(get_pretty_name) == "get_pretty_name"
+
+    dst = {"a": 1, "b": {"x": 1}}
+    out = merge_dicts({"b": {"y": 2}, "c": 3}, dst)
+    assert out == {"a": 1, "b": {"x": 1, "y": 2}, "c": 3} and out is dst
+
+    os.environ["ATPU_TEST_ENV"] = "keepme"
+    with clear_environment():
+        assert "ATPU_TEST_ENV" not in os.environ
+        os.environ["ATPU_TEST_ENV"] = "discarded"
+    assert os.environ.pop("ATPU_TEST_ENV") == "keepme"
+
+    env = {"GOOD": "1", "BAD NAME": "2", "ALSO<BAD": "3", "EMPTY": ""}
+    assert convert_dict_to_env_variables(env) == ["GOOD=1\n"]
+
+    import accelerate_tpu.nn as nn
+    from accelerate_tpu.hooks import AlignDevicesHook, add_hook_to_module
+
+    lin = nn.Linear(2, 2)
+    assert has_offloaded_params(lin) is False
+    add_hook_to_module(lin, AlignDevicesHook(offload=True))
+    assert has_offloaded_params(lin) is True
