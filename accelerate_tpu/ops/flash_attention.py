@@ -12,10 +12,12 @@ forward additionally emits the per-row logsumexp (LSE); the backward
 recomputes each (q-block, k-block) probability tile from q/k/LSE inside ONE
 fused kernel and contracts it against dO for dq, dk AND dv — so no O(S²)
 tensor ever reaches HBM in either direction and the QKᵀ recompute + input
-DMA streams are paid once, not twice (known cost: dq's output block is
-flushed on every inner q step, so its HBM writes scale with the k-block
-count — garbage until the last k iteration, then overwritten; correct, but
-write-amplified whenever sk/block_k > 1).  A cheap XLA-fused
+DMA streams are paid once, not twice.  dq is carried as ONE whole-q-length
+output block per (batch·head) whose index map ignores the k/q grid dims, so
+Pallas keeps it VMEM-resident across the entire tile walk and flushes it to
+HBM exactly once per bh — row-exact sq·d writes however fine the k tiling
+(the previous per-q-block output spec flushed on every inner q step, write-
+amplifying by the k-block count).  A cheap XLA-fused
 ``delta = rowsum(dO·O)`` precomputation feeds it.
 
 The reference framework has no attention kernels at all (SURVEY.md §2.7 —
@@ -358,7 +360,7 @@ def _flash_bwd_kernel(
     do_ref,  # (1, block_q, d)
     lse_ref,  # (1, block_q, 1) f32
     delta_ref,  # (1, block_q, 1) f32
-    dq_ref,  # (1, block_q, d) out
+    dq_ref,  # (1, seq_q, d) out — ONE whole-length block per bh
     dk_ref,  # (1, block_k, d) out
     dv_ref,  # (1, block_k, d) out
     dq_scratch,  # (seq_q, d) f32 — FULL q-length accumulator
@@ -382,7 +384,13 @@ def _flash_bwd_kernel(
     bounded): Pallas does NOT reload non-consecutively revisited output
     blocks, so accumulating into dq_ref across ki would silently read stale
     buffer contents whenever the k grid exceeds the VMEM window, and bf16
-    output accumulation would round partial sums every hop."""
+    output accumulation would round partial sums every hop.  The dq OUTPUT is
+    likewise one whole-q-length block whose index map ignores (ki, qi): the
+    buffer stays VMEM-resident for the whole per-bh tile walk and Pallas
+    flushes it to HBM once per bh, so finalized rows written at the last ki
+    cost exactly sq·d HBM traffic regardless of the k-block count (a
+    per-q-block output spec would flush block_q·d on EVERY inner q step —
+    sk/block_k× write amplification)."""
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     num_q = pl.num_programs(2)
@@ -461,7 +469,7 @@ def _flash_bwd_kernel(
 
     @pl.when(ki == num_k - 1)
     def _flush_dq():
-        dq_ref[0] = dq_scratch[q_rows, :].astype(dq_ref.dtype)
+        dq_ref[0, q_rows, :] = dq_scratch[q_rows, :].astype(dq_ref.dtype)
 
     @pl.when(qi == num_q - 1)
     def _finalize():
@@ -727,8 +735,10 @@ def _flash_backward(
         grid=(bh, sk // block_k, sq // block_q),
         in_specs=[_off_spec(), q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=[
+            # dq: one whole-q-length block per bh (index map ignores ki/qi) —
+            # VMEM-resident across the tile walk, flushed once per bh
             pl.BlockSpec(
-                (1, block_q, d), lambda bh_, ki, qi: (bh_, qi, 0), memory_space=pltpu.VMEM
+                (1, sq, d), lambda bh_, ki, qi: (bh_, 0, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
                 (1, block_k, d), lambda bh_, ki, qi: (bh_, ki, 0), memory_space=pltpu.VMEM

@@ -1,15 +1,19 @@
 #!/bin/bash
-# Watch for the TPU tunnel to return; when it does, run the round-4 queued
+# Watch for the TPU tunnel to return; when it does, run the round-5 queued
 # perf work ONCE, in VERDICT priority order, leaving artifacts in the repo
 # root (picked up by the round-end auto-commit if no one is around).
-#   1. plain bench.py            -> BENCH_r04_live.json  (the headline artifact)
-#   2. flag experiments          -> TPU_EXPERIMENTS_r04.log
-#   3. profiler trace            -> /tmp/tpu_sweep4/trace (+ note in log)
-#   4. BENCH_FULL staged extras  -> BENCH_FULL_r04.json (incremental partials)
+#   1. plain bench.py            -> BENCH_r05_live.json  (the headline artifact)
+#   2. BENCH_FULL staged extras  -> BENCH_FULL_r05.json  (BERT-MRPC row first —
+#                                    the BASELINE primary metric)
+#   2b. flash bwd block sweep    -> in-log JSON lines (the dq write-amp fix
+#                                    changed the tiling economics; fwd blocks
+#                                    are covered by the flag experiments)
+#   3. flag experiments          -> TPU_EXPERIMENTS_r05.log
+#   4. profiler trace            -> /tmp/tpu_sweep5/trace (+ note in log)
 # Usage: setsid nohup bash tools/tpu_when_up.sh &
 set -u
 cd "$(dirname "$0")/.."
-MARK=/tmp/tpu_when_up_r04.ran
+MARK=/tmp/tpu_when_up_r05.ran
 [ -e "$MARK" ] && exit 0
 while true; do
   ok=$(timeout -k 10 110 python - <<'EOF' 2>/dev/null
@@ -25,13 +29,20 @@ touch "$MARK"
 {
   echo "== TPU returned $(date -u +%FT%TZ) =="
   echo "== 1. plain bench (driver-format artifact) =="
-  BENCH_INIT_ATTEMPTS=2 timeout 1800 python bench.py 2>/tmp/bench_r04_err.log \
-    | tee BENCH_r04_live.json
-  echo "== 2. flag experiments =="
-  bash tools/tpu_flag_experiments.sh /tmp/tpu_exp4 && cat /tmp/tpu_exp4/exp.log
-  echo "== 3. profiler trace =="
-  bash tools/tpu_trace.sh /tmp/tpu_sweep4 || true
-  echo "== 4. BENCH_FULL staged extras =="
-  BENCH_FULL=1 BENCH_INIT_ATTEMPTS=2 BENCH_PARTIAL_PATH=BENCH_FULL_r04.json \
-    timeout 4900 python bench.py 2>/tmp/bench_full_r04_err.log
-} > TPU_EXPERIMENTS_r04.log 2>&1
+  BENCH_INIT_ATTEMPTS=2 timeout 1800 python bench.py 2>/tmp/bench_r05_err.log \
+    | tee BENCH_r05_live.json
+  echo "== 2. BENCH_FULL staged extras (BERT-MRPC primary row first) =="
+  BENCH_FULL=1 BENCH_INIT_ATTEMPTS=2 BENCH_PARTIAL_PATH=BENCH_FULL_r05.json \
+    timeout 4900 python bench.py 2>/tmp/bench_full_r05_err.log
+  echo "== 2b. flash bwd block sweep (write-amp fix changes the tiling economics) =="
+  for BK in 256 512; do
+    echo "-- bwd block $BK --"
+    ACCELERATE_TPU_FLASH_BWD_BLOCK_Q=$BK ACCELERATE_TPU_FLASH_BWD_BLOCK_K=$BK \
+      BENCH_INIT_ATTEMPTS=2 timeout 1200 python bench.py \
+      2>/tmp/bench_sweep_r05_bwd${BK}_err.log
+  done
+  echo "== 3. flag experiments =="
+  bash tools/tpu_flag_experiments.sh /tmp/tpu_exp5 && cat /tmp/tpu_exp5/exp.log
+  echo "== 4. profiler trace =="
+  bash tools/tpu_trace.sh /tmp/tpu_sweep5 || true
+} > TPU_EXPERIMENTS_r05.log 2>&1
