@@ -796,21 +796,22 @@ class Accelerator:
         """Checkpoint everything registered with the Accelerator.
 
         ``async_save=True`` overlaps checkpoint serialization and file
-        writes with continued training.  The state is snapshotted at call
-        time into buffers the training loop can never invalidate (donation
-        in a captured step deletes live buffers regardless of held
-        references): unsharded saves complete a parallelized device→host
-        transfer here and hand the thread pure numpy; sharded saves take an
-        on-device copy (keeping the GSPMD layout the shard writer needs) at
-        the cost of a transient extra state copy in HBM.  Steps taken after
-        the call never leak into the checkpoint.  One save may be in flight
-        at a time; ``wait_for_checkpoint()`` blocks until it is durable
-        (``load_state``/``end_training``/the next ``save_state`` wait
-        automatically, and the writer is non-daemon so interpreter exit
-        joins it).
+        writes with continued training, on any process count.  The save's
+        prepare phase runs at call time on the main thread of every
+        process: all collectives (unsharded multi-host gathers) plus every
+        device→host transfer, materializing the state into host numpy the
+        training loop can never invalidate (donation in a captured step
+        deletes live buffers regardless of held references; sharded saves
+        pull only this host's unique GSPMD shards — O(shard) host memory,
+        no extra HBM copy).  The writer thread then only serializes and
+        writes files, so it cannot race the training loop's collectives.
+        Steps taken after the call never leak into the checkpoint.  One
+        save may be in flight at a time; ``wait_for_checkpoint()`` joins
+        the writer and runs the collective finalize (barrier +
+        stale-artifact cleanup) — ``load_state``/``end_training``/the next
+        ``save_state`` call it automatically on every rank, and the writer
+        is non-daemon so interpreter exit joins it.
         """
-        from .checkpointing import save_accelerator_state
-
         self.wait_for_checkpoint()
         if self.project_configuration.automatic_checkpoint_naming:
             output_dir = os.path.join(self.project_dir or ".", "checkpoints")
@@ -851,135 +852,77 @@ class Accelerator:
             hook(self._models, weights, output_dir)
         model_states = [FrozenState(w) for w in weights]
 
-        if async_save and self.num_processes > 1:
-            # the save path runs cross-process barriers (and, unsharded,
-            # allgathers); issuing those from a background thread would race
-            # the training loop's own collectives — same hazard as the
-            # dispatch loader's producer.  Fall back loudly.
-            logger.warning(
-                "async_save is only supported with a single host process; "
-                "saving synchronously"
-            )
-            async_save = False
+        # Three-phase save (checkpointing.py): prepare runs EVERY collective
+        # (unsharded multi-host gathers) and every device→host transfer here
+        # on the main thread of every process, so the write phase is pure
+        # file IO.  That is what makes async safe multi-process: the writer
+        # thread never issues a collective that could race the training
+        # loop's own (the dispatch-loader producer hazard).  snapshot=True
+        # additionally deep-copies Python-side state; device arrays are
+        # materialized to host numpy either way (donation in a later
+        # captured step invalidates live buffers regardless of references).
+        from .checkpointing import (
+            finalize_accelerator_save,
+            prepare_accelerator_save,
+            write_accelerator_save,
+        )
+
+        plan = prepare_accelerator_save(
+            output_dir,
+            models=model_states,
+            optimizers=self._optimizers,
+            schedulers=self._schedulers,
+            dataloaders=self._dataloaders,
+            custom_objects=self._custom_objects,
+            step=self.step,
+            scaler=self.scaler,
+            safe_serialization=safe_serialization,
+            sharded_state=sharded_state,
+            snapshot=async_save,
+        )
         if not async_save:
-            save_accelerator_state(
-                output_dir,
-                models=model_states,
-                optimizers=self._optimizers,
-                schedulers=self._schedulers,
-                dataloaders=self._dataloaders,
-                custom_objects=self._custom_objects,
-                step=self.step,
-                scaler=self.scaler,
-                safe_serialization=safe_serialization,
-                sharded_state=sharded_state,
-            )
+            write_accelerator_save(plan)
+            finalize_accelerator_save(plan)
             return output_dir
 
-        import copy as _copy
         import threading as _threading
-
-        import numpy as _np
-
-        from .checkpointing import FrozenOptimizer, FrozenState, _rng_states
-
-        # Snapshot at call time.  Holding references is NOT enough: a later
-        # captured step DONATES the live buffers and donation invalidates
-        # them regardless of outstanding Python references.  So array leaves
-        # are materialized into buffers the training loop can never touch:
-        #   - unsharded saves: host numpy, with every D2H started async
-        #     first so the call stalls for max(transfer), not sum(transfer);
-        #     the thread then only serializes and writes.
-        #   - sharded saves: an on-device copy (jnp.copy keeps the GSPMD
-        #     layout the per-shard writer needs) — a transient extra state
-        #     copy in HBM until the thread drains it.
-        # Python-side state is deep-copied before training mutates it.
-        def _snapshot_to_host(tree):
-            leaves, treedef = jax.tree_util.tree_flatten(tree)
-            for leaf in leaves:
-                if hasattr(leaf, "copy_to_host_async"):
-                    leaf.copy_to_host_async()
-            out = [
-                _np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x
-                for x in leaves
-            ]
-            return jax.tree_util.tree_unflatten(treedef, out)
-
-        def _snapshot_on_device(tree):
-            snap = jax.tree_util.tree_map(
-                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree
-            )
-            # the copies must be materialized before we return control to a
-            # loop that may donate the sources
-            jax.block_until_ready(
-                [x for x in jax.tree_util.tree_leaves(snap) if isinstance(x, jax.Array)]
-            )
-            return snap
-
-        snap_arrays = _snapshot_on_device if sharded_state else _snapshot_to_host
-        frozen_models = [FrozenState(snap_arrays(w)) for w in weights]
-        if sharded_state:
-            frozen_opts = []
-            for o in self._optimizers:
-                arrays, opt_meta = o.optimizer.sharded_state_arrays()
-                frozen_opts.append(
-                    FrozenOptimizer(
-                        None, (_snapshot_on_device(arrays), _copy.deepcopy(opt_meta))
-                    )
-                )
-        else:
-            frozen_opts = [
-                FrozenOptimizer(_snapshot_to_host(o.state_dict()), None)
-                for o in self._optimizers
-            ]
-        frozen_scheds = [FrozenState(_copy.deepcopy(s.state_dict())) for s in self._schedulers]
-        frozen_dls = [
-            FrozenState(_copy.deepcopy(dl.state_dict()))
-            if hasattr(dl, "state_dict")
-            else object()
-            for dl in self._dataloaders
-        ]
-        frozen_custom = [
-            FrozenState(_copy.deepcopy(_snapshot_to_host(obj.state_dict())))
-            for obj in self._custom_objects
-        ]
-        frozen_scaler = (
-            FrozenState(_copy.deepcopy(self.scaler.state_dict()))
-            if self.scaler is not None
-            else None
-        )
-        rng_snapshot = _rng_states()
-        step_snapshot = self.step
-
-        def _write():
-            save_accelerator_state(
-                output_dir,
-                models=frozen_models,
-                optimizers=frozen_opts,
-                schedulers=frozen_scheds,
-                dataloaders=frozen_dls,
-                custom_objects=frozen_custom,
-                step=step_snapshot,
-                scaler=frozen_scaler,
-                safe_serialization=safe_serialization,
-                sharded_state=sharded_state,
-                rng_states=rng_snapshot,
-            )
 
         def _runner():
             try:
-                _write()
+                write_accelerator_save(plan)
             except BaseException as exc:  # noqa: BLE001 — surfaced on wait
                 self._async_save_error = exc
 
         self._async_save_error = None
+        self._async_save_plan = plan
         # non-daemon: a normal interpreter exit joins this thread, so a
         # script that ends right after save_state still gets a complete
-        # checkpoint instead of a silently truncated one
+        # checkpoint instead of a silently truncated one.  The collective
+        # finalize (barrier + stale-artifact cleanup) runs on the main
+        # thread in wait_for_checkpoint.
         self._async_save_thread = _threading.Thread(
             target=_runner, name="accelerate-tpu-async-save", daemon=False
         )
         self._async_save_thread.start()
+        # Exit-without-wait safety net: CPython joins non-daemon threads
+        # BEFORE atexit callbacks run, so a handler registered here sees the
+        # write finished and can run the (deferred) finalize cleanup.
+        # Single-process only — finalize's barriers are no-ops there; with
+        # multiple processes an atexit-time collective against ranks that
+        # may already be gone could hang, so those must call
+        # wait_for_checkpoint (load_state/end_training do) or stale-file
+        # cleanup is skipped.
+        if self.num_processes == 1 and not getattr(self, "_async_atexit_armed", False):
+            import atexit
+
+            def _finalize_at_exit():
+                try:
+                    self.wait_for_checkpoint()
+                except Exception as exc:  # noqa: BLE001 — exit path, log only
+                    logger.warning(f"async checkpoint failed at interpreter exit: {exc}")
+
+            atexit.register(_finalize_at_exit)
+            self._async_atexit_armed = True
         return output_dir
 
     def register_save_state_pre_hook(self, hook):
@@ -1006,7 +949,15 @@ class Accelerator:
 
     def wait_for_checkpoint(self) -> None:
         """Block until an in-flight ``save_state(async_save=True)`` is
-        durable on disk; re-raise any error it hit."""
+        durable on disk; re-raise any error it hit.
+
+        Collective on multi-process: after joining the local writer thread
+        this runs the save's finalize phase (cross-process barrier +
+        stale-artifact cleanup), so every process must call it — which the
+        automatic call sites (``load_state``/``end_training``/the next
+        ``save_state``) already do on every rank.  If the writer failed,
+        cleanup is skipped (older checkpoint files stay loadable) and the
+        error re-raises after the barrier."""
         thread = getattr(self, "_async_save_thread", None)
         if thread is None:
             return
@@ -1014,6 +965,21 @@ class Accelerator:
         self._async_save_thread = None
         error = getattr(self, "_async_save_error", None)
         self._async_save_error = None
+        plan = getattr(self, "_async_save_plan", None)
+        self._async_save_plan = None
+        if plan is not None:
+            from .checkpointing import finalize_accelerator_save
+
+            failed = error is not None
+            if self.num_processes > 1:
+                # cleanup must be all-or-nothing: a writer failure on ANY
+                # rank means some new artifact is missing/truncated there,
+                # and deleting the previous checkpoint's files elsewhere
+                # would leave no loadable checkpoint at all
+                from .utils.operations import gather_object
+
+                failed = any(gather_object([failed]))
+            finalize_accelerator_save(plan, cleanup=not failed)
         if error is not None:
             raise error
 

@@ -164,6 +164,235 @@ class FrozenOptimizer(FrozenState):
         return self._sharded_parts
 
 
+import dataclasses as _dataclasses
+
+
+@_dataclasses.dataclass
+class SavePlan:
+    """Everything ``write_accelerator_save`` needs, holding NO device handles
+    and requiring NO collectives: ``prepare_accelerator_save`` runs every
+    gather/D2H at call time on the main thread, so the write phase is safe to
+    run from a background thread even multi-process (a thread issuing
+    collectives would race the training loop's own — the dispatch-loader
+    producer hazard)."""
+
+    output_dir: str
+    payloads: list  # (filename, payload, kind in {"weights", "pickle"})
+    shard_files: list  # (filename, {slice_key: np.ndarray}) — this host's shards
+    index_files: list  # (filename, json_payload) — rank-0 writes
+    meta: dict
+    rng_filename: str
+    rng_payload: dict
+    preexisting: set
+    ckpt_names: list
+    sharded_state: bool
+    safe_serialization: bool
+    is_main: bool
+
+
+def prepare_accelerator_save(
+    output_dir: str,
+    models: list = (),
+    optimizers: list = (),
+    schedulers: list = (),
+    dataloaders: list = (),
+    custom_objects: list = (),
+    step: int = 0,
+    scaler=None,
+    safe_serialization: bool = True,
+    sharded_state: bool = False,
+    rng_states: Optional[dict] = None,
+    snapshot: bool = False,
+) -> SavePlan:
+    """Assemble a :class:`SavePlan`: the collective/device half of a save.
+
+    Every cross-process gather (unsharded multi-host arrays) and every
+    device→host transfer happens HERE, so it must run on the main thread of
+    every process.  ``snapshot=True`` additionally deep-copies Python-side
+    state (scheduler/sampler/scaler dicts) so a training loop that keeps
+    running before the write lands cannot mutate the checkpoint — device
+    arrays are always materialised to fresh host numpy regardless (donation
+    in a later captured step invalidates live buffers, so holding references
+    would not be enough).
+    """
+    state = PartialState()
+
+    # Record which artifacts already exist for every name we are about to
+    # write: a reused checkpoint directory may hold files from a PREVIOUS
+    # save with a different world size or sharded-ness, and the loader globs
+    # every {name}.shard-* file / prefers an index.json — stale files would
+    # be silently mixed into (or preferred over) the new state.  Cleanup
+    # runs in finalize, AFTER the new artifacts are fully written (deleting
+    # first would destroy the only checkpoint if this save crashes
+    # mid-write), gated per HOST (dirs may be host-local, not shared).
+    import copy as _copy
+    import glob as _glob
+
+    ckpt_names = [MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}" for i in range(len(models))]
+    ckpt_names += [
+        OPTIMIZER_NAME if i == 0 else f"{OPTIMIZER_NAME}_{i}" for i in range(len(optimizers))
+    ]
+    preexisting: set[str] = set()
+    for name in ckpt_names:
+        preexisting.update(_glob.glob(os.path.join(output_dir, f"{name}.shard-*.safetensors")))
+        for f in (f"{name}.index.json", f"{name}.safetensors", f"{name}.npz",
+                  f"{name}.bin", f"{name}.meta.bin"):
+            path = os.path.join(output_dir, f)
+            if os.path.exists(path):
+                preexisting.add(path)
+
+    def _copy_if_snapshot(obj):
+        return _copy.deepcopy(obj) if snapshot else obj
+
+    def _start_d2h(tree):
+        # D2H overlap: kick off every device→host copy before the first
+        # blocking np.asarray, so the stall is max(transfer), not sum
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if hasattr(leaf, "copy_to_host_async") and getattr(
+                leaf, "is_fully_addressable", True
+            ):
+                leaf.copy_to_host_async()
+
+    payloads: list[tuple[str, Any, str]] = []  # (filename, payload, kind)
+    shard_files: list[tuple[str, dict]] = []
+    index_files: list[tuple[str, Any]] = []
+    if sharded_state:
+        from .utils.fsdp_utils import collect_sharded_model_state, sharded_index_path
+
+        # every process collects (and later writes) its own shards — the
+        # assembly is host-local, no collectives involved
+        for i, model in enumerate(models):
+            name = MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}"
+            fname, arrays, index = collect_sharded_model_state(
+                model.state_dict(), name=name
+            )
+            shard_files.append((fname, arrays))
+            index_files.append((os.path.basename(sharded_index_path(".", name)), index))
+        for i, opt in enumerate(optimizers):
+            inner = opt.optimizer if hasattr(opt, "optimizer") else opt
+            oname = OPTIMIZER_NAME if i == 0 else f"{OPTIMIZER_NAME}_{i}"
+            arrays, meta = inner.sharded_state_arrays()
+            fname, collected, index = collect_sharded_model_state(arrays, name=oname)
+            shard_files.append((fname, collected))
+            index_files.append((os.path.basename(sharded_index_path(".", oname)), index))
+            payloads.append((f"{oname}.meta.bin", _copy_if_snapshot(meta), "pickle"))
+    else:
+        for model in models:
+            _start_d2h(list(model.state_dict().values()))
+        for opt in optimizers:
+            _start_d2h(opt.state_dict())
+        for i, model in enumerate(models):
+            name = MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}"
+            arrays = {k: _gather_numpy(v) for k, v in model.state_dict().items()}
+            payloads.append((name, arrays, "weights"))
+        for i, opt in enumerate(optimizers):
+            name = f"{OPTIMIZER_NAME}.bin" if i == 0 else f"{OPTIMIZER_NAME}_{i}.bin"
+            payloads.append(
+                (name, jax.tree_util.tree_map(_maybe_numpy, opt.state_dict()), "pickle")
+            )
+    for i, sched in enumerate(schedulers):
+        name = f"{SCHEDULER_NAME}.bin" if i == 0 else f"{SCHEDULER_NAME}_{i}.bin"
+        payloads.append((name, _copy_if_snapshot(sched.state_dict()), "pickle"))
+    for i, dl in enumerate(dataloaders):
+        name = f"{SAMPLER_NAME}.bin" if i == 0 else f"{SAMPLER_NAME}_{i}.bin"
+        if hasattr(dl, "state_dict"):
+            payloads.append((name, _copy_if_snapshot(dl.state_dict()), "pickle"))
+    for i, obj in enumerate(custom_objects):
+        name = f"{CUSTOM_STATES_NAME}_{i}.pkl"
+        # deepcopy under snapshot: tree_map rebuilds dict/list containers but
+        # passes unregistered mutable leaves (deques, stats objects) through
+        # by reference — training could mutate them before the write lands
+        payloads.append(
+            (
+                name,
+                _copy_if_snapshot(jax.tree_util.tree_map(_maybe_numpy, obj.state_dict())),
+                "pickle",
+            )
+        )
+    meta = {"step": step}
+    if scaler is not None:
+        meta["scaler"] = _copy_if_snapshot(scaler.state_dict())
+
+    # RNG state is per-process (reference checkpointing.py:143-172) and
+    # captured at call time so async saves don't leak later draws
+    return SavePlan(
+        output_dir=output_dir,
+        payloads=payloads,
+        shard_files=shard_files,
+        index_files=index_files,
+        meta=meta,
+        rng_filename=f"{RNG_STATE_NAME}_{state.process_index}.pkl",
+        rng_payload=rng_states if rng_states is not None else _rng_states(),
+        preexisting=preexisting,
+        ckpt_names=ckpt_names,
+        sharded_state=sharded_state,
+        safe_serialization=safe_serialization,
+        is_main=state.is_main_process,
+    )
+
+
+def write_accelerator_save(plan: SavePlan) -> None:
+    """Pure file IO — no collectives, no device access.  Safe to run from a
+    background thread on every process concurrently with training."""
+    from .native.st import pick_save_file
+    from .utils.fsdp_utils import SHARD_FILE_METADATA
+
+    os.makedirs(plan.output_dir, exist_ok=True)
+    save_file = pick_save_file()
+    for fname, arrays in plan.shard_files:
+        save_file(arrays, os.path.join(plan.output_dir, fname), metadata=SHARD_FILE_METADATA)
+    if plan.is_main:
+        for fname, index in plan.index_files:
+            with open(os.path.join(plan.output_dir, fname), "w") as f:
+                json.dump(index, f, indent=1)
+        for name, payload, kind in plan.payloads:
+            if kind == "weights":
+                _write_weight_arrays(payload, plan.output_dir, plan.safe_serialization, name)
+            else:
+                with open(os.path.join(plan.output_dir, name), "wb") as f:
+                    pickle.dump(payload, f)
+        with open(os.path.join(plan.output_dir, "accelerator_meta.json"), "w") as f:
+            json.dump(plan.meta, f)
+    with open(os.path.join(plan.output_dir, plan.rng_filename), "wb") as f:
+        pickle.dump(plan.rng_payload, f)
+
+
+def finalize_accelerator_save(plan: SavePlan, cleanup: bool = True) -> None:
+    """Collective epilogue: barrier all processes past their writes, then
+    drop PREEXISTING artifacts this save did not overwrite (e.g. shard files
+    from a different world size, or a stale index.json after a
+    sharded→full transition).  Runs on the main thread — for async saves,
+    from ``wait_for_checkpoint`` after the writer joins; ``cleanup=False``
+    (writer failed) keeps whatever older checkpoint files exist."""
+    import glob as _glob
+
+    state = PartialState()
+    state.wait_for_everyone()
+    if cleanup and getattr(state, "is_local_main_process", state.is_main_process):
+        world = state.num_processes
+        valid: set[str] = set()
+        for name in plan.ckpt_names:
+            if plan.sharded_state:
+                valid.update(
+                    _glob.glob(
+                        os.path.join(
+                            plan.output_dir, f"{name}.shard-*-of-{world:05d}.safetensors"
+                        )
+                    )
+                )
+                valid.add(os.path.join(plan.output_dir, f"{name}.index.json"))
+                valid.add(os.path.join(plan.output_dir, f"{name}.meta.bin"))
+            else:
+                valid.add(os.path.join(plan.output_dir, f"{name}.safetensors"))
+                valid.add(os.path.join(plan.output_dir, f"{name}.npz"))
+                valid.add(os.path.join(plan.output_dir, f"{name}.bin"))
+        for path in plan.preexisting - valid:
+            if os.path.exists(path):
+                os.remove(path)
+    state.wait_for_everyone()
+    logger.info(f"Saved accelerator state to {plan.output_dir}")
+
+
 def save_accelerator_state(
     output_dir: str,
     models: list = (),
@@ -185,119 +414,27 @@ def save_accelerator_state(
     host memory, N→M resharded restore.  Counterpart of the reference's
     FSDP SHARDED_STATE_DICT path incl. the optimizer
     (fsdp_utils.py:66-246, save_fsdp_optimizer :175).
+
+    Implemented as prepare (collectives + D2H) → write (file IO) →
+    finalize (barriers + stale-artifact cleanup); the async checkpoint path
+    (accelerator.save_state) runs the same three phases with the middle one
+    on a writer thread.
     """
-    state = PartialState()
-    os.makedirs(output_dir, exist_ok=True)
-
-    # Record which artifacts already exist for every name we are about to
-    # write: a reused checkpoint directory may hold files from a PREVIOUS
-    # save with a different world size or sharded-ness, and the loader globs
-    # every {name}.shard-* file / prefers an index.json — stale files would
-    # be silently mixed into (or preferred over) the new state.  Cleanup
-    # runs AFTER the new artifacts are fully written (deleting first would
-    # destroy the only checkpoint if this save crashes mid-write), gated per
-    # HOST (dirs may be host-local, not shared storage).
-    import glob as _glob
-
-    ckpt_names = [MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}" for i in range(len(models))]
-    ckpt_names += [
-        OPTIMIZER_NAME if i == 0 else f"{OPTIMIZER_NAME}_{i}" for i in range(len(optimizers))
-    ]
-    preexisting: set[str] = set()
-    for name in ckpt_names:
-        preexisting.update(_glob.glob(os.path.join(output_dir, f"{name}.shard-*.safetensors")))
-        for f in (f"{name}.index.json", f"{name}.safetensors", f"{name}.npz",
-                  f"{name}.bin", f"{name}.meta.bin"):
-            path = os.path.join(output_dir, f)
-            if os.path.exists(path):
-                preexisting.add(path)
-
-    # Payload assembly may involve cross-host allgathers of sharded arrays,
-    # so EVERY process must execute it (collectives deadlock otherwise); only
-    # the file writes are gated on the main process.
-    payloads: list[tuple[str, Any, str]] = []  # (filename, payload, kind)
-    if sharded_state:
-        from .utils.fsdp_utils import save_sharded_model_state
-
-        # every process writes its own shard files — NOT rank-gated
-        for i, model in enumerate(models):
-            name = MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}"
-            save_sharded_model_state(model.state_dict(), output_dir, name=name)
-        for i, opt in enumerate(optimizers):
-            inner = opt.optimizer if hasattr(opt, "optimizer") else opt
-            oname = OPTIMIZER_NAME if i == 0 else f"{OPTIMIZER_NAME}_{i}"
-            arrays, meta = inner.sharded_state_arrays()
-            save_sharded_model_state(arrays, output_dir, name=oname)
-            payloads.append((f"{oname}.meta.bin", meta, "pickle"))
-    else:
-        for i, model in enumerate(models):
-            name = MODEL_NAME if i == 0 else f"{MODEL_NAME}_{i}"
-            arrays = {k: _gather_numpy(v) for k, v in model.state_dict().items()}
-            payloads.append((name, arrays, "weights"))
-        for i, opt in enumerate(optimizers):
-            name = f"{OPTIMIZER_NAME}.bin" if i == 0 else f"{OPTIMIZER_NAME}_{i}.bin"
-            payloads.append(
-                (name, jax.tree_util.tree_map(_maybe_numpy, opt.state_dict()), "pickle")
-            )
-    for i, sched in enumerate(schedulers):
-        name = f"{SCHEDULER_NAME}.bin" if i == 0 else f"{SCHEDULER_NAME}_{i}.bin"
-        payloads.append((name, sched.state_dict(), "pickle"))
-    for i, dl in enumerate(dataloaders):
-        name = f"{SAMPLER_NAME}.bin" if i == 0 else f"{SAMPLER_NAME}_{i}.bin"
-        if hasattr(dl, "state_dict"):
-            payloads.append((name, dl.state_dict(), "pickle"))
-    for i, obj in enumerate(custom_objects):
-        name = f"{CUSTOM_STATES_NAME}_{i}.pkl"
-        payloads.append(
-            (name, jax.tree_util.tree_map(_maybe_numpy, obj.state_dict()), "pickle")
-        )
-    meta = {"step": step}
-    if scaler is not None:
-        meta["scaler"] = scaler.state_dict()
-
-    if state.is_main_process:
-        for name, payload, kind in payloads:
-            if kind == "weights":
-                _write_weight_arrays(payload, output_dir, safe_serialization, name)
-            else:
-                with open(os.path.join(output_dir, name), "wb") as f:
-                    pickle.dump(payload, f)
-        with open(os.path.join(output_dir, "accelerator_meta.json"), "w") as f:
-            json.dump(meta, f)
-
-    # RNG state is per-process (reference checkpointing.py:143-172);
-    # async saves pass the states captured at call time
-    rng_file = os.path.join(output_dir, f"{RNG_STATE_NAME}_{state.process_index}.pkl")
-    with open(rng_file, "wb") as f:
-        pickle.dump(rng_states if rng_states is not None else _rng_states(), f)
-    state.wait_for_everyone()
-
-    # post-write cleanup: drop PREEXISTING artifacts this save did not
-    # overwrite (e.g. shard files from a different world size, or a stale
-    # index.json after a sharded→full transition).  Per host, after every
-    # process finished writing, so a crash mid-save never deletes the only
-    # loadable checkpoint.
-    if getattr(state, "is_local_main_process", state.is_main_process):
-        world = state.num_processes
-        valid: set[str] = set()
-        for name in ckpt_names:
-            if sharded_state:
-                valid.update(
-                    _glob.glob(
-                        os.path.join(output_dir, f"{name}.shard-*-of-{world:05d}.safetensors")
-                    )
-                )
-                valid.add(os.path.join(output_dir, f"{name}.index.json"))
-                valid.add(os.path.join(output_dir, f"{name}.meta.bin"))
-            else:
-                valid.add(os.path.join(output_dir, f"{name}.safetensors"))
-                valid.add(os.path.join(output_dir, f"{name}.npz"))
-                valid.add(os.path.join(output_dir, f"{name}.bin"))
-        for path in preexisting - valid:
-            if os.path.exists(path):
-                os.remove(path)
-    state.wait_for_everyone()
-    logger.info(f"Saved accelerator state to {output_dir}")
+    plan = prepare_accelerator_save(
+        output_dir,
+        models=models,
+        optimizers=optimizers,
+        schedulers=schedulers,
+        dataloaders=dataloaders,
+        custom_objects=custom_objects,
+        step=step,
+        scaler=scaler,
+        safe_serialization=safe_serialization,
+        sharded_state=sharded_state,
+        rng_states=rng_states,
+    )
+    write_accelerator_save(plan)
+    finalize_accelerator_save(plan)
     return output_dir
 
 

@@ -2,7 +2,7 @@ from .bert import BertConfig, BertForSequenceClassification, BertModel
 from .gpt import GPTConfig, GPTLMHeadModel, PipelinedGPTLMHeadModel
 from .gptj import GPTJConfig, GPTJForCausalLM
 from .gptneox import GPTNeoXConfig, GPTNeoXForCausalLM
-from .llama import LlamaConfig, LlamaForCausalLM
+from .llama import LlamaConfig, LlamaForCausalLM, RopeScaling
 from .opt import OPTConfig, OPTForCausalLM
 from .t5 import T5Config, T5ForConditionalGeneration
 

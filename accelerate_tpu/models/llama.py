@@ -30,6 +30,55 @@ from ..nn import Tensor
 from .gpt import lm_shift_loss, maybe_remat
 
 
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Rotary frequency rescaling for long-context Llama variants.
+
+    Mirrors the HF ``rope_scaling`` config block (transformers
+    modeling_rope_utils): ``linear`` divides every inverse frequency by
+    ``factor`` (positions effectively compressed); ``llama3`` is the
+    NTK-by-parts scheme Llama-3.1+ ships — wavelengths longer than
+    ``original_max_position_embeddings / low_freq_factor`` are divided by
+    ``factor``, wavelengths shorter than ``original / high_freq_factor``
+    are kept, and the band between is smoothly interpolated.  Frozen (and
+    therefore hashable) so it can ride the static decode cfg through jit.
+    """
+
+    rope_type: str = "llama3"
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+    @classmethod
+    def from_hf(cls, d) -> "RopeScaling | None":
+        """Normalize an HF ``rope_scaling`` dict (``rope_type`` new-style or
+        ``type`` legacy).  None / "default" → None; unsupported schemes
+        (yarn, dynamic, longrope) refuse loudly — their math would be
+        silently wrong here."""
+        if d is None or isinstance(d, cls):
+            return d
+        kind = d.get("rope_type") or d.get("type") or "default"
+        if kind == "default":
+            return None
+        if kind == "linear":
+            return cls(rope_type="linear", factor=float(d.get("factor", 1.0)))
+        if kind == "llama3":
+            return cls(
+                rope_type="llama3",
+                factor=float(d.get("factor", 8.0)),
+                low_freq_factor=float(d.get("low_freq_factor", 1.0)),
+                high_freq_factor=float(d.get("high_freq_factor", 4.0)),
+                original_max_position_embeddings=int(
+                    d.get("original_max_position_embeddings", 8192)
+                ),
+            )
+        raise NotImplementedError(
+            f"rope_scaling type {kind!r} is not supported; implemented: "
+            "'linear', 'llama3' (and 'default' = no scaling)"
+        )
+
+
 @dataclasses.dataclass
 class LlamaConfig:
     vocab_size: int = 32000  # already a 128 multiple (250×128) — MXU-clean
@@ -48,6 +97,13 @@ class LlamaConfig:
     # flash FORWARD visits only in-band k-tiles — cost scales with window;
     # backward gates MXU work per tile, see ops/flash_attention.py)
     sliding_window: int = 0
+    # Llama-3.1+ long-context rotary rescaling; accepts an HF-style dict or
+    # a RopeScaling and normalizes to the latter (None = plain theta)
+    rope_scaling: "RopeScaling | None" = None
+
+    def __post_init__(self):
+        if isinstance(self.rope_scaling, dict):
+            self.rope_scaling = RopeScaling.from_hf(self.rope_scaling)
 
     @classmethod
     def tiny(cls) -> "LlamaConfig":
@@ -74,6 +130,21 @@ class LlamaConfig:
         )
 
     @classmethod
+    def llama31_8b(cls) -> "LlamaConfig":
+        """Llama-3.1-8B: GQA 4:1, 128k context via llama3 rope scaling,
+        128256-vocab (divisible by 128 — MXU-clean as shipped)."""
+        return cls(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, max_position_embeddings=131072,
+            rms_norm_eps=1e-5, rope_theta=500000.0,
+            rope_scaling=RopeScaling(
+                rope_type="llama3", factor=8.0, low_freq_factor=1.0,
+                high_freq_factor=4.0, original_max_position_embeddings=8192,
+            ),
+        )
+
+    @classmethod
     def llama2_7b_proxy(cls) -> "LlamaConfig":
         """7B layer geometry at 8-layer depth — same per-layer math/sharding,
         fits one v5e chip for bench/dryrun work."""
@@ -92,14 +163,42 @@ def _pure_rmsnorm(x, w, eps):
     return w * (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype)
 
 
-def _rope_rotate(x, positions, theta):
+def _rope_inv_freq(d: int, theta: float, scaling: "RopeScaling | None"):
+    """Per-pair inverse frequencies (d/2,) fp32, optionally rescaled.
+
+    llama3 scheme (transformers modeling_rope_utils
+    _compute_llama3_parameters): wavelength 2π/f longer than
+    ``original/low_freq_factor`` → f/factor; shorter than
+    ``original/high_freq_factor`` → f unchanged; in between → linear
+    interpolation in ``smooth = (original/wavelength - low)/(high - low)``.
+    """
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    if scaling is None:
+        return inv
+    if scaling.rope_type == "linear":
+        return inv / scaling.factor
+    # llama3 NTK-by-parts
+    orig = scaling.original_max_position_embeddings
+    low_wl = orig / scaling.low_freq_factor
+    high_wl = orig / scaling.high_freq_factor
+    wl = 2.0 * jnp.pi / inv
+    scaled = jnp.where(wl > low_wl, inv / scaling.factor, inv)
+    smooth = (orig / wl - scaling.low_freq_factor) / (
+        scaling.high_freq_factor - scaling.low_freq_factor
+    )
+    smoothed = (1.0 - smooth) * inv / scaling.factor + smooth * inv
+    in_band = jnp.logical_and(wl <= low_wl, wl >= high_wl)
+    return jnp.where(in_band, smoothed, scaled)
+
+
+def _rope_rotate(x, positions, theta, scaling=None):
     """Rotate-half rotary embedding on (b, h, s, d), positions (s,) global.
 
     HF convention (transformers LlamaRotaryEmbedding): fp32 angle tables,
     ``emb = cat(freqs, freqs)``, ``x*cos + rotate_half(x)*sin``.
     """
     d = x.shape[-1]
-    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    inv = _rope_inv_freq(d, theta, scaling)
     freqs = positions.astype(jnp.float32)[:, None] * inv[None, :]  # (s, d/2)
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # (s, d)
     cos = jnp.cos(emb).astype(x.dtype)[None, None]
@@ -109,7 +208,8 @@ def _rope_rotate(x, positions, theta):
     return x * cos + rotated * sin
 
 
-def llama_attn_in(l, x, positions, *, n_head: int, n_kv_head: int, eps: float, theta: float):
+def llama_attn_in(l, x, positions, *, n_head: int, n_kv_head: int, eps: float,
+                  theta: float, rope_scaling=None):
     """RMSNorm + q/k/v projections + RoPE: (b,s,c) → q (b,H,s,d), k/v (b,Hkv,s,d)."""
     b, s, c = x.shape
     d = c // n_head
@@ -121,7 +221,11 @@ def llama_attn_in(l, x, positions, *, n_head: int, n_kv_head: int, eps: float, t
     q = heads(h @ l["q_w"].T, n_head)
     k = heads(h @ l["k_w"].T, n_kv_head)
     v = heads(h @ l["v_w"].T, n_kv_head)
-    return _rope_rotate(q, positions, theta), _rope_rotate(k, positions, theta), v
+    return (
+        _rope_rotate(q, positions, theta, rope_scaling),
+        _rope_rotate(k, positions, theta, rope_scaling),
+        v,
+    )
 
 
 def llama_attn_out(l, x, att, *, eps: float):
@@ -137,13 +241,15 @@ def llama_attn_out(l, x, att, *, eps: float):
 _LAYER_KEYS = ("ln1_w", "q_w", "k_w", "v_w", "o_w", "ln2_w", "gate_w", "up_w", "down_w")
 
 
-def _llama_block(l, x, positions, *, n_head, n_kv_head, eps, theta, window=0):
+def _llama_block(l, x, positions, *, n_head, n_kv_head, eps, theta, window=0,
+                 rope_scaling=None):
     """Causal (optionally sliding-window) training block: the pure pair
     around flash attention."""
     from ..ops.attention import sdpa_tpu
 
     q, k, v = llama_attn_in(
-        l, x, positions, n_head=n_head, n_kv_head=n_kv_head, eps=eps, theta=theta
+        l, x, positions, n_head=n_head, n_kv_head=n_kv_head, eps=eps,
+        theta=theta, rope_scaling=rope_scaling,
     )
     group = n_head // n_kv_head
     if group > 1:  # flash kernel wants matched head counts
@@ -209,7 +315,7 @@ class LlamaDecoderLayer(nn.Module):
                 n_head=cfg.num_attention_heads,
                 n_kv_head=cfg.num_key_value_heads,
                 eps=cfg.rms_norm_eps, theta=cfg.rope_theta,
-                window=cfg.sliding_window,
+                window=cfg.sliding_window, rope_scaling=cfg.rope_scaling,
             )
 
         return nn.tape_op(maybe_remat(fn), x, *self.param_tensors())
@@ -292,6 +398,7 @@ class LlamaForCausalLM(nn.Module):
                 head_dim=cfg.hidden_size // cfg.num_attention_heads,
                 eps=cfg.rms_norm_eps,
                 theta=cfg.rope_theta,
+                rope_scaling=cfg.rope_scaling,
             ),
             max_len=cfg.max_position_embeddings,
             stack=self._stack_decoder_params,
@@ -318,6 +425,7 @@ class _LlamaDecodeCfg:
     head_dim: int
     eps: float
     theta: float
+    rope_scaling: "RopeScaling | None" = None
 
 
 def _dec_embed(g, ids, positions, cfg):
@@ -328,6 +436,7 @@ def _dec_attn_in(l, x, positions, cfg):
     return llama_attn_in(
         l, x, positions,
         n_head=cfg.n_head, n_kv_head=cfg.n_kv_head, eps=cfg.eps, theta=cfg.theta,
+        rope_scaling=cfg.rope_scaling,
     )
 
 

@@ -95,6 +95,38 @@ def main():
         loss = float(step(ids))
         assert np.isfinite(loss)
         print(f"rank{acc.process_index}: sharded save/load + resume ok (loss {loss:.4f})")
+
+        # async save under the real multi-process rendezvous: prepare runs
+        # the collective/D2H phase at call time, the writer thread does pure
+        # file IO, and wait_for_checkpoint runs the collective finalize on
+        # every rank.  Steps taken while the writer runs (which donate the
+        # live buffers) must not leak into the checkpoint.
+        snap = local_sum(target)
+        ckpt_async = launch_scoped_tmpdir("acc_tpu_shckpt_async")
+        try:
+            acc.save_state(ckpt_async, async_save=True)
+            for _ in range(2):
+                float(step(ids))  # mutates + donates state mid-write
+            acc.wait_for_checkpoint()
+            if world > 1:
+                for name in (MODEL_NAME, OPTIMIZER_NAME):
+                    files = glob.glob(
+                        os.path.join(
+                            ckpt_async, f"{name}.shard-*-of-{world:05d}.safetensors"
+                        )
+                    )
+                    assert len(files) == world, (name, files)
+            target.data = target.data * 0.0
+            acc.load_state(ckpt_async)
+            restored = local_sum(target)
+            assert abs(restored - snap) < 1e-4 * max(1.0, abs(snap)), (restored, snap)
+            loss = float(step(ids))
+            assert np.isfinite(loss)
+            print(f"rank{acc.process_index}: ASYNC sharded save/load ok (loss {loss:.4f})")
+        finally:
+            acc.wait_for_everyone()
+            if acc.is_main_process:
+                shutil.rmtree(ckpt_async, ignore_errors=True)
     finally:
         acc.wait_for_everyone()
         if acc.is_main_process:

@@ -111,6 +111,46 @@ def _unique_shard_bounds(arr) -> list:
     return out
 
 
+def collect_sharded_model_state(
+    state_dict: dict[str, Any],
+    name: str = MODEL_NAME,
+    process_index: Optional[int] = None,
+    num_processes: Optional[int] = None,
+) -> tuple[str, dict[str, np.ndarray], dict[str, Any]]:
+    """Materialise this host's unique shards to host numpy WITHOUT writing.
+
+    Returns ``(shard_filename, arrays, index)`` where ``arrays`` maps slice
+    keys to write-ready (bf16-viewed) numpy buffers and ``index`` is the
+    rank-0 index.json payload.  Purely host-local — no collectives — so the
+    async checkpoint path can run it at call time on the main thread and
+    hand the result to a writer thread that only touches disk.
+    """
+    import jax
+
+    rank = jax.process_index() if process_index is None else process_index
+    world = jax.process_count() if num_processes is None else num_processes
+
+    local_arrays: dict[str, np.ndarray] = {}
+    index: dict[str, Any] = {"metadata": {"num_shards": world}, "tensors": {}}
+    for tensor_name, value in state_dict.items():
+        if isinstance(value, jax.Array) and hasattr(value, "addressable_shards"):
+            shards = _unique_shard_bounds(value)
+            shape = [int(d) for d in value.shape]
+            dtype = _dtype_str(np.asarray(shards[0][1]).dtype)
+        else:
+            arr = np.asarray(value)
+            shards = [([(0, int(d)) for d in arr.shape], arr)]
+            shape = list(arr.shape)
+            dtype = _dtype_str(arr.dtype)
+        for bounds, data in shards:
+            local_arrays[_slice_key(tensor_name, bounds)] = _bf16_to_view(data)
+        index["tensors"][tensor_name] = {"shape": shape, "dtype": dtype}
+    return _shard_file(name, rank, world), local_arrays, index
+
+
+SHARD_FILE_METADATA = {"format": "accelerate_tpu-sharded"}
+
+
 def save_sharded_model_state(
     state_dict: dict[str, Any],
     output_dir: str,
@@ -131,30 +171,11 @@ def save_sharded_model_state(
 
     save_file = pick_save_file()  # parallel native body IO when available
     rank = jax.process_index() if process_index is None else process_index
-    world = jax.process_count() if num_processes is None else num_processes
     os.makedirs(output_dir, exist_ok=True)
-
-    local_arrays: dict[str, np.ndarray] = {}
-    index: dict[str, Any] = {"metadata": {"num_shards": world}, "tensors": {}}
-    for tensor_name, value in state_dict.items():
-        if isinstance(value, jax.Array) and hasattr(value, "addressable_shards"):
-            shards = _unique_shard_bounds(value)
-            shape = [int(d) for d in value.shape]
-            dtype = _dtype_str(np.asarray(shards[0][1]).dtype)
-        else:
-            arr = np.asarray(value)
-            shards = [([(0, int(d)) for d in arr.shape], arr)]
-            shape = list(arr.shape)
-            dtype = _dtype_str(arr.dtype)
-        for bounds, data in shards:
-            local_arrays[_slice_key(tensor_name, bounds)] = data
-        index["tensors"][tensor_name] = {"shape": shape, "dtype": dtype}
-
-    save_file(
-        {k: _bf16_to_view(v) for k, v in local_arrays.items()},
-        os.path.join(output_dir, _shard_file(name, rank, world)),
-        metadata={"format": "accelerate_tpu-sharded"},
+    fname, local_arrays, index = collect_sharded_model_state(
+        state_dict, name=name, process_index=process_index, num_processes=num_processes
     )
+    save_file(local_arrays, os.path.join(output_dir, fname), metadata=SHARD_FILE_METADATA)
     if rank == 0:
         with open(sharded_index_path(output_dir, name), "w") as f:
             json.dump(index, f, indent=1)

@@ -274,14 +274,11 @@ def gpt2_config_from_hf(cfg: dict):
 def llama_config_from_hf(cfg: dict):
     from ..models.llama import LlamaConfig
 
-    # refuse configs whose math we would silently get wrong: Llama-3.1+
-    # rope scaling changes the rotary frequencies, attention_bias adds
-    # projections our layer math does not carry
-    if cfg.get("rope_scaling"):
-        raise NotImplementedError(
-            f"rope_scaling={cfg['rope_scaling']!r} is not supported; only "
-            "plain-theta rotary embeddings (Llama-1/2 geometry) are implemented"
-        )
+    # refuse configs whose math we would silently get wrong: attention_bias
+    # adds projections our layer math does not carry.  rope_scaling is
+    # normalized by RopeScaling.from_hf — linear and llama3 (Llama-3.1+)
+    # are implemented in models/llama.py:_rope_inv_freq; yarn/dynamic/
+    # longrope still refuse loudly inside from_hf.
     if cfg.get("attention_bias"):
         raise NotImplementedError(
             "attention_bias=True Llama variants are not supported "
@@ -315,6 +312,7 @@ def llama_config_from_hf(cfg: dict):
         tie_word_embeddings=cfg.get("tie_word_embeddings", False),
         # Mistral configs carry sliding_window (null for Llama); 0 = full
         sliding_window=cfg.get("sliding_window") or 0,
+        rope_scaling=cfg.get("rope_scaling"),  # dict → RopeScaling in __post_init__
     )
 
 

@@ -1,0 +1,136 @@
+"""Llama-3.x rope scaling: frequency-table numerics + HF bridge ingestion.
+
+Reference semantics: transformers ``modeling_rope_utils``
+``_compute_llama3_parameters`` (the Llama-3.1+ NTK-by-parts scheme) and
+``_compute_linear_scaling_rope_parameters``.  The expected tables below are
+computed independently in numpy from the published formula, not imported.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, RopeScaling
+from accelerate_tpu.models.llama import _rope_inv_freq, _rope_rotate
+from accelerate_tpu.utils.hf import llama_config_from_hf
+
+
+def _llama3_reference(d, theta, factor, low_f, high_f, orig):
+    """The published Llama-3.1 frequency rescale, straight from the paper/HF
+    docs, in numpy."""
+    inv = 1.0 / (theta ** (np.arange(0, d, 2, dtype=np.float64) / d))
+    out = []
+    for f in inv:
+        wavelen = 2 * np.pi / f
+        if wavelen < orig / high_f:  # high-frequency: keep
+            out.append(f)
+        elif wavelen > orig / low_f:  # low-frequency: slow down by factor
+            out.append(f / factor)
+        else:  # medium band: interpolate
+            smooth = (orig / wavelen - low_f) / (high_f - low_f)
+            out.append((1 - smooth) * f / factor + smooth * f)
+    return np.asarray(out, dtype=np.float32)
+
+
+def test_llama3_freq_table_matches_published_formula():
+    d, theta = 128, 500000.0
+    sc = RopeScaling(rope_type="llama3", factor=8.0, low_freq_factor=1.0,
+                     high_freq_factor=4.0, original_max_position_embeddings=8192)
+    got = np.asarray(_rope_inv_freq(d, theta, sc))
+    want = _llama3_reference(d, theta, 8.0, 1.0, 4.0, 8192)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # band structure: the highest frequency is untouched, the lowest is /8
+    plain = np.asarray(_rope_inv_freq(d, theta, None))
+    assert got[0] == pytest.approx(plain[0])
+    assert got[-1] == pytest.approx(plain[-1] / 8.0)
+    # the table is monotone decreasing like the plain one
+    assert np.all(np.diff(got) < 0)
+
+
+def test_linear_scaling_divides_uniformly():
+    d, theta = 64, 10000.0
+    sc = RopeScaling(rope_type="linear", factor=4.0)
+    got = np.asarray(_rope_inv_freq(d, theta, sc))
+    plain = np.asarray(_rope_inv_freq(d, theta, None))
+    np.testing.assert_allclose(got, plain / 4.0, rtol=1e-6)
+
+
+def test_rope_rotate_applies_scaling():
+    """Scaled rotation must differ from plain at long positions but agree at
+    position 0 (angle 0 regardless of frequency)."""
+    x = jnp.ones((1, 1, 3, 8), jnp.float32)
+    pos = jnp.asarray([0, 100, 1000])
+    sc = RopeScaling(rope_type="linear", factor=2.0)
+    plain = np.asarray(_rope_rotate(x, pos, 10000.0))
+    scaled = np.asarray(_rope_rotate(x, pos, 10000.0, sc))
+    np.testing.assert_allclose(plain[:, :, 0], scaled[:, :, 0], atol=1e-6)
+    assert np.abs(plain[:, :, 1:] - scaled[:, :, 1:]).max() > 1e-3
+
+
+def test_hf_bridge_ingests_llama3_config():
+    cfg = llama_config_from_hf(
+        {
+            "vocab_size": 128256,
+            "hidden_size": 4096,
+            "intermediate_size": 14336,
+            "num_hidden_layers": 32,
+            "num_attention_heads": 32,
+            "num_key_value_heads": 8,
+            "max_position_embeddings": 131072,
+            "rms_norm_eps": 1e-5,
+            "rope_theta": 500000.0,
+            "rope_scaling": {
+                "factor": 8.0,
+                "low_freq_factor": 1.0,
+                "high_freq_factor": 4.0,
+                "original_max_position_embeddings": 8192,
+                "rope_type": "llama3",
+            },
+            "attention_bias": False,
+            "mlp_bias": False,
+        }
+    )
+    assert isinstance(cfg.rope_scaling, RopeScaling)
+    assert cfg.rope_scaling.rope_type == "llama3"
+    assert cfg.rope_scaling.factor == 8.0
+    assert cfg == LlamaConfig.llama31_8b()
+
+
+def test_hf_bridge_still_refuses_unsupported_schemes():
+    base = {"hidden_size": 256, "num_attention_heads": 4}
+    for kind in ("yarn", "dynamic", "longrope"):
+        with pytest.raises(NotImplementedError, match=kind):
+            llama_config_from_hf({**base, "rope_scaling": {"rope_type": kind}})
+    # legacy "type" key and "default" both pass through
+    assert llama_config_from_hf(
+        {**base, "rope_scaling": {"type": "default"}}
+    ).rope_scaling is None
+    assert llama_config_from_hf(
+        {**base, "rope_scaling": {"type": "linear", "factor": 2.0}}
+    ).rope_scaling == RopeScaling(rope_type="linear", factor=2.0)
+
+
+def test_scaling_reaches_forward_and_decode():
+    """The same tiny model with/without scaling must produce different
+    logits (proof the table is plumbed through), and greedy decode must
+    match the forward argmax under scaling (proof the decode cfg carries
+    it too)."""
+    import accelerate_tpu.nn as nn
+
+    sc = RopeScaling(rope_type="linear", factor=4.0)
+    nn.manual_seed(0)
+    plain = LlamaForCausalLM(LlamaConfig.tiny())
+    nn.manual_seed(0)
+    import dataclasses
+
+    scaled_cfg = dataclasses.replace(LlamaConfig.tiny(), rope_scaling=sc)
+    scaled = LlamaForCausalLM(scaled_cfg)
+
+    ids = jnp.arange(1, 33, dtype=jnp.int32)[None, :]
+    lp = plain(ids)["logits"]
+    ls = scaled(ids)["logits"]
+    assert np.abs(np.asarray(lp) - np.asarray(ls)).max() > 1e-4
+
+    out = scaled.generate(ids, max_new_tokens=1)
+    want = int(np.asarray(ls)[0, -1].argmax())
+    assert int(np.asarray(out)[0, -1]) == want
