@@ -92,7 +92,10 @@ class Accelerator:
         self.autocast_handler = None
         self.fp8_recipe_handler = None
         self.ddp_handler = None
-        self._comm_hook = None  # normalized "fp16"/"bf16"/None, set below
+        # normalized "fp16"/"bf16"/"powersgd"/"batched_powersgd"/None, set below
+        self._comm_hook = None
+        self._comm_wrapper = None  # "fp16"/"bf16" factor rounding for powersgd
+        self._powersgd_state = None  # per-model {q, err} arrays, capture-threaded
         from .utils.dataclasses import FP8RecipeKwargs
 
         from .utils.dataclasses import AutocastKwargs, DistributedDataParallelKwargs
@@ -119,13 +122,26 @@ class Accelerator:
                         # the reference's NO hook is a valid no-op default —
                         # run uncompressed rather than failing construction
                         hook = None
-                    elif hook not in ("fp16", "bf16"):
+                    elif hook in ("power_sgd", "batched_power_sgd"):
+                        hook = hook.replace("_sgd", "sgd")  # normalize spelling
+                    elif hook not in ("fp16", "bf16", "powersgd", "batched_powersgd"):
                         # fail at configuration time, not mid-first-train-step
                         raise ValueError(
-                            f"unsupported comm_hook {handler.comm_hook!r}; use 'fp16' or 'bf16'"
+                            f"unsupported comm_hook {handler.comm_hook!r}; use "
+                            "'fp16', 'bf16', 'powersgd' or 'batched_powersgd'"
                         )
                     # normalized copy — the caller-owned handler stays untouched
                     self._comm_hook = hook
+                if getattr(handler, "comm_wrapper", None) is not None:
+                    wrapper = str(handler.comm_wrapper).lower().rsplit(".", 1)[-1]
+                    if wrapper in ("no", "none"):
+                        wrapper = None
+                    elif wrapper not in ("fp16", "bf16"):
+                        raise ValueError(
+                            f"unsupported comm_wrapper {handler.comm_wrapper!r}; "
+                            "use 'fp16' or 'bf16'"
+                        )
+                    self._comm_wrapper = wrapper
 
         if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "false").lower() in ("1", "true"):
             fsdp_plugin = FullyShardedDataParallelPlugin()
@@ -385,8 +401,25 @@ class Accelerator:
             self.state.fsdp_plugin is not None
             and getattr(self.state.fsdp_plugin, "offload_optimizer", False)
         )
+        # training-time parameter offload (reference FSDP CPUOffload /
+        # DeepSpeed offload_param): params pinned to host between steps,
+        # staged back by a forward hook (traced h2d under compile_step)
+        offload_params = bool(
+            self.state.fsdp_plugin is not None
+            and getattr(self.state.fsdp_plugin, "cpu_offload", False)
+        )
         for opt in self._optimizers:
-            opt.optimizer.relayout_for_sharded_params(offload_to_host=offload_opt)
+            opt.optimizer.relayout_for_sharded_params(
+                offload_to_host=offload_opt, offload_params=offload_params
+            )
+        if offload_params:
+            from .hooks import ParamOffloadHook, add_hook_to_module
+
+            for model in self._models:
+                if not getattr(model, "_atpu_param_offload", False):
+                    add_hook_to_module(model, ParamOffloadHook(), append=True)
+                    model._atpu_param_offload = True
+        self._ensure_powersgd_state()
         return result[0] if len(result) == 1 else tuple(result)
 
     def _prepare_one(self, obj):
@@ -552,7 +585,15 @@ class Accelerator:
         of the dp gradient all-reduce XLA inserts *inside* the backward —
         that follows the compute dtype (bf16 mixed precision already reduces
         in bf16), and a cast placed after the reduce cannot legally be hoisted
-        above it.  The optimizer upcasts to fp32 masters at apply time."""
+        above it.  The optimizer upcasts to fp32 masters at apply time.
+
+        The powersgd hooks run the full rank-k + error-feedback recurrence
+        (utils/powersgd.py) on the synced gradients instead of a cast; the
+        (Q, error) state rides the captured-step pytree like optimizer
+        state, so the hook works identically under compile_step."""
+        if self._comm_hook in ("powersgd", "batched_powersgd"):
+            self._apply_powersgd_hook()
+            return
         dtype = None
         if self._comm_hook is not None:
             dtype = jnp.float16 if self._comm_hook == "fp16" else jnp.bfloat16
@@ -565,6 +606,76 @@ class Accelerator:
             for p in model.parameters():
                 if p.grad is not None and p.grad.dtype != dtype:
                     p.grad = p.grad.astype(dtype)
+
+    # -- PowerSGD machinery ---------------------------------------------------
+    def _powersgd_options(self) -> dict:
+        opts = dict(getattr(self.ddp_handler, "comm_state_option", None) or {})
+        return {
+            "rank": int(opts.get("matrix_approximation_rank", 1)),
+            "use_error_feedback": bool(opts.get("use_error_feedback", True)),
+            "warm_start": bool(opts.get("warm_start", True)),
+        }
+
+    def _ensure_powersgd_state(self) -> None:
+        """Build (Q, error) buffers for every prepared model that lacks them.
+
+        Runs eagerly at ``prepare()`` so the captured-step state pytree is
+        structurally complete before the first trace (a mid-trace
+        structure change would force a second compile)."""
+        if self._comm_hook not in ("powersgd", "batched_powersgd"):
+            return
+        from .nn import random as nn_random
+        from .utils import powersgd as psgd
+
+        opts = self._powersgd_options()
+        init = (
+            psgd.init_batched_powersgd_state
+            if self._comm_hook == "batched_powersgd"
+            else psgd.init_powersgd_state
+        )
+        if self._powersgd_state is None:
+            self._powersgd_state = []
+        while len(self._powersgd_state) < len(self._models):
+            model = self._models[len(self._powersgd_state)]
+            shapes = {n: tuple(p.shape) for n, p in model.named_parameters()}
+            self._powersgd_state.append(init(shapes, opts["rank"], nn_random.next_key()))
+
+    def _apply_powersgd_hook(self) -> None:
+        from .nn import random as nn_random
+        from .utils import powersgd as psgd
+
+        self._ensure_powersgd_state()
+        opts = self._powersgd_options()
+        wrapper_dtype = None
+        if self._comm_wrapper is not None:
+            wrapper_dtype = jnp.float16 if self._comm_wrapper == "fp16" else jnp.bfloat16
+        apply = (
+            psgd.apply_batched_powersgd
+            if self._comm_hook == "batched_powersgd"
+            else psgd.apply_powersgd
+        )
+        for i, model in enumerate(self._models):
+            named = dict(model.named_parameters())
+            grads = {n: p.grad for n, p in named.items() if p.grad is not None}
+            new_grads, new_state = apply(
+                grads,
+                self._powersgd_state[i],
+                use_error_feedback=opts["use_error_feedback"],
+                warm_start=opts["warm_start"],
+                rng_key=None if opts["warm_start"] else nn_random.next_key(),
+                wrapper_dtype=wrapper_dtype,
+            )
+            for n, g in new_grads.items():
+                named[n].grad = g
+            self._powersgd_state[i] = new_state
+
+    def _comm_hook_capture_state(self):
+        """Arrays the captured step must thread (None when no powersgd)."""
+        return self._powersgd_state
+
+    def _bind_comm_hook_state(self, state) -> None:
+        if state is not None:
+            self._powersgd_state = state
 
     @contextlib.contextmanager
     def accumulate(self, *models):

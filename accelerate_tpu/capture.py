@@ -95,10 +95,22 @@ def _unwrap_tree(tree):
 
 def _is_offloaded(x) -> bool:
     """True when the array lives outside default device memory (host-offloaded
-    optimizer state) — the single predicate behind both the layout-pin and
-    the donation split below."""
+    optimizer state / params) — the single predicate behind both the
+    layout-pin and the donation split below."""
     s = getattr(x, "sharding", None)
     return getattr(s, "memory_kind", None) not in (None, "device")
+
+
+def _zeros_like_on_device(x):
+    """zeros_like, but always in device memory: a placeholder grad for a
+    host-OFFLOADED param must not inherit pinned_host (the backward
+    accumulates real device grads into it — XLA refuses mixed spaces)."""
+    if isinstance(x, jax.Array) and _is_offloaded(x):
+        s = x.sharding
+        return jax.device_put(
+            jnp.zeros(x.shape, x.dtype), jax.sharding.NamedSharding(s.mesh, s.spec)
+        )
+    return jnp.zeros_like(x)
 
 
 class CapturedStep:
@@ -123,7 +135,7 @@ class CapturedStep:
             "buffers": [m.buffer_pytree() for m in models],
             "grads": [
                 {
-                    name: (p.grad if p.grad is not None else jnp.zeros_like(p.data))
+                    name: (p.grad if p.grad is not None else _zeros_like_on_device(p.data))
                     for name, p in m.named_parameters()
                 }
                 for m in models
@@ -131,6 +143,8 @@ class CapturedStep:
             "opt": [o.optimizer.capture_state() for o in optimizers],
             "rng": nn_random.next_key(),
             "scaler": acc.scaler.capture_state() if acc.scaler is not None else None,
+            # PowerSGD comm-hook (Q, error) buffers — persistent across steps
+            "comm": acc._comm_hook_capture_state(),
         }
         return state
 
@@ -148,6 +162,7 @@ class CapturedStep:
             o.optimizer.bind_capture_state(s)
         if state.get("scaler") is not None and acc.scaler is not None:
             acc.scaler.bind_capture_state(state["scaler"])
+        acc._bind_comm_hook_state(state.get("comm"))
 
     def _snapshot_state(self) -> dict:
         acc = self.accelerator
@@ -156,13 +171,14 @@ class CapturedStep:
             "buffers": [m.buffer_pytree() for m in acc._models],
             "grads": [
                 {
-                    name: (p.grad if p.grad is not None else jnp.zeros_like(p.data))
+                    name: (p.grad if p.grad is not None else _zeros_like_on_device(p.data))
                     for name, p in m.named_parameters()
                 }
                 for m in acc._models
             ],
             "opt": [o.optimizer.capture_state() for o in acc._optimizers],
             "scaler": acc.scaler.capture_state() if acc.scaler is not None else None,
+            "comm": acc._comm_hook_capture_state(),
         }
 
     # -- call ----------------------------------------------------------------
@@ -261,7 +277,7 @@ class CapturedStep:
 
         ref_shardings = {
             k: jax.tree_util.tree_map(_leaf_sharding, state_template[k])
-            for k in ("params", "buffers", "grads", "opt", "scaler")
+            for k in ("params", "buffers", "grads", "opt", "scaler", "comm")
             if state_template.get(k) is not None
         }
 
@@ -327,10 +343,13 @@ class CapturedStep:
                 named[name].grad = g
         for o, s in zip(acc._optimizers, new_state["opt"]):
             o.optimizer.bind_capture_state(s)
-            # host-offloaded optimizer state: the compiled program's outputs
-            # land in HBM; re-pin to pinned_host so the saving is real and
-            # the next call's input placement (and thus the jit cache key)
-            # stays fixed.  No-op unless offload was requested.
+            # host-offloaded optimizer state (and, with param offload, the
+            # params): the compiled program's outputs land in HBM; re-pin to
+            # pinned_host so the saving is real and the next call's input
+            # placement (and thus the jit cache key) stays fixed.  No-ops
+            # unless offload was requested.
             o.optimizer.reoffload_state_to_host()
+            o.optimizer.reoffload_params_to_host()
         if new_state.get("scaler") is not None and acc.scaler is not None:
             acc.scaler.bind_capture_state(new_state["scaler"])
+        acc._bind_comm_hook_state(new_state.get("comm"))

@@ -468,6 +468,35 @@ def attach_align_device_hook_on_blocks(
 # CPU offload hooks (sequential pipelines, e.g. diffusion UNet/VAE swapping)
 # ---------------------------------------------------------------------------
 
+class ParamOffloadHook(ModelHook):
+    """Training-time parameter offload (ZeRO-Infinity analog): stage every
+    pinned-host parameter into device memory at forward entry.
+
+    Counterpart of reference FSDP ``CPUOffload(offload_params=True)`` /
+    DeepSpeed ``offload_param`` (reference utils/dataclasses.py:1082-1090),
+    TPU-native: between optimizer steps the params live in pinned host
+    memory (``optim.Optimizer.reoffload_params_to_host``); this hook's
+    ``device_put`` runs INSIDE a captured step's trace, so XLA schedules the
+    host→HBM stream into the step program and overlaps it with compute.
+    Eagerly it is a plain blocking transfer.  Params stay device-resident
+    from forward through backward and update (the tape holds them for the
+    vjp), so intra-step HBM is unchanged — what offload buys is the
+    BETWEEN-step residency: HBM holds no params/moments/masters while the
+    host assembles the next batch, and models whose params+opt state exceed
+    HBM only need the params+grads+activations working set to fit.
+    """
+
+    def pre_forward(self, module, *args, **kwargs):
+        import jax
+
+        # unconditional: inside a captured trace the params are tracers
+        # (whose host memory space lives in the aval, not a .sharding attr),
+        # and device→device put is free for anything already resident
+        for p in module.parameters():
+            p.data = jax.device_put(p.data, jax.memory.Space.Device)
+        return args, kwargs
+
+
 class CpuOffload(ModelHook):
     """Keep the model on host; move to chip at forward, optionally kicking the
     previous model back to host first (reference: hooks.py:691)."""

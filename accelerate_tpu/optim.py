@@ -78,6 +78,11 @@ class Optimizer:
             closure()
         self._ensure_master()
         self.stage_state_on_device()
+        # ZeRO-Infinity-style param offload: the no-master path reads p.data
+        # in the update math below, and XLA refuses mixed-memory operands —
+        # stage any still-host-resident params (free if the forward's
+        # staging hook already did; see stage_params_on_device)
+        self.stage_params_on_device()
         # update math in fp32 against master weights (mixed-precision safe)
         params = [
             m if m is not None else p.data
@@ -158,6 +163,29 @@ class Optimizer:
             new_leaves.append(leaf)
         self.opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
 
+    def stage_params_on_device(self) -> None:
+        """Move host-offloaded PARAMS into device memory (traced h2d inside a
+        captured step; eager device_put otherwise).  No-op unless param
+        offload was requested, and free for params the forward's staging
+        hook already moved (device→device put)."""
+        if not getattr(self, "_offload_params", False):
+            return
+        for p in self.param_list:
+            p.data = jax.device_put(p.data, jax.memory.Space.Device)
+
+    def reoffload_params_to_host(self) -> None:
+        """Re-pin params to pinned host memory after an update (the
+        ZeRO-Infinity analog of ``reoffload_state_to_host``): between steps
+        HBM holds no param copy — reference FSDP ``CPUOffload``/DeepSpeed
+        ``offload_param`` (reference utils/dataclasses.py:1082-1090).
+        Idempotent; no-op unless requested via relayout."""
+        if not getattr(self, "_offload_params", False):
+            return
+        for p in self.param_list:
+            s = getattr(p.data, "sharding", None)
+            if isinstance(s, jax.sharding.NamedSharding) and s.memory_kind != "pinned_host":
+                p.data = jax.device_put(p.data, self._host_sharding(s))
+
     def reoffload_state_to_host(self) -> None:
         """Re-pin per-param optimizer state + masters to pinned host memory.
 
@@ -179,7 +207,9 @@ class Optimizer:
 
         self._map_per_param_state(to_host)
 
-    def relayout_for_sharded_params(self, offload_to_host: bool = False) -> None:
+    def relayout_for_sharded_params(
+        self, offload_to_host: bool = False, offload_params: bool = False
+    ) -> None:
         """Move optimizer state + fp32 masters onto the params' shardings.
 
         ``tx.init`` runs at construction time, *before* ``Accelerator.prepare``
@@ -195,6 +225,7 @@ class Optimizer:
         """
         self._ensure_master()
         self._offload_host = bool(offload_to_host)
+        self._offload_params = bool(offload_params)
         shardings = [p.data.sharding for p in self.param_list]
 
         def to_param_layout(leaf, i):
@@ -220,6 +251,10 @@ class Optimizer:
             else None
         )
         self._map_per_param_state(to_param_layout, scalar_fn)
+        # training-time parameter offload: pin the params themselves to host
+        # now; the forward staging hook (hooks.ParamOffloadHook) brings them
+        # back per step
+        self.reoffload_params_to_host()
 
     # -- functional bridge (used by Accelerator's step capture) --------------
     def capture_state(self) -> dict:

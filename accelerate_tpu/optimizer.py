@@ -172,11 +172,12 @@ class AcceleratedOptimizer:
         from .capture import current_capture
 
         if current_capture() is None:
-            # eager: the update left the new moments/masters in device HBM —
-            # re-pin them to host if offload was requested (a no-op
-            # otherwise).  Under capture this runs on tracers, so the
-            # CapturedStep does it after each replay instead.
+            # eager: the update left the new moments/masters (and, with
+            # param offload, the params) in device HBM — re-pin to host if
+            # offload was requested (no-ops otherwise).  Under capture this
+            # runs on tracers, so the CapturedStep does it after each replay.
             self.optimizer.reoffload_state_to_host()
+            self.optimizer.reoffload_params_to_host()
 
     def _step_with_scaler(self, closure) -> None:
         """fp16 step: finite-check, unscale, conditionally apply, update scale.

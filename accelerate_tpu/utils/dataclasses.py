@@ -165,15 +165,27 @@ class DistributedDataParallelKwargs(KwargsHandler):
     scheduler's job.  ``gradient_as_bucket_view`` etc. are accepted and
     ignored; ``comm_hook`` ("fp16"/"bf16") compresses synced gradients at
     the backward boundary — half-width grad buffers and downstream
-    consumers; see Accelerator._apply_comm_hook for exactly what this does
-    and does not change about XLA's collective dtypes.
+    consumers — and "powersgd"/"batched_powersgd" run rank-k compression
+    with error feedback there (utils/powersgd.py); see
+    Accelerator._apply_comm_hook for exactly what this does and does not
+    change about XLA's collective dtypes.
+
+    ``comm_wrapper`` ("fp16"/"bf16") composes with the PowerSGD hooks the
+    way the reference's fp16/bf16 wrappers compose with powerSGD_hook: the
+    transported low-rank factors are rounded through that dtype.
+    ``comm_state_option`` carries the PowerSGDState options
+    (``matrix_approximation_rank``, ``use_error_feedback``, ``warm_start``;
+    ``start_powerSGD_iter`` is accepted and ignored — compression runs from
+    step 0, see utils/powersgd.py).  Reference: dataclasses.py:137-215.
     """
 
     bucket_cap_mb: int = 25
     find_unused_parameters: bool = False
     gradient_as_bucket_view: bool = False
     static_graph: bool = False
-    comm_hook: Optional[str] = None  # "fp16" | "bf16" → gradient all-reduce dtype
+    comm_hook: Optional[str] = None  # "fp16"|"bf16"|"powersgd"|"batched_powersgd"
+    comm_wrapper: Optional[str] = None  # "fp16" | "bf16" wrapper for powersgd
+    comm_state_option: dict = field(default_factory=dict)
 
 
 # ---------------------------------------------------------------------------
@@ -243,6 +255,11 @@ class FullyShardedDataParallelPlugin:
     auto_wrap_policy: Optional[str] = "transformer_based_wrap"
     transformer_cls_names_to_wrap: Optional[list[str]] = None
     min_num_params: int = 0
+    # training-time parameter offload (torch FSDP CPUOffload(offload_params)
+    # / DeepSpeed ZeRO-Infinity offload_param, reference
+    # dataclasses.py:1082-1090): fsdp-sharded params live in pinned host
+    # memory between steps and are staged back by a forward hook traced into
+    # the captured step (hooks.ParamOffloadHook).  Env: FSDP_OFFLOAD_PARAMS.
     cpu_offload: bool = False
     state_dict_type: str = "SHARDED_STATE_DICT"  # or FULL_STATE_DICT
     use_orig_params: bool = True  # parity; always true functionally

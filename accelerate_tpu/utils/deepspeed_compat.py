@@ -130,13 +130,27 @@ def from_deepspeed_config(
             )
     param_dev = _get(cfg, "zero_optimization.offload_param.device")
     if param_dev in ("cpu", "nvme"):
-        warnings.warn(
-            f"ds_config requests zero_optimization.offload_param.device="
-            f"{param_dev!r}; TPU HBM sharding replaces ZeRO param offload — "
-            "use big_modeling host/disk offload (cpu_offload/disk_offload) "
-            "for models beyond HBM",
-            stacklevel=2,
-        )
+        # ZeRO-Infinity training-time param offload has a real TPU
+        # mechanism too: fsdp-sharded params pinned to host between steps,
+        # staged back by a traced forward hook
+        # (FullyShardedDataParallelPlugin.cpu_offload → hooks.ParamOffloadHook
+        # + optim.reoffload_params_to_host)
+        if fsdp_plugin is not None:
+            fsdp_plugin.cpu_offload = True
+            if param_dev == "nvme":
+                warnings.warn(
+                    "ds_config offload_param.device='nvme' maps to pinned "
+                    "host memory on TPU (no per-chip NVMe tier)",
+                    stacklevel=2,
+                )
+        else:
+            warnings.warn(
+                "ds_config requests offload_param with zero stage 0; param "
+                "host offload rides the fsdp plugin — set zero stage >= 1 "
+                "(or pass FullyShardedDataParallelPlugin(cpu_offload=True) "
+                "with your intended strategy)",
+                stacklevel=2,
+            )
 
     if _resolve_auto(_get(cfg, "bf16.enabled"), False):
         mixed_precision = "bf16"

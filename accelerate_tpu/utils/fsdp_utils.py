@@ -130,6 +130,16 @@ def collect_sharded_model_state(
     rank = jax.process_index() if process_index is None else process_index
     world = jax.process_count() if num_processes is None else num_processes
 
+    # D2H overlap: start every shard's device→host copy before the first
+    # blocking np.asarray below, so the stall is max(transfer) not
+    # sum(transfer) — matters for async save, whose call-time cost is
+    # exactly this collection
+    for value in state_dict.values():
+        if isinstance(value, jax.Array) and hasattr(value, "addressable_shards"):
+            for shard in value.addressable_shards:
+                if hasattr(shard.data, "copy_to_host_async"):
+                    shard.data.copy_to_host_async()
+
     local_arrays: dict[str, np.ndarray] = {}
     index: dict[str, Any] = {"metadata": {"num_shards": world}, "tensors": {}}
     for tensor_name, value in state_dict.items():

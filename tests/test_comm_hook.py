@@ -64,7 +64,7 @@ def test_invalid_comm_hook_raises_at_construction():
     Accelerator._reset_state()
     with pytest.raises(ValueError, match="comm_hook"):
         Accelerator(
-            kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="powersgd")]
+            kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="int3")]
         )
 
 

@@ -41,10 +41,16 @@ def test_zero2_and_fp16_and_stage0():
     assert c0.fsdp_plugin is None and c0.zero_stage == 0 and c0.mixed_precision == "no"
 
 
-def test_offload_warns():
+def test_offload_param_maps_and_stage0_warns():
+    # stage >= 1: offload_param maps to the real param-offload mechanism
+    # (tests/test_param_offload.py exercises it end to end)
     cfg = {"zero_optimization": {"stage": 3, "offload_param": {"device": "cpu"}}}
+    compat = from_deepspeed_config(cfg)
+    assert compat.fsdp_plugin.cpu_offload is True
+    # stage 0 has no fsdp plugin to ride — still warns
+    cfg0 = {"zero_optimization": {"stage": 0, "offload_param": {"device": "cpu"}}}
     with pytest.warns(UserWarning, match="offload"):
-        from_deepspeed_config(cfg)
+        from_deepspeed_config(cfg0)
 
 
 def test_unsupported_stage_raises():
