@@ -15,12 +15,14 @@ test_core:
 	  tests/test_optimizer.py tests/test_optimizer_offload.py \
 	  tests/test_capture_stability.py tests/test_precision.py \
 	  tests/test_fp16_capture.py tests/test_autocast.py \
-	  tests/test_comm_hook.py tests/test_config_knobs.py \
+	  tests/test_comm_hook.py tests/test_powersgd.py \
+	  tests/test_config_knobs.py \
 	  tests/test_tracking.py tests/test_utils_misc.py \
-	  tests/test_deepspeed_compat.py -q
+	  tests/test_deepspeed_compat.py tests/test_param_offload.py -q
 
 test_models:
 	python -m pytest tests/test_models.py tests/test_llama.py \
+	  tests/test_llama_rope_scaling.py \
 	  tests/test_opt.py tests/test_gptj_neox.py tests/test_t5.py \
 	  tests/test_generation.py tests/test_quantized_decode.py \
 	  tests/test_moe.py \

@@ -150,20 +150,6 @@ class FrozenState:
         return self._state_dict
 
 
-class FrozenOptimizer(FrozenState):
-    """Optimizer snapshot: full ``state_dict`` plus the pre-captured sharded
-    form (the save path calls whichever the checkpoint mode needs)."""
-
-    def __init__(self, state_dict, sharded_parts=None):
-        super().__init__(state_dict)
-        self._sharded_parts = sharded_parts
-
-    def sharded_state_arrays(self):
-        if self._sharded_parts is None:
-            raise RuntimeError("snapshot was not captured for sharded save")
-        return self._sharded_parts
-
-
 import dataclasses as _dataclasses
 
 

@@ -55,6 +55,77 @@ def test_rng_sync():
     print("rng sync ok")
 
 
+def test_rng_types_deep():
+    """Per-source RNG sync (reference rng_sync_check test_script.py:174):
+    after synchronize_rng_states each source draws identically everywhere;
+    a process-specific seed then diverges the local streams again."""
+    import random as pyrandom
+
+    import jax
+
+    acc = Accelerator()
+    synchronize_rng_states(["numpy", "python", "jax"])
+    from accelerate_tpu.utils import operations as ops
+
+    draws = np.asarray(
+        [np.random.rand(), pyrandom.random(), float(jax.random.uniform(nn.random.default_rng.next_key(), ()))],
+        dtype=np.float64,
+    )
+    gathered = np.asarray(ops.gather_object([draws.tolist()]))
+    assert np.allclose(gathered, gathered[0]), "per-source RNG out of sync"
+    # device_specific seeding must DIVERGE processes (reference set_seed
+    # device_specific=True) — only observable multi-process
+    set_seed(1234, device_specific=True)
+    local = np.random.rand()
+    locals_all = ops.gather_object([local])
+    if acc.num_processes > 1:
+        assert len(set(np.round(locals_all, 12))) > 1, "device_specific seed identical"
+    print("rng types deep ok")
+
+
+def test_object_collectives():
+    """gather_object / broadcast_object_list on arbitrary picklables
+    (reference test_script.py:min gather_object + broadcast sections)."""
+    from accelerate_tpu.utils import operations as ops
+
+    acc = Accelerator()
+    mine = {"rank": acc.process_index, "tag": f"p{acc.process_index}"}
+    everyone = ops.gather_object([mine])
+    assert len(everyone) == acc.num_processes
+    assert sorted(d["rank"] for d in everyone) == list(range(acc.num_processes))
+
+    payload = ["from-main", {"nested": 7}] if acc.is_main_process else [None, None]
+    out = ops.broadcast_object_list(payload)
+    assert out[0] == "from-main" and out[1] == {"nested": 7}, out
+    print("object collectives ok")
+
+
+def test_join_uneven_inputs():
+    """join_uneven_inputs contract (reference test_script.py join section):
+    under SPMD the global loader already evens batches, so the context is a
+    documented pass-through — training inside it must work unchanged, and
+    overriding even_batches warns rather than silently changing math."""
+    Accelerator._reset_state()  # clear any config a prior check installed
+    acc = Accelerator()
+    model = RegressionModel()
+    opt = optim.SGD(model.parameters(), lr=0.05)
+    model, opt = acc.prepare(model, opt)
+    with acc.join_uneven_inputs([model]):
+        for i in range(3):  # same count everywhere: SPMD programs are uniform
+            opt.zero_grad()
+            x = Tensor(np.full((2, 1), float(i), np.float32))
+            loss = nn.F.mse_loss(model(x), Tensor(np.zeros((2, 1), np.float32)))
+            acc.backward(loss)
+            opt.step()
+    acc.wait_for_everyone()
+    from accelerate_tpu.utils import operations as ops
+
+    a = float(np.asarray(model.a.data))
+    vals = ops.gather_object([a])
+    assert all(abs(v - vals[0]) < 1e-6 for v in vals), vals
+    print("join_uneven_inputs ok")
+
+
 def _dataset(n):
     return [{"x": np.float32(i), "y": np.float32(2 * i + 1)} for i in range(n)]
 
@@ -161,6 +232,102 @@ def mock_training():
     assert abs(got_a - a) < 1e-3, f"a: {got_a} vs baseline {a}"
     assert abs(got_b - b) < 1e-3, f"b: {got_b} vs baseline {b}"
     print(f"mock training ok (a={got_a:.4f}, b={got_b:.4f})")
+
+
+def _regression_setup(lr=0.1, **acc_kwargs):
+    # these checks vary Accelerator config (precision, accumulation), and
+    # AcceleratorState is a Borg that refuses conflicting re-init — reset
+    # first (the jax.distributed rendezvous is module-global and survives)
+    Accelerator._reset_state()
+    set_seed(42)
+    acc = Accelerator(**acc_kwargs)
+    model = RegressionModel()
+    opt = optim.SGD(model.parameters(), lr=lr)
+    model, opt = acc.prepare(model, opt)
+    return acc, model, opt
+
+
+def mock_training_accumulate():
+    """Gradient accumulation parity (reference test_script.py training
+    section): two half-batch micro-steps under accumulate() must produce
+    the same update as one full-batch step."""
+    data = RegressionDataset(length=16, seed=11)
+    x, y = data.x.astype(np.float32), data.y.astype(np.float32)
+
+    acc, model, opt = _regression_setup(gradient_accumulation_steps=2)
+    for lo in (0, 8):
+        with acc.accumulate(model):
+            pred = model(Tensor(x[lo : lo + 8].reshape(-1, 1)))
+            loss = nn.F.mse_loss(pred, Tensor(y[lo : lo + 8].reshape(-1, 1)))
+            acc.backward(loss)
+            opt.step()
+            opt.zero_grad()
+    a_acc = float(np.asarray(model.a.data))
+
+    acc2, model2, opt2 = _regression_setup()
+    opt2.zero_grad()
+    pred = model2(Tensor(x.reshape(-1, 1)))
+    loss = nn.F.mse_loss(pred, Tensor(y.reshape(-1, 1)))
+    acc2.backward(loss)
+    opt2.step()
+    a_full = float(np.asarray(model2.a.data))
+    assert abs(a_acc - a_full) < 1e-5, f"accumulate parity: {a_acc} vs {a_full}"
+    print("mock training accumulate ok")
+
+
+def mock_training_capture_parity():
+    """compile_step replays must match eager stepping bit-for-bit on the
+    same data (the capture engine is the default execution path on TPU)."""
+    data = RegressionDataset(length=8, seed=5)
+    x = Tensor(data.x.astype(np.float32).reshape(-1, 1))
+    y = Tensor(data.y.astype(np.float32).reshape(-1, 1))
+
+    def body(acc, model, opt):
+        def fn(xb, yb):
+            opt.zero_grad()
+            loss = nn.F.mse_loss(model(xb), yb)
+            acc.backward(loss)
+            opt.step()
+            return loss
+
+        return fn
+
+    acc_e, model_e, opt_e = _regression_setup()
+    fn_e = body(acc_e, model_e, opt_e)
+    eager = [float(fn_e(x, y)) for _ in range(3)]
+
+    acc_c, model_c, opt_c = _regression_setup()
+    step = acc_c.compile_step(body(acc_c, model_c, opt_c))
+    captured = [float(step(x, y)) for _ in range(3)]
+    assert np.allclose(eager, captured, rtol=1e-6), (eager, captured)
+    assert abs(float(np.asarray(model_e.a.data)) - float(np.asarray(model_c.a.data))) < 1e-6
+    print("mock training capture parity ok")
+
+
+def mock_training_bf16():
+    """bf16 mixed precision trains and keeps fp32 master accuracy
+    (reference test_script.py fp16/bf16 training variants)."""
+    data = RegressionDataset(length=32, seed=7)
+    acc, model, opt = _regression_setup(mixed_precision="bf16", lr=0.05)
+    x = Tensor(data.x.astype(np.float32).reshape(-1, 1))
+    y = Tensor(data.y.astype(np.float32).reshape(-1, 1))
+    losses = []
+    for _ in range(6):
+        opt.zero_grad()
+        with acc.autocast():
+            loss = nn.F.mse_loss(model(x), y)
+        acc.backward(loss)
+        opt.step()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    print("mock training bf16 ok")
+
+
+def test_dispatch_grid():
+    """Dispatch-mode loader over the same grid/rules as the sharded loader
+    (reference central_dl_preparation_check, test_script.py:255-316) — one
+    shared grid walker so the two modes cannot drift."""
+    _dl_grid_check(dispatch_batches=True, ns=(22,), label="dispatch grid")
 
 
 def test_gather_for_metrics():
@@ -355,14 +522,13 @@ def test_print_in_order():
         acc.wait_for_everyone()
 
 
-def test_uneven_tail_grid():
-    """(batch_size × even_batches × split_batches) grid under the REAL
-    launcher (reference dl_preparation_check/central grids,
-    test_script.py:192-316): coverage and duplication rules hold in every
-    cell."""
+def _dl_grid_check(dispatch_batches: bool, ns: tuple, label: str) -> None:
+    """ONE grid walker for both loader modes: (n × batch_size ×
+    even_batches × split_batches), asserting coverage + the exact
+    loop-back count under even_batches and no-duplication otherwise."""
     acc = Accelerator()
     shards = max(1, acc.state.num_batch_shards)
-    for n in (18, 22):
+    for n in ns:
         for bs in sorted({2, 4, shards}):
             for even_batches in (True, False):
                 for split_batches in (True, False):
@@ -371,11 +537,15 @@ def test_uneven_tail_grid():
                     dl = prepare_data_loader(
                         dataset=_dataset(n),
                         batch_size=bs,
+                        dispatch_batches=dispatch_batches,
                         even_batches=even_batches,
                         split_batches=split_batches,
                     )
                     seen = _collect_seen(acc, dl)
-                    cell = f"n={n} bs={bs} even={even_batches} split={split_batches}"
+                    cell = (
+                        f"dispatch={dispatch_batches} n={n} bs={bs} "
+                        f"even={even_batches} split={split_batches}"
+                    )
                     if even_batches:
                         assert set(seen) == set(range(n)), f"{cell}: coverage broken"
                         gbs = dl.total_batch_size
@@ -384,7 +554,15 @@ def test_uneven_tail_grid():
                     else:
                         assert len(seen) == len(set(seen)), f"{cell}: duplicated"
                         assert set(seen) <= set(range(n)), f"{cell}: out of range"
-    print("uneven-tail grid ok")
+    print(f"{label} ok")
+
+
+def test_uneven_tail_grid():
+    """(batch_size × even_batches × split_batches) grid under the REAL
+    launcher (reference dl_preparation_check/central grids,
+    test_script.py:192-316): coverage and duplication rules hold in every
+    cell."""
+    _dl_grid_check(dispatch_batches=False, ns=(18, 22), label="uneven-tail grid")
 
 
 def main():
@@ -401,13 +579,20 @@ def main():
     test_split_between_processes_tensor()
     test_split_between_processes_evenly()
     test_rng_sync()
+    test_rng_types_deep()
+    test_object_collectives()
     test_dataloader_coverage()
     test_dataloader_even_batches_off()
     test_uneven_tail_grid()
     test_dispatch_loader()
+    test_dispatch_grid()
     test_skip_first_batches()
     test_gather_for_metrics()
     mock_training()
+    mock_training_accumulate()
+    mock_training_capture_parity()
+    mock_training_bf16()
+    test_join_uneven_inputs()
     test_save_load_roundtrip()
     test_trigger()
     state.wait_for_everyone()

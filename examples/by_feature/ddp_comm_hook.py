@@ -6,8 +6,11 @@ all-reduce; here the SPMD analog is
 ``DistributedDataParallelKwargs(comm_hook=...)`` — synced gradients are cast
 to the compression dtype at the backward boundary (half-width grad buffers
 and downstream consumers; see Accelerator._apply_comm_hook for exactly what
-this does and does not change about XLA's collective dtypes).  Lines marked
-`# New Code #` are what this feature adds to nlp_example.py.
+this does and does not change about XLA's collective dtypes).  The
+``powersgd``/``batched_powersgd`` values run rank-k compression with error
+feedback instead of a cast (the reference's POWER_SGD hook, redesigned in
+utils/powersgd.py).  Lines marked `# New Code #` are what this feature adds
+to nlp_example.py.
 """
 
 from __future__ import annotations
@@ -51,20 +54,27 @@ def training_function(args):
         model, optimizer, train_dl, val_dl, scheduler
     )
 
+    def train_step(batch):
+        out = model(
+            batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            token_type_ids=batch["token_type_ids"],
+            labels=batch["labels"],
+        )
+        accelerator.backward(out["loss"])
+        optimizer.step()
+        scheduler.step()
+        optimizer.zero_grad()
+        return out["loss"]
+
+    step = accelerator.compile_step(train_step)
+
+    loss = None
     for epoch in range(args.num_epochs):
         model.train()
-        for step, batch in enumerate(train_dl):
-            out = model(
-                batch["input_ids"],
-                attention_mask=batch["attention_mask"],
-                token_type_ids=batch["token_type_ids"],
-                labels=batch["labels"],
-            )
-            accelerator.backward(out["loss"])
-            optimizer.step()
-            scheduler.step()
-            optimizer.zero_grad()
-        accelerator.print(f"epoch {epoch}: loss={float(out['loss'].item()):.4f}")
+        for batch in train_dl:
+            loss = step(batch)
+        accelerator.print(f"epoch {epoch}: loss={float(loss.item()):.4f}")
     return model
 
 
@@ -77,7 +87,14 @@ def main():
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--small", action="store_true")
     # New Code #
-    parser.add_argument("--comm_hook", type=str, default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument(
+        "--comm_hook",
+        type=str,
+        default="bf16",
+        # powersgd/batched_powersgd: rank-k compression with error feedback
+        # (utils/powersgd.py) — the reference's POWER_SGD hook analogs
+        choices=["no", "fp16", "bf16", "powersgd", "batched_powersgd"],
+    )
     args = parser.parse_args()
     training_function(args)
 

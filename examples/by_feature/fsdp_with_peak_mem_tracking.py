@@ -59,10 +59,21 @@ def per_device_bytes(model, optimizer) -> dict:
 def training_function(args):
     # New Code #
     # fsdp_size lays parameters (and optimizer state) across the mesh's
-    # fsdp axis — ZeRO semantics as a sharding, not a wrapper module
+    # fsdp axis — ZeRO semantics as a sharding, not a wrapper module.
+    # --offload adds the ZeRO-Infinity analog: optimizer state AND params
+    # pinned to host between steps (docs/gradient_synchronization.md,
+    # estimate-memory's "idle w/ full offload" column)
+    fsdp_plugin = None
+    if args.offload:
+        from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+        fsdp_plugin = FullyShardedDataParallelPlugin(
+            offload_optimizer=True, cpu_offload=True
+        )
     accelerator = Accelerator(
         mixed_precision=args.mixed_precision,
         parallelism_config=ParallelismConfig(fsdp_size=args.fsdp_size),
+        fsdp_plugin=fsdp_plugin,
     )
     nn.manual_seed(args.seed)
     train_dl, val_dl, vocab = get_dataloaders(accelerator, args.batch_size, args.seed)
@@ -78,23 +89,30 @@ def training_function(args):
         model, optimizer, train_dl, val_dl, scheduler
     )
 
+    def train_step(batch):
+        optimizer.zero_grad()
+        out = model(
+            batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            token_type_ids=batch["token_type_ids"],
+            labels=batch["labels"],
+        )
+        accelerator.backward(out["loss"])
+        optimizer.step()
+        scheduler.step()
+        return out["loss"]
+
+    step = accelerator.compile_step(train_step)
+
+    loss = None
     for epoch in range(args.num_epochs):
         model.train()
         for batch in train_dl:
-            optimizer.zero_grad()
-            out = model(
-                batch["input_ids"],
-                attention_mask=batch["attention_mask"],
-                token_type_ids=batch["token_type_ids"],
-                labels=batch["labels"],
-            )
-            accelerator.backward(out["loss"])
-            optimizer.step()
-            scheduler.step()
+            loss = step(batch)
         # New Code #
         mem = per_device_bytes(model, optimizer)
         accelerator.print(
-            f"epoch {epoch}: loss={float(out['loss'].item()):.4f} "
+            f"epoch {epoch}: loss={float(loss.item()):.4f} "
             f"param_bytes/device={mem['param_bytes']:,} "
             f"opt_state_bytes/device={mem['opt_state_bytes']:,}"
             + (f" hbm_in_use={mem['hbm_in_use']:,}" if mem["hbm_in_use"] else "")
@@ -111,6 +129,11 @@ def main():
     parser.add_argument("--lr", type=float, default=2e-5)
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--small", action="store_true")
+    # New Code #
+    parser.add_argument(
+        "--offload", action="store_true",
+        help="ZeRO-Infinity-style host offload of params + optimizer state",
+    )
     args = parser.parse_args()
     training_function(args)
 

@@ -68,3 +68,18 @@ def test_merge_weights_script():
 def test_metrics_script():
     out = _run("accelerate_tpu.test_utils.scripts.external_deps.test_metrics")
     assert "All metrics checks passed" in out
+
+
+def test_zero3_integration_script():
+    out = _run("accelerate_tpu.test_utils.scripts.external_deps.test_zero3_integration")
+    assert "zero3 integration ok" in out
+
+
+def test_ds_multiple_model_script():
+    out = _run("accelerate_tpu.test_utils.scripts.external_deps.test_ds_multiple_model")
+    assert "multiple-model ds training ok" in out
+
+
+def test_pippy_script():
+    out = _run("accelerate_tpu.test_utils.scripts.external_deps.test_pippy")
+    assert "pipelined gpt2 parity ok" in out
