@@ -479,11 +479,15 @@ class ParamOffloadHook(ModelHook):
     ``device_put`` runs INSIDE a captured step's trace, so XLA schedules the
     host→HBM stream into the step program and overlaps it with compute.
     Eagerly it is a plain blocking transfer.  Params stay device-resident
-    from forward through backward and update (the tape holds them for the
-    vjp), so intra-step HBM is unchanged — what offload buys is the
-    BETWEEN-step residency: HBM holds no params/moments/masters while the
-    host assembles the next batch, and models whose params+opt state exceed
-    HBM only need the params+grads+activations working set to fit.
+    from forward through backward and update (the tape differentiates the
+    STAGED copies, keeping gradients in device memory — a per-layer
+    staging inside the layer fns would root autodiff at the host arrays
+    and land cotangents in pinned_host, which TPU collectives/optimizer
+    math cannot consume), so intra-step HBM is unchanged — what offload
+    buys is the BETWEEN-step residency: HBM holds no params/moments/
+    masters while the host assembles the next batch, and models whose
+    params+opt state exceed HBM only need the params+grads+activations
+    working set to fit.
     """
 
     def pre_forward(self, module, *args, **kwargs):

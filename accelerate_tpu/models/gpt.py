@@ -302,7 +302,9 @@ class GPTLMHeadModel(nn.Module):
 # ---------------------------------------------------------------------------
 
 def maybe_remat(fn):
-    """Per-layer activation checkpointing (``ACCELERATE_TPU_REMAT=1``).
+    """Per-layer activation checkpointing (``ACCELERATE_TPU_REMAT=1`` or
+    ``FullyShardedDataParallelPlugin(activation_checkpointing=True)`` /
+    ``FSDP_ACTIVATION_CHECKPOINTING`` from the launcher protocol).
 
     Wraps a pure block function in ``jax.checkpoint``: the backward
     recomputes the layer forward instead of keeping its activations alive —
@@ -311,12 +313,20 @@ def maybe_remat(fn):
     workloads; sweep with bench.py).  Used by every pure-fn decoder family
     (Llama/OPT/GPT-J/NeoX); numerics are exactly unchanged (tested).
 
-    The env var is read at TRACE time: captured steps bake the value at
+    The knobs are read at TRACE time: captured steps bake the value at
     first compile, eager steps read it per layer call (a cheap dict get).
     """
     import os
 
     if os.environ.get("ACCELERATE_TPU_REMAT", "0").lower() in ("1", "true", "yes"):
+        return jax.checkpoint(fn)
+    from ..state import AcceleratorState
+
+    # read the Borg dict directly: constructing AcceleratorState() here
+    # could silently re-run a full default init if a prior Accelerator
+    # construction failed partway, and this runs per layer call
+    plugin = AcceleratorState._shared_state.get("fsdp_plugin")
+    if plugin is not None and getattr(plugin, "activation_checkpointing", False):
         return jax.checkpoint(fn)
     return fn
 

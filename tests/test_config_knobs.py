@@ -106,3 +106,25 @@ def test_fp8_amax_compute_algo():
     bad.set_delayed(True)
     with pytest.raises(ValueError, match="amax_compute_algo"):
         bad(x)
+
+
+def test_plugin_activation_checkpointing_engages_remat():
+    """FSDP activation_checkpointing (also set by the launcher's
+    FSDP_ACTIVATION_CHECKPOINTING env) must wire through to per-layer
+    jax.checkpoint in maybe_remat — previously a dormant accepted knob."""
+    from accelerate_tpu.models.gpt import maybe_remat
+    from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+    def fn(x):
+        return x * 2
+
+    _fresh()
+    assert maybe_remat(fn) is fn  # no state, no env: untouched
+    Accelerator(fsdp_plugin=FullyShardedDataParallelPlugin(activation_checkpointing=True))
+    wrapped = maybe_remat(fn)
+    assert wrapped is not fn, "plugin flag did not engage jax.checkpoint"
+    np.testing.assert_allclose(
+        np.asarray(wrapped(jnp.arange(4.0))), np.asarray(fn(jnp.arange(4.0)))
+    )
+    _fresh()
+    assert maybe_remat(fn) is fn
