@@ -22,4 +22,14 @@ run vmem64m XLA_FLAGS=--xla_tpu_scoped_vmem_limit_kib=65536
 # FORWARD flash blocks (only backward was swept)
 run fwdblk512 ACCELERATE_TPU_FLASH_BLOCK_Q=512 ACCELERATE_TPU_FLASH_BLOCK_K=512
 run fwdblk256 ACCELERATE_TPU_FLASH_BLOCK_Q=256 ACCELERATE_TPU_FLASH_BLOCK_K=256
+# remat frees activation HBM -> larger per-chip batch; untested combo (the
+# round-3 batch sweep ran remat-off, where 12 beat 16/24 on memory pressure).
+# remat_b12 is the single-variable control so wins attribute cleanly.
+run remat_b12 ACCELERATE_TPU_REMAT=1
+run remat_b16 ACCELERATE_TPU_REMAT=1 BENCH_BATCH=16
+run remat_b24 ACCELERATE_TPU_REMAT=1 BENCH_BATCH=24
+# scheduler toggle: overlap HBM prefetch with MXU work (default varies by
+# XLA version; measure both states explicitly)
+run lhs_on XLA_FLAGS=--xla_tpu_enable_latency_hiding_scheduler=true
+run lhs_off XLA_FLAGS=--xla_tpu_enable_latency_hiding_scheduler=false
 echo "experiments done" | tee -a "$OUT/exp.log"
