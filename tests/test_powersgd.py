@@ -155,3 +155,47 @@ def test_powersgd_comm_wrapper_and_cold_start():
 def test_reference_enum_spelling_accepted():
     losses, acc = _train("DDPCommunicationHookType.POWER_SGD", steps=2)
     assert acc._comm_hook == "powersgd"
+
+
+def test_powersgd_composes_with_fsdp_mesh():
+    """Sharded gradients through the rank-k recurrence: under an fsdp axis
+    the gradient matrices are GSPMD-sharded, so M@Q / QR / PQ^T run with
+    partitioned operands — training must still learn and the state must
+    keep threading."""
+    from accelerate_tpu import ParallelismConfig
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(fsdp_size=2),
+        kwargs_handlers=[
+            DistributedDataParallelKwargs(
+                comm_hook="powersgd",
+                comm_state_option={"matrix_approximation_rank": 2},
+            )
+        ],
+    )
+    model = GPTLMHeadModel(GPTConfig.tiny())
+    opt = optim.SGD(model.parameters(), lr=0.3)
+    model, opt = acc.prepare(model, opt)
+
+    def fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(fn)
+    ids = batch_to_global_array(
+        jnp.asarray(np.random.default_rng(0).integers(0, 1024, (8, 16)), jnp.int32),
+        mesh=acc.mesh,
+    )
+    q0 = {n: np.asarray(q).copy() for n, q in acc._powersgd_state[0]["q"].items()}
+    losses = [float(step(ids)) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+    q1 = acc._powersgd_state[0]["q"]
+    assert any(not np.allclose(q0[n], np.asarray(q1[n])) for n in q0)
