@@ -22,7 +22,7 @@ test_core:
 
 test_models:
 	python -m pytest tests/test_models.py tests/test_llama.py \
-	  tests/test_llama_rope_scaling.py \
+	  tests/test_llama_rope_scaling.py tests/test_chunked_ce.py \
 	  tests/test_opt.py tests/test_gptj_neox.py tests/test_t5.py \
 	  tests/test_generation.py tests/test_quantized_decode.py \
 	  tests/test_moe.py \

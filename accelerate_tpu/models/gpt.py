@@ -19,6 +19,16 @@ from .. import nn
 from ..nn import F, Tensor
 
 
+def shift_labels_for_lm(labels) -> jnp.ndarray:
+    """Next-token targets as a flat (B*S,) id array with the final position
+    masked to ignore_index (-100) — shared by the dense and chunked loss
+    paths so their masking cannot drift."""
+    lab = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
+    return jnp.concatenate(
+        [lab[:, 1:], jnp.full((lab.shape[0], 1), -100, lab.dtype)], axis=1
+    ).reshape(-1)
+
+
 def lm_shift_loss(logits, labels, vocab_size: int):
     """Next-token cross entropy without slicing logits to an odd length.
 
@@ -28,11 +38,7 @@ def lm_shift_loss(logits, labels, vocab_size: int):
     physical copy per step on GPT-2-small/v5e, where the masked form is a
     free bitcast.
     """
-    lab = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
-    shift_labels = jnp.concatenate(
-        [lab[:, 1:], jnp.full((lab.shape[0], 1), -100, lab.dtype)], axis=1
-    ).reshape(-1)
-    return F.cross_entropy(logits.reshape(-1, vocab_size), shift_labels)
+    return F.cross_entropy(logits.reshape(-1, vocab_size), shift_labels_for_lm(labels))
 
 
 @dataclasses.dataclass
@@ -213,16 +219,26 @@ class GPTLMHeadModel(nn.Module):
         for block in self.h:
             x = constrain_activation(block(x))
         x = self.ln_f(x)
-        logits = self.lm_head(x)  # tied head: x @ wte^T
         if labels is not None:
-            loss = lm_shift_loss(logits, labels, self.config.vocab_size)
+            chunk = F.ce_chunk_size()
+            if chunk > 0:
+                # fused head+CE: the (B·S, V) logits never exist, so none
+                # are returned — training loops consume only the loss
+                logits = None
+                loss = F.chunked_lm_head_ce(
+                    x, self.lm_head.weight, shift_labels_for_lm(labels),
+                    self.config.vocab_size, chunk,
+                )
+            else:
+                logits = self.lm_head(x)  # tied head: x @ wte^T
+                loss = lm_shift_loss(logits, labels, self.config.vocab_size)
             if self.config.n_experts > 0:
                 for block in self.h:
                     aux = getattr(block.mlp, "last_aux_loss", None)
                     if aux is not None:
                         loss = loss + self.config.moe_aux_weight * aux
             return {"loss": loss, "logits": logits}
-        return {"logits": logits}
+        return {"logits": self.lm_head(x)}
 
     def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0,
                  rng=None, quantize_weights=None):

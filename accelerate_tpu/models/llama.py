@@ -365,11 +365,22 @@ class LlamaForCausalLM(nn.Module):
         for layer in self.layers:
             x = constrain_activation(layer(x))
         x = self.norm(x)
-        logits = self.lm_head(x)
         if labels is not None:
+            from ..nn import F
+            from .gpt import shift_labels_for_lm
+
+            chunk = F.ce_chunk_size()
+            if chunk > 0:
+                # fused head+CE (see models/gpt.py): logits never materialize
+                loss = F.chunked_lm_head_ce(
+                    x, self.lm_head.weight, shift_labels_for_lm(labels),
+                    self.config.vocab_size, chunk,
+                )
+                return {"loss": loss, "logits": None}
+            logits = self.lm_head(x)
             loss = lm_shift_loss(logits, labels, self.config.vocab_size)
             return {"loss": loss, "logits": logits}
-        return {"logits": logits}
+        return {"logits": self.lm_head(x)}
 
     def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0,
                  rng=None, quantize_weights=None):

@@ -32,4 +32,9 @@ run remat_b24 ACCELERATE_TPU_REMAT=1 BENCH_BATCH=24
 # XLA version; measure both states explicitly)
 run lhs_on XLA_FLAGS=--xla_tpu_enable_latency_hiding_scheduler=true
 run lhs_off XLA_FLAGS=--xla_tpu_enable_latency_hiding_scheduler=false
+# chunked fused head+CE: the (B*S, V) logits tensor (~1.2 GB/step at the
+# flagship geometry, ~4.8 GB of HBM round-trips with its gradient) never
+# materializes; numerics pinned to the dense path by tests/test_chunked_ce.py
+run ce8k ACCELERATE_TPU_CE_CHUNK=8192
+run ce16k ACCELERATE_TPU_CE_CHUNK=16384
 echo "experiments done" | tee -a "$OUT/exp.log"
