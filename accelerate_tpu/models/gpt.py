@@ -29,6 +29,26 @@ def shift_labels_for_lm(labels) -> jnp.ndarray:
     ).reshape(-1)
 
 
+def lm_head_loss(x, head, labels, vocab_size: int):
+    """ONE dispatch for every causal family's loss tail: dense head+CE, or
+    the fused chunked path when ``ACCELERATE_TPU_CE_CHUNK`` is set
+    (nn.functional.chunked_lm_head_ce — logits never materialize).
+
+    ``head`` is the family's output ``nn.Linear`` (biased for GPT-J).
+    Returns ``(loss, logits_or_None)`` — None under the fused path, which
+    is the documented contract for label-bearing calls with the knob on.
+    """
+    chunk = F.ce_chunk_size()
+    if chunk > 0:
+        loss = F.chunked_lm_head_ce(
+            x, head.weight, shift_labels_for_lm(labels), vocab_size, chunk,
+            bias=getattr(head, "bias", None),
+        )
+        return loss, None
+    logits = head(x)
+    return lm_shift_loss(logits, labels, vocab_size), logits
+
+
 def lm_shift_loss(logits, labels, vocab_size: int):
     """Next-token cross entropy without slicing logits to an odd length.
 
@@ -220,18 +240,9 @@ class GPTLMHeadModel(nn.Module):
             x = constrain_activation(block(x))
         x = self.ln_f(x)
         if labels is not None:
-            chunk = F.ce_chunk_size()
-            if chunk > 0:
-                # fused head+CE: the (B·S, V) logits never exist, so none
-                # are returned — training loops consume only the loss
-                logits = None
-                loss = F.chunked_lm_head_ce(
-                    x, self.lm_head.weight, shift_labels_for_lm(labels),
-                    self.config.vocab_size, chunk,
-                )
-            else:
-                logits = self.lm_head(x)  # tied head: x @ wte^T
-                loss = lm_shift_loss(logits, labels, self.config.vocab_size)
+            loss, logits = lm_head_loss(
+                x, self.lm_head, labels, self.config.vocab_size
+            )
             if self.config.n_experts > 0:
                 for block in self.h:
                     aux = getattr(block.mlp, "last_aux_loss", None)

@@ -193,11 +193,14 @@ class GPTJForCausalLM(nn.Module):
         for block in self.h:
             x = constrain_activation(block(x))
         x = self.ln_f(x)
-        logits = self.lm_head(x)
         if labels is not None:
-            loss = lm_shift_loss(logits, labels, self.config.vocab_size)
+            from .gpt import lm_head_loss
+
+            loss, logits = lm_head_loss(
+                x, self.lm_head, labels, self.config.vocab_size
+            )
             return {"loss": loss, "logits": logits}
-        return {"logits": logits}
+        return {"logits": self.lm_head(x)}
 
     def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0,
                  rng=None, quantize_weights=None):

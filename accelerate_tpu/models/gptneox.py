@@ -207,11 +207,14 @@ class GPTNeoXForCausalLM(nn.Module):
         for layer in self.layers:
             x = constrain_activation(layer(x))
         x = self.final_layer_norm(x)
-        logits = self.embed_out(x)
         if labels is not None:
-            loss = lm_shift_loss(logits, labels, self.config.vocab_size)
+            from .gpt import lm_head_loss
+
+            loss, logits = lm_head_loss(
+                x, self.embed_out, labels, self.config.vocab_size
+            )
             return {"loss": loss, "logits": logits}
-        return {"logits": logits}
+        return {"logits": self.embed_out(x)}
 
     def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0,
                  rng=None, quantize_weights=None):

@@ -366,19 +366,11 @@ class LlamaForCausalLM(nn.Module):
             x = constrain_activation(layer(x))
         x = self.norm(x)
         if labels is not None:
-            from ..nn import F
-            from .gpt import shift_labels_for_lm
+            from .gpt import lm_head_loss
 
-            chunk = F.ce_chunk_size()
-            if chunk > 0:
-                # fused head+CE (see models/gpt.py): logits never materialize
-                loss = F.chunked_lm_head_ce(
-                    x, self.lm_head.weight, shift_labels_for_lm(labels),
-                    self.config.vocab_size, chunk,
-                )
-                return {"loss": loss, "logits": None}
-            logits = self.lm_head(x)
-            loss = lm_shift_loss(logits, labels, self.config.vocab_size)
+            loss, logits = lm_head_loss(
+                x, self.lm_head, labels, self.config.vocab_size
+            )
             return {"loss": loss, "logits": logits}
         return {"logits": self.lm_head(x)}
 

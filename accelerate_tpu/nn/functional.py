@@ -207,32 +207,37 @@ def _chunked_head_ce(labels, ignore_index, vocab_size: int, chunk: int):
     denom_fn = lambda: jnp.maximum(mask32.sum(), 1.0)  # noqa: E731
     offsets = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
 
-    def _chunk_logits(hs, w_pad, off):
+    def _chunk_logits(hs, w_pad, b_pad, off):
         # operands stay in their region dtype (bf16 under mixed precision —
         # full MXU rate); accumulation and everything downstream is fp32
         wc = jax.lax.dynamic_slice_in_dim(w_pad, off, chunk, axis=0)
+        bc = jax.lax.dynamic_slice_in_dim(b_pad, off, chunk, axis=0)
         logits = jax.lax.dot_general(
             hs, wc,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (N, chunk) fp32
+        ) + bc.astype(jnp.float32)[None, :]  # (N, chunk) fp32
         col = off + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
         return jnp.where(col < vocab_size, logits, -jnp.inf), wc
 
-    def _pad_w(w):
-        return jnp.pad(w, ((0, v_pad - vocab_size), (0, 0))) if v_pad > vocab_size else w
+    def _pad_rows(t):
+        if v_pad == vocab_size:
+            return t
+        pad = [(0, v_pad - vocab_size)] + [(0, 0)] * (t.ndim - 1)
+        return jnp.pad(t, pad)
 
     @jax.custom_vjp
-    def fused(hs, w):
-        return _fwd(hs, w)[0]
+    def fused(hs, w, b):
+        return _fwd(hs, w, b)[0]
 
-    def _stats(hs, w):
-        w_pad = _pad_w(w)
+    def _stats(hs, w, b):
+        w_pad = _pad_rows(w)
+        b_pad = _pad_rows(b)
         n = hs.shape[0]
 
         def body(carry, off):
             m, s, ll = carry
-            logits, _ = _chunk_logits(hs, w_pad, off)
+            logits, _ = _chunk_logits(hs, w_pad, b_pad, off)
             cmax = logits.max(axis=1)
             m_new = jnp.maximum(m, cmax)
             s = s * jnp.exp(m - m_new) + jnp.exp(logits - m_new[:, None]).sum(axis=1)
@@ -253,21 +258,22 @@ def _chunked_head_ce(labels, ignore_index, vocab_size: int, chunk: int):
         lse = m + jnp.log(s)
         return lse, ll
 
-    def _fwd(hs, w):
-        lse, ll = _stats(hs, w)
+    def _fwd(hs, w, b):
+        lse, ll = _stats(hs, w, b)
         denom = denom_fn()
         loss = (jnp.where(mask, lse - ll, 0.0)).sum() / denom
-        return loss, (hs, w, lse, denom)
+        return loss, (hs, w, b, lse, denom)
 
     def _bwd(res, g):
-        hs, w, lse, denom = res
-        w_pad = _pad_w(w)
+        hs, w, b, lse, denom = res
+        w_pad = _pad_rows(w)
+        b_pad = _pad_rows(b)
         n, c = hs.shape
         coeff = mask32 * (g / denom)  # (N,)
 
         def body(carry, off):
-            dh, dw_pad = carry
-            logits, wc = _chunk_logits(hs, w_pad, off)
+            dh, dw_pad, db_pad = carry
+            logits, wc = _chunk_logits(hs, w_pad, b_pad, off)
             p = jnp.exp(logits - lse[:, None])  # −inf cols → exactly 0
             dlog = p * coeff[:, None]
             rel = safe - off
@@ -289,32 +295,51 @@ def _chunked_head_ce(labels, ignore_index, vocab_size: int, chunk: int):
                 preferred_element_type=jnp.float32,
             )  # (chunk, C); chunks are disjoint, so a plain update suffices
             dw_pad = jax.lax.dynamic_update_slice_in_dim(dw_pad, dwc, off, axis=0)
-            return (dh, dw_pad), None
+            db_pad = jax.lax.dynamic_update_slice_in_dim(
+                db_pad, dlog.sum(axis=0), off, axis=0
+            )
+            return (dh, dw_pad, db_pad), None
 
-        init = (jnp.zeros((n, c), jnp.float32), jnp.zeros((v_pad, c), jnp.float32))
-        (dh, dw_pad), _ = jax.lax.scan(body, init, offsets)
+        init = (
+            jnp.zeros((n, c), jnp.float32),
+            jnp.zeros((v_pad, c), jnp.float32),
+            jnp.zeros((v_pad,), jnp.float32),
+        )
+        (dh, dw_pad, db_pad), _ = jax.lax.scan(body, init, offsets)
         dw = dw_pad[:vocab_size] if v_pad > vocab_size else dw_pad
-        return dh.astype(hs.dtype), dw.astype(w.dtype)
+        db = db_pad[:vocab_size] if v_pad > vocab_size else db_pad
+        return dh.astype(hs.dtype), dw.astype(w.dtype), db.astype(b.dtype)
 
     fused.defvjp(_fwd, _bwd)
     return fused
 
 
 def chunked_lm_head_ce(hidden, head_weight, labels, vocab_size: int,
-                       chunk: int, ignore_index: int = -100):
+                       chunk: int, ignore_index: int = -100, bias=None):
     """Tape-level fused head+CE: ``hidden`` (..., C) Tensor (flattened to
     (N, C) internally), ``head_weight`` (V, C) Tensor (e.g. the tied wte),
-    ``labels`` (N,) int ids with ``ignore_index`` masking — returns the
-    mean NLL WITHOUT materializing logits.  Numerically equivalent to
-    ``cross_entropy(hidden @ head_weight.T, labels)`` (tested to fp32
-    tolerance); see ``_chunked_head_ce`` for the memory story."""
+    optional ``bias`` (V,) Tensor (GPT-J's biased head), ``labels`` (N,)
+    int ids with ``ignore_index`` masking — returns the mean NLL WITHOUT
+    materializing logits.  Numerically equivalent to
+    ``cross_entropy(hidden @ head_weight.T + bias, labels)`` (tested to
+    fp32 tolerance); see ``_chunked_head_ce`` for the memory story."""
     labels = _unwrap(labels) if isinstance(labels, Tensor) else jnp.asarray(labels)
     fused = _chunked_head_ce(labels, ignore_index, vocab_size, chunk)
 
-    def _fn(h, w):
-        return fused(region_cast(h).reshape(-1, h.shape[-1]), w)
+    if bias is None:
 
-    return tape_op(_fn, hidden, head_weight)
+        def _fn(h, w):
+            return fused(
+                region_cast(h).reshape(-1, h.shape[-1]), w,
+                jnp.zeros((vocab_size,), jnp.float32),
+            )
+
+        return tape_op(_fn, hidden, head_weight)
+
+    def _fn(h, w, b):
+        return fused(region_cast(h).reshape(-1, h.shape[-1]), w, b)
+
+    return tape_op(_fn, hidden, head_weight, bias)
 
 
 def cross_entropy(logits, labels, ignore_index: Optional[int] = -100, label_smoothing: float = 0.0):
