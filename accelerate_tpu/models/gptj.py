@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import Tensor
-from .gpt import _pure_layernorm, lm_shift_loss, maybe_remat
+from .gpt import _pure_layernorm, lm_head_loss, maybe_remat
 
 
 @dataclasses.dataclass
@@ -194,8 +194,6 @@ class GPTJForCausalLM(nn.Module):
             x = constrain_activation(block(x))
         x = self.ln_f(x)
         if labels is not None:
-            from .gpt import lm_head_loss
-
             loss, logits = lm_head_loss(
                 x, self.lm_head, labels, self.config.vocab_size
             )

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import Tensor
-from .gpt import _pure_layernorm, lm_shift_loss, maybe_remat
+from .gpt import _pure_layernorm, lm_head_loss, maybe_remat
 
 
 @dataclasses.dataclass
@@ -208,8 +208,6 @@ class GPTNeoXForCausalLM(nn.Module):
             x = constrain_activation(layer(x))
         x = self.final_layer_norm(x)
         if labels is not None:
-            from .gpt import lm_head_loss
-
             loss, logits = lm_head_loss(
                 x, self.embed_out, labels, self.config.vocab_size
             )

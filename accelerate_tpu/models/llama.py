@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import Tensor
-from .gpt import lm_shift_loss, maybe_remat
+from .gpt import lm_head_loss, maybe_remat
 
 
 @dataclasses.dataclass(frozen=True)
@@ -366,8 +366,6 @@ class LlamaForCausalLM(nn.Module):
             x = constrain_activation(layer(x))
         x = self.norm(x)
         if labels is not None:
-            from .gpt import lm_head_loss
-
             loss, logits = lm_head_loss(
                 x, self.lm_head, labels, self.config.vocab_size
             )

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import Tensor
-from .gpt import _pure_layernorm, lm_shift_loss, maybe_remat
+from .gpt import _pure_layernorm, lm_head_loss, maybe_remat
 
 
 @dataclasses.dataclass
@@ -223,8 +223,6 @@ class OPTForCausalLM(nn.Module):
             x = constrain_activation(layer(x))
         x = self.final_layer_norm(x)
         if labels is not None:
-            from .gpt import lm_head_loss
-
             loss, logits = lm_head_loss(
                 x, self.lm_head, labels, self.config.vocab_size
             )

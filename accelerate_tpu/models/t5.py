@@ -407,14 +407,24 @@ class T5ForConditionalGeneration(nn.Module):
         x = F.rms_norm(x, self.decoder.final_layer_norm.weight, cfg.layer_norm_epsilon)
         if cfg.tie_word_embeddings:
             x = x * (cfg.d_model**-0.5)  # HF tied-head scaling
-        logits = self.lm_head(x)
         if labels is not None:
             lab = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
+            chunk = F.ce_chunk_size()
+            if chunk > 0:
+                # fused head+CE (see models/gpt.py); T5 labels align with
+                # decoder positions directly (the shift lives in
+                # decoder_input_ids), so no -100 tail masking is added here
+                loss = F.chunked_lm_head_ce(
+                    x, self.lm_head.weight, lab.reshape(-1),
+                    cfg.vocab_size, chunk,
+                )
+                return {"loss": loss, "logits": None}
+            logits = self.lm_head(x)
             loss = F.cross_entropy(
                 logits.reshape(-1, cfg.vocab_size), lab.reshape(-1)
             )
             return {"loss": loss, "logits": logits}
-        return {"logits": logits}
+        return {"logits": self.lm_head(x)}
 
     def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0,
                  rng=None, quantize_weights=None):

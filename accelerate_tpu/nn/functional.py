@@ -176,7 +176,8 @@ def ce_chunk_size() -> int:
     return int(os.environ.get("ACCELERATE_TPU_CE_CHUNK", "0") or 0)
 
 
-def _chunked_head_ce(labels, ignore_index, vocab_size: int, chunk: int):
+def _chunked_head_ce(labels, ignore_index, vocab_size: int, chunk: int,
+                     has_bias: bool = False):
     """Fused LM-head projection + mean NLL that NEVER materializes the
     (N, V) logits tensor.
 
@@ -192,6 +193,10 @@ def _chunked_head_ce(labels, ignore_index, vocab_size: int, chunk: int):
     Liger-kernel-style fusion, expressed as an XLA scan instead of a
     hand-written kernel.  Reductions in fp32; the vocab is logically
     padded to a chunk multiple with −inf columns (exp → 0, grads → 0).
+    ``has_bias=True`` (GPT-J's biased head) adds the bias slice per chunk
+    and carries a db accumulator; the bias-free variant compiles without
+    either (scan carries are not dead-code-eliminated, so a dummy zero
+    bias would cost real work on every bias-less family).
     """
     import math
 
@@ -211,28 +216,24 @@ def _chunked_head_ce(labels, ignore_index, vocab_size: int, chunk: int):
         # operands stay in their region dtype (bf16 under mixed precision —
         # full MXU rate); accumulation and everything downstream is fp32
         wc = jax.lax.dynamic_slice_in_dim(w_pad, off, chunk, axis=0)
-        bc = jax.lax.dynamic_slice_in_dim(b_pad, off, chunk, axis=0)
         logits = jax.lax.dot_general(
             hs, wc,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) + bc.astype(jnp.float32)[None, :]  # (N, chunk) fp32
+        )  # (N, chunk) fp32
+        if b_pad is not None:
+            bc = jax.lax.dynamic_slice_in_dim(b_pad, off, chunk, axis=0)
+            logits = logits + bc.astype(jnp.float32)[None, :]
         col = off + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
         return jnp.where(col < vocab_size, logits, -jnp.inf), wc
 
     def _pad_rows(t):
-        if v_pad == vocab_size:
+        if t is None or v_pad == vocab_size:
             return t
         pad = [(0, v_pad - vocab_size)] + [(0, 0)] * (t.ndim - 1)
         return jnp.pad(t, pad)
 
-    @jax.custom_vjp
-    def fused(hs, w, b):
-        return _fwd(hs, w, b)[0]
-
-    def _stats(hs, w, b):
-        w_pad = _pad_rows(w)
-        b_pad = _pad_rows(b)
+    def _stats(hs, w_pad, b_pad):
         n = hs.shape[0]
 
         def body(carry, off):
@@ -256,23 +257,19 @@ def _chunked_head_ce(labels, ignore_index, vocab_size: int, chunk: int):
         )
         (m, s, ll), _ = jax.lax.scan(body, init, offsets)
         lse = m + jnp.log(s)
-        return lse, ll
-
-    def _fwd(hs, w, b):
-        lse, ll = _stats(hs, w, b)
         denom = denom_fn()
         loss = (jnp.where(mask, lse - ll, 0.0)).sum() / denom
-        return loss, (hs, w, b, lse, denom)
+        return loss, lse, denom
 
-    def _bwd(res, g):
-        hs, w, b, lse, denom = res
-        w_pad = _pad_rows(w)
-        b_pad = _pad_rows(b)
+    def _grads(hs, w_pad, b_pad, lse, denom, g):
         n, c = hs.shape
         coeff = mask32 * (g / denom)  # (N,)
 
         def body(carry, off):
-            dh, dw_pad, db_pad = carry
+            if has_bias:
+                dh, dw_pad, db_pad = carry
+            else:
+                dh, dw_pad = carry
             logits, wc = _chunk_logits(hs, w_pad, b_pad, off)
             p = jnp.exp(logits - lse[:, None])  # −inf cols → exactly 0
             dlog = p * coeff[:, None]
@@ -295,20 +292,57 @@ def _chunked_head_ce(labels, ignore_index, vocab_size: int, chunk: int):
                 preferred_element_type=jnp.float32,
             )  # (chunk, C); chunks are disjoint, so a plain update suffices
             dw_pad = jax.lax.dynamic_update_slice_in_dim(dw_pad, dwc, off, axis=0)
-            db_pad = jax.lax.dynamic_update_slice_in_dim(
-                db_pad, dlog.sum(axis=0), off, axis=0
-            )
-            return (dh, dw_pad, db_pad), None
+            if has_bias:
+                db_pad = jax.lax.dynamic_update_slice_in_dim(
+                    db_pad, dlog.sum(axis=0), off, axis=0
+                )
+                return (dh, dw_pad, db_pad), None
+            return (dh, dw_pad), None
 
-        init = (
+        init = [
             jnp.zeros((n, c), jnp.float32),
             jnp.zeros((v_pad, c), jnp.float32),
-            jnp.zeros((v_pad,), jnp.float32),
-        )
-        (dh, dw_pad, db_pad), _ = jax.lax.scan(body, init, offsets)
-        dw = dw_pad[:vocab_size] if v_pad > vocab_size else dw_pad
-        db = db_pad[:vocab_size] if v_pad > vocab_size else db_pad
-        return dh.astype(hs.dtype), dw.astype(w.dtype), db.astype(b.dtype)
+        ]
+        if has_bias:
+            init.append(jnp.zeros((v_pad,), jnp.float32))
+        out, _ = jax.lax.scan(body, tuple(init), offsets)
+        trim = (lambda t: t[:vocab_size]) if v_pad > vocab_size else (lambda t: t)
+        if has_bias:
+            dh, dw_pad, db_pad = out
+            return dh, trim(dw_pad), trim(db_pad)
+        dh, dw_pad = out
+        return dh, trim(dw_pad), None
+
+    if has_bias:
+
+        @jax.custom_vjp
+        def fused(hs, w, b):
+            return _stats(hs, _pad_rows(w), _pad_rows(b))[0]
+
+        def _fwd(hs, w, b):
+            loss, lse, denom = _stats(hs, _pad_rows(w), _pad_rows(b))
+            return loss, (hs, w, b, lse, denom)
+
+        def _bwd(res, g):
+            hs, w, b, lse, denom = res
+            dh, dw, db = _grads(hs, _pad_rows(w), _pad_rows(b), lse, denom, g)
+            return dh.astype(hs.dtype), dw.astype(w.dtype), db.astype(b.dtype)
+
+        fused.defvjp(_fwd, _bwd)
+        return fused
+
+    @jax.custom_vjp
+    def fused(hs, w):
+        return _stats(hs, _pad_rows(w), None)[0]
+
+    def _fwd(hs, w):
+        loss, lse, denom = _stats(hs, _pad_rows(w), None)
+        return loss, (hs, w, lse, denom)
+
+    def _bwd(res, g):
+        hs, w, lse, denom = res
+        dh, dw, _ = _grads(hs, _pad_rows(w), None, lse, denom, g)
+        return dh.astype(hs.dtype), dw.astype(w.dtype)
 
     fused.defvjp(_fwd, _bwd)
     return fused
@@ -322,22 +356,25 @@ def chunked_lm_head_ce(hidden, head_weight, labels, vocab_size: int,
     int ids with ``ignore_index`` masking — returns the mean NLL WITHOUT
     materializing logits.  Numerically equivalent to
     ``cross_entropy(hidden @ head_weight.T + bias, labels)`` (tested to
-    fp32 tolerance); see ``_chunked_head_ce`` for the memory story."""
+    fp32 tolerance); see ``_chunked_head_ce`` for the memory story.
+    Inputs are region-cast like the dense ``F.linear`` path, so bf16
+    autocast reads the vocab weight at bf16 width here too."""
     labels = _unwrap(labels) if isinstance(labels, Tensor) else jnp.asarray(labels)
-    fused = _chunked_head_ce(labels, ignore_index, vocab_size, chunk)
+    fused = _chunked_head_ce(
+        labels, ignore_index, vocab_size, chunk, has_bias=bias is not None
+    )
 
     if bias is None:
 
         def _fn(h, w):
-            return fused(
-                region_cast(h).reshape(-1, h.shape[-1]), w,
-                jnp.zeros((vocab_size,), jnp.float32),
-            )
+            h, w = region_cast(h, w)
+            return fused(h.reshape(-1, h.shape[-1]), w)
 
         return tape_op(_fn, hidden, head_weight)
 
     def _fn(h, w, b):
-        return fused(region_cast(h).reshape(-1, h.shape[-1]), w, b)
+        h, w, b = region_cast(h, w, b)
+        return fused(h.reshape(-1, h.shape[-1]), w, b)
 
     return tape_op(_fn, hidden, head_weight, bias)
 

@@ -45,10 +45,9 @@ def test_value_and_grads_match_dense(chunk):
     h, w, labels = _setup()
     want_val, want_gh, want_gw = _dense(h, w, labels)
 
-    zb = jnp.zeros((w.shape[0],), jnp.float32)
     fused = F._chunked_head_ce(labels, -100, w.shape[0], chunk)
-    got_val = float(fused(h, w, zb))
-    gh, gw = jax.grad(lambda h, w: fused(h, w, zb), argnums=(0, 1))(h, w)
+    got_val = float(fused(h, w))
+    gh, gw = jax.grad(lambda h, w: fused(h, w), argnums=(0, 1))(h, w)
     assert got_val == pytest.approx(want_val, rel=1e-6)
     np.testing.assert_allclose(np.asarray(gh), want_gh, atol=1e-6, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(gw), want_gw, atol=1e-6, rtol=1e-5)
@@ -57,10 +56,9 @@ def test_value_and_grads_match_dense(chunk):
 def test_all_labels_ignored_is_zero_and_finite():
     h, w, _ = _setup()
     labels = jnp.full((h.shape[0],), -100, jnp.int32)
-    zb = jnp.zeros((w.shape[0],), jnp.float32)
     fused = F._chunked_head_ce(labels, -100, w.shape[0], 16)
-    val = float(fused(h, w, zb))
-    gh, gw = jax.grad(lambda h, w: fused(h, w, zb), argnums=(0, 1))(h, w)
+    val = float(fused(h, w))
+    gh, gw = jax.grad(lambda h, w: fused(h, w), argnums=(0, 1))(h, w)
     assert val == 0.0
     assert np.isfinite(np.asarray(gh)).all() and np.isfinite(np.asarray(gw)).all()
     assert np.abs(np.asarray(gh)).max() == 0.0
@@ -139,7 +137,7 @@ def test_biased_head_matches_dense():
     want = float(dense(h, w, b))
     wgh, wgw, wgb = jax.grad(dense, argnums=(0, 1, 2))(h, w, b)
 
-    fused = F._chunked_head_ce(labels, -100, 29, 8)
+    fused = F._chunked_head_ce(labels, -100, 29, 8, has_bias=True)
     got = float(fused(h, w, b))
     gh, gw, gb = jax.grad(lambda h, w, b: fused(h, w, b), argnums=(0, 1, 2))(h, w, b)
     assert got == pytest.approx(want, rel=1e-6)
