@@ -100,6 +100,13 @@ class LlamaConfig:
     # Llama-3.1+ long-context rotary rescaling; accepts an HF-style dict or
     # a RopeScaling and normalizes to the latter (None = plain theta)
     rope_scaling: "RopeScaling | None" = None
+    # decoupled per-head width (Mistral-Nemo: 128-dim heads on d_model 5120);
+    # None = the usual hidden_size // num_attention_heads
+    head_dim: "int | None" = None
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
 
     def __post_init__(self):
         if isinstance(self.rope_scaling, dict):
@@ -210,9 +217,14 @@ def _rope_rotate(x, positions, theta, scaling=None):
 
 def llama_attn_in(l, x, positions, *, n_head: int, n_kv_head: int, eps: float,
                   theta: float, rope_scaling=None):
-    """RMSNorm + q/k/v projections + RoPE: (b,s,c) → q (b,H,s,d), k/v (b,Hkv,s,d)."""
+    """RMSNorm + q/k/v projections + RoPE: (b,s,c) → q (b,H,s,d), k/v (b,Hkv,s,d).
+
+    head_dim derives from the q projection WEIGHT, not ``c // n_head``, so
+    decoupled-head variants (Mistral-Nemo: 128-dim heads on a 5120 model)
+    run the same math.
+    """
     b, s, c = x.shape
-    d = c // n_head
+    d = l["q_w"].shape[0] // n_head
     h = _pure_rmsnorm(x, l["ln1_w"], eps)
 
     def heads(t, n):
@@ -229,9 +241,14 @@ def llama_attn_in(l, x, positions, *, n_head: int, n_kv_head: int, eps: float,
 
 
 def llama_attn_out(l, x, att, *, eps: float):
-    """o_proj + residual, then RMSNorm + SwiGLU MLP + residual."""
+    """o_proj + residual, then RMSNorm + SwiGLU MLP + residual.
+
+    The attention output flattens to (b, s, H·d) — which equals the model
+    width only when head_dim is the derived default; o_proj maps it back
+    to ``c`` either way."""
     b, s, c = x.shape
-    att = att.transpose(0, 2, 1, 3).reshape(b, s, c)
+    att = att.transpose(0, 2, 1, 3)
+    att = att.reshape(b, s, att.shape[2] * att.shape[3])
     h = x + att @ l["o_w"].T
     h2 = _pure_rmsnorm(h, l["ln2_w"], eps)
     ff = jax.nn.silu(h2 @ l["gate_w"].T) * (h2 @ l["up_w"].T)
@@ -265,7 +282,7 @@ def _llama_block(l, x, positions, *, n_head, n_kv_head, eps, theta, window=0,
 class LlamaAttention(nn.Module):
     def __init__(self, config: LlamaConfig):
         super().__init__()
-        c, d = config.hidden_size, config.hidden_size // config.num_attention_heads
+        c, d = config.hidden_size, config.resolved_head_dim
         self.q_proj = nn.Linear(c, config.num_attention_heads * d, bias=False)
         self.k_proj = nn.Linear(c, config.num_key_value_heads * d, bias=False)
         self.v_proj = nn.Linear(c, config.num_key_value_heads * d, bias=False)
@@ -383,7 +400,10 @@ class LlamaForCausalLM(nn.Module):
     def num_flops_per_token(self) -> float:
         n = self.num_parameters
         c = self.config
-        attn = 12 * c.num_hidden_layers * c.hidden_size * c.max_position_embeddings
+        # attention width is H*d, which equals hidden_size only for the
+        # derived default (decoupled-head variants like Mistral-Nemo differ)
+        attn_width = c.num_attention_heads * c.resolved_head_dim
+        attn = 12 * c.num_hidden_layers * attn_width * c.max_position_embeddings
         return 6 * n + attn
 
     # -- cached decode hooks (generic engine in models/generation.py) -------
@@ -396,7 +416,7 @@ class LlamaForCausalLM(nn.Module):
             cfg=_LlamaDecodeCfg(
                 n_head=cfg.num_attention_heads,
                 n_kv_head=cfg.num_key_value_heads,
-                head_dim=cfg.hidden_size // cfg.num_attention_heads,
+                head_dim=cfg.resolved_head_dim,
                 eps=cfg.rms_norm_eps,
                 theta=cfg.rope_theta,
                 rope_scaling=cfg.rope_scaling,

@@ -291,14 +291,6 @@ def llama_config_from_hf(cfg: dict):
             "silently drop the bias tensors)"
         )
     heads = cfg.get("num_attention_heads", 32)
-    explicit_hd = cfg.get("head_dim")
-    if explicit_hd and explicit_hd != cfg.get("hidden_size", 4096) // heads:
-        raise NotImplementedError(
-            f"head_dim={explicit_hd} != hidden_size/num_attention_heads "
-            f"({cfg.get('hidden_size', 4096)}//{heads}) — decoupled head_dim "
-            "variants (e.g. Mistral-Nemo) are not supported; models/llama.py "
-            "derives head_dim from hidden_size"
-        )
     return LlamaConfig(
         vocab_size=cfg.get("vocab_size", 32000),
         hidden_size=cfg.get("hidden_size", 4096),
@@ -313,6 +305,8 @@ def llama_config_from_hf(cfg: dict):
         # Mistral configs carry sliding_window (null for Llama); 0 = full
         sliding_window=cfg.get("sliding_window") or 0,
         rope_scaling=cfg.get("rope_scaling"),  # dict → RopeScaling in __post_init__
+        # decoupled per-head width (Mistral-Nemo); None derives from hidden
+        head_dim=cfg.get("head_dim"),
     )
 
 

@@ -168,3 +168,30 @@ def test_from_pretrained_roundtrip(tmp_path, hf_pair):
     a = np.asarray(ours(jnp.asarray(ids))["logits"].data)
     b = np.asarray(loaded(jnp.asarray(ids))["logits"].data)
     np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_decoupled_head_dim_trains_and_decodes():
+    """Mistral-Nemo geometry: explicit head_dim != hidden // heads must
+    train, and cached decode must match the forward argmax (the pure math
+    derives d from the q weight, not the model width)."""
+    from accelerate_tpu.utils.hf import llama_config_from_hf
+
+    cfg = llama_config_from_hf(
+        {
+            "vocab_size": 512, "hidden_size": 96, "intermediate_size": 192,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "max_position_embeddings": 128,
+            "head_dim": 32,  # derived would be 24
+        }
+    )
+    assert cfg.resolved_head_dim == 32
+    nn.manual_seed(0)
+    model = LlamaForCausalLM(cfg)
+    assert model.layers[0].self_attn.q_proj.weight.shape == (4 * 32, 96)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 512, (2, 16)), jnp.int32)
+    out = model(ids, labels=ids)
+    out["loss"].backward()
+    assert all(p.grad is not None for p in model.parameters())
+    gen = model.generate(ids[:1], max_new_tokens=1)
+    want = int(np.asarray(out["logits"])[0, -1].argmax())
+    assert int(np.asarray(gen)[0, -1]) == want
