@@ -40,8 +40,12 @@ class RopeScaling:
     NTK-by-parts scheme Llama-3.1+ ships — wavelengths longer than
     ``original_max_position_embeddings / low_freq_factor`` are divided by
     ``factor``, wavelengths shorter than ``original / high_freq_factor``
-    are kept, and the band between is smoothly interpolated.  Frozen (and
-    therefore hashable) so it can ride the static decode cfg through jit.
+    are kept, and the band between is smoothly interpolated; ``yarn``
+    blends interpolated and extrapolated frequencies with a linear ramp
+    between the ``beta_fast``/``beta_slow`` correction dims and scales the
+    cos/sin tables by ``attention_factor`` (default ``0.1·ln(factor)+1``).
+    Frozen (and therefore hashable) so it can ride the static decode cfg
+    through jit.
     """
 
     rope_type: str = "llama3"
@@ -49,13 +53,23 @@ class RopeScaling:
     low_freq_factor: float = 1.0
     high_freq_factor: float = 4.0
     original_max_position_embeddings: int = 8192
+    # yarn-only knobs (transformers _compute_yarn_parameters defaults)
+    attention_factor: "float | None" = None
+    beta_fast: float = 32.0
+    beta_slow: float = 1.0
+    # DeepSeek-style mscale pair: when both set (and attention_factor is
+    # None) the cos/sin scale is get_mscale(factor, mscale) /
+    # get_mscale(factor, mscale_all_dim)
+    mscale: "float | None" = None
+    mscale_all_dim: "float | None" = None
+    truncate: bool = True  # floor/ceil the correction range (HF default)
 
     @classmethod
     def from_hf(cls, d) -> "RopeScaling | None":
         """Normalize an HF ``rope_scaling`` dict (``rope_type`` new-style or
         ``type`` legacy).  None / "default" → None; unsupported schemes
-        (yarn, dynamic, longrope) refuse loudly — their math would be
-        silently wrong here."""
+        (dynamic NTK — seq-length-dependent tables — and longrope) refuse
+        loudly — their math would be silently wrong here."""
         if d is None or isinstance(d, cls):
             return d
         kind = d.get("rope_type") or d.get("type") or "default"
@@ -73,10 +87,49 @@ class RopeScaling:
                     d.get("original_max_position_embeddings", 8192)
                 ),
             )
+        if kind == "yarn":
+            af = d.get("attention_factor")
+            ms, msad = d.get("mscale"), d.get("mscale_all_dim")
+            return cls(
+                rope_type="yarn",
+                factor=float(d.get("factor", 1.0)),
+                original_max_position_embeddings=int(
+                    d.get("original_max_position_embeddings", 8192)
+                ),
+                attention_factor=None if af is None else float(af),
+                # HF semantics: falsy (0/None/absent) -> the paper defaults
+                beta_fast=float(d.get("beta_fast") or 32.0),
+                beta_slow=float(d.get("beta_slow") or 1.0),
+                mscale=None if ms is None else float(ms),
+                mscale_all_dim=None if msad is None else float(msad),
+                truncate=bool(d.get("truncate", True)),
+            )
         raise NotImplementedError(
             f"rope_scaling type {kind!r} is not supported; implemented: "
-            "'linear', 'llama3' (and 'default' = no scaling)"
+            "'linear', 'llama3', 'yarn' (and 'default' = no scaling)"
         )
+
+    @property
+    def resolved_attention_factor(self) -> float:
+        """yarn's cos/sin scale (transformers _compute_yarn_parameters):
+        explicit ``attention_factor``; else the DeepSeek mscale ratio when
+        both mscale knobs are set; else ``get_mscale(factor)``."""
+        import math as _math
+
+        if self.attention_factor is not None:
+            return self.attention_factor
+
+        def get_mscale(scale, m=1.0):
+            if scale <= 1:
+                return 1.0
+            return 0.1 * m * _math.log(scale) + 1.0
+
+        if self.mscale and self.mscale_all_dim:
+            return float(
+                get_mscale(self.factor, self.mscale)
+                / get_mscale(self.factor, self.mscale_all_dim)
+            )
+        return get_mscale(self.factor)
 
 
 @dataclasses.dataclass
@@ -110,7 +163,13 @@ class LlamaConfig:
 
     def __post_init__(self):
         if isinstance(self.rope_scaling, dict):
-            self.rope_scaling = RopeScaling.from_hf(self.rope_scaling)
+            d = dict(self.rope_scaling)
+            kind = d.get("rope_type") or d.get("type")
+            # HF fallback: yarn's original_max_position_embeddings defaults
+            # to the model's max_position_embeddings when absent
+            if kind == "yarn" and not d.get("original_max_position_embeddings"):
+                d["original_max_position_embeddings"] = self.max_position_embeddings
+            self.rope_scaling = RopeScaling.from_hf(d)
 
     @classmethod
     def tiny(cls) -> "LlamaConfig":
@@ -184,6 +243,32 @@ def _rope_inv_freq(d: int, theta: float, scaling: "RopeScaling | None"):
         return inv
     if scaling.rope_type == "linear":
         return inv / scaling.factor
+    if scaling.rope_type == "yarn":
+        # transformers _compute_yarn_parameters: blend interpolated
+        # (inv/factor) and extrapolated (inv) frequencies with a linear ramp
+        # between the correction dims of beta_fast/beta_slow rotations
+        import math as _math
+
+        orig = scaling.original_max_position_embeddings
+
+        def corr_dim(num_rot):
+            return (d * _math.log(orig / (num_rot * 2 * _math.pi))) / (
+                2 * _math.log(theta)
+            )
+
+        low, high = corr_dim(scaling.beta_fast), corr_dim(scaling.beta_slow)
+        if scaling.truncate:
+            low, high = _math.floor(low), _math.ceil(high)
+        low, high = max(low, 0), min(high, d - 1)
+        if low == high:
+            high += 0.001  # avoid zero division, per the reference impl
+        ramp = jnp.clip(
+            (jnp.arange(d // 2, dtype=jnp.float32) - low) / (high - low), 0.0, 1.0
+        )
+        extrapolation_factor = 1.0 - ramp
+        return (inv / scaling.factor) * (1.0 - extrapolation_factor) + (
+            inv * extrapolation_factor
+        )
     # llama3 NTK-by-parts
     orig = scaling.original_max_position_embeddings
     low_wl = orig / scaling.low_freq_factor
@@ -208,8 +293,14 @@ def _rope_rotate(x, positions, theta, scaling=None):
     inv = _rope_inv_freq(d, theta, scaling)
     freqs = positions.astype(jnp.float32)[:, None] * inv[None, :]  # (s, d/2)
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # (s, d)
-    cos = jnp.cos(emb).astype(x.dtype)[None, None]
-    sin = jnp.sin(emb).astype(x.dtype)[None, None]
+    cos32, sin32 = jnp.cos(emb), jnp.sin(emb)
+    if scaling is not None and scaling.rope_type == "yarn":
+        # yarn scales the tables (transformers applies attention_scaling
+        # to cos/sin, equivalent to scaling attention logits)
+        af = scaling.resolved_attention_factor
+        cos32, sin32 = cos32 * af, sin32 * af
+    cos = cos32.astype(x.dtype)[None, None]
+    sin = sin32.astype(x.dtype)[None, None]
     x1, x2 = x[..., : d // 2], x[..., d // 2 :]
     rotated = jnp.concatenate([-x2, x1], axis=-1)
     return x * cos + rotated * sin

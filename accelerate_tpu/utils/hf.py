@@ -276,9 +276,10 @@ def llama_config_from_hf(cfg: dict):
 
     # refuse configs whose math we would silently get wrong: attention_bias
     # adds projections our layer math does not carry.  rope_scaling is
-    # normalized by RopeScaling.from_hf — linear and llama3 (Llama-3.1+)
-    # are implemented in models/llama.py:_rope_inv_freq; yarn/dynamic/
-    # longrope still refuse loudly inside from_hf.
+    # normalized by RopeScaling.from_hf — linear, llama3 (Llama-3.1+) and
+    # yarn (incl. DeepSeek-style mscale) are implemented in
+    # models/llama.py:_rope_inv_freq; dynamic/longrope refuse loudly
+    # inside from_hf.
     if cfg.get("attention_bias"):
         raise NotImplementedError(
             "attention_bias=True Llama variants are not supported "

@@ -147,10 +147,11 @@ def test_unsupported_config_fields_rejected():
     from accelerate_tpu.utils.hf import llama_config_from_hf
 
     base = {"hidden_size": 128, "num_attention_heads": 4, "vocab_size": 1024}
-    # llama3/linear rope scaling is implemented (tests/test_llama_rope_scaling.py);
-    # schemes whose math we don't carry still refuse
-    with pytest.raises(NotImplementedError, match="yarn"):
-        llama_config_from_hf({**base, "rope_scaling": {"rope_type": "yarn"}})
+    # llama3/linear/yarn rope scaling are implemented
+    # (tests/test_llama_rope_scaling.py); schemes whose math we don't carry
+    # still refuse
+    with pytest.raises(NotImplementedError, match="longrope"):
+        llama_config_from_hf({**base, "rope_scaling": {"rope_type": "longrope"}})
     with pytest.raises(NotImplementedError, match="attention_bias"):
         llama_config_from_hf({**base, "attention_bias": True})
     with pytest.raises(NotImplementedError, match="mlp_bias"):

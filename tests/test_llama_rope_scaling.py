@@ -98,7 +98,7 @@ def test_hf_bridge_ingests_llama3_config():
 
 def test_hf_bridge_still_refuses_unsupported_schemes():
     base = {"hidden_size": 256, "num_attention_heads": 4}
-    for kind in ("yarn", "dynamic", "longrope"):
+    for kind in ("dynamic", "longrope"):
         with pytest.raises(NotImplementedError, match=kind):
             llama_config_from_hf({**base, "rope_scaling": {"rope_type": kind}})
     # legacy "type" key and "default" both pass through
@@ -134,3 +134,115 @@ def test_scaling_reaches_forward_and_decode():
     out = scaled.generate(ids, max_new_tokens=1)
     want = int(np.asarray(ls)[0, -1].argmax())
     assert int(np.asarray(out)[0, -1]) == want
+
+
+def _yarn_reference(d, theta, factor, beta_fast, beta_slow, orig):
+    """transformers _compute_yarn_parameters, independently in numpy."""
+    import math
+
+    pos_freqs = theta ** (np.arange(0, d, 2, dtype=np.float64) / d)
+    inv_extra = 1.0 / pos_freqs
+    inv_inter = 1.0 / (factor * pos_freqs)
+
+    def corr_dim(num_rot):
+        return (d * math.log(orig / (num_rot * 2 * math.pi))) / (2 * math.log(theta))
+
+    low = max(math.floor(corr_dim(beta_fast)), 0)
+    high = min(math.ceil(corr_dim(beta_slow)), d - 1)
+    if low == high:
+        high += 0.001
+    ramp = np.clip((np.arange(d // 2) - low) / (high - low), 0, 1)
+    extra_factor = 1 - ramp
+    return (inv_inter * (1 - extra_factor) + inv_extra * extra_factor).astype(
+        np.float32
+    )
+
+
+def test_yarn_freq_table_matches_published_formula():
+    d, theta = 128, 10000.0
+    sc = RopeScaling(rope_type="yarn", factor=4.0,
+                     original_max_position_embeddings=4096)
+    got = np.asarray(_rope_inv_freq(d, theta, sc))
+    want = _yarn_reference(d, theta, 4.0, 32.0, 1.0, 4096)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # band structure: highest frequency extrapolated (unchanged), lowest
+    # interpolated (divided by factor)
+    plain = np.asarray(_rope_inv_freq(d, theta, None))
+    assert got[0] == pytest.approx(plain[0], rel=1e-6)
+    assert got[-1] == pytest.approx(plain[-1] / 4.0, rel=1e-6)
+
+
+def test_yarn_attention_factor():
+    import math
+
+    sc = RopeScaling(rope_type="yarn", factor=4.0)
+    assert sc.resolved_attention_factor == pytest.approx(0.1 * math.log(4.0) + 1.0)
+    sc2 = RopeScaling(rope_type="yarn", factor=4.0, attention_factor=1.25)
+    assert sc2.resolved_attention_factor == 1.25
+    # the factor reaches the rotation: scaled tables shrink/stretch outputs
+    x = jnp.ones((1, 1, 2, 8), jnp.float32)
+    pos = jnp.asarray([0, 7])
+    base = np.asarray(_rope_rotate(x, pos, 1e4, RopeScaling(
+        rope_type="yarn", factor=4.0, attention_factor=1.0)))
+    scaled = np.asarray(_rope_rotate(x, pos, 1e4, sc2))
+    np.testing.assert_allclose(scaled, 1.25 * base, rtol=1e-6)
+
+
+def test_hf_bridge_ingests_yarn():
+    cfg = llama_config_from_hf(
+        {
+            "hidden_size": 256, "num_attention_heads": 4,
+            "rope_scaling": {"rope_type": "yarn", "factor": 4.0,
+                             "original_max_position_embeddings": 4096,
+                             "beta_fast": 32, "beta_slow": 1},
+        }
+    )
+    assert cfg.rope_scaling.rope_type == "yarn"
+    assert cfg.rope_scaling.factor == 4.0
+    # dynamic/longrope still refuse
+    for kind in ("dynamic", "longrope"):
+        with pytest.raises(NotImplementedError, match=kind):
+            llama_config_from_hf(
+                {"hidden_size": 256, "num_attention_heads": 4,
+                 "rope_scaling": {"rope_type": kind}}
+            )
+
+
+def test_yarn_and_llama3_match_installed_transformers():
+    """TRUE independence: compare our tables against the installed
+    transformers rope-init functions, not a transcription of our own
+    formula (which would share any transcription error)."""
+    transformers = pytest.importorskip("transformers")
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    cases = [
+        ("yarn", {"rope_type": "yarn", "factor": 4.0,
+                  "original_max_position_embeddings": 4096}),
+        ("yarn", {"rope_type": "yarn", "factor": 40.0,
+                  "original_max_position_embeddings": 4096,
+                  "mscale": 0.707, "mscale_all_dim": 0.707}),
+        ("yarn", {"rope_type": "yarn", "factor": 8.0}),  # orig falls back
+        ("llama3", {"rope_type": "llama3", "factor": 8.0,
+                    "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                    "original_max_position_embeddings": 8192}),
+    ]
+    for kind, rs in cases:
+        hf_cfg = transformers.LlamaConfig(
+            hidden_size=256, num_attention_heads=2, num_key_value_heads=2,
+            max_position_embeddings=16384, rope_theta=10000.0,
+            rope_scaling=dict(rs),
+        )
+        inv_hf, att_hf = ROPE_INIT_FUNCTIONS[kind](hf_cfg, device="cpu")
+        ours = llama_config_from_hf(
+            {"hidden_size": 256, "num_attention_heads": 2,
+             "max_position_embeddings": 16384, "rope_theta": 10000.0,
+             "rope_scaling": dict(rs)}
+        ).rope_scaling
+        got = np.asarray(_rope_inv_freq(128, 10000.0, ours))
+        np.testing.assert_allclose(
+            got, inv_hf.numpy(), rtol=1e-5, err_msg=str(rs)
+        )
+        if kind == "yarn":
+            assert ours.resolved_attention_factor == pytest.approx(
+                float(att_hf), rel=1e-6
+            ), rs
