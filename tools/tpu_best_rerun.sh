@@ -1,0 +1,72 @@
+#!/bin/bash
+# After tools/tpu_flag_experiments.sh, pick the best-throughput experiment
+# and re-run bench.py under that configuration (replayed from the "env:"
+# line the experiments log records), saving the JSON line to the given
+# artifact path IFF the rerun actually beats the plain-run number.
+# Usage: bash tools/tpu_best_rerun.sh <exp.log> <plain_bench.json> <out.json>
+set -u
+EXP_LOG="$1"; PLAIN="$2"; OUT="$3"
+cd "$(dirname "$0")/.."
+
+best=$(python3 - "$EXP_LOG" "$PLAIN" <<'EOF'
+import json, sys
+tag = env = None
+val = -1.0
+cur_tag, cur_env = None, ""
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if line.startswith("== ") and line.endswith(" =="):
+        cur_tag, cur_env = line.strip("= ").strip(), ""
+    elif line.startswith("env: "):
+        cur_env = line[len("env: "):]
+    elif line.startswith("{"):
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        # accelerator rows only (the tunnel may report "axon"); steps100 is
+        # the timing-baseline control, not a candidate config
+        if d.get("platform") not in ("tpu", "axon") or cur_tag in (None, "steps100"):
+            continue
+        v = float(d.get("value", 0))
+        if v > val and cur_env:
+            tag, env, val = cur_tag, cur_env, v
+try:
+    plain = float(json.loads(open(sys.argv[2]).read()).get("value", 0))
+except Exception:
+    plain = 0.0
+print(json.dumps({"tag": tag, "env": env, "value": val, "plain": plain}))
+EOF
+)
+tag=$(echo "$best" | python3 -c "import json,sys; print(json.load(sys.stdin)['tag'] or '')")
+envline=$(echo "$best" | python3 -c "import json,sys; print(json.load(sys.stdin)['env'] or '')")
+val=$(echo "$best" | python3 -c "import json,sys; print(json.load(sys.stdin)['value'])")
+plain=$(echo "$best" | python3 -c "import json,sys; print(json.load(sys.stdin)['plain'])")
+echo "best experiment: ${tag:-none} ($val tok/s) vs plain $plain"
+[ -z "$tag" ] && exit 0
+better=$(python3 -c "print(1 if float('$val') > float('$plain') else 0)")
+[ "$better" = "1" ] || { echo "plain run already best; no rerun"; exit 0; }
+
+echo "re-running bench with: $envline (longer 100-step timing window)"
+tmp=$(mktemp /tmp/bench_best.XXXXXX.json)
+env $envline BENCH_STEPS=100 BENCH_INIT_ATTEMPTS=2 timeout 1500 python bench.py \
+  2>/tmp/bench_best_err.log | tee "$tmp"
+# save the artifact only if the rerun is a valid accelerator row that beats
+# the plain run — a hang/fallback/regression must not leave a misleading file
+keep=$(python3 - "$tmp" "$plain" <<'EOF'
+import json, sys
+try:
+    d = json.loads(open(sys.argv[1]).read())
+except Exception:
+    print(0); raise SystemExit
+ok = d.get("platform") in ("tpu", "axon") and float(d.get("value", 0)) > float(sys.argv[2])
+print(1 if ok else 0)
+EOF
+)
+if [ "$keep" = "1" ]; then
+  mv "$tmp" "$OUT"
+  echo "saved $OUT"
+else
+  rm -f "$tmp"
+  echo "rerun did not beat the plain run (or fell back); no artifact saved"
+fi

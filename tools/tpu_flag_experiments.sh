@@ -10,6 +10,9 @@ cd "$(dirname "$0")/.."
 run() {
   tag="$1"; shift
   echo "== $tag ==" | tee -a "$OUT/exp.log"
+  # record the exact env so tools/tpu_best_rerun.sh can replay the winner
+  # without a hand-maintained mirror table
+  echo "env: $*" | tee -a "$OUT/exp.log"
   env "$@" BENCH_INIT_ATTEMPTS=2 timeout 600 python bench.py \
     2>"$OUT/err_$tag.log" | tee -a "$OUT/exp.log"
 }

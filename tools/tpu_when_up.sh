@@ -9,7 +9,10 @@
 #                                    changed the tiling economics; fwd blocks
 #                                    are covered by the flag experiments)
 #   3. flag experiments          -> TPU_EXPERIMENTS_r05.log
-#   4. profiler trace            -> /tmp/tpu_sweep5/trace (+ note in log)
+#   4. best-config bench rerun   -> BENCH_r05_best.json (only if a flag
+#                                    experiment beat the plain run AND the
+#                                    100-step replay confirms it)
+#   5. profiler trace            -> /tmp/tpu_sweep5/trace (+ note in log)
 # Usage: setsid nohup bash tools/tpu_when_up.sh &
 set -u
 cd "$(dirname "$0")/.."
@@ -43,6 +46,9 @@ touch "$MARK"
   done
   echo "== 3. flag experiments =="
   bash tools/tpu_flag_experiments.sh /tmp/tpu_exp5 && cat /tmp/tpu_exp5/exp.log
-  echo "== 4. profiler trace =="
+  echo "== 4. best-config bench rerun (if an experiment beat the plain run) =="
+  bash tools/tpu_best_rerun.sh /tmp/tpu_exp5/exp.log BENCH_r05_live.json \
+    BENCH_r05_best.json || true
+  echo "== 5. profiler trace =="
   bash tools/tpu_trace.sh /tmp/tpu_sweep5 || true
 } > TPU_EXPERIMENTS_r05.log 2>&1
