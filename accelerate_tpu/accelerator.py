@@ -635,10 +635,32 @@ class Accelerator:
         )
         if self._powersgd_state is None:
             self._powersgd_state = []
+        if self.scaler is not None and not getattr(self, "_powersgd_fp16_warned", False):
+            self._powersgd_fp16_warned = True
+            logger.warning(
+                "comm_hook=powersgd with fp16 dynamic loss scaling: the error-"
+                "feedback residual is carried at the loss scale it was produced "
+                "under, so a scale change mis-scales one step's residual "
+                "injection. Prefer mixed_precision='bf16' (no scaler) with "
+                "PowerSGD, or accept the transient after each scale update."
+            )
         while len(self._powersgd_state) < len(self._models):
             model = self._models[len(self._powersgd_state)]
-            shapes = {n: tuple(p.shape) for n, p in model.named_parameters()}
-            self._powersgd_state.append(init(shapes, opts["rank"], nn_random.next_key()))
+            named = dict(model.named_parameters())
+            shapes = {n: tuple(p.shape) for n, p in named.items()}
+            state = init(shapes, opts["rank"], nn_random.next_key())
+            # shard each error buffer like its parameter: it is grad-shaped
+            # and grad-sized, and an unsharded fp32 copy would undo ZeRO's
+            # memory savings (per-tensor mode; the batched buffer has no
+            # per-param layout to inherit)
+            if self._comm_hook == "powersgd":
+                for n, err in state["err"].items():
+                    s = getattr(named[n].data, "sharding", None)
+                    if isinstance(s, jax.sharding.NamedSharding):
+                        state["err"][n] = jax.device_put(
+                            err, jax.sharding.NamedSharding(s.mesh, s.spec)
+                        )
+            self._powersgd_state.append(state)
 
     def _apply_powersgd_hook(self) -> None:
         from .nn import random as nn_random
@@ -654,9 +676,22 @@ class Accelerator:
             if self._comm_hook == "batched_powersgd"
             else psgd.apply_powersgd
         )
+        batched = self._comm_hook == "batched_powersgd"
         for i, model in enumerate(self._models):
             named = dict(model.named_parameters())
-            grads = {n: p.grad for n, p in named.items() if p.grad is not None}
+            if batched:
+                # the batched error buffer is a FLAT layout over the whole
+                # param set — the name set must be identical every call, so
+                # zero-fill params without grads and only write back to the
+                # ones that had one (utils/powersgd.py contract)
+                had_grad = {n for n, p in named.items() if p.grad is not None}
+                grads = {
+                    n: (p.grad if p.grad is not None else jnp.zeros_like(p.data))
+                    for n, p in named.items()
+                }
+            else:
+                had_grad = None
+                grads = {n: p.grad for n, p in named.items() if p.grad is not None}
             new_grads, new_state = apply(
                 grads,
                 self._powersgd_state[i],
@@ -666,7 +701,8 @@ class Accelerator:
                 wrapper_dtype=wrapper_dtype,
             )
             for n, g in new_grads.items():
-                named[n].grad = g
+                if had_grad is None or n in had_grad:
+                    named[n].grad = g
             self._powersgd_state[i] = new_state
 
     def _comm_hook_capture_state(self):

@@ -273,8 +273,15 @@ def prepare_accelerator_save(
             payloads.append((name, arrays, "weights"))
         for i, opt in enumerate(optimizers):
             name = f"{OPTIMIZER_NAME}.bin" if i == 0 else f"{OPTIMIZER_NAME}_{i}.bin"
+            # deepcopy under snapshot for the same reason as custom_objects:
+            # tree_map rebuilds containers but passes unregistered mutable
+            # leaves through by reference
             payloads.append(
-                (name, jax.tree_util.tree_map(_maybe_numpy, opt.state_dict()), "pickle")
+                (
+                    name,
+                    _copy_if_snapshot(jax.tree_util.tree_map(_maybe_numpy, opt.state_dict())),
+                    "pickle",
+                )
             )
     for i, sched in enumerate(schedulers):
         name = f"{SCHEDULER_NAME}.bin" if i == 0 else f"{SCHEDULER_NAME}_{i}.bin"

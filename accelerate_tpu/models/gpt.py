@@ -30,9 +30,11 @@ def shift_labels_for_lm(labels) -> jnp.ndarray:
 
 
 def lm_head_loss(x, head, labels, vocab_size: int):
-    """ONE dispatch for every causal family's loss tail: dense head+CE, or
-    the fused chunked path when ``ACCELERATE_TPU_CE_CHUNK`` is set
-    (nn.functional.chunked_lm_head_ce — logits never materialize).
+    """ONE dispatch for every unrolled causal family's loss tail: dense
+    head+CE, or the fused chunked path when ``ACCELERATE_TPU_CE_CHUNK`` is
+    set (nn.functional.chunked_lm_head_ce — logits never materialize).
+    The pipelined trunk computes its loss inside the last pp stage and is
+    NOT covered (it warns when the knob is set).
 
     ``head`` is the family's output ``nn.Linear`` (biased for GPT-J).
     Returns ``(loss, logits_or_None)`` — None under the fused path, which
@@ -660,6 +662,16 @@ class PipelinedGPTLMHeadModel(nn.Module):
         x = self.ln_f(x)
         logits = self.lm_head(x)
         if labels is not None:
+            if F.ce_chunk_size() > 0 and not getattr(self, "_ce_chunk_warned", False):
+                self._ce_chunk_warned = True
+                warnings.warn(
+                    "ACCELERATE_TPU_CE_CHUNK has no effect on "
+                    "PipelinedGPTLMHeadModel: the pipelined loss runs inside "
+                    "the last pp stage (1F1B computes it per microbatch) and "
+                    "materializes dense logits; the fused chunked head+CE "
+                    "covers the unrolled families only.",
+                    stacklevel=2,
+                )
             loss = lm_shift_loss(logits, labels, cfg.vocab_size)
             return {"loss": loss, "logits": logits}
         return {"logits": logits}

@@ -177,7 +177,13 @@ def apply_batched_powersgd(
     rng_key=None,
     wrapper_dtype=None,
 ) -> tuple[dict, dict]:
-    """Compress the whole gradient set as one padded square matrix."""
+    """Compress the whole gradient set as one padded square matrix.
+
+    CONTRACT: the caller must pass the SAME name set on every call (the
+    accelerator passes every parameter, zero-filling absent grads) — the
+    error buffer is a flat layout over the concatenation, so a name set
+    that varies between calls would shift the offsets and add one tensor's
+    residual into another's gradient region."""
     names = sorted(named_grads)
     flats = [named_grads[n].astype(jnp.float32).ravel() for n in names]
     sizes = [f.shape[0] for f in flats]

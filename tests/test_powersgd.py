@@ -199,3 +199,54 @@ def test_powersgd_composes_with_fsdp_mesh():
     assert losses[-1] < losses[0], losses
     q1 = acc._powersgd_state[0]["q"]
     assert any(not np.allclose(q0[n], np.asarray(q1[n])) for n in q0)
+
+
+def test_batched_layout_stable_when_grads_are_missing():
+    """A param without a grad on some call must not shift the batched error
+    buffer's flat layout: the accelerator zero-fills absent grads so offsets
+    stay canonical, and never writes a grad back onto a grad-less param."""
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        kwargs_handlers=[
+            DistributedDataParallelKwargs(
+                comm_hook="batched_powersgd",
+                comm_state_option={"matrix_approximation_rank": 1},
+            )
+        ]
+    )
+    model = nn.Sequential(nn.Linear(6, 6), nn.Linear(6, 4))
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+    named = dict(model.named_parameters())
+    rng = np.random.default_rng(0)
+
+    # call 1: every param has a grad
+    for p in named.values():
+        p.grad = jnp.asarray(rng.normal(size=p.shape), jnp.float32)
+    acc._apply_comm_hook()
+    # call 2: one weight's grad is absent — layout must stay canonical
+    for n, p in named.items():
+        p.grad = jnp.asarray(rng.normal(size=p.shape), jnp.float32)
+    missing = "1.weight"
+    named[missing].grad = None
+    state_before = {
+        "q": jnp.asarray(acc._powersgd_state[0]["q"]),
+        "err": jnp.asarray(acc._powersgd_state[0]["err"]),
+    }
+    present = {
+        n: jnp.asarray(p.grad) for n, p in named.items() if p.grad is not None
+    }
+    acc._apply_comm_hook()
+    assert named[missing].grad is None, "grad materialized on a grad-less param"
+    # oracle: the same apply with the missing grad zero-filled
+    from accelerate_tpu.utils import powersgd as psgd
+
+    full = dict(present)
+    full[missing] = jnp.zeros(named[missing].shape, jnp.float32)
+    want, _ = psgd.apply_batched_powersgd(full, state_before)
+    for n in present:
+        np.testing.assert_allclose(
+            np.asarray(named[n].grad), np.asarray(want[n]), rtol=1e-5, atol=1e-6,
+            err_msg=n,
+        )

@@ -47,9 +47,11 @@ echo "best experiment: ${tag:-none} ($val tok/s) vs plain $plain"
 better=$(python3 -c "print(1 if float('$val') > float('$plain') else 0)")
 [ "$better" = "1" ] || { echo "plain run already best; no rerun"; exit 0; }
 
-echo "re-running bench with: $envline (longer 100-step timing window)"
+echo "re-running bench with: $envline (same timing window as the plain run)"
 tmp=$(mktemp /tmp/bench_best.XXXXXX.json)
-env $envline BENCH_STEPS=100 BENCH_INIT_ATTEMPTS=2 timeout 1500 python bench.py \
+# same BENCH_STEPS window as the plain run and the candidates, so the
+# keep-gate compares like with like
+env $envline BENCH_INIT_ATTEMPTS=2 timeout 1500 python bench.py \
   2>/tmp/bench_best_err.log | tee "$tmp"
 # save the artifact only if the rerun is a valid accelerator row that beats
 # the plain run — a hang/fallback/regression must not leave a misleading file
