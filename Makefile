@@ -3,9 +3,15 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_slow bench
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint bench
 
-test:
+# graftlint: trace-safety & collective-correctness static analysis
+# (docs/graftlint.md). Runs before the suite — it's a ~3 s AST pass that
+# catches host-syncs-in-trace / axis typos which otherwise only fail on TPU.
+lint:
+	python tools/graftlint.py accelerate_tpu/
+
+test: lint
 	python -m pytest tests/ -q
 
 test_core:
@@ -47,6 +53,9 @@ test_checkpoint:
 
 test_examples:
 	python -m pytest tests/test_examples.py tests/test_external_scripts.py -q
+
+test_analysis:
+	python -m pytest tests/test_graftlint.py -q
 
 # the slow split: subprocess launches + big compiles, partitioned out of
 # the default suite by the `slow` marker; CI runs both targets

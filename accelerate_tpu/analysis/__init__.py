@@ -1,0 +1,39 @@
+"""graftlint — static trace-safety & collective-correctness analysis.
+
+The paper's promise is that one unmodified loop body runs from 1-process CPU
+to a multi-chip TPU mesh.  The failure modes that break that promise — host
+syncs baked into a ``jax.jit`` trace, per-step recompiles, collectives over
+axis names the mesh does not carry — surface only at runtime, often only on
+hardware (see TPU_OUTAGE_r0*.log).  This subsystem catches them from the AST,
+in CI, on the virtual 8-device CPU mesh.
+
+Layout:
+  engine.py     file discovery, suppressions, baseline, rule runner
+  callgraph.py  per-module call graph + traced-region reachability
+  rules/        one module per rule (six rules at birth)
+
+Entry point: ``tools/graftlint.py`` (also ``make lint``).
+"""
+
+from .engine import (
+    AnalysisResult,
+    Finding,
+    ModuleInfo,
+    Rule,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+from .rules import ALL_RULES, get_rules
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisResult",
+    "Finding",
+    "ModuleInfo",
+    "Rule",
+    "get_rules",
+    "load_baseline",
+    "run_analysis",
+    "write_baseline",
+]

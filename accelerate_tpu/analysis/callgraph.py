@@ -1,0 +1,210 @@
+"""Per-module call graph + traced-region reachability.
+
+A function is a *trace root* when it is handed to a tracing transform —
+decorated with ``jax.jit`` / ``partial(jax.jit, ...)``, or passed by name to
+``jax.jit`` / ``shard_map`` / ``shard_map_compat`` / ``pl.pallas_call`` /
+``lax.scan``-family / ``accelerator.compile_step``.  Everything reachable
+from a root through same-module calls (including functions passed as
+callbacks and ``self.method()`` dispatch) executes under trace, so the
+trace-safety rules (host-sync, blocking) only fire inside that region.
+
+The graph is deliberately per-module: cross-module reachability would need
+whole-program import resolution for marginal recall, since this codebase's
+traced bodies (capture.py, ops/, parallel/) call within their own file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, Optional
+
+# Leaves that are tracing transforms regardless of prefix (project- or
+# jax-specific spellings that never collide with stdlib/user names).
+_WRAPPER_LEAVES = {
+    "jit",
+    "pjit",
+    "pmap",
+    "shard_map",
+    "shard_map_compat",
+    "pallas_call",
+    "compile_step",
+    "CapturedStep",
+    "remat",
+    "xmap",
+}
+# Generic leaves that only count when the dotted path shows they come from
+# jax (``lax.scan`` yes, ``self.scan`` no).
+_JAX_ONLY_LEAVES = {
+    "scan",
+    "fori_loop",
+    "while_loop",
+    "cond",
+    "switch",
+    "associative_scan",
+    "map",
+    "vmap",
+    "grad",
+    "value_and_grad",
+    "vjp",
+    "jvp",
+    "linearize",
+    "checkpoint",
+    "custom_vjp",
+    "custom_jvp",
+    "eval_shape",
+    "make_jaxpr",
+}
+
+
+def is_trace_wrapper(resolved: Optional[str]) -> bool:
+    if not resolved:
+        return False
+    parts = resolved.split(".")
+    leaf = parts[-1]
+    if leaf in _WRAPPER_LEAVES:
+        return True
+    if leaf in _JAX_ONLY_LEAVES:
+        return "jax" in parts or parts[0] in ("lax", "pl", "pallas")
+    return False
+
+
+def iter_own_nodes(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested def/class bodies
+    (those are their own call-graph nodes, reached through edges)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            # still surface the nested def's decorators/defaults — they
+            # evaluate in the enclosing scope
+            stack.extend(node.decorator_list)
+            if not isinstance(node, ast.ClassDef):
+                stack.extend(node.args.defaults + [d for d in node.args.kw_defaults if d])
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    name: str
+    qualname: str
+    node: ast.AST
+    edges: set[str] = dataclasses.field(default_factory=set)
+
+
+class _Collector(ast.NodeVisitor):
+    def __init__(self):
+        self.stack: list[str] = []
+        self.functions: list[FunctionInfo] = []
+
+    def _visit_fn(self, node):
+        qual = ".".join(self.stack + [node.name])
+        info = FunctionInfo(node.name, qual, node)
+        # names bound as data in this scope (params, assignments, loop vars):
+        # a data binding passed as an argument is a value, not a reference to
+        # a same-named module function — without this, a parameter named like
+        # a method creates phantom edges
+        local_data = {a.arg for a in ast.walk(node.args) if isinstance(a, ast.arg)}
+        for sub in iter_own_nodes(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, (ast.Store, ast.Del)):
+                local_data.add(sub.id)
+        for sub in iter_own_nodes(node):
+            if isinstance(sub, ast.Call):
+                # direct calls: f(...) and self.f(...) / cls.f(...)
+                fn = sub.func
+                if isinstance(fn, ast.Name):
+                    info.edges.add(fn.id)
+                elif isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+                    if fn.value.id in ("self", "cls"):
+                        info.edges.add(fn.attr)
+                # callback pattern: names passed as arguments may be called
+                # by the callee (ring hops, pipeline schedules do this).
+                # Nested defs are not Store bindings, so they stay eligible.
+                for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id not in local_data:
+                        info.edges.add(arg.id)
+        self.functions.append(info)
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+
+class CallGraph:
+    def __init__(self, module):
+        self.module = module
+        collector = _Collector()
+        collector.visit(module.tree)
+        self.functions: dict[str, FunctionInfo] = {
+            f.qualname: f for f in collector.functions
+        }
+        self.by_leaf: dict[str, list[FunctionInfo]] = {}
+        for f in collector.functions:
+            self.by_leaf.setdefault(f.name, []).append(f)
+        # reached: qualname -> human-readable reason ("root ..." / "via ...")
+        self.reached: dict[str, str] = {}
+        self._find_roots()
+        self._propagate()
+
+    # -- roots --------------------------------------------------------------
+    def _mark(self, info: FunctionInfo, reason: str) -> None:
+        self.reached.setdefault(info.qualname, reason)
+
+    def _find_roots(self) -> None:
+        mod = self.module
+        for info in self.functions.values():
+            for dec in getattr(info.node, "decorator_list", []):
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                resolved = mod.resolve(target)
+                if is_trace_wrapper(resolved):
+                    self._mark(info, f"decorated with {resolved}")
+                elif (
+                    isinstance(dec, ast.Call)
+                    and resolved
+                    and resolved.rsplit(".", 1)[-1] == "partial"
+                ):
+                    for a in dec.args:
+                        wr = mod.resolve(a)
+                        if is_trace_wrapper(wr):
+                            self._mark(info, f"decorated with partial({wr}, ...)")
+        # call-form: jax.jit(f, ...), shard_map_compat(f, ...), lax.scan(f, ...)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = mod.resolve(node.func)
+            if not is_trace_wrapper(resolved):
+                continue
+            # walk the whole argument expressions, not just bare Names: the
+            # `shard_map_compat(partial(local_fn, ...), ...)` idiom buries the
+            # traced function one call deep
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        for info in self.by_leaf.get(sub.id, []):
+                            self._mark(info, f"passed to {resolved}")
+
+    # -- reachability -------------------------------------------------------
+    def _propagate(self) -> None:
+        frontier = list(self.reached)
+        while frontier:
+            qual = frontier.pop()
+            info = self.functions[qual]
+            for name in info.edges:
+                for callee in self.by_leaf.get(name, []):
+                    if callee.qualname not in self.reached:
+                        root = self.reached[qual].split(" via ")[0]
+                        self.reached[callee.qualname] = f"{root} via {qual}"
+                        frontier.append(callee.qualname)
+
+    def traced_functions(self) -> Iterator[tuple[FunctionInfo, str]]:
+        for qual, reason in sorted(self.reached.items()):
+            yield self.functions[qual], reason

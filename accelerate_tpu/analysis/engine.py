@@ -1,0 +1,400 @@
+"""graftlint engine: discovery, suppression comments, baseline, rule runner.
+
+Pure-stdlib AST analysis — importing this module must never import jax (the
+CLI runs it in a few hundred milliseconds so it can sit inside ``make test``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import time
+import tokenize
+from typing import Iterable, Optional, Sequence
+
+# Suppression comment grammar (the leading hash is spelled \x23 here so this
+# very comment can't register itself): "\x23 graftlint: disable=rule-a,rule-b"
+# on (or as the comment line above) the offending line;
+# "\x23 graftlint: disable-file=rule-a" anywhere silences a whole file.  A
+# bare "disable" with no =list silences every rule.  Anything after the rule
+# list (a justification like "-- profiling only") is ignored.
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<scope>-file)?(?:\s*=\s*(?P<rules>[A-Za-z0-9_,\- ]+))?"
+)
+_RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    symbol: str = ""  # enclosing function qualname (stable across line drift)
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the baseline file, so grandfathered
+        findings survive unrelated edits above them."""
+        key = "|".join((self.rule, self.path.replace(os.sep, "/"), self.symbol, self.message))
+        return hashlib.sha1(key.encode("utf-8")).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{loc}: {self.rule}: {self.message}{sym}"
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``description`` and implement check()."""
+
+    id: str = ""
+    description: str = ""
+
+    def check(self, module: "ModuleInfo", ctx: "AnalysisContext") -> list[Finding]:
+        raise NotImplementedError
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _collect_aliases(tree: ast.AST) -> dict[str, str]:
+    """alias -> canonical dotted prefix, from every import in the file.
+
+    ``import jax.numpy as jnp`` → jnp: jax.numpy; ``from jax import lax`` →
+    lax: jax.lax; relative imports keep their module tail (suffix matching in
+    the rules absorbs the missing package prefix).
+    """
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                full = f"{base}.{a.name}" if base else a.name
+                aliases[a.asname or a.name] = full
+    return aliases
+
+
+def _parse_rule_list(raw: Optional[str]) -> set[str]:
+    """Rule ids from the text after `disable=`, tolerating a trailing
+    justification: each comma part contributes its first word, and parsing
+    stops at the first word that isn't a rule-shaped token (`-- because...`)."""
+    if raw is None:
+        return {"all"}
+    rules: set[str] = set()
+    for part in raw.split(","):
+        words = part.split()
+        if not words or not _RULE_TOKEN_RE.match(words[0]):
+            break
+        rules.add(words[0])
+    return rules or {"all"}
+
+
+def _collect_suppressions(source: str):
+    """Suppressions from real COMMENT tokens only — a docstring that merely
+    *mentions* the syntax must not disable anything, so the raw-line regex
+    approach is out; we tokenize."""
+    per_line: dict[int, set[str]] = {}
+    per_file: set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return per_line, per_file  # ast.parse already vets the file upstream
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = _parse_rule_list(m.group("rules"))
+        if m.group("scope"):
+            per_file |= rules
+        else:
+            line = tok.start[0]
+            per_line.setdefault(line, set()).update(rules)
+            if tok.line[: tok.start[1]].strip() == "":
+                # comment-only line: also covers the next line (pylint-style)
+                per_line.setdefault(line + 1, set()).update(rules)
+    return per_line, per_file
+
+
+class ModuleInfo:
+    """One parsed file plus the derived maps every rule shares."""
+
+    def __init__(self, path: str, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = _collect_aliases(self.tree)
+        self.line_suppressions, self.file_suppressions = _collect_suppressions(source)
+        # module-level `NAME = "literal"` string constants (axis-name rule
+        # resolves bare-Name axis arguments through this)
+        self.str_constants: dict[str, str] = {}
+        for node in self.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                self.str_constants[node.targets[0].id] = node.value.value
+        self._callgraph = None
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of an expression, with import aliases applied
+        to the head segment (``jnp.zeros`` → ``jax.numpy.zeros``)."""
+        d = _dotted(node)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        base = self.aliases.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from .callgraph import CallGraph
+
+            self._callgraph = CallGraph(self)
+        return self._callgraph
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        if {"all", finding.rule} & self.file_suppressions:
+            return True
+        rules = self.line_suppressions.get(finding.line, ())
+        return "all" in rules or finding.rule in rules
+
+
+@dataclasses.dataclass
+class AnalysisContext:
+    """Cross-file facts collected in a first pass before rules run."""
+
+    axis_universe: set[str] = dataclasses.field(default_factory=set)
+    axis_sources: dict[str, str] = dataclasses.field(default_factory=dict)
+    modules: list[ModuleInfo] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: list[Finding]
+    new_findings: list[Finding]  # findings minus the baseline
+    files_analyzed: int
+    duration_s: float
+    suppressed: int
+
+    def to_dict(self) -> dict:
+        return {
+            "files_analyzed": self.files_analyzed,
+            "duration_s": round(self.duration_s, 3),
+            "suppressed": self.suppressed,
+            "baseline_filtered": len(self.findings) - len(self.new_findings),
+            "findings": [f.to_dict() for f in self.new_findings],
+        }
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".hg", "node_modules", "build", "dist"}
+
+
+def discover_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs if d not in _SKIP_DIRS and not d.startswith(".")
+                )
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files) if f.endswith(".py")
+                )
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# axis-universe collection (first pass; consumed by the axis-name rule)
+# ---------------------------------------------------------------------------
+
+# Fallback when the analyzed tree declares no mesh at all (e.g. a lone
+# fixture file): the framework's canonical axes from utils/constants.py.
+# Named so the harvester below does NOT match it ("AXES"/"MESH_AXIS"
+# patterns) — the linter's own fallback must never feed the harvested
+# universe when this package is itself the analysis target.
+FALLBACK_AXIS_UNIVERSE = ("dp", "fsdp", "tp", "sp", "ep", "pp")
+
+
+def _literal_strs(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def _collect_axes(module: ModuleInfo, ctx: AnalysisContext) -> None:
+    where = module.rel_path
+
+    def add(name: str, why: str) -> None:
+        ctx.axis_universe.add(name)
+        ctx.axis_sources.setdefault(name, f"{where}: {why}")
+
+    for node in ast.walk(module.tree):
+        # MESH_AXIS_DP = "dp" / ALL_MESH_AXES = (MESH_AXIS_DP, ...)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                if tgt.id.startswith("MESH_AXIS"):
+                    for s in _literal_strs(node.value):
+                        add(s, tgt.id)
+                elif "AXES" in tgt.id:
+                    for s in _literal_strs(node.value):
+                        add(s, tgt.id)
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        for e in node.value.elts:
+                            if isinstance(e, ast.Name) and e.id in module.str_constants:
+                                add(module.str_constants[e.id], tgt.id)
+        elif isinstance(node, ast.Call):
+            resolved = module.resolve(node.func) or ""
+            leaf = resolved.rsplit(".", 1)[-1]
+            # Mesh(devs, axis_names=(...)) / Mesh(devs, ("dp", ...))
+            if leaf in ("Mesh", "AbstractMesh", "make_mesh"):
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        for s in _literal_strs(kw.value):
+                            add(s, "axis_names=")
+                if leaf in ("Mesh", "AbstractMesh") and len(node.args) >= 2:
+                    for s in _literal_strs(node.args[1]):
+                        add(s, "Mesh(...)")
+                # make_mesh({"dp": 2, ...})
+                if leaf == "make_mesh" and node.args and isinstance(node.args[0], ast.Dict):
+                    for k in node.args[0].keys:
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                            add(k.value, "make_mesh({...})")
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> set[str]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"] for e in data.get("findings", [])}
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> None:
+    data = {
+        "comment": (
+            "graftlint baseline: grandfathered findings (by line-free "
+            "fingerprint). Regenerate with --write-baseline."
+        ),
+        "findings": [
+            {
+                "fingerprint": f.fingerprint(),
+                "rule": f.rule,
+                "path": f.path,
+                "symbol": f.symbol,
+                "message": f.message,
+            }
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def run_analysis(
+    paths: Sequence[str],
+    rules: Optional[Sequence[Rule]] = None,
+    baseline: Optional[set[str]] = None,
+) -> AnalysisResult:
+    if rules is None:
+        from .rules import ALL_RULES
+
+        rules = [cls() for cls in ALL_RULES]
+    t0 = time.monotonic()
+    files = discover_files(paths)
+    cwd = os.getcwd()
+    ctx = AnalysisContext()
+    findings: list[Finding] = []
+    suppressed = 0
+    modules: list[ModuleInfo] = []
+    for path in files:
+        rel = os.path.relpath(path, cwd) if os.path.isabs(path) else path
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            modules.append(ModuleInfo(path, rel, source))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            lineno = getattr(e, "lineno", 0) or 0
+            findings.append(
+                Finding("syntax-error", rel, lineno, 0, f"cannot parse: {e}")
+            )
+    ctx.modules = modules
+    for m in modules:
+        _collect_axes(m, ctx)
+    if not ctx.axis_universe:
+        ctx.axis_universe = set(FALLBACK_AXIS_UNIVERSE)
+        ctx.axis_sources = {
+            a: "builtin default (no mesh declaration found)"
+            for a in FALLBACK_AXIS_UNIVERSE
+        }
+    for m in modules:
+        for rule in rules:
+            for f in rule.check(m, ctx):
+                if m.is_suppressed(f):
+                    suppressed += 1
+                else:
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    new = (
+        [f for f in findings if f.fingerprint() not in baseline]
+        if baseline
+        else list(findings)
+    )
+    return AnalysisResult(
+        findings=findings,
+        new_findings=new,
+        files_analyzed=len(files),
+        duration_s=time.monotonic() - t0,
+        suppressed=suppressed,
+    )

@@ -1,0 +1,28 @@
+"""Rule registry. Adding a rule = new module here + an entry in ALL_RULES."""
+
+from .axis_names import AxisNameMismatch
+from .blocking import BlockingInHotLoop
+from .donation import DonationReuse
+from .dtype_widen import DtypeWiden
+from .host_sync import HostSyncInTrace
+from .recompile import RecompileHazard
+
+ALL_RULES = [
+    HostSyncInTrace,
+    RecompileHazard,
+    AxisNameMismatch,
+    DonationReuse,
+    DtypeWiden,
+    BlockingInHotLoop,
+]
+
+
+def get_rules(ids=None):
+    """Instantiate all rules, or the subset named in ``ids``."""
+    if ids is None:
+        return [cls() for cls in ALL_RULES]
+    by_id = {cls.id: cls for cls in ALL_RULES}
+    unknown = set(ids) - set(by_id)
+    if unknown:
+        raise KeyError(f"unknown rule id(s): {sorted(unknown)}")
+    return [by_id[i]() for i in ids]

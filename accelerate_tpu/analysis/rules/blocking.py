@@ -1,0 +1,124 @@
+"""blocking-in-hot-loop: per-iteration host synchronization in step loops.
+
+``x.block_until_ready()`` inside a training loop serializes host and device
+— the async dispatch queue (the thing hiding all python overhead between
+step launches) drains to depth 0 every iteration.  Legitimate uses are
+profiling/benchmark timers, so calls under an ``if`` whose condition
+mentions profiling/debug knobs, or inside functions whose name says
+bench/profile/warmup, are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import Finding, Rule
+
+_BLOCKING_LEAVES = {"block_until_ready", "effects_barrier"}
+_GUARD_NAME_RE = re.compile(
+    r"profil|debug|verbose|bench|warmup|timing|timeit|trace|sync_every|"
+    r"sync_each|log_every|barrier|measure",
+    re.IGNORECASE,
+)
+
+
+def _is_guard(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and _GUARD_NAME_RE.search(name):
+            return True
+    return False
+
+
+class _LoopVisitor(ast.NodeVisitor):
+    def __init__(self, rule, module, fn_qual):
+        self.rule = rule
+        self.module = module
+        self.fn_qual = fn_qual
+        self.loop_depth = 0
+        self.guard_depth = 0
+        self.findings: list[Finding] = []
+
+    def visit_For(self, node):
+        # the iterable expression evaluates once, outside the hot body
+        self.visit(node.iter)
+        self.loop_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_depth -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_While(self, node):
+        # unlike For.iter, the While test re-evaluates EVERY iteration — a
+        # blocking call in the condition is a per-step sync too
+        self.loop_depth += 1
+        self.visit(node.test)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_depth -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_If(self, node):
+        self.visit(node.test)
+        guarded = _is_guard(node.test)
+        self.guard_depth += guarded
+        for stmt in node.body:
+            self.visit(stmt)
+        self.guard_depth -= guarded
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_Call(self, node):
+        if self.loop_depth > 0 and self.guard_depth == 0:
+            fn = node.func
+            resolved = self.module.resolve(fn) or ""
+            leaf = resolved.rsplit(".", 1)[-1]
+            is_blocking = leaf in _BLOCKING_LEAVES or (
+                isinstance(fn, ast.Attribute) and fn.attr in _BLOCKING_LEAVES
+            )
+            if is_blocking:
+                self.findings.append(
+                    Finding(
+                        self.rule.id,
+                        self.module.rel_path,
+                        node.lineno,
+                        node.col_offset,
+                        f"{leaf}() inside a loop drains the async dispatch queue "
+                        "every iteration — gate it behind a profiling flag or "
+                        "sync once after the loop",
+                        symbol=self.fn_qual,
+                    )
+                )
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs are scanned as their own functions
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+
+class BlockingInHotLoop(Rule):
+    id = "blocking-in-hot-loop"
+    description = (
+        "block_until_ready/effects_barrier inside a step loop outside a "
+        "profiling guard"
+    )
+
+    def check(self, module, ctx):
+        findings = []
+        for info in module.callgraph.functions.values():
+            if _GUARD_NAME_RE.search(info.name):
+                continue  # bench/profiling helpers sync on purpose
+            v = _LoopVisitor(self, module, info.qualname)
+            for stmt in info.node.body:
+                v.visit(stmt)
+            findings.extend(v.findings)
+        return findings

@@ -1,0 +1,165 @@
+"""donation-reuse: reading a buffer after handing it to ``donate_argnums``.
+
+Donation aliases the input buffer to an output — after the call the python
+reference points at freed/overwritten device memory.  JAX only *warns* (and
+only sometimes), the read returns garbage or raises much later.  The rule
+tracks, per function body and in execution order, names passed at donated
+positions of a known donating callable; any later read before a rebind is
+flagged.
+
+Known limitation (documented in docs/graftlint.md): the scan is linear, so a
+use-after-donate that only manifests across loop iterations is not seen.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..engine import Finding, Rule
+
+_JIT_LEAVES = {"jit", "pjit"}
+
+
+def _donated_positions(call: ast.Call) -> Optional[list[int]]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            out = [
+                e.value
+                for e in elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            ]
+            return out or None
+    return None
+
+
+def _donating_callables(module) -> dict[str, list[int]]:
+    """name -> donated positions, for `g = jax.jit(f, donate_argnums=...)`
+    assignments and `@partial(jax.jit, donate_argnums=...)` decorated defs."""
+    out: dict[str, list[int]] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            resolved = module.resolve(node.value.func) or ""
+            if resolved.rsplit(".", 1)[-1] in _JIT_LEAVES:
+                pos = _donated_positions(node.value)
+                if pos:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                resolved = module.resolve(dec.func) or ""
+                leaf = resolved.rsplit(".", 1)[-1]
+                is_jit_factory = leaf in _JIT_LEAVES
+                is_partial_jit = leaf == "partial" and any(
+                    (module.resolve(a) or "").rsplit(".", 1)[-1] in _JIT_LEAVES
+                    for a in dec.args
+                )
+                if is_jit_factory or is_partial_jit:
+                    pos = _donated_positions(dec)
+                    if pos:
+                        out[node.name] = pos
+    return out
+
+
+class _LinearScanner(ast.NodeVisitor):
+    """Emit (use/store/donate) events in approximate execution order; the
+    default field order of Assign (targets before value) is the one place
+    AST order disagrees with evaluation order, so it's special-cased."""
+
+    def __init__(self, rule, module, fn_qual, donors):
+        self.rule = rule
+        self.module = module
+        self.fn_qual = fn_qual
+        self.donors = donors
+        self.dead: dict[str, tuple[str, int]] = {}  # name -> (donor, lineno)
+        self.findings: list[Finding] = []
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        for t in node.targets:
+            self.visit(t)
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        # target is read-then-write: the read part sees the donated state
+        if isinstance(node.target, ast.Name):
+            self._use(node.target, node.target.id)
+            self.dead.pop(node.target.id, None)
+        else:
+            self.visit(node.target)
+
+    def visit_AnnAssign(self, node):
+        if node.value:
+            self.visit(node.value)
+        self.visit(node.target)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self._use(node, node.id)
+        else:  # Store/Del rebinds the name away from the dead buffer
+            self.dead.pop(node.id, None)
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in self.donors:
+            for arg in node.args:
+                self.visit(arg)
+            for kw in node.keywords:
+                self.visit(kw.value)
+            for pos in self.donors[fn.id]:
+                if pos < len(node.args) and isinstance(node.args[pos], ast.Name):
+                    self.dead[node.args[pos].id] = (fn.id, node.lineno)
+        else:
+            self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs: separate scope, scanned separately
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def _use(self, node, name):
+        if name in self.dead:
+            donor, _line = self.dead.pop(name)  # report once per donation
+            self.findings.append(
+                Finding(
+                    self.rule.id,
+                    self.module.rel_path,
+                    node.lineno,
+                    node.col_offset,
+                    # no line numbers in the message: it feeds the baseline
+                    # fingerprint, which must survive unrelated line drift
+                    f"'{name}' is read after being donated to '{donor}' "
+                    "(donate_argnums aliases its buffer to an output; "
+                    "rebind the result or drop the donation)",
+                    symbol=self.fn_qual,
+                )
+            )
+
+
+class DonationReuse(Rule):
+    id = "donation-reuse"
+    description = "buffer read after appearing at a donate_argnums position"
+
+    def check(self, module, ctx):
+        donors = _donating_callables(module)
+        if not donors:
+            return []
+        findings = []
+        for info in module.callgraph.functions.values():
+            scanner = _LinearScanner(self, module, info.qualname, donors)
+            for stmt in info.node.body:
+                scanner.visit(stmt)
+            findings.extend(scanner.findings)
+        # module top level
+        scanner = _LinearScanner(self, module, "<module>", donors)
+        for stmt in module.tree.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                scanner.visit(stmt)
+        findings.extend(scanner.findings)
+        return findings

@@ -1,0 +1,102 @@
+"""dtype-widen: accidental float64 on TPU paths.
+
+TPUs have no f64 ALU: with x64 enabled, every float64 op is emulated at a
+fraction of peak FLOPs and doubles HBM traffic; with x64 off (the JAX
+default), a float64 dtype request silently truncates to f32 — either way the
+author didn't get what they wrote.  Flagged: float64/double dtypes handed to
+jnp constructors, ``.astype(jnp.float64)``, ``jnp.float64(...)`` casts, and
+library code flipping ``jax_enable_x64`` globally.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Rule
+
+_WIDE_ATTRS = {"jax.numpy.float64", "jax.numpy.double", "numpy.float64", "numpy.double"}
+_WIDE_STRS = {"float64", "double", "f8", "<f8", ">f8"}
+# jnp constructors whose dtype can also arrive positionally
+_DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "asarray": 1, "array": 1, "full": 2}
+
+
+class DtypeWiden(Rule):
+    id = "dtype-widen"
+    description = "float64 promotion on a TPU path (jnp dtype, astype, or jax_enable_x64)"
+
+    def _is_wide(self, module, node: ast.AST, allow_builtin_float: bool) -> bool:
+        resolved = module.resolve(node)
+        if resolved in _WIDE_ATTRS:
+            return True
+        if isinstance(node, ast.Constant) and node.value in _WIDE_STRS:
+            return True
+        if allow_builtin_float and isinstance(node, ast.Name) and node.id == "float":
+            return True  # dtype=float means float64 under x64
+        return False
+
+    def check(self, module, ctx):
+        findings = []
+
+        def hit(node, msg):
+            findings.append(
+                Finding(self.id, module.rel_path, node.lineno, node.col_offset, msg)
+            )
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            resolved = module.resolve(fn) or ""
+            leaf = resolved.rsplit(".", 1)[-1]
+            if resolved in ("jax.numpy.float64", "jax.numpy.double"):
+                hit(node, f"jnp.{leaf}() cast — TPUs emulate f64; use jnp.float32")
+            elif resolved.startswith("jax."):
+                # dtype= kwarg on any jax/jnp call, plus positional dtype slots
+                dtype_expr = None
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        dtype_expr = kw.value
+                if dtype_expr is None and leaf in _DTYPE_POS:
+                    pos = _DTYPE_POS[leaf]
+                    if len(node.args) > pos:
+                        dtype_expr = node.args[pos]
+                if dtype_expr is not None and self._is_wide(module, dtype_expr, True):
+                    hit(
+                        node,
+                        f"float64 dtype passed to {leaf}() — TPUs emulate f64 "
+                        "(or silently truncate with x64 off); use float32/bfloat16",
+                    )
+                if resolved == "jax.config.update" and node.args:
+                    arg0 = node.args[0]
+                    truthy = len(node.args) > 1 and not (
+                        isinstance(node.args[1], ast.Constant) and not node.args[1].value
+                    )
+                    if (
+                        isinstance(arg0, ast.Constant)
+                        and arg0.value == "jax_enable_x64"
+                        and truthy
+                    ):
+                        hit(
+                            node,
+                            "jax_enable_x64 flipped globally in library code — "
+                            "every downstream op widens to f64 on TPU",
+                        )
+            elif isinstance(fn, ast.Attribute) and fn.attr == "astype" and node.args:
+                # .astype(jnp.float64) is unambiguous; .astype(np.float64) only
+                # matters inside traced code (host numpy f64 is fine)
+                arg = node.args[0]
+                if module.resolve(arg) in ("jax.numpy.float64", "jax.numpy.double"):
+                    hit(node, ".astype(jnp.float64) — TPUs emulate f64; use float32")
+                elif self._is_wide(module, arg, False):
+                    reached = module.callgraph.reached
+                    for info, _ in module.callgraph.traced_functions():
+                        lo = info.node.lineno
+                        hi = getattr(info.node, "end_lineno", lo)
+                        if lo <= node.lineno <= hi and info.qualname in reached:
+                            hit(
+                                node,
+                                ".astype(float64) inside traced code — TPUs "
+                                "emulate f64; use float32",
+                            )
+                            break
+        return findings

@@ -1,0 +1,251 @@
+"""recompile-hazard: python-scalar control flow / shapes inside jit without
+``static_argnums``.
+
+A jit argument used in an ``if``/``while`` test, in ``range()``, or as a
+shape raises ConcretizationTypeError at trace time — or, when the caller
+papers over it by passing python ints, silently recompiles the whole program
+for every distinct value (the multi-minute XLA compile, per step).  The fix
+is ``static_argnums``/``static_argnames`` (hashable, cache-keyed) or
+``lax.cond``/``jnp.where`` for genuinely dynamic branches.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Rule
+
+# module-level constructors: leaf -> positional index of the shape argument
+_SHAPE_CREATORS = {
+    "zeros": 0,
+    "ones": 0,
+    "empty": 0,
+    "full": 0,
+    "eye": 0,
+    "arange": 0,
+    "linspace": 2,
+    "broadcast_to": 1,
+    "reshape": 1,
+    "tile": 1,
+}
+# array methods: every argument is part of the shape
+_SHAPE_METHODS = {"reshape", "broadcast_to", "tile"}
+_JIT_LEAVES = {"jit", "pjit"}
+
+
+def _jit_statics(call: ast.Call, module):
+    """(static_argnums, static_argnames) literals from a jit(...) call."""
+    nums: list[int] = []
+    names: list[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            nums.extend(
+                e.value for e in elts if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            )
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            names.extend(
+                e.value for e in elts if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+    return nums, names
+
+
+def _jit_sites(module):
+    """qualname -> (static_argnums, static_argnames) for every locally
+    defined function wrapped by jit (decorator or call form)."""
+    sites: dict[str, tuple[list[int], list[str]]] = {}
+    cg = module.callgraph
+    for info in cg.functions.values():
+        for dec in getattr(info.node, "decorator_list", []):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            resolved = module.resolve(target) or ""
+            leaf = resolved.rsplit(".", 1)[-1]
+            if leaf in _JIT_LEAVES:
+                statics = _jit_statics(dec, module) if isinstance(dec, ast.Call) else ([], [])
+                sites[info.qualname] = statics
+            elif leaf == "partial" and isinstance(dec, ast.Call):
+                if any(
+                    (module.resolve(a) or "").rsplit(".", 1)[-1] in _JIT_LEAVES
+                    for a in dec.args
+                ):
+                    sites[info.qualname] = _jit_statics(dec, module)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = module.resolve(node.func) or ""
+        if resolved.rsplit(".", 1)[-1] not in _JIT_LEAVES:
+            continue
+        if node.args and isinstance(node.args[0], ast.Name):
+            for info in cg.by_leaf.get(node.args[0].id, []):
+                sites.setdefault(info.qualname, _jit_statics(node, module))
+    return sites
+
+
+def _dynamic_shape_names(expr: ast.AST) -> set[str]:
+    """Names a shape expression *dynamically* depends on.  ``x.shape[0]`` /
+    ``x.ndim`` / ``len(x)`` are static at trace time, so names that only
+    appear under those forms don't make the shape dynamic."""
+    static_subtrees: set[int] = set()
+    for node in ast.walk(expr):
+        is_static = (
+            isinstance(node, ast.Attribute) and node.attr in ("shape", "ndim", "size")
+        ) or (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "len"
+        )
+        if is_static:
+            for sub in ast.walk(node):
+                static_subtrees.add(id(sub))
+    return {
+        n.id
+        for n in ast.walk(expr)
+        if isinstance(n, ast.Name) and id(n) not in static_subtrees
+    }
+
+
+def _names_in_concretizing_positions(test: ast.AST):
+    """Names whose truthiness/ordering the test depends on — excluding
+    trace-safe forms (`x is None`, isinstance/hasattr/callable, len(), and
+    `.shape`/`.ndim`/`.size` reads, which are static at trace time)."""
+    out: set[str] = set()
+    skip: set[int] = set()
+    for node in ast.walk(test):
+        if id(node) in skip:
+            continue
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+        elif isinstance(node, ast.Attribute) and node.attr in ("shape", "ndim", "size"):
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in (
+                "isinstance",
+                "hasattr",
+                "callable",
+                "getattr",
+                "len",
+            ):
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+    for node in ast.walk(test):
+        if id(node) not in skip and isinstance(node, ast.Name):
+            out.add(node.id)
+    return out
+
+
+class RecompileHazard(Rule):
+    id = "recompile-hazard"
+    description = (
+        "jit argument used in python control flow / range() / shapes without "
+        "static_argnums, or an unhashable static default"
+    )
+
+    def check(self, module, ctx):
+        findings = []
+        cg = module.callgraph
+        for qual, (argnums, argnames) in _jit_sites(module).items():
+            info = cg.functions[qual]
+            node = info.node
+            a = node.args
+            params = [p.arg for p in a.posonlyargs + a.args]
+            static = set(argnames)
+            static.update(params[i] for i in argnums if 0 <= i < len(params))
+            dynamic = {
+                p
+                for p in params + [p.arg for p in a.kwonlyargs]
+                if p not in static and p not in ("self", "cls")
+            }
+            # unhashable default on a *static* param breaks the jit cache key
+            defaults = dict(zip(params[len(params) - len(a.defaults):], a.defaults))
+            for p in sorted(static):
+                d = defaults.get(p)
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(
+                        Finding(
+                            self.id,
+                            module.rel_path,
+                            d.lineno,
+                            d.col_offset,
+                            f"static argument '{p}' of jitted '{qual}' has an "
+                            "unhashable default (list/dict/set) — jit's cache "
+                            "key requires hashable statics",
+                            symbol=qual,
+                        )
+                    )
+            findings.extend(self._scan_body(module, info, dynamic))
+        return findings
+
+    def _scan_body(self, module, info, dynamic):
+        findings = []
+        qual = info.qualname
+
+        def hit(node, msg):
+            findings.append(
+                Finding(self.id, module.rel_path, node.lineno, node.col_offset, msg, symbol=qual)
+            )
+
+        for node in ast.walk(info.node):
+            if isinstance(node, (ast.If, ast.While)):
+                used = _names_in_concretizing_positions(node.test) & dynamic
+                for p in sorted(used):
+                    hit(
+                        node,
+                        f"python control flow on traced argument '{p}' of jitted "
+                        f"'{qual}' — mark it static_argnums/static_argnames or "
+                        "use lax.cond/jnp.where",
+                    )
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                resolved = module.resolve(fn) or ""
+                leaf = resolved.rsplit(".", 1)[-1]
+                if isinstance(fn, ast.Name) and fn.id == "range":
+                    used = {
+                        n.id
+                        for a_ in node.args
+                        for n in ast.walk(a_)
+                        if isinstance(n, ast.Name)
+                    } & dynamic
+                    for p in sorted(used):
+                        hit(
+                            node,
+                            f"range() over traced argument '{p}' of jitted '{qual}' "
+                            "— mark it static or use lax.fori_loop",
+                        )
+                elif leaf in _SHAPE_CREATORS and resolved.startswith(("jax.numpy", "numpy")):
+                    pos = _SHAPE_CREATORS[leaf]
+                    shape_arg = node.args[pos] if len(node.args) > pos else None
+                    for kw in node.keywords:
+                        if kw.arg == "shape":
+                            shape_arg = kw.value
+                    if shape_arg is not None:
+                        used = _dynamic_shape_names(shape_arg) & dynamic
+                        for p in sorted(used):
+                            hit(
+                                node,
+                                f"shape of {leaf}() derives from traced argument "
+                                f"'{p}' of jitted '{qual}' — shapes must be static "
+                                "under jit (static_argnums, or pad to a bucket)",
+                            )
+                elif (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in _SHAPE_METHODS
+                    and not resolved.startswith(("jax.", "numpy"))
+                ):
+                    used = set().union(
+                        set(), *(_dynamic_shape_names(a_) for a_ in node.args)
+                    ) & dynamic
+                    for p in sorted(used):
+                        hit(
+                            node,
+                            f".{fn.attr}() shape derives from traced argument '{p}' "
+                            f"of jitted '{qual}' — shapes must be static under jit",
+                        )
+        return findings

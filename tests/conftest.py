@@ -44,6 +44,11 @@ def pytest_configure(config):
         "slow: heavyweight test (subprocess launches, big compiles); "
         "skipped unless RUN_SLOW=1, selectable via -m slow / -m 'not slow'",
     )
+    config.addinivalue_line(
+        "markers",
+        "graftlint: static-analyzer tests (pure AST, no tracing); "
+        "selectable via -m graftlint",
+    )
 
 
 @pytest.fixture(autouse=True)

@@ -65,4 +65,5 @@ def test_canonical_spec_rejects_unknown_axis():
 
     mesh = make_mesh({"dp": len(jax.devices())})
     with pytest.raises(ValueError, match="does not exist in mesh"):
-        canonical_spec(P("tpp"), mesh)
+        # deliberately-bogus axis: the ValueError IS the assertion
+        canonical_spec(P("tpp"), mesh)  # graftlint: disable=axis-name-mismatch

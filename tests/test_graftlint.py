@@ -1,0 +1,446 @@
+"""graftlint: every rule must fire on its bad fixture and stay silent on the
+good twin, suppressions and the baseline must filter, and the CLI must run
+clean over the real package fast enough to live inside `make test`."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from accelerate_tpu.analysis import (
+    get_rules,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+
+pytestmark = pytest.mark.graftlint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GRAFTLINT = os.path.join(REPO, "tools", "graftlint.py")
+
+
+def lint(tmp_path, source, rule=None, name="snippet.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    rules = get_rules([rule]) if rule else None
+    return run_analysis([str(f)], rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# good/bad fixture pairs, one per rule
+# ---------------------------------------------------------------------------
+
+FIXTURES = {
+    "host-sync-in-trace": (
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            y = x.item()          # host transfer inside trace
+            z = np.asarray(x)     # numpy concretization inside trace
+            return float(x)       # python-scalar cast inside trace
+        """,
+        3,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.asarray(x) * 2   # device op: trace-safe
+
+        def report(loss):
+            return float(loss.item())   # eager host code: not traced
+        """,
+    ),
+    "recompile-hazard": (
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def pad(x, n):
+            if n:                       # concretizes the tracer
+                x = x + 1
+            return jnp.zeros((n, 4))    # traced value as a shape
+        """,
+        2,
+        """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def pad(x, n):
+            if n:
+                x = x + 1
+            return jnp.zeros((n, 4))
+        """,
+    ),
+    "axis-name-mismatch": (
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        import numpy as np
+
+        mesh = Mesh(np.array(jax.devices()), ("dp", "tp"))
+
+        def allreduce(x):
+            return jax.lax.psum(x, "batch")      # mesh has no 'batch'
+
+        spec = P("model", None)                  # nor 'model'
+        """,
+        2,
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        import numpy as np
+
+        mesh = Mesh(np.array(jax.devices()), ("dp", "tp"))
+
+        def allreduce(x):
+            return jax.lax.psum(x, ("dp", "tp"))
+
+        spec = P("dp", None)
+        """,
+    ),
+    "donation-reuse": (
+        """
+        import jax
+
+        def f(a):
+            return a + 1
+
+        g = jax.jit(f, donate_argnums=(0,))
+
+        def train(x):
+            y = g(x)
+            return x + y      # x's buffer was donated to g
+        """,
+        1,
+        """
+        import jax
+
+        def f(a):
+            return a + 1
+
+        g = jax.jit(f, donate_argnums=(0,))
+
+        def train(x):
+            x = g(x)          # rebinding the name is the blessed pattern
+            return x
+        """,
+    ),
+    "dtype-widen": (
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def make():
+            jax.config.update("jax_enable_x64", True)
+            return jnp.zeros((4,), dtype=jnp.float64)
+        """,
+        2,
+        """
+        import jax.numpy as jnp
+
+        def make():
+            return jnp.zeros((4,), dtype=jnp.float32)
+        """,
+    ),
+    "blocking-in-hot-loop": (
+        """
+        def train(step, batches):
+            for b in batches:
+                out = step(b)
+                out.block_until_ready()     # drains the dispatch queue
+            return out
+        """,
+        1,
+        """
+        def train(step, batches, profile_every=0):
+            for i, b in enumerate(batches):
+                out = step(b)
+                if profile_every and i % profile_every == 0:
+                    out.block_until_ready()  # profiling guard: allowed
+            out.block_until_ready()          # after the loop: allowed
+            return out
+        """,
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_fires_on_bad_fixture(tmp_path, rule):
+    bad, expected, _ = FIXTURES[rule]
+    res = lint(tmp_path, bad, rule=rule)
+    assert len(res.new_findings) == expected, [f.render() for f in res.new_findings]
+    assert all(f.rule == rule for f in res.new_findings)
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_silent_on_good_twin(tmp_path, rule):
+    _, _, good = FIXTURES[rule]
+    res = lint(tmp_path, good, rule=rule)
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_good_twin_clean_under_all_rules(tmp_path, rule):
+    """The good fixtures must not trip *other* rules either."""
+    _, _, good = FIXTURES[rule]
+    res = lint(tmp_path, good)
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_shape_control_flow_is_trace_static(tmp_path):
+    """`if x.shape[0] > 2:` inside jit is legal (shapes are static at trace
+    time) and must not trip recompile-hazard."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            if x.shape[0] > 2:
+                x = x[:2]
+            return jnp.zeros((x.shape[0], 4))
+        """,
+        rule="recompile-hazard",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_blocking_in_while_test_is_flagged(tmp_path):
+    """A While test re-evaluates every iteration — a blocking call there is
+    a per-step sync, same as in the body."""
+    res = lint(
+        tmp_path,
+        """
+        def converge(state, step):
+            while not state.done.block_until_ready():
+                state = step(state)
+            return state
+        """,
+        rule="blocking-in-hot-loop",
+    )
+    assert len(res.new_findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+def test_same_line_suppression(tmp_path):
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()  # graftlint: disable=host-sync-in-trace
+        """,
+        rule="host-sync-in-trace",
+    )
+    assert res.new_findings == []
+    assert res.suppressed == 1
+
+
+def test_preceding_line_suppression(tmp_path):
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            # graftlint: disable=host-sync-in-trace
+            return x.item()
+        """,
+        rule="host-sync-in-trace",
+    )
+    assert res.new_findings == []
+    assert res.suppressed == 1
+
+
+def test_suppression_is_per_rule(tmp_path):
+    """Disabling one rule must not silence another on the same line."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()  # graftlint: disable=dtype-widen
+        """,
+        rule="host-sync-in-trace",
+    )
+    assert len(res.new_findings) == 1
+
+
+def test_suppression_tolerates_justification_text(tmp_path):
+    """Project policy requires a justification after the rule id — it must
+    not break the rule-name parse."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()  # graftlint: disable=host-sync-in-trace -- demo of policy-mandated justification
+        """,
+        rule="host-sync-in-trace",
+    )
+    assert res.new_findings == []
+    assert res.suppressed == 1
+
+
+def test_docstring_mentioning_syntax_does_not_suppress(tmp_path):
+    """Only real comments suppress; prose in a docstring that documents the
+    syntax must not disable rules for the file."""
+    res = lint(
+        tmp_path,
+        '''
+        """Docs: silence a rule with `# graftlint: disable-file=host-sync-in-trace`."""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+        ''',
+        rule="host-sync-in-trace",
+    )
+    assert len(res.new_findings) == 1
+
+
+def test_file_level_suppression(tmp_path):
+    res = lint(
+        tmp_path,
+        """
+        # graftlint: disable-file=host-sync-in-trace
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+        """,
+        rule="host-sync-in-trace",
+    )
+    assert res.new_findings == []
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def test_baseline_grandfathers_existing_findings(tmp_path):
+    bad, _, _ = FIXTURES["donation-reuse"]
+    f = tmp_path / "legacy.py"
+    f.write_text(textwrap.dedent(bad))
+    first = run_analysis([str(f)])
+    assert first.new_findings
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(first.findings, str(baseline_path))
+    again = run_analysis([str(f)], baseline=load_baseline(str(baseline_path)))
+    assert again.new_findings == []       # baselined
+    assert len(again.findings) == len(first.findings)  # still detected
+
+
+def test_baseline_survives_line_drift_but_not_new_findings(tmp_path):
+    bad, _, _ = FIXTURES["donation-reuse"]
+    f = tmp_path / "legacy.py"
+    f.write_text(textwrap.dedent(bad))
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(run_analysis([str(f)]).findings, str(baseline_path))
+    # unrelated edit above shifts every line; old finding stays baselined,
+    # the fresh violation (a new symbol) is reported
+    f.write_text(
+        "HEADER = 1\n"
+        + textwrap.dedent(bad)
+        + textwrap.dedent(
+            """
+            def train2(x):
+                y = g(x)
+                return x + y
+            """
+        )
+    )
+    res = run_analysis([str(f)], baseline=load_baseline(str(baseline_path)))
+    assert len(res.new_findings) == 1
+    assert res.new_findings[0].symbol == "train2"
+
+
+def test_unknown_rule_id_raises():
+    with pytest.raises(KeyError):
+        get_rules(["not-a-rule"])
+
+
+# ---------------------------------------------------------------------------
+# CLI (subprocess: the exact invocation `make lint` runs)
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, GRAFTLINT, *args],
+        cwd=cwd,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_cli_exits_nonzero_with_findings(tmp_path):
+    bad, _, _ = FIXTURES["blocking-in-hot-loop"]
+    (tmp_path / "bad.py").write_text(textwrap.dedent(bad))
+    proc = _run_cli(str(tmp_path))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "blocking-in-hot-loop" in proc.stdout
+
+
+def test_cli_json_output(tmp_path):
+    bad, _, _ = FIXTURES["dtype-widen"]
+    (tmp_path / "bad.py").write_text(textwrap.dedent(bad))
+    proc = _run_cli(str(tmp_path), "--format", "json")
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert data["files_analyzed"] == 1
+    assert {f["rule"] for f in data["findings"]} == {"dtype-widen"}
+    assert all("fingerprint" in f for f in data["findings"])
+
+
+def test_cli_write_then_use_baseline(tmp_path):
+    bad, _, _ = FIXTURES["donation-reuse"]
+    (tmp_path / "bad.py").write_text(textwrap.dedent(bad))
+    baseline = tmp_path / "baseline.json"
+    assert _run_cli(str(tmp_path), "--write-baseline", str(baseline)).returncode == 0
+    proc = _run_cli(str(tmp_path), "--baseline", str(baseline))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in FIXTURES:
+        assert rule in proc.stdout
+
+
+def test_package_is_clean_and_fast():
+    """Acceptance gate: the real package lints clean, within the <15 s budget
+    that lets `make lint` sit in front of every `make test`."""
+    proc = _run_cli("accelerate_tpu", "--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["findings"] == []
+    assert data["files_analyzed"] > 100
+    assert data["duration_s"] < 15.0, f"analysis took {data['duration_s']}s"

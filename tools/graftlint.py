@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""graftlint — trace-safety & collective-correctness linter for accelerate_tpu.
+
+    python tools/graftlint.py accelerate_tpu/                # human output
+    python tools/graftlint.py accelerate_tpu/ --format json
+    python tools/graftlint.py --list-rules
+    python tools/graftlint.py pkg/ --write-baseline graftlint_baseline.json
+    python tools/graftlint.py pkg/ --baseline graftlint_baseline.json
+
+Exit codes: 0 clean (or all findings baselined), 1 findings, 2 usage/internal
+error.  Rules and suppression syntax: docs/graftlint.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import_analysis():
+    """Import accelerate_tpu.analysis without executing the package __init__
+    (which imports jax and the whole framework, ~3 s); the linter is pure
+    stdlib and must stay fast enough to sit inside `make test`."""
+    sys.path.insert(0, _REPO)
+    if "accelerate_tpu" not in sys.modules:
+        stub = types.ModuleType("accelerate_tpu")
+        stub.__path__ = [os.path.join(_REPO, "accelerate_tpu")]
+        sys.modules["accelerate_tpu"] = stub
+    import accelerate_tpu.analysis as analysis
+
+    return analysis
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories to analyze")
+    parser.add_argument("--format", choices=("human", "json"), default="human")
+    parser.add_argument("--rules", help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--list-rules", action="store_true", help="print rules and exit")
+    parser.add_argument("--baseline", help="JSON allowlist; baselined findings don't fail the run")
+    parser.add_argument(
+        "--write-baseline",
+        metavar="PATH",
+        help="write current findings as the new baseline and exit 0",
+    )
+    args = parser.parse_args(argv)
+
+    analysis = _import_analysis()
+    rules = None
+    if args.rules:
+        try:
+            rules = analysis.get_rules([r.strip() for r in args.rules.split(",") if r.strip()])
+        except KeyError as e:
+            print(f"graftlint: {e.args[0]}", file=sys.stderr)
+            return 2
+    if args.list_rules:
+        for cls in analysis.ALL_RULES:
+            print(f"{cls.id:24s} {cls.description}")
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print("graftlint: no paths given", file=sys.stderr)
+        return 2
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = analysis.load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"graftlint: cannot read baseline {args.baseline}: {e}", file=sys.stderr)
+            return 2
+    try:
+        result = analysis.run_analysis(args.paths, rules=rules, baseline=baseline)
+    except FileNotFoundError as e:
+        print(f"graftlint: no such path: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        analysis.write_baseline(result.findings, args.write_baseline)
+        print(
+            f"graftlint: wrote {len(result.findings)} finding(s) to baseline "
+            f"{args.write_baseline}"
+        )
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        for f in result.new_findings:
+            print(f.render())
+        baselined = len(result.findings) - len(result.new_findings)
+        extra = f", {baselined} baselined" if baselined else ""
+        extra += f", {result.suppressed} suppressed" if result.suppressed else ""
+        print(
+            f"graftlint: {len(result.new_findings)} finding(s) in "
+            f"{result.files_analyzed} file(s) ({result.duration_s:.2f}s{extra})"
+        )
+    return 1 if result.new_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
