@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint bench
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint multichip bench
 
 # graftlint: trace-safety & collective-correctness static analysis
 # (docs/graftlint.md). Runs before the suite — it's a ~3 s AST pass that
@@ -11,7 +11,15 @@
 lint:
 	python tools/graftlint.py accelerate_tpu/
 
-test: lint
+# dp>1 sharded-update proof on a DIFFERENT mesh extent than the default
+# suite (which forces 8 virtual devices): ZeRO-1 numerics/memory/stability
+# at dp=4, so a divisibility or reshard bug that happens to vanish at 8
+# still fails CI (docs/zero1.md)
+multichip:
+	XLA_FLAGS=--xla_force_host_platform_device_count=4 python -m pytest \
+	  tests/test_zero1.py tests/test_zero_sharding.py -q
+
+test: lint multichip
 	python -m pytest tests/ -q
 
 test_core:
@@ -36,6 +44,7 @@ test_models:
 
 test_parallel:
 	python -m pytest tests/test_sharding_plan.py tests/test_zero_sharding.py \
+	  tests/test_zero1.py \
 	  tests/test_pipeline.py tests/test_1f1b.py tests/test_ring_attention.py \
 	  tests/test_flash_attention.py tests/test_sliding_window.py -q
 

@@ -40,6 +40,7 @@ from .state import AcceleratorState, GradientState, PartialState
 from .utils import operations as ops
 from .utils.dataclasses import (
     DataLoaderConfiguration,
+    DataParallelPlugin,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     GradScalerKwargs,
@@ -68,6 +69,7 @@ class Accelerator:
         fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
         tp_plugin: Optional[TensorParallelPlugin] = None,
         sp_plugin: Optional[SequenceParallelPlugin] = None,
+        dp_plugin: Optional[DataParallelPlugin] = None,
         pp_plugin=None,
         parallelism_config: Optional[ParallelismConfig] = None,
         rng_types: Optional[list] = None,
@@ -153,6 +155,7 @@ class Accelerator:
             fsdp_plugin=fsdp_plugin,
             tp_plugin=tp_plugin,
             sp_plugin=sp_plugin,
+            dp_plugin=dp_plugin,
             pp_plugin=pp_plugin,
             _from_accelerator=True,
             **(
@@ -408,9 +411,16 @@ class Accelerator:
             self.state.fsdp_plugin is not None
             and getattr(self.state.fsdp_plugin, "cpu_offload", False)
         )
+        # ZeRO-1 (arXiv:2004.13336): with a dp axis and no fsdp owner the
+        # state relayout below additionally shards masters + moments over dp,
+        # turning the captured update into reduce-scatter → 1/dp-shard-local
+        # AdamW → all-gather with no eager-mode change for users
+        zero1_mesh = self.state.mesh if self.state.zero1_enabled else None
         for opt in self._optimizers:
             opt.optimizer.relayout_for_sharded_params(
-                offload_to_host=offload_opt, offload_params=offload_params
+                offload_to_host=offload_opt,
+                offload_params=offload_params,
+                zero1_mesh=zero1_mesh,
             )
         if offload_params:
             from .hooks import ParamOffloadHook, add_hook_to_module

@@ -21,6 +21,7 @@ from .engine import (
     ModuleInfo,
     Rule,
     load_baseline,
+    load_ckpt_specs,
     run_analysis,
     write_baseline,
 )
@@ -34,6 +35,7 @@ __all__ = [
     "Rule",
     "get_rules",
     "load_baseline",
+    "load_ckpt_specs",
     "run_analysis",
     "write_baseline",
 ]

@@ -15,7 +15,7 @@ import os
 import re
 import time
 import tokenize
-from typing import Iterable, Optional, Sequence
+from typing import Iterable, Optional, Sequence, Union
 
 # Suppression comment grammar (the leading hash is spelled \x23 here so this
 # very comment can't register itself): "\x23 graftlint: disable=rule-a,rule-b"
@@ -200,6 +200,9 @@ class AnalysisContext:
     axis_universe: set[str] = dataclasses.field(default_factory=set)
     axis_sources: dict[str, str] = dataclasses.field(default_factory=dict)
     modules: list[ModuleInfo] = dataclasses.field(default_factory=list)
+    # tensor → recorded PartitionSpec (JSON form) from a checkpoint
+    # index.json, when the caller passed one (sharding-spec-drift input)
+    ckpt_specs: dict[str, list] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -342,10 +345,39 @@ def write_baseline(findings: Sequence[Finding], path: str) -> None:
 # runner
 # ---------------------------------------------------------------------------
 
+def load_ckpt_specs(path: str) -> dict[str, list]:
+    """Recorded {tensor: PartitionSpec-as-JSON} from a sharded checkpoint.
+
+    ``path`` may be one ``*.index.json`` file or a checkpoint directory, in
+    which case every ``*.index.json`` inside contributes.  Tensors whose
+    entry predates the spec record (older checkpoints) are skipped.
+    """
+    index_files = []
+    if os.path.isdir(path):
+        index_files = [
+            os.path.join(path, f)
+            for f in sorted(os.listdir(path))
+            if f.endswith(".index.json")
+        ]
+        if not index_files:
+            raise FileNotFoundError(f"no *.index.json files under {path}")
+    else:
+        index_files = [path]
+    specs: dict[str, list] = {}
+    for f in index_files:
+        with open(f, encoding="utf-8") as fh:
+            data = json.load(fh)
+        for tensor, entry in data.get("tensors", {}).items():
+            if isinstance(entry, dict) and "spec" in entry:
+                specs[tensor] = entry["spec"]
+    return specs
+
+
 def run_analysis(
     paths: Sequence[str],
     rules: Optional[Sequence[Rule]] = None,
     baseline: Optional[set[str]] = None,
+    ckpt_index: Optional[Union[str, dict]] = None,
 ) -> AnalysisResult:
     if rules is None:
         from .rules import ALL_RULES
@@ -355,6 +387,14 @@ def run_analysis(
     files = discover_files(paths)
     cwd = os.getcwd()
     ctx = AnalysisContext()
+    if ckpt_index:
+        # a dict is an already-loaded {tensor: spec} mapping (the CLI
+        # validates + loads once and hands it over); a str is a path
+        ctx.ckpt_specs = (
+            dict(ckpt_index)
+            if isinstance(ckpt_index, dict)
+            else load_ckpt_specs(ckpt_index)
+        )
     findings: list[Finding] = []
     suppressed = 0
     modules: list[ModuleInfo] = []

@@ -6,6 +6,7 @@ from .donation import DonationReuse
 from .dtype_widen import DtypeWiden
 from .host_sync import HostSyncInTrace
 from .recompile import RecompileHazard
+from .spec_drift import ShardingSpecDrift
 
 ALL_RULES = [
     HostSyncInTrace,
@@ -14,6 +15,7 @@ ALL_RULES = [
     DonationReuse,
     DtypeWiden,
     BlockingInHotLoop,
+    ShardingSpecDrift,
 ]
 
 

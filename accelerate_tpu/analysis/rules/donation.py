@@ -7,8 +7,12 @@ tracks, per function body and in execution order, names passed at donated
 positions of a known donating callable; any later read before a rebind is
 flagged.
 
-Known limitation (documented in docs/graftlint.md): the scan is linear, so a
-use-after-donate that only manifests across loop iterations is not seen.
+Loop bodies get a second pass: a read that *precedes* the donation in source
+order is fine on iteration 1 but reads a dead buffer on iteration 2 unless
+the name was rebound in between — the scanner visits each loop body twice
+(with the loop-carried donation state) and deduplicates against the linear
+findings, so straight-line reuse is reported once and loop-carried reuse is
+caught at all.
 """
 
 from __future__ import annotations
@@ -123,6 +127,32 @@ class _LinearScanner(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
     visit_ClassDef = visit_FunctionDef
 
+    # -- loop bodies: second pass ------------------------------------------
+    # A read BEFORE the donation in source order is fine on iteration 1 but
+    # reads freed memory on iteration 2 unless the name was rebound; walking
+    # the body twice with the carried `dead` state is exactly iteration-2
+    # semantics.  Duplicate straight-line findings (same line, re-reported by
+    # the second pass) are dropped in DonationReuse.check.
+    def visit_For(self, node):
+        self.visit(node.iter)
+        self.visit(node.target)
+        for _ in range(2):
+            for stmt in node.body:
+                self.visit(stmt)
+            self.visit(node.target)  # re-bound from the iterator each pass
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node):
+        for _ in range(2):
+            self.visit(node.test)
+            for stmt in node.body:
+                self.visit(stmt)
+        for stmt in node.orelse:
+            self.visit(stmt)
+
     def _use(self, node, name):
         if name in self.dead:
             donor, _line = self.dead.pop(name)  # report once per donation
@@ -162,4 +192,12 @@ class DonationReuse(Rule):
             if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
                 scanner.visit(stmt)
         findings.extend(scanner.findings)
-        return findings
+        # the loop second pass re-reports straight-line reuse at the same
+        # location; keep the first occurrence only
+        seen: set = set()
+        unique = []
+        for f in findings:
+            if f not in seen:
+                seen.add(f)
+                unique.append(f)
+        return unique

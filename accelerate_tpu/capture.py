@@ -18,6 +18,7 @@ compiled program, so LR schedules work across replays without recompiles.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Any, Callable, Optional
 
 import jax
@@ -94,11 +95,39 @@ def _unwrap_tree(tree):
 
 
 def _is_offloaded(x) -> bool:
-    """True when the array lives outside default device memory (host-offloaded
-    optimizer state / params) — the single predicate behind both the
-    layout-pin and the donation split below."""
+    """True when the array lives outside TPU device memory (host-offloaded
+    optimizer state / params) — the predicate behind the donation split
+    below.  On the CPU backend every array reports ``unpinned_host``, so CPU
+    runs donate nothing; that matches historical behavior and keeps eager
+    references valid in the virtual-mesh test suite."""
     s = getattr(x, "sharding", None)
     return getattr(s, "memory_kind", None) not in (None, "device")
+
+
+_DEFAULT_MEMORY_KIND: Optional[str] = None
+
+
+def _default_memory_kind() -> str:
+    global _DEFAULT_MEMORY_KIND
+    if _DEFAULT_MEMORY_KIND is None:
+        try:
+            _DEFAULT_MEMORY_KIND = jax.devices()[0].default_memory().kind
+        except Exception:
+            _DEFAULT_MEMORY_KIND = "device"
+    return _DEFAULT_MEMORY_KIND
+
+
+def _nondefault_memory(x) -> bool:
+    """True only for genuinely offloaded leaves (pinned_host on TPU *or*
+    CPU).  Unlike ``_is_offloaded`` this compares against the backend's
+    default memory kind — the CPU backend's default is ``unpinned_host``,
+    and treating that as "offloaded" would disable the layout pin exactly
+    where the virtual-mesh tests need it (a ZeRO-1 state-sharded program
+    would then drift its unpinned grad outputs to the dp layout and
+    silently re-trace on call 2)."""
+    s = getattr(x, "sharding", None)
+    kind = getattr(s, "memory_kind", None)
+    return kind is not None and kind not in ("device", _default_memory_kind())
 
 
 def _zeros_like_on_device(x):
@@ -120,6 +149,11 @@ class CapturedStep:
         self.accelerator = accelerator
         self.fn = fn
         self._cache: dict = {}
+        # host-side argument-assembly accounting (collect/flatten/key/split
+        # before each dispatch): replay calls only — trace/compile calls are
+        # excluded so bench.py can report steady-state host overhead per step
+        self.host_assembly_ms_total = 0.0
+        self.host_assembly_calls = 0
         # None until the first trace reveals whether the body contains
         # `with accelerator.accumulate(...):`; True → __call__ advances the
         # accumulation schedule host-side before each replay
@@ -183,6 +217,7 @@ class CapturedStep:
 
     # -- call ----------------------------------------------------------------
     def __call__(self, *args):
+        t_call = _time.perf_counter()
         acc = self.accelerator
         if self._uses_accumulate:
             # body contains `with accelerator.accumulate(...)`: advance the
@@ -211,11 +246,15 @@ class CapturedStep:
             # objects prepared): rebuild, exactly where plain jit would
             # silently re-trace
             entry = None
-        if entry is None:
+        built = entry is None
+        if built:
             entry = self._build(key, state, args)
         jitted, ctx, _, host_mask = entry
         dev_leaves = tuple(x for x, h in zip(flat_state, host_mask) if not h)
         host_leaves = tuple(x for x, h in zip(flat_state, host_mask) if h)
+        if not built:
+            self.host_assembly_ms_total += (_time.perf_counter() - t_call) * 1e3
+            self.host_assembly_calls += 1
         new_state, out = jitted(dev_leaves, host_leaves, *flat_args)
         self._writeback(new_state)
         if self._uses_accumulate is None:
@@ -267,7 +306,7 @@ class CapturedStep:
             s = getattr(x, "sharding", None)
             if not isinstance(s, jax.sharding.NamedSharding):
                 return _NOPIN
-            if _is_offloaded(x):
+            if _nondefault_memory(x):
                 # host-offloaded leaves: with_sharding_constraint cannot pin
                 # a non-default memory space on every backend — their
                 # placement is re-established eagerly after each replay

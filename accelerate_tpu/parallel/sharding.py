@@ -58,6 +58,43 @@ def canonical_spec(spec: P, mesh: Mesh) -> P:
     return P(*out)
 
 
+def zero1_state_spec(shape: tuple, mesh: Mesh, param_spec: Optional[P] = None) -> P:
+    """PartitionSpec for ZeRO-1 optimizer state (fp32 masters + moments).
+
+    "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+    Training" (arXiv:2004.13336) as a layout decision: the state leaf keeps
+    its parameter's spec and additionally shards the largest still-free axis
+    over ``dp`` when divisible.  GSPMD then lowers the captured update to
+    reduce-scatter → shard-local AdamW → all-gather.  Tiny/indivisible
+    params fall back to the param layout (replicated under pure DP), and
+    ``canonical_spec`` guarantees a dp:1 mesh yields the axis-free spec so
+    the capture cache key cannot drift into a recompile.
+    """
+    spec = list(param_spec) if param_spec is not None else []
+    spec += [None] * (len(shape) - len(spec))
+    dp_size = mesh.shape.get("dp", 1)
+    used: set = set()
+    for entry in spec:
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        elif entry is not None:
+            used.add(entry)
+    if dp_size > 1 and "dp" not in used:
+        order = sorted(range(len(shape)), key=lambda i: -shape[i])
+        for axis in order:
+            if spec[axis] is None and shape[axis] % dp_size == 0 and shape[axis] >= dp_size:
+                spec[axis] = "dp"
+                break
+    return canonical_spec(P(*spec), mesh)
+
+
+def spec_to_jsonable(spec: P) -> list:
+    """PartitionSpec → JSON-ready list (str | [str, ...] | None per dim) —
+    the form recorded in checkpoint index.json metadata and consumed by
+    graftlint's sharding-spec-drift rule."""
+    return [list(e) if isinstance(e, (tuple, list)) else e for e in spec]
+
+
 def plan_param_spec(
     name: str,
     shape: tuple,

@@ -362,6 +362,7 @@ class AcceleratorState:
         sp_plugin=None,
         pp_plugin=None,
         ep_plugin=None,
+        dp_plugin=None,
         _from_accelerator: bool = False,
         **kwargs,
     ):
@@ -385,6 +386,7 @@ class AcceleratorState:
                 ("sp_plugin", sp_plugin),
                 ("pp_plugin", pp_plugin),
                 ("ep_plugin", ep_plugin),
+                ("dp_plugin", dp_plugin),
             ):
                 if new is not None and new != getattr(self, name):
                     conflicts.append(name)
@@ -413,6 +415,13 @@ class AcceleratorState:
         self.sp_plugin = sp_plugin
         self.pp_plugin = pp_plugin
         self.ep_plugin = ep_plugin
+        if dp_plugin is None and "ACCELERATE_ZERO1" in os.environ:
+            # launcher↔child env protocol: a bare ACCELERATE_ZERO1 resolves
+            # to a plugin even when the script never constructs one
+            from .utils.dataclasses import DataParallelPlugin
+
+            dp_plugin = DataParallelPlugin()
+        self.dp_plugin = dp_plugin
 
         if parallelism_config is None:
             parallelism_config = ParallelismConfig.from_env()
@@ -477,6 +486,27 @@ class AcceleratorState:
     @property
     def use_fsdp(self) -> bool:
         return self.parallelism_config.fsdp_size > 1 or self.fsdp_plugin is not None
+
+    @property
+    def zero1_enabled(self) -> bool:
+        """Cross-replica sharded weight update (ZeRO-1) over the dp axis.
+
+        Resolution order: an explicit ``DataParallelPlugin.zero1`` wins;
+        otherwise automatic — on for dp > 1 unless an fsdp axis already owns
+        the params (FULL_SHARD/HYBRID_SHARD relayouts state onto the param
+        shards, so dp-sharding it again buys nothing by default).
+        """
+        if not self.initialized or self.mesh.shape.get("dp", 1) <= 1:
+            return False
+        plugin = self.__dict__.get("dp_plugin")
+        if plugin is not None and plugin.zero1 is not None:
+            return bool(plugin.zero1)
+        if self.mesh.shape.get("fsdp", 1) > 1 and (
+            self.fsdp_plugin is None
+            or self.fsdp_plugin.sharding_strategy in ("FULL_SHARD", "HYBRID_SHARD")
+        ):
+            return False
+        return True
 
     @property
     def use_tp(self) -> bool:

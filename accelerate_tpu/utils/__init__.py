@@ -14,6 +14,7 @@ from .dataclasses import (
     BaseEnum,
     ComputeBackend,
     DataLoaderConfiguration,
+    DataParallelPlugin,
     DistributedDataParallelKwargs,
     DistributedType,
     ExpertParallelPlugin,
@@ -81,6 +82,7 @@ from .memory import (
     clear_device_cache,
     find_executable_batch_size,
     get_device_memory_stats,
+    opt_state_bytes_per_replica,
     release_memory,
     should_reduce_batch_size,
 )

@@ -324,6 +324,29 @@ class FullyShardedDataParallelPlugin:
 
 
 @dataclass
+class DataParallelPlugin:
+    """Knobs for the plain ``dp`` mesh axis.
+
+    ``zero1`` shards the *weight update* cross-replica (ZeRO-1,
+    arXiv:2004.13336): fp32 masters and optax moments get a NamedSharding
+    over the dp axis, so GSPMD lowers the captured step to reduce-scatter →
+    shard-local update → all-gather inside the one XLA program.  Per-replica
+    optimizer-state HBM drops to ~1/dp and the update math is deduplicated;
+    params, grads and the user-visible API are untouched.
+
+    ``None`` (default) = automatic: on whenever dp > 1 and no ``fsdp`` axis
+    already owns the params (FULL_SHARD/HYBRID_SHARD state follows the
+    params, making ZeRO-1 redundant there).  Env: ACCELERATE_ZERO1.
+    """
+
+    zero1: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.zero1 is None and "ACCELERATE_ZERO1" in os.environ:
+            self.zero1 = bool(str_to_bool(os.environ["ACCELERATE_ZERO1"]))
+
+
+@dataclass
 class TensorParallelPlugin:
     """Tensor parallelism on the ``tp`` mesh axis.
 

@@ -143,10 +143,16 @@ def collect_sharded_model_state(
     local_arrays: dict[str, np.ndarray] = {}
     index: dict[str, Any] = {"metadata": {"num_shards": world}, "tensors": {}}
     for tensor_name, value in state_dict.items():
+        spec = None
         if isinstance(value, jax.Array) and hasattr(value, "addressable_shards"):
             shards = _unique_shard_bounds(value)
             shape = [int(d) for d in value.shape]
             dtype = _dtype_str(np.asarray(shards[0][1]).dtype)
+            s = getattr(value, "sharding", None)
+            if isinstance(s, jax.sharding.NamedSharding):
+                from ..parallel.sharding import spec_to_jsonable
+
+                spec = spec_to_jsonable(s.spec)
         else:
             arr = np.asarray(value)
             shards = [([(0, int(d)) for d in arr.shape], arr)]
@@ -154,7 +160,14 @@ def collect_sharded_model_state(
             dtype = _dtype_str(arr.dtype)
         for bounds, data in shards:
             local_arrays[_slice_key(tensor_name, bounds)] = _bf16_to_view(data)
-        index["tensors"][tensor_name] = {"shape": shape, "dtype": dtype}
+        entry: dict[str, Any] = {"shape": shape, "dtype": dtype}
+        if spec is not None:
+            # save-time PartitionSpec: restore reshards by slice bounds
+            # regardless, but the record lets tooling (graftlint
+            # sharding-spec-drift) catch a plan edit that silently disagrees
+            # with how this checkpoint was laid out
+            entry["spec"] = spec
+        index["tensors"][tensor_name] = entry
     return _shard_file(name, rank, world), local_arrays, index
 
 

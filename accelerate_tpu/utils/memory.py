@@ -83,6 +83,30 @@ def get_device_memory_stats(device: Optional[jax.Device] = None) -> dict:
     return stats
 
 
+def opt_state_bytes_per_replica(optimizer) -> int:
+    """Bytes of optimizer state (optax moments + fp32 masters) resident on
+    ONE device — the number ZeRO-1/FSDP state sharding shrinks by ~1/dp.
+
+    Accepts an ``optim.Optimizer`` or an ``AcceleratedOptimizer`` wrapper.
+    Per-device residency is the first addressable shard's bytes per leaf
+    (replicated leaves report full size, dp/fsdp-sharded leaves 1/axis);
+    0-d leaves (step counters, hyperparams) are skipped as noise.
+    """
+    inner = getattr(optimizer, "optimizer", optimizer)
+    leaves = list(jax.tree_util.tree_leaves(inner.opt_state))
+    leaves += [m for m in getattr(inner, "master_params", []) if m is not None]
+    total = 0
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array) and leaf.ndim >= 1:
+            # addressable_shards works on multi-host (non-fully-addressable)
+            # global arrays too — each host sees its own shards, and shard 0
+            # is one replica's residency either way
+            shards = leaf.addressable_shards
+            if shards:
+                total += shards[0].data.nbytes
+    return total
+
+
 def find_executable_batch_size(
     function: Optional[Callable] = None,
     starting_batch_size: int = 128,

@@ -444,3 +444,167 @@ def test_package_is_clean_and_fast():
     assert data["findings"] == []
     assert data["files_analyzed"] > 100
     assert data["duration_s"] < 15.0, f"analysis took {data['duration_s']}s"
+
+
+# ---------------------------------------------------------------------------
+# donation-reuse: loop second pass (use-after-donate across iterations)
+# ---------------------------------------------------------------------------
+
+LOOP_DONATION_BAD = """
+import jax
+
+step = jax.jit(lambda s: s * 2, donate_argnums=(0,))
+
+def train(state, batches):
+    for batch in batches:
+        report(state)        # fine on iteration 1, dead buffer on iteration 2
+        out = step(state)    # donates `state` without rebinding it
+    return out
+"""
+
+LOOP_DONATION_GOOD = """
+import jax
+
+step = jax.jit(lambda s: s * 2, donate_argnums=(0,))
+
+def train(state, batches):
+    for batch in batches:
+        report(state)        # rebind below makes iteration 2 read live data
+        state = step(state)
+    return state
+"""
+
+LOOP_DONATION_WHILE_BAD = """
+import jax
+
+step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+def train(state):
+    while state_norm(state) > 1.0:   # the TEST reads the donated buffer too
+        _ = step(state)
+    return None
+"""
+
+
+def test_donation_loop_carried_reuse_is_flagged(tmp_path):
+    res = lint(tmp_path, LOOP_DONATION_BAD, rule="donation-reuse")
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert "state" in res.new_findings[0].message
+
+
+def test_donation_loop_rebind_is_clean(tmp_path):
+    res = lint(tmp_path, LOOP_DONATION_GOOD, rule="donation-reuse")
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_donation_while_test_reuse_is_flagged(tmp_path):
+    res = lint(tmp_path, LOOP_DONATION_WHILE_BAD, rule="donation-reuse")
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+
+
+def test_donation_straight_line_in_loop_reported_once(tmp_path):
+    """The second pass must not duplicate findings the linear scan already
+    reported."""
+    src = """
+    import jax
+
+    step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+    def train(state, batches):
+        for batch in batches:
+            out = step(state)
+            loss = state.sum()   # straight-line use-after-donate
+            state = out
+    """
+    res = lint(tmp_path, src, rule="donation-reuse")
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+
+
+# ---------------------------------------------------------------------------
+# sharding-spec-drift (needs a checkpoint index to compare against)
+# ---------------------------------------------------------------------------
+
+PLAN_SNIPPET = """
+class Model:
+    tp_plan = {
+        ".*q_proj.weight": ("tp", None),
+        ".*mlp.weight": (None, "tp"),
+    }
+"""
+
+
+def _write_index(tmp_path, specs, name="model"):
+    index = {
+        "metadata": {"num_shards": 1},
+        "tensors": {
+            tensor: {"shape": [8, 8], "dtype": "float32", "spec": spec}
+            for tensor, spec in specs.items()
+        },
+    }
+    path = tmp_path / f"{name}.index.json"
+    path.write_text(json.dumps(index))
+    return str(path)
+
+
+def _lint_with_index(tmp_path, source, index_path):
+    f = tmp_path / "plan.py"
+    f.write_text(textwrap.dedent(source))
+    return run_analysis(
+        [str(f)], rules=get_rules(["sharding-spec-drift"]), ckpt_index=index_path
+    )
+
+
+def test_spec_drift_flags_plan_edit(tmp_path):
+    # checkpoint was saved with q_proj sharded ("tp", None); the plan now
+    # says (None, "tp") — same axes, different dim: silent step-one reshard
+    index = _write_index(
+        tmp_path,
+        {"layers.0.q_proj.weight": [None, "tp"], "layers.0.mlp.weight": [None, "tp"]},
+    )
+    res = _lint_with_index(tmp_path, PLAN_SNIPPET, index)
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    f = res.new_findings[0]
+    assert f.rule == "sharding-spec-drift"
+    assert "q_proj" in f.message
+
+
+def test_spec_drift_silent_when_plan_matches(tmp_path):
+    index = _write_index(
+        tmp_path,
+        {"layers.0.q_proj.weight": ["tp"], "layers.0.mlp.weight": [None, "tp"]},
+    )
+    res = _lint_with_index(tmp_path, PLAN_SNIPPET, index)
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_spec_drift_ignores_replicated_record(tmp_path):
+    """A fully-replicated record proves nothing (a tp:1 mesh canonicalizes
+    every template away) — no finding."""
+    index = _write_index(tmp_path, {"layers.0.q_proj.weight": []})
+    res = _lint_with_index(tmp_path, PLAN_SNIPPET, index)
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_spec_drift_inert_without_index(tmp_path):
+    res = lint(tmp_path, PLAN_SNIPPET, rule="sharding-spec-drift")
+    assert res.new_findings == []
+
+
+def test_spec_drift_cli_ckpt_index(tmp_path):
+    index = _write_index(tmp_path, {"layers.0.q_proj.weight": [None, "tp"]})
+    (tmp_path / "plan.py").write_text(textwrap.dedent(PLAN_SNIPPET))
+    proc = _run_cli(str(tmp_path / "plan.py"), "--ckpt-index", index)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "sharding-spec-drift" in proc.stdout
+    # same invocation minus the index: clean
+    proc = _run_cli(str(tmp_path / "plan.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_spec_drift_ignores_auto_added_fsdp_axis(tmp_path):
+    """plan_param_spec layers "fsdp" onto a template-free dim on fsdp>1
+    meshes; a recorded fsdp the template never mentioned is auto-sharding,
+    not drift (false-positive regression from review)."""
+    index = _write_index(tmp_path, {"layers.0.q_proj.weight": ["tp", "fsdp"]})
+    res = _lint_with_index(tmp_path, PLAN_SNIPPET, index)
+    assert res.new_findings == [], [f.render() for f in res.new_findings]

@@ -1,0 +1,209 @@
+"""ZeRO-1 cross-replica sharded weight update (arXiv:2004.13336).
+
+The contract under test: with a dp mesh axis and no fsdp owner, fp32
+masters + optax moments live dp-sharded (NamedSharding over the largest
+divisible axis), the captured step runs reduce-scatter → shard-local
+update → all-gather inside ONE XLA program, and nothing else changes —
+losses match the replicated update to float tolerance, per-replica
+optimizer-state bytes drop ~1/dp, and no recompiles happen across replays.
+
+Runs on any virtual CPU mesh size: the default tier-1 suite forces 8
+devices (tests/conftest.py) and `make multichip` re-runs this file at 4
+(XLA_FLAGS=--xla_force_host_platform_device_count=4), so both dp extents
+exercise the same assertions.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator, DataParallelPlugin
+from accelerate_tpu.data_loader import batch_to_global_array
+from accelerate_tpu.nn import F
+from accelerate_tpu.utils.memory import opt_state_bytes_per_replica
+
+DIM = 64  # divides both multichip extents (4 and 8) exactly
+ODD = 6  # divides neither: the per-param replicated fallback path
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    yield
+    Accelerator._reset_state()
+
+
+def _build(zero1, precision="bf16", dim=DIM):
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        mixed_precision=precision, dp_plugin=DataParallelPlugin(zero1=zero1)
+    )
+    model = nn.Sequential(nn.Linear(dim, dim), nn.ReLU(), nn.Linear(dim, dim))
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(x, y):
+        opt.zero_grad()
+        pred = model(x)
+        loss = F.mse_loss(pred, y)
+        acc.backward(loss)
+        opt.step()
+        return loss
+
+    return acc, model, opt, acc.compile_step(step_fn)
+
+
+def _batches(acc, n=2, dim=DIM):
+    rng = np.random.default_rng(0)
+
+    def mk():
+        return batch_to_global_array(
+            jnp.asarray(rng.normal(size=(8, dim)).astype(np.float32)), mesh=acc.mesh
+        )
+
+    return [(mk(), mk()) for _ in range(n)]
+
+
+def _losses(step, batches, steps):
+    return [float(step(*batches[i % len(batches)])) for i in range(steps)]
+
+
+def test_zero1_defaults_on_for_dp_and_shards_state():
+    acc, model, opt, _ = _build(zero1=None)
+    dp = acc.mesh.shape["dp"]
+    assert dp > 1, "suite requires a multi-device virtual mesh"
+    assert acc.state.zero1_enabled
+    inner = opt.optimizer
+    for p, m in zip(inner.param_list, inner.master_params):
+        assert m is not None  # bf16 params ⇒ fp32 masters
+        assert "dp" in str(m.sharding.spec), f"master not dp-sharded: {m.sharding.spec}"
+        # params themselves stay on their own (replicated) layout
+        assert p.data.sharding.spec == jax.sharding.PartitionSpec()
+
+
+@pytest.mark.parametrize("precision", ["bf16", "no"])
+def test_sharded_update_losses_match_replicated(precision):
+    """Acceptance: sharded vs replicated update agree to 1e-6 over 10 steps
+    (bitwise on this CPU mesh — the update math is elementwise-identical,
+    just partitioned)."""
+    acc_on, _, _, step_on = _build(zero1=True, precision=precision)
+    on = _losses(step_on, _batches(acc_on), 10)
+
+    acc_off, _, _, step_off = _build(zero1=False, precision=precision)
+    off = _losses(step_off, _batches(acc_off), 10)
+
+    diffs = [abs(a - b) for a, b in zip(on, off)]
+    assert max(diffs) <= 1e-6, f"loss divergence {diffs}"
+
+
+def test_opt_state_bytes_shrink_about_one_over_dp():
+    acc, _, opt_on, step = _build(zero1=True)
+    dp = acc.mesh.shape["dp"]
+    _losses(step, _batches(acc), 2)  # bytes must hold AFTER captured steps
+    sharded = opt_state_bytes_per_replica(opt_on)
+
+    acc_off, _, opt_off, step_off = _build(zero1=False)
+    _losses(step_off, _batches(acc_off), 2)
+    repl = opt_state_bytes_per_replica(opt_off)
+
+    assert sharded <= repl / dp + 4096, (
+        f"opt state not ZeRO-1 sharded: {sharded}B/replica vs {repl}B "
+        f"replicated (expected ~{repl // dp}B)"
+    )
+    if dp >= 4:
+        assert sharded <= 0.35 * repl  # the ISSUE acceptance bound
+
+
+def test_no_recompile_across_replays():
+    acc, _, _, step = _build(zero1=True)
+    batches = _batches(acc)
+    _losses(step, batches, 10)
+    assert len(step._cache) == 1, "captured-step cache grew across replays"
+    (entry,) = step._cache.values()
+    assert entry[0]._cache_size() == 1, (
+        "inner jit re-traced: carried-state sharding drifted between replays"
+    )
+
+
+def test_indivisible_params_fall_back_to_replicated():
+    acc, _, opt, step = _build(zero1=True, dim=ODD)
+    assert ODD % acc.mesh.shape["dp"] != 0
+    inner = opt.optimizer
+    for m in inner.master_params:
+        assert m.sharding.spec == jax.sharding.PartitionSpec()
+    # and the step still runs + replays without recompiling
+    _losses(step, _batches(acc, dim=ODD), 3)
+    (entry,) = step._cache.values()
+    assert entry[0]._cache_size() == 1
+
+
+def test_sharded_checkpoint_records_specs_and_reshards(tmp_path):
+    """Save under ZeRO-1 (dp-sharded state) → restore into a replicated-
+    update run: the loader reshards by global bounds and training continues
+    on the exact numbers; index.json carries the save-time PartitionSpecs."""
+    import json
+
+    acc, model, opt, step = _build(zero1=True)
+    batches = _batches(acc)
+    _losses(step, batches, 3)
+    ckpt = str(tmp_path / "ckpt")
+    acc.save_state(ckpt, sharded_state=True)
+
+    with open(os.path.join(ckpt, "optimizer.index.json")) as f:
+        index = json.load(f)
+    specs = [e.get("spec") for e in index["tensors"].values()]
+    assert any(s and "dp" in str(s) for s in specs), (
+        f"optimizer index.json records no dp-sharded spec: {specs}"
+    )
+    import pickle
+
+    with open(os.path.join(ckpt, "optimizer.meta.bin"), "rb") as f:
+        meta = pickle.load(f)
+    assert any("dp" in str(v) for v in meta["partition_specs"].values())
+
+    # continue the reference run, and a restored zero1=off run, in lockstep
+    ref = _losses(step, batches, 2)
+    acc2, model2, opt2, step2 = _build(zero1=False)
+    acc2.load_state(ckpt)
+    restored = _losses(step2, _batches(acc2), 2)
+    diffs = [abs(a - b) for a, b in zip(ref, restored)]
+    assert max(diffs) <= 1e-6, f"restored run diverged: {diffs}"
+    # the replicated run's state really is replicated after the reshard
+    for leaf in jax.tree_util.tree_leaves(opt2.optimizer.opt_state):
+        if isinstance(leaf, jax.Array) and leaf.ndim >= 1:
+            assert leaf.sharding.spec == jax.sharding.PartitionSpec()
+
+
+def test_pickle_checkpoint_restores_onto_zero1_layout(tmp_path):
+    """The full-array (pickle) optimizer checkpoint must come back COMMITTED
+    to this run's dp-sharded layout — an uncommitted host array would flip
+    the next captured call's placement into a silent re-trace."""
+    acc, model, opt, step = _build(zero1=True)
+    batches = _batches(acc)
+    _losses(step, batches, 3)
+    ckpt = str(tmp_path / "ckpt")
+    acc.save_state(ckpt, sharded_state=False)
+    ref = _losses(step, batches, 2)
+
+    acc2, model2, opt2, step2 = _build(zero1=True)
+    acc2.load_state(ckpt)
+    for m in opt2.optimizer.master_params:
+        assert "dp" in str(m.sharding.spec), f"master lost dp layout: {m.sharding.spec}"
+    restored = _losses(step2, _batches(acc2), 2)
+    diffs = [abs(a - b) for a, b in zip(ref, restored)]
+    assert max(diffs) <= 1e-6, f"restored run diverged: {diffs}"
+    (entry,) = step2._cache.values()
+    assert entry[0]._cache_size() == 1, "restore forced a re-trace"
+
+
+def test_explicit_opt_out_keeps_replicated_state():
+    _, _, opt, _ = _build(zero1=False)
+    for m in opt.optimizer.master_params:
+        assert m.sharding.spec == jax.sharding.PartitionSpec()

@@ -26,23 +26,29 @@ def _fresh():
     Accelerator._reset_state()
 
 
-def _per_device_opt_bytes(opt: optim.Optimizer) -> int:
-    """Bytes of optimizer state (moments + fp32 masters) on ONE device."""
-    total = 0
-    leaves = jax.tree_util.tree_leaves(opt.opt_state)
-    leaves += [m for m in opt.master_params if m is not None]
-    for leaf in leaves:
-        if isinstance(leaf, jax.Array) and leaf.ndim >= 1:
-            total += leaf.addressable_shards[0].data.nbytes
-    return total
+# single source of truth for per-replica residency accounting (also used by
+# tests/test_zero1.py and bench.py)
+from accelerate_tpu.utils.memory import opt_state_bytes_per_replica as _per_device_opt_bytes  # noqa: E402
+
+
+def _n_dev() -> int:
+    # device-count agnostic: the default suite forces 8 virtual devices,
+    # `make multichip` re-runs this file at 4
+    return len(jax.devices())
 
 
 def _build(fsdp_size: int):
+    from accelerate_tpu import DataParallelPlugin
+
     Accelerator._reset_state()
     nn.manual_seed(0)
     acc = Accelerator(
         parallelism_config=ParallelismConfig(fsdp_size=fsdp_size),
         mixed_precision="bf16",
+        # fsdp_size=1 leaves a dp axis, and ZeRO-1 defaults ON there
+        # (tests/test_zero1.py) — opt out so this file keeps measuring the
+        # fsdp axis against a genuinely replicated baseline
+        dp_plugin=DataParallelPlugin(zero1=False),
     )
     model = nn.Sequential(nn.Linear(256, 256), nn.ReLU(), nn.Linear(256, 256))
     opt = optim.AdamW(model.parameters(), lr=1e-3)
@@ -54,19 +60,21 @@ def test_opt_state_bytes_shrink_with_fsdp_size():
     _, _, opt_repl = _build(fsdp_size=1)
     repl_bytes = _per_device_opt_bytes(opt_repl.optimizer)
 
-    _, _, opt_sharded = _build(fsdp_size=8)
+    n = _n_dev()
+    _, _, opt_sharded = _build(fsdp_size=n)
     sharded_bytes = _per_device_opt_bytes(opt_sharded.optimizer)
 
-    # every param axis here (256, 256) and bias (256) divides 8 exactly, so
-    # per-device optimizer bytes must be total/8 (tiny scalar counts aside)
-    assert sharded_bytes <= repl_bytes / 8 + 4096, (
+    # every param axis here (256, 256) and bias (256) divides the device
+    # count exactly, so per-device optimizer bytes must be total/n (tiny
+    # scalar counts aside)
+    assert sharded_bytes <= repl_bytes / n + 4096, (
         f"optimizer state not ZeRO-sharded: {sharded_bytes}B per device vs "
-        f"{repl_bytes}B replicated (expected ~{repl_bytes // 8}B)"
+        f"{repl_bytes}B replicated (expected ~{repl_bytes // n}B)"
     )
 
 
 def test_masters_follow_param_sharding():
-    acc, model, opt = _build(fsdp_size=8)
+    acc, model, opt = _build(fsdp_size=_n_dev())
     inner = opt.optimizer
     for p, m in zip(inner.param_list, inner.master_params):
         assert m is not None  # bf16 params ⇒ fp32 masters exist
@@ -76,7 +84,7 @@ def test_masters_follow_param_sharding():
 
 
 def test_opt_state_sharded_after_steps():
-    acc, model, opt = _build(fsdp_size=8)
+    acc, model, opt = _build(fsdp_size=_n_dev())
 
     def step_fn(x, y):
         opt.zero_grad()

@@ -44,6 +44,13 @@ def main(argv=None):
     parser.add_argument("--list-rules", action="store_true", help="print rules and exit")
     parser.add_argument("--baseline", help="JSON allowlist; baselined findings don't fail the run")
     parser.add_argument(
+        "--ckpt-index",
+        metavar="PATH",
+        help="checkpoint *.index.json (or directory of them) whose recorded "
+        "PartitionSpecs the sharding-spec-drift rule cross-checks against "
+        "sharding plans in the analyzed source",
+    )
+    parser.add_argument(
         "--write-baseline",
         metavar="PATH",
         help="write current findings as the new baseline and exit 0",
@@ -74,8 +81,22 @@ def main(argv=None):
         except (OSError, ValueError) as e:
             print(f"graftlint: cannot read baseline {args.baseline}: {e}", file=sys.stderr)
             return 2
+    ckpt_specs = None
+    if args.ckpt_index:
+        # load eagerly (once) so a typo'd index path gets ITS diagnostic and
+        # exit 2, not the generic no-such-path message (or a traceback)
+        try:
+            ckpt_specs = analysis.load_ckpt_specs(args.ckpt_index)
+        except (OSError, ValueError) as e:
+            print(
+                f"graftlint: cannot read --ckpt-index {args.ckpt_index}: {e}",
+                file=sys.stderr,
+            )
+            return 2
     try:
-        result = analysis.run_analysis(args.paths, rules=rules, baseline=baseline)
+        result = analysis.run_analysis(
+            args.paths, rules=rules, baseline=baseline, ckpt_index=ckpt_specs
+        )
     except FileNotFoundError as e:
         print(f"graftlint: no such path: {e}", file=sys.stderr)
         return 2
