@@ -8,14 +8,18 @@ hardware (see TPU_OUTAGE_r0*.log).  This subsystem catches them from the AST,
 in CI, on the virtual 8-device CPU mesh.
 
 Layout:
-  engine.py     file discovery, suppressions, baseline, rule runner
+  engine.py     file discovery, suppressions, baseline, rule runner, cache glue
   callgraph.py  per-module call graph + traced-region reachability
-  rules/        one module per rule (six rules at birth)
+  program.py    whole-program import graph: cross-module reachability,
+                donors/escapers/blockers resolved through imports
+  cache.py      on-disk per-module cache (content hash + environment hash)
+  rules/        one module per rule
 
 Entry point: ``tools/graftlint.py`` (also ``make lint``).
 """
 
 from .engine import (
+    ANALYSIS_VERSION,
     AnalysisResult,
     Finding,
     ModuleInfo,
@@ -25,17 +29,22 @@ from .engine import (
     run_analysis,
     write_baseline,
 )
+from .program import ModuleSummary, ProgramGraph, module_name_for
 from .rules import ALL_RULES, get_rules
 
 __all__ = [
     "ALL_RULES",
+    "ANALYSIS_VERSION",
     "AnalysisResult",
     "Finding",
     "ModuleInfo",
+    "ModuleSummary",
+    "ProgramGraph",
     "Rule",
     "get_rules",
     "load_baseline",
     "load_ckpt_specs",
+    "module_name_for",
     "run_analysis",
     "write_baseline",
 ]

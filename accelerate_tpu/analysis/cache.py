@@ -1,0 +1,75 @@
+"""On-disk per-module analysis cache.
+
+One JSON file per analyzed source file (named by a hash of its rel_path),
+holding:
+
+* ``content_hash`` — sha256 of the source text.  A mismatch discards the
+  entry wholesale: summary extraction is re-run and findings are dropped.
+* ``summary`` — the :class:`program.ModuleSummary` digest.  Replaying it
+  lets a warm run build the whole-program graph without parsing a single
+  unchanged file.
+* ``results`` — findings keyed by *environment hash* (everything outside
+  the file that its findings depend on: the module's cross-module reached
+  set, the axis universe, visible donors/escapers/blockers, the rule list,
+  checkpoint specs — see ``engine._module_env_hash``).  Editing file A
+  therefore invalidates A by content and invalidates B only when A's edit
+  changed what B actually sees.
+
+The cache is best-effort: any IO/parse error on load or store is treated as
+a miss and never surfaces to the caller.  ``ANALYSIS_VERSION`` is baked into
+every entry so an analyzer upgrade starts cold instead of replaying stale
+findings.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Optional
+
+from .engine import ANALYSIS_VERSION
+
+
+class AnalysisCache:
+    def __init__(self, cache_dir: str):
+        self.dir = cache_dir
+        os.makedirs(cache_dir, exist_ok=True)
+
+    def _entry_path(self, rel_path: str) -> str:
+        key = hashlib.sha1(rel_path.replace(os.sep, "/").encode("utf-8")).hexdigest()
+        return os.path.join(self.dir, f"{key}.json")
+
+    def load(self, rel_path: str, content_hash: str) -> Optional[dict]:
+        try:
+            with open(self._entry_path(rel_path), encoding="utf-8") as f:
+                entry = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if (
+            entry.get("version") != ANALYSIS_VERSION
+            or entry.get("path") != rel_path
+            or entry.get("content_hash") != content_hash
+            or not isinstance(entry.get("summary"), dict)
+            or not isinstance(entry.get("results"), dict)
+        ):
+            return None
+        return entry
+
+    def store(self, rel_path: str, content_hash: str, entry: dict) -> None:
+        entry = dict(entry)
+        entry["version"] = ANALYSIS_VERSION
+        entry["path"] = rel_path
+        entry["content_hash"] = content_hash
+        path = self._entry_path(rel_path)
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(entry, f)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except (OSError, UnboundLocalError):
+                pass

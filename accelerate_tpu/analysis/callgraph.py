@@ -8,9 +8,12 @@ from a root through same-module calls (including functions passed as
 callbacks and ``self.method()`` dispatch) executes under trace, so the
 trace-safety rules (host-sync, blocking) only fire inside that region.
 
-The graph is deliberately per-module: cross-module reachability would need
-whole-program import resolution for marginal recall, since this codebase's
-traced bodies (capture.py, ops/, parallel/) call within their own file.
+This module is the *per-file* half of the analysis: it collects functions,
+call edges (bare names, ``self.method``, and dotted ``alias.fn`` forms) and
+local roots.  ``program.py`` stitches the per-module graphs into a
+whole-program one — resolving ``from .x import f`` / ``import pkg.mod as m``
+edges and ``__init__.py`` re-exports — and injects the extra cross-module
+reachability back into each module's ``reached`` map before rules run.
 """
 
 from __future__ import annotations
@@ -69,6 +72,18 @@ def is_trace_wrapper(resolved: Optional[str]) -> bool:
     return False
 
 
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.f`` for an Attribute chain bottoming at a Name, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
 def iter_own_nodes(fn_node: ast.AST) -> Iterator[ast.AST]:
     """Walk a function body without descending into nested def/class bodies
     (those are their own call-graph nodes, reached through edges)."""
@@ -92,6 +107,24 @@ class FunctionInfo:
     qualname: str
     node: ast.AST
     edges: set[str] = dataclasses.field(default_factory=set)
+    # borg-singleton initializer (`self.__dict__ = cls._shared_state`): its
+    # body runs once per process, so constructing the class under trace does
+    # NOT execute it — reachability must not propagate through it
+    barrier: bool = False
+
+
+def _is_singleton_init(fn_node: ast.AST) -> bool:
+    for sub in iter_own_nodes(fn_node):
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "__dict__"
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    return True
+    return False
 
 
 class _Collector(ast.NodeVisitor):
@@ -101,7 +134,7 @@ class _Collector(ast.NodeVisitor):
 
     def _visit_fn(self, node):
         qual = ".".join(self.stack + [node.name])
-        info = FunctionInfo(node.name, qual, node)
+        info = FunctionInfo(node.name, qual, node, barrier=_is_singleton_init(node))
         # names bound as data in this scope (params, assignments, loop vars):
         # a data binding passed as an argument is a value, not a reference to
         # a same-named module function — without this, a parameter named like
@@ -112,13 +145,25 @@ class _Collector(ast.NodeVisitor):
                 local_data.add(sub.id)
         for sub in iter_own_nodes(node):
             if isinstance(sub, ast.Call):
-                # direct calls: f(...) and self.f(...) / cls.f(...)
+                # direct calls: f(...), self.f(...) / cls.f(...), and dotted
+                # alias.f(...) — the dotted form is what program.py resolves
+                # across module boundaries (``utils.sync(x)``)
                 fn = sub.func
                 if isinstance(fn, ast.Name):
                     info.edges.add(fn.id)
-                elif isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
-                    if fn.value.id in ("self", "cls"):
+                elif isinstance(fn, ast.Attribute):
+                    d = dotted_name(fn)
+                    if d is None:
+                        pass
+                    elif isinstance(fn.value, ast.Name) and fn.value.id in ("self", "cls"):
                         info.edges.add(fn.attr)
+                    elif d.split(".", 1)[0] in ("self", "cls"):
+                        # deeper chains (self.state.update()): the receiver's
+                        # type is unknown — a bare-leaf edge would collide
+                        # with any same-module function named `update`
+                        pass
+                    elif d.split(".", 1)[0] not in local_data:
+                        info.edges.add(d)
                 # callback pattern: names passed as arguments may be called
                 # by the callee (ring hops, pipeline schedules do this).
                 # Nested defs are not Store bindings, so they stay eligible.
@@ -200,6 +245,8 @@ class CallGraph:
             info = self.functions[qual]
             for name in info.edges:
                 for callee in self.by_leaf.get(name, []):
+                    if callee.barrier:
+                        continue  # singleton init: runs once, never in-trace
                     if callee.qualname not in self.reached:
                         root = self.reached[qual].split(" via ")[0]
                         self.reached[callee.qualname] = f"{root} via {qual}"
@@ -208,3 +255,57 @@ class CallGraph:
     def traced_functions(self) -> Iterator[tuple[FunctionInfo, str]]:
         for qual, reason in sorted(self.reached.items()):
             yield self.functions[qual], reason
+
+
+# ---------------------------------------------------------------------------
+# donation helpers (shared by rules/donation.py, rules/transitive_donation.py
+# and program.py — living here keeps the import graph acyclic)
+# ---------------------------------------------------------------------------
+
+_JIT_LEAVES = {"jit", "pjit"}
+
+
+def donated_positions(call: ast.Call) -> Optional[list[int]]:
+    """Literal ``donate_argnums`` positions of a jit(...) call, or None."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            out = [
+                e.value
+                for e in elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            ]
+            return out or None
+    return None
+
+
+def donating_callables(module) -> dict[str, list[int]]:
+    """name -> donated positions, for `g = jax.jit(f, donate_argnums=...)`
+    assignments and `@partial(jax.jit, donate_argnums=...)` decorated defs."""
+    out: dict[str, list[int]] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            resolved = module.resolve(node.value.func) or ""
+            if resolved.rsplit(".", 1)[-1] in _JIT_LEAVES:
+                pos = donated_positions(node.value)
+                if pos:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                resolved = module.resolve(dec.func) or ""
+                leaf = resolved.rsplit(".", 1)[-1]
+                is_jit_factory = leaf in _JIT_LEAVES
+                is_partial_jit = leaf == "partial" and any(
+                    (module.resolve(a) or "").rsplit(".", 1)[-1] in _JIT_LEAVES
+                    for a in dec.args
+                )
+                if is_jit_factory or is_partial_jit:
+                    pos = donated_positions(dec)
+                    if pos:
+                        out[node.name] = pos
+    return out

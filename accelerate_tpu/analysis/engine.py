@@ -28,6 +28,33 @@ _SUPPRESS_RE = re.compile(
 )
 _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 
+# Bumping this invalidates every on-disk cache entry (cache.py keys on it):
+# bump whenever a rule or the graph machinery changes what it reports for
+# unchanged source.
+ANALYSIS_VERSION = "2"
+
+# Names that mark a branch/function as profiling/benchmark plumbing, where a
+# deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the
+# whole-program transitive-blocking closure (program.py).
+GUARD_NAME_RE = re.compile(
+    r"profil|debug|verbose|bench|warmup|timing|timeit|trace|sync_every|"
+    r"sync_each|log_every|barrier|measure",
+    re.IGNORECASE,
+)
+
+
+def is_guard_expr(test: ast.AST) -> bool:
+    """True when a test expression mentions a profiling/debug knob."""
+    for node in ast.walk(test):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and GUARD_NAME_RE.search(name):
+            return True
+    return False
+
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -56,24 +83,25 @@ class Finding:
 
 
 class Rule:
-    """Base class: subclasses set ``id``/``description`` and implement check()."""
+    """Base class: subclasses set ``id``/``description``/``kind`` and
+    implement check().  ``kind`` is "reachability" when the rule consumes the
+    traced-region call graph (so it benefits from cross-module analysis) and
+    "syntactic" when it fires on local syntax alone — `--list-rules` prints
+    it so suppression triage knows which findings can shift when
+    whole-program mode is toggled."""
 
     id: str = ""
     description: str = ""
+    kind: str = "syntactic"
 
     def check(self, module: "ModuleInfo", ctx: "AnalysisContext") -> list[Finding]:
         raise NotImplementedError
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
+    from .callgraph import dotted_name
+
+    return dotted_name(node)
 
 
 def _collect_aliases(tree: ast.AST) -> dict[str, str]:
@@ -98,6 +126,32 @@ def _collect_aliases(tree: ast.AST) -> dict[str, str]:
                 full = f"{base}.{a.name}" if base else a.name
                 aliases[a.asname or a.name] = full
     return aliases
+
+
+def _collect_import_records(tree: ast.AST) -> list[dict]:
+    """Raw import statements with their relative level preserved — the
+    program graph resolves these against the package layout on disk
+    (``_collect_aliases`` flattens levels away, which is fine for dotted-name
+    canonicalization but loses what ``from ..x import f`` points at)."""
+    records: list[dict] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            records.append(
+                {
+                    "kind": "import",
+                    "names": [[a.name, a.asname] for a in node.names],
+                }
+            )
+        elif isinstance(node, ast.ImportFrom):
+            records.append(
+                {
+                    "kind": "from",
+                    "module": node.module or "",
+                    "level": node.level,
+                    "names": [[a.name, a.asname] for a in node.names if a.name != "*"],
+                }
+            )
+    return records
 
 
 def _parse_rule_list(raw: Optional[str]) -> set[str]:
@@ -153,6 +207,7 @@ class ModuleInfo:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         self.aliases = _collect_aliases(self.tree)
+        self.import_records = _collect_import_records(self.tree)
         self.line_suppressions, self.file_suppressions = _collect_suppressions(source)
         # module-level `NAME = "literal"` string constants (axis-name rule
         # resolves bare-Name axis arguments through this)
@@ -199,10 +254,24 @@ class AnalysisContext:
 
     axis_universe: set[str] = dataclasses.field(default_factory=set)
     axis_sources: dict[str, str] = dataclasses.field(default_factory=dict)
-    modules: list[ModuleInfo] = dataclasses.field(default_factory=list)
     # tensor → recorded PartitionSpec (JSON form) from a checkpoint
     # index.json, when the caller passed one (sharding-spec-drift input)
     ckpt_specs: dict[str, list] = dataclasses.field(default_factory=dict)
+    # whole-program facts (program.ProgramGraph output), keyed by rel_path.
+    # Filled from the per-module summaries in both modes; with cross-module
+    # analysis off the maps only carry same-module entries.
+    cross_module: bool = True
+    # extra traced functions per module, beyond its own local roots:
+    # rel_path -> {qualname: reason}
+    cross_reached: dict = dataclasses.field(default_factory=dict)
+    # rel_path -> {visible callable name (bare or dotted): donated positions}
+    donor_aliases: dict = dataclasses.field(default_factory=dict)
+    # rel_path -> {visible callable name: {"positions": [...], "where": ...}}
+    # for helpers that STORE a parameter beyond the call (transitive-donation)
+    escape_aliases: dict = dataclasses.field(default_factory=dict)
+    # rel_path -> {visible callable name: chain} for functions that
+    # transitively hit block_until_ready/effects_barrier (blocking rule)
+    blocking_aliases: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -212,6 +281,9 @@ class AnalysisResult:
     files_analyzed: int
     duration_s: float
     suppressed: int
+    cross_module: bool = True
+    cache_hits: int = 0
+    cache_misses: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -219,6 +291,9 @@ class AnalysisResult:
             "duration_s": round(self.duration_s, 3),
             "suppressed": self.suppressed,
             "baseline_filtered": len(self.findings) - len(self.new_findings),
+            "cross_module": self.cross_module,
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
             "findings": [f.to_dict() for f in self.new_findings],
         }
 
@@ -268,12 +343,13 @@ def _literal_strs(node: ast.AST) -> list[str]:
     return []
 
 
-def _collect_axes(module: ModuleInfo, ctx: AnalysisContext) -> None:
-    where = module.rel_path
+def collect_axes(module: ModuleInfo) -> list[tuple[str, str]]:
+    """Harvest ``(axis, why)`` declarations from one module.  Pure so the
+    result can live in the per-module summary cache."""
+    out: list[tuple[str, str]] = []
 
     def add(name: str, why: str) -> None:
-        ctx.axis_universe.add(name)
-        ctx.axis_sources.setdefault(name, f"{where}: {why}")
+        out.append((name, why))
 
     for node in ast.walk(module.tree):
         # MESH_AXIS_DP = "dp" / ALL_MESH_AXES = (MESH_AXIS_DP, ...)
@@ -307,6 +383,7 @@ def _collect_axes(module: ModuleInfo, ctx: AnalysisContext) -> None:
                     for k in node.args[0].keys:
                         if isinstance(k, ast.Constant) and isinstance(k.value, str):
                             add(k.value, "make_mesh({...})")
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -373,20 +450,66 @@ def load_ckpt_specs(path: str) -> dict[str, list]:
     return specs
 
 
+@dataclasses.dataclass
+class _FileRecord:
+    """One discovered file through the pipeline: parsed eagerly on a cache
+    miss, replayed from its cached summary otherwise."""
+
+    path: str
+    rel_path: str
+    content_hash: str
+    source: str
+    module: Optional[ModuleInfo]
+    summary: object  # program.ModuleSummary
+    cache_entry: Optional[dict]
+
+
+def _module_env_hash(rel: str, rule_ids: Sequence[str], ctx: AnalysisContext, ckpt_hash: str) -> str:
+    """Everything OUTSIDE a module's own text that its findings depend on.
+    The findings cache is keyed on (content hash, this) — so editing file A
+    re-analyzes A via the content hash, and re-analyzes B only when A's edit
+    actually changed what B sees (its cross-module reached set, the axis
+    universe, visible donors/escapers/blockers, the checkpoint specs)."""
+    payload = {
+        "version": ANALYSIS_VERSION,
+        "rules": list(rule_ids),
+        "cross": ctx.cross_module,
+        "axes": sorted(ctx.axis_universe),
+        "reached": sorted(ctx.cross_reached.get(rel, {}).items()),
+        "donors": sorted(
+            (k, list(v)) for k, v in ctx.donor_aliases.get(rel, {}).items()
+        ),
+        "escapes": sorted(
+            (k, sorted(v["positions"]), v["where"])
+            for k, v in ctx.escape_aliases.get(rel, {}).items()
+        ),
+        "blocking": sorted(ctx.blocking_aliases.get(rel, {}).items()),
+        "ckpt": ckpt_hash,
+    }
+    blob = json.dumps(payload, sort_keys=True).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()[:24]
+
+
 def run_analysis(
     paths: Sequence[str],
     rules: Optional[Sequence[Rule]] = None,
     baseline: Optional[set[str]] = None,
     ckpt_index: Optional[Union[str, dict]] = None,
+    cross_module: bool = True,
+    cache_dir: Optional[str] = None,
 ) -> AnalysisResult:
+    from .cache import AnalysisCache
+    from .program import ModuleSummary, ProgramGraph, extract_summary
+
     if rules is None:
         from .rules import ALL_RULES
 
         rules = [cls() for cls in ALL_RULES]
+    rule_ids = sorted(r.id for r in rules)
     t0 = time.monotonic()
     files = discover_files(paths)
     cwd = os.getcwd()
-    ctx = AnalysisContext()
+    ctx = AnalysisContext(cross_module=cross_module)
     if ckpt_index:
         # a dict is an already-loaded {tensor: spec} mapping (the CLI
         # validates + loads once and hands it over); a str is a path
@@ -395,36 +518,126 @@ def run_analysis(
             if isinstance(ckpt_index, dict)
             else load_ckpt_specs(ckpt_index)
         )
-    findings: list[Finding] = []
-    suppressed = 0
-    modules: list[ModuleInfo] = []
+    cache = AnalysisCache(cache_dir) if cache_dir else None
+
+    # -- pass 1: summaries (cache-replayed or freshly extracted) ------------
+    records: list[_FileRecord] = []
     for path in files:
         rel = os.path.relpath(path, cwd) if os.path.isabs(path) else path
         try:
             with open(path, encoding="utf-8") as f:
                 source = f.read()
-            modules.append(ModuleInfo(path, rel, source))
-        except (SyntaxError, UnicodeDecodeError) as e:
-            lineno = getattr(e, "lineno", 0) or 0
-            findings.append(
-                Finding("syntax-error", rel, lineno, 0, f"cannot parse: {e}")
+        except UnicodeDecodeError as e:
+            records.append(
+                _FileRecord(
+                    path, rel, "", "", None,
+                    ModuleSummary(error=f"cannot parse: {e}"), None,
+                )
             )
-    ctx.modules = modules
-    for m in modules:
-        _collect_axes(m, ctx)
+            continue
+        content_hash = hashlib.sha256(source.encode("utf-8")).hexdigest()
+        entry = cache.load(rel, content_hash) if cache else None
+        if entry is not None:
+            summary = ModuleSummary.from_dict(entry["summary"])
+            records.append(
+                _FileRecord(path, rel, content_hash, source, None, summary, entry)
+            )
+            continue
+        try:
+            module = ModuleInfo(path, rel, source)
+        except SyntaxError as e:
+            lineno = getattr(e, "lineno", 0) or 0
+            summary = ModuleSummary(error=f"cannot parse: {e}", error_line=lineno)
+            module = None
+        else:
+            summary = extract_summary(module)
+        entry = {"summary": summary.to_dict(), "results": {}} if cache else None
+        records.append(
+            _FileRecord(path, rel, content_hash, source, module, summary, entry)
+        )
+
+    # -- pass 2: cross-file facts (axis universe + whole-program graph) -----
+    for r in records:
+        for axis, why in r.summary.axes:
+            ctx.axis_universe.add(axis)
+            ctx.axis_sources.setdefault(axis, f"{r.rel_path}: {why}")
     if not ctx.axis_universe:
         ctx.axis_universe = set(FALLBACK_AXIS_UNIVERSE)
         ctx.axis_sources = {
             a: "builtin default (no mesh declaration found)"
             for a in FALLBACK_AXIS_UNIVERSE
         }
-    for m in modules:
+    program = ProgramGraph(records, cross=cross_module)
+    ctx.cross_reached = program.cross_reached
+    ctx.donor_aliases = program.donor_aliases
+    ctx.escape_aliases = program.escape_aliases
+    ctx.blocking_aliases = program.blocking_aliases
+
+    ckpt_hash = (
+        hashlib.sha256(
+            json.dumps(ctx.ckpt_specs, sort_keys=True).encode("utf-8")
+        ).hexdigest()
+        if ctx.ckpt_specs
+        else ""
+    )
+
+    # -- pass 3: rules (per module, findings cache-keyed on content + env) --
+    findings: list[Finding] = []
+    suppressed = 0
+    cache_hits = cache_misses = 0
+    for r in records:
+        if r.summary.error:
+            findings.append(
+                Finding("syntax-error", r.rel_path, r.summary.error_line, 0, r.summary.error)
+            )
+            continue
+        env = _module_env_hash(r.rel_path, rule_ids, ctx, ckpt_hash)
+        cached = r.cache_entry["results"].get(env) if r.cache_entry else None
+        if cached is not None:
+            for fd in cached["findings"]:
+                findings.append(
+                    Finding(
+                        fd["rule"], fd["path"], fd["line"], fd["col"],
+                        fd["message"], fd.get("symbol", ""),
+                    )
+                )
+            suppressed += cached["suppressed"]
+            cache_hits += 1
+            results = r.cache_entry["results"]
+            if next(reversed(results)) != env:
+                # LRU refresh: move the env just used to most-recent, so the
+                # eviction below drops stale variants, not the busiest one
+                results[env] = results.pop(env)
+                cache.store(r.rel_path, r.content_hash, r.cache_entry)
+            continue
+        module = r.module
+        if module is None:  # cached summary but stale/absent findings: parse
+            # the pass-1 source (NOT a re-read — the file may have changed
+            # since, and findings are stored under the pass-1 content hash)
+            module = ModuleInfo(r.path, r.rel_path, r.source)
+        # inject the whole-program reachability before any rule looks at it
+        module.callgraph.reached.update(ctx.cross_reached.get(r.rel_path, {}))
+        mod_findings: list[Finding] = []
+        mod_suppressed = 0
         for rule in rules:
-            for f in rule.check(m, ctx):
-                if m.is_suppressed(f):
-                    suppressed += 1
+            for f in rule.check(module, ctx):
+                if module.is_suppressed(f):
+                    mod_suppressed += 1
                 else:
-                    findings.append(f)
+                    mod_findings.append(f)
+        findings.extend(mod_findings)
+        suppressed += mod_suppressed
+        if cache is not None and r.cache_entry is not None:
+            cache_misses += 1
+            results = r.cache_entry["results"]
+            results[env] = {
+                "findings": [dataclasses.asdict(f) for f in mod_findings],
+                "suppressed": mod_suppressed,
+            }
+            while len(results) > 8:  # drop the least-recently-used variants
+                results.pop(next(iter(results)))
+            cache.store(r.rel_path, r.content_hash, r.cache_entry)
+
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     new = (
         [f for f in findings if f.fingerprint() not in baseline]
@@ -437,4 +650,7 @@ def run_analysis(
         files_analyzed=len(files),
         duration_s=time.monotonic() - t0,
         suppressed=suppressed,
+        cross_module=cross_module,
+        cache_hits=cache_hits,
+        cache_misses=cache_misses,
     )

@@ -1,0 +1,626 @@
+"""Whole-program half of the graftlint call graph.
+
+``callgraph.py`` sees one module at a time; this module stitches those
+per-file graphs into a package-wide one:
+
+* **Module naming** — each analyzed file gets its dotted module name by
+  walking the ``__init__.py`` chain on disk, so ``pkg/ops/matmul.py`` is
+  ``pkg.ops.matmul`` and relative imports can be resolved against it.
+* **Import resolution** — ``from .x import f``, ``from ..utils import g as
+  h``, ``import pkg.mod as m`` and re-exports through ``__init__.py``
+  (``pkg/__init__.py: from .impl import f`` makes ``from pkg import f``
+  land on ``pkg.impl.f``) all become call-graph edges.
+* **Cross-module reachability** — trace roots propagate through those
+  edges, so a jitted body in ``ops/`` calling a helper in ``utils/`` marks
+  that helper traced and every reachability rule (host-sync-in-trace,
+  dtype-widen, donation, blocking) sees it.
+* **Derived whole-program facts** — per module, the visible donating
+  callables (`donate_argnums`), the helpers that *store* a parameter beyond
+  the call (transitive-donation), and the functions that transitively hit
+  ``block_until_ready`` (blocking-in-hot-loop).
+
+Everything here works off :class:`ModuleSummary` — a small, JSON-able
+digest of one module — so the on-disk cache (``cache.py``) can replay a
+summary by content hash without re-parsing the file.
+
+With ``cross=False`` (the ``--no-cross-module`` escape hatch) import
+resolution is disabled AND the transitive maps (escapers, blockers) stay
+empty, so behavior matches the historical per-module linter: direct calls
+only, local reachability only.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Optional
+
+from .callgraph import (
+    donating_callables,
+    dotted_name,
+    is_trace_wrapper,
+    iter_own_nodes,
+)
+from .engine import GUARD_NAME_RE, is_guard_expr
+
+# methods whose argument escapes into the receiver (stored beyond the call)
+_STORE_METHODS = {
+    "append",
+    "add",
+    "extend",
+    "insert",
+    "appendleft",
+    "setdefault",
+    "update",
+    "put",
+    "register",
+}
+_BLOCKING_LEAVES = {"block_until_ready", "effects_barrier"}
+
+_MAX_REEXPORT_DEPTH = 8
+
+
+# ---------------------------------------------------------------------------
+# per-module summary (the cacheable digest)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FunctionSummary:
+    name: str
+    qualname: str
+    edges: list  # bare and dotted call-edge names
+    escapes: list  # positional parameter indices stored beyond the call
+    blocks: bool  # unguarded block_until_ready/effects_barrier in own body
+    guard: bool  # function name marks it as profiling/bench plumbing
+    barrier: bool = False  # borg-singleton init: reachability stops here
+
+    def to_list(self) -> list:
+        return [
+            self.name, self.qualname, self.edges, self.escapes, self.blocks,
+            self.guard, self.barrier,
+        ]
+
+    @classmethod
+    def from_list(cls, row: list) -> "FunctionSummary":
+        return cls(*row)
+
+
+@dataclasses.dataclass
+class ModuleSummary:
+    """Everything the program graph needs to know about one module, without
+    its AST.  Serializable: this is what ``.graftlint_cache`` stores."""
+
+    functions: list = dataclasses.field(default_factory=list)
+    reached: dict = dataclasses.field(default_factory=dict)  # local roots + local closure
+    wrapper_passed: list = dataclasses.field(default_factory=list)  # [wrapper, name]
+    donors: dict = dataclasses.field(default_factory=dict)  # name -> positions
+    axes: list = dataclasses.field(default_factory=list)  # [axis, why]
+    imports: list = dataclasses.field(default_factory=list)  # raw import records
+    error: Optional[str] = None  # set when the file failed to parse
+    error_line: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "functions": [f.to_list() for f in self.functions],
+            "reached": self.reached,
+            "wrapper_passed": self.wrapper_passed,
+            "donors": self.donors,
+            "axes": [list(a) for a in self.axes],
+            "imports": self.imports,
+            "error": self.error,
+            "error_line": self.error_line,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModuleSummary":
+        return cls(
+            functions=[FunctionSummary.from_list(row) for row in d.get("functions", [])],
+            reached=dict(d.get("reached", {})),
+            wrapper_passed=[list(w) for w in d.get("wrapper_passed", [])],
+            donors={k: list(v) for k, v in d.get("donors", {}).items()},
+            axes=[tuple(a) for a in d.get("axes", [])],
+            imports=d.get("imports", []),
+            error=d.get("error"),
+            error_line=d.get("error_line", 0),
+        )
+
+
+def escaping_params(fn_node: ast.AST) -> list[int]:
+    """Positional-parameter indices of ``fn_node`` that are *stored* beyond
+    the call: appended/added to a container, assigned to an attribute or
+    subscript, or bound to a ``global`` name.  A caller that passes a buffer
+    at such a position has leaked an alias that outlives the call — which is
+    exactly what donation must not coexist with."""
+    args = fn_node.args
+    params = [a.arg for a in args.posonlyargs + args.args]
+    # drop a leading self/cls so indices line up with the CALLER's positional
+    # arguments (constructors resolve to Cls.__init__, whose arg 0 is self —
+    # the caller's arg 0 is the init's arg 1)
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    pset = set(params)
+    if not pset:
+        return []
+    global_names: set[str] = set()
+    escaped: set[str] = set()
+    for node in iter_own_nodes(fn_node):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            global_names.update(node.names)
+    for node in iter_own_nodes(fn_node):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _STORE_METHODS:
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id in pset:
+                        escaped.add(arg.id)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            value = node.value
+            if value is None:
+                continue
+            # only storing the buffer ITSELF leaks an alias: a bare param
+            # name, possibly inside a tuple/list/set/dict literal — storing
+            # a derived value (x.shape[0], float(x)) does not.  `acc += x`
+            # stores old+x (a NEW array), so a bare-Name AugAssign is
+            # derived too; `log += [x]` is list-extend and keeps the alias
+            if isinstance(value, ast.Name):
+                candidates = [] if isinstance(node, ast.AugAssign) else [value]
+            elif isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+                candidates = value.elts
+            elif isinstance(value, ast.Dict):
+                candidates = value.values
+            else:
+                candidates = []
+            value_names = {
+                n.id for n in candidates if isinstance(n, ast.Name) and n.id in pset
+            }
+            if not value_names:
+                continue
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    escaped |= value_names
+                elif isinstance(t, ast.Name) and t.id in global_names:
+                    escaped |= value_names
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    stores = [
+                        isinstance(e, (ast.Attribute, ast.Subscript))
+                        or (isinstance(e, ast.Name) and e.id in global_names)
+                        for e in t.elts
+                    ]
+                    if not any(stores):
+                        continue
+                    if isinstance(value, (ast.Tuple, ast.List)) and len(
+                        value.elts
+                    ) == len(t.elts):
+                        # pairwise unpack: only values landing in a storing
+                        # slot escape (`local, STATE[k] = buf, cfg` stores
+                        # cfg, not buf)
+                        for stored, v in zip(stores, value.elts):
+                            if stored and isinstance(v, ast.Name) and v.id in pset:
+                                escaped.add(v.id)
+                    else:
+                        escaped |= value_names
+    return sorted(params.index(p) for p in escaped if p in params)
+
+
+class _BlockScan(ast.NodeVisitor):
+    """Structural scan for an unguarded blocking call: guard-``if`` bodies
+    are exempt at any nesting depth (inside loops, try, with, ...), and
+    nested defs are their own functions, not this one's behavior."""
+
+    def __init__(self):
+        self.guard_depth = 0
+        self.found = False
+
+    def visit_If(self, node):
+        self.visit(node.test)
+        guarded = is_guard_expr(node.test)
+        self.guard_depth += guarded
+        for stmt in node.body:
+            self.visit(stmt)
+        self.guard_depth -= guarded
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def visit_Call(self, node):
+        if self.guard_depth == 0 and not self.found:
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _BLOCKING_LEAVES:
+                self.found = True
+            else:
+                d = dotted_name(fn)
+                if d and d.rsplit(".", 1)[-1] in _BLOCKING_LEAVES:
+                    self.found = True
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs are separate call-graph nodes
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+
+def _has_unguarded_block(fn_node: ast.AST) -> bool:
+    """True when the function body reaches block_until_ready/effects_barrier
+    outside any profiling-guard ``if`` — i.e. calling this function blocks
+    unconditionally."""
+    scanner = _BlockScan()
+    for stmt in getattr(fn_node, "body", []):
+        scanner.visit(stmt)
+    return scanner.found
+
+
+def extract_summary(module) -> ModuleSummary:
+    """Digest one parsed :class:`ModuleInfo` into its cacheable summary."""
+    from .engine import collect_axes
+
+    cg = module.callgraph
+    functions = [
+        FunctionSummary(
+            name=info.name,
+            qualname=info.qualname,
+            edges=sorted(info.edges),
+            escapes=escaping_params(info.node),
+            blocks=_has_unguarded_block(info.node),
+            guard=bool(GUARD_NAME_RE.search(info.name)),
+            barrier=info.barrier,
+        )
+        for info in cg.functions.values()
+    ]
+    # names (bare or dotted) appearing inside trace-wrapper call arguments:
+    # the per-module graph already rooted same-module matches; the program
+    # graph resolves the rest through imports (`jax.jit(ops.step)`,
+    # `shard_map_compat(partial(do_step, cfg), ...)` with do_step imported)
+    wrapper_passed: list[list] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = module.resolve(node.func)
+        if not is_trace_wrapper(resolved):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name):
+                    wrapper_passed.append([resolved, sub.id])
+                elif isinstance(sub, ast.Attribute):
+                    d = dotted_name(sub)
+                    if d and "." in d and d.split(".", 1)[0] not in ("self", "cls"):
+                        wrapper_passed.append([resolved, d])
+    return ModuleSummary(
+        functions=functions,
+        reached=dict(cg.reached),
+        wrapper_passed=wrapper_passed,
+        donors=donating_callables(module),
+        axes=collect_axes(module),
+        imports=module.import_records,
+    )
+
+
+# ---------------------------------------------------------------------------
+# module naming
+# ---------------------------------------------------------------------------
+
+def module_name_for(path: str) -> str:
+    """Dotted module name from the on-disk package layout: walk parent
+    directories while they contain ``__init__.py``.  A file outside any
+    package is just its stem."""
+    path = os.path.abspath(path)
+    stem = os.path.splitext(os.path.basename(path))[0]
+    parts = [] if stem == "__init__" else [stem]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return ".".join(reversed(parts)) if parts else stem
+
+
+# ---------------------------------------------------------------------------
+# the whole-program graph
+# ---------------------------------------------------------------------------
+
+class ProgramGraph:
+    """Cross-module import + call graph over the analyzed file set.
+
+    Consumes the per-file records from ``engine.run_analysis`` (anything
+    with ``.path`` / ``.rel_path`` / ``.summary``).  Produces, keyed by
+    rel_path: ``cross_reached`` (extra traced functions beyond the module's
+    own roots), ``donor_aliases``, ``escape_aliases`` and
+    ``blocking_aliases`` (visible-name maps merged over local definitions
+    and imports).
+    """
+
+    def __init__(self, records, cross: bool = True):
+        self.cross = cross
+        self.records = [r for r in records if r.summary.error is None]
+        self.names = [module_name_for(r.path) for r in self.records]
+        self.is_pkg = [
+            os.path.basename(r.path) == "__init__.py" for r in self.records
+        ]
+        self.by_name: dict[str, int] = {}
+        dupes: set[str] = set()
+        for i, n in enumerate(self.names):
+            if n in self.by_name:
+                dupes.add(n)
+            else:
+                self.by_name[n] = i
+        for n in dupes:
+            # two analyzed files claim the same dotted name (same-stem
+            # scripts outside any package, src/ + build/ copies): resolving
+            # either would cross-wire facts to an arbitrary file — treat
+            # the name as unresolvable instead
+            del self.by_name[n]
+        self.fn_by_qual = [
+            {f.qualname: f for f in r.summary.functions} for r in self.records
+        ]
+        self.fn_by_leaf: list[dict[str, list[FunctionSummary]]] = []
+        for r in self.records:
+            leafed: dict[str, list[FunctionSummary]] = {}
+            for f in r.summary.functions:
+                leafed.setdefault(f.name, []).append(f)
+            self.fn_by_leaf.append(leafed)
+        # per-module import bindings (empty maps when cross is off)
+        self.mod_aliases: list[dict[str, str]] = []
+        self.sym_aliases: list[dict[str, tuple[str, str]]] = []
+        for i in range(len(self.records)):
+            ma, sa = self._import_bindings(i) if cross else ({}, {})
+            self.mod_aliases.append(ma)
+            self.sym_aliases.append(sa)
+
+        self._propagate()
+        self._collect_aliases_maps()
+
+    # -- imports ------------------------------------------------------------
+    def _import_bindings(self, i: int):
+        """(module aliases, symbol aliases) bound by module *i*'s imports."""
+        mod_alias: dict[str, str] = {}
+        sym_alias: dict[str, tuple[str, str]] = {}
+        mn = self.names[i]
+        pkg = mn if self.is_pkg[i] else (mn.rsplit(".", 1)[0] if "." in mn else "")
+        for rec in self.records[i].summary.imports:
+            if rec["kind"] == "import":
+                for name, asname in rec["names"]:
+                    if asname:
+                        if name in self.by_name:
+                            mod_alias[asname] = name
+                    else:
+                        # `import a.b.c` binds `a`; dotted call edges carry
+                        # the full path, resolved in _resolve_dotted
+                        parts = name.split(".")
+                        mod_alias.setdefault(parts[0], parts[0])
+                        # every analyzed dotted prefix is callable through
+                        # the binding too (`a.b.fn(x)`) — register it so the
+                        # donor/escape/blocking fact maps get full-path keys
+                        for k in range(2, len(parts) + 1):
+                            prefix = ".".join(parts[:k])
+                            if prefix in self.by_name:
+                                mod_alias.setdefault(prefix, prefix)
+                continue
+            base = rec["module"]
+            level = rec.get("level", 0)
+            if level:
+                parts = pkg.split(".") if pkg else []
+                if level - 1 > len(parts):
+                    continue  # relative import escapes the analyzed tree
+                parts = parts[: len(parts) - (level - 1)]
+                base = ".".join(parts + ([base] if base else []))
+            if not base:
+                continue
+            for name, asname in rec["names"]:
+                bound = asname or name
+                sub = f"{base}.{name}"
+                if sub in self.by_name:
+                    mod_alias[bound] = sub
+                else:
+                    sym_alias[bound] = (base, name)
+        return mod_alias, sym_alias
+
+    def _resolve_symbol(self, module_name: str, sym: str, depth: int = 0):
+        """(module index, qualname) a symbol of ``module_name`` refers to,
+        chasing ``__init__.py`` re-export chains."""
+        i = self.by_name.get(module_name)
+        if i is None or depth > _MAX_REEXPORT_DEPTH:
+            return None
+        fns = self.fn_by_qual[i]
+        if sym in fns:
+            return (i, sym)
+        if f"{sym}.__init__" in fns:
+            # calling an imported class runs its __init__ (under trace when
+            # the construction site is traced)
+            return (i, f"{sym}.__init__")
+        sa = self.sym_aliases[i]
+        if sym in sa:
+            return self._resolve_symbol(sa[sym][0], sa[sym][1], depth + 1)
+        ma = self.mod_aliases[i]
+        if sym in ma and ma[sym] != module_name:
+            # `from . import ops` style: the bound name IS a module — not a
+            # callable, nothing to link here
+            return None
+        return None
+
+    def _resolve_dotted(self, i: int, dotted: str):
+        """Resolve a dotted edge (``alias.fn`` / ``pkg.mod.fn``) from module
+        *i* to a function somewhere in the analyzed set."""
+        parts = dotted.split(".")
+        head = parts[0]
+        ma = self.mod_aliases[i]
+        if head not in ma:
+            return None
+        base = ma[head]
+        if len(parts) == 2:
+            return self._resolve_symbol(base, parts[1])
+        mod = ".".join([base] + parts[1:-1])
+        return self._resolve_symbol(mod, parts[-1])
+
+    def _resolve_edge(self, i: int, edge: str) -> list[tuple[int, str]]:
+        out: list[tuple[int, str]] = []
+        if "." not in edge:
+            for f in self.fn_by_leaf[i].get(edge, []):
+                out.append((i, f.qualname))
+            if not out and self.cross:
+                sa = self.sym_aliases[i]
+                if edge in sa:
+                    r = self._resolve_symbol(sa[edge][0], sa[edge][1])
+                    if r is not None:
+                        out.append(r)
+            return out
+        if self.cross:
+            r = self._resolve_dotted(i, edge)
+            if r is not None:
+                out.append(r)
+        return out
+
+    # -- reachability -------------------------------------------------------
+    def _propagate(self) -> None:
+        reached: dict[tuple[int, str], str] = {}
+        for i, r in enumerate(self.records):
+            for qual, reason in r.summary.reached.items():
+                reached[(i, qual)] = reason
+        if self.cross:
+            # call-form roots whose function lives in another module:
+            # jax.jit(ops.step), compile_step(imported_fn), ...
+            for i, r in enumerate(self.records):
+                for wrapper, name in r.summary.wrapper_passed:
+                    targets = self._resolve_edge(i, name)
+                    for (j, qual) in targets:
+                        if j != i:
+                            reached.setdefault(
+                                (j, qual),
+                                f"passed to {wrapper} in {self.records[i].rel_path}",
+                            )
+        frontier = list(reached)
+        while frontier:
+            node = frontier.pop()
+            i, qual = node
+            f = self.fn_by_qual[i].get(qual)
+            if f is None:
+                continue
+            root = reached[node].split(" via ")[0]
+            for edge in f.edges:
+                for (j, q2) in self._resolve_edge(i, edge):
+                    if (j, q2) in reached or self.fn_by_qual[j][q2].barrier:
+                        continue
+                    where = qual if j == i else f"{self.records[i].rel_path}:{qual}"
+                    reached[(j, q2)] = f"{root} via {where}"
+                    frontier.append((j, q2))
+        self.reached = reached
+        self.cross_reached: dict[str, dict[str, str]] = {}
+        for (i, qual), reason in reached.items():
+            if qual not in self.records[i].summary.reached:
+                self.cross_reached.setdefault(self.records[i].rel_path, {})[qual] = reason
+
+    # -- derived whole-program fact maps ------------------------------------
+    def _blocking_closure(self) -> dict[tuple[int, str], str]:
+        """node -> human-readable chain, for functions that transitively call
+        block_until_ready/effects_barrier.  Guard-named functions neither
+        seed nor relay the closure (bench helpers sync on purpose)."""
+        blocking: dict[tuple[int, str], str] = {}
+        for i, r in enumerate(self.records):
+            for f in r.summary.functions:
+                if f.blocks and not f.guard:
+                    blocking[(i, f.qualname)] = "calls block_until_ready"
+        # reverse edges once
+        rev: dict[tuple[int, str], list[tuple[tuple[int, str], str]]] = {}
+        for i, r in enumerate(self.records):
+            for f in r.summary.functions:
+                for edge in f.edges:
+                    for tgt in self._resolve_edge(i, edge):
+                        rev.setdefault(tgt, []).append(((i, f.qualname), edge))
+        frontier = list(blocking)
+        while frontier:
+            node = frontier.pop()
+            for caller, edge in rev.get(node, []):
+                if caller in blocking:
+                    continue
+                i, qual = caller
+                f = self.fn_by_qual[i][qual]
+                if f.guard:
+                    continue
+                j, q2 = node
+                where = q2 if j == i else f"{self.records[j].rel_path}:{q2}"
+                blocking[caller] = f"via {where}, which {blocking[node]}"
+                frontier.append(caller)
+        return blocking
+
+    def _visible_callables(self, i: int):
+        """Yield (visible name, (module idx, qualname)) for everything module
+        *i* can call by a bare or dotted name: its own top-level functions,
+        symbols it imported, and ``alias.fn`` for imported modules."""
+        for f in self.records[i].summary.functions:
+            if "." not in f.qualname:
+                yield f.qualname, (i, f.qualname)
+            elif f.qualname.count(".") == 1 and f.qualname.endswith(".__init__"):
+                # Cls(...) runs Cls.__init__ — a same-module constructor
+                # stores buffers exactly like an imported one
+                yield f.qualname.rsplit(".", 1)[0], (i, f.qualname)
+        for bound, (bm, nm) in self.sym_aliases[i].items():
+            r = self._resolve_symbol(bm, nm)
+            if r is not None:
+                yield bound, r
+        for bound, target_mod in self.mod_aliases[i].items():
+            j = self.by_name.get(target_mod)
+            if j is None or j == i:
+                continue
+            for f in self.records[j].summary.functions:
+                if "." not in f.qualname:
+                    yield f"{bound}.{f.qualname}", (j, f.qualname)
+                elif f.qualname.count(".") == 1 and f.qualname.endswith(".__init__"):
+                    yield f"{bound}.{f.qualname.rsplit('.', 1)[0]}", (j, f.qualname)
+
+    def _resolve_donor(self, module_name: str, name: str, depth: int = 0):
+        i = self.by_name.get(module_name)
+        if i is None or depth > _MAX_REEXPORT_DEPTH:
+            return None
+        donors = self.records[i].summary.donors
+        if name in donors:
+            return donors[name]
+        sa = self.sym_aliases[i]
+        if name in sa:
+            return self._resolve_donor(sa[name][0], sa[name][1], depth + 1)
+        return None
+
+    def _collect_aliases_maps(self) -> None:
+        # The transitive capabilities (helper-stores-a-buffer, helper-blocks)
+        # are part of whole-program mode even for same-module helpers: with
+        # --no-cross-module the maps stay EMPTY so the escape hatch really is
+        # the historical per-module behavior (direct calls only).
+        blocking = self._blocking_closure() if self.cross else {}
+        self.donor_aliases: dict[str, dict[str, list[int]]] = {}
+        self.escape_aliases: dict[str, dict[str, dict]] = {}
+        self.blocking_aliases: dict[str, dict[str, str]] = {}
+        for i, r in enumerate(self.records):
+            rel = r.rel_path
+            donors = dict(r.summary.donors)
+            escapes: dict[str, dict] = {}
+            blocks: dict[str, str] = {}
+            if self.cross:
+                for visible, (j, qual) in self._visible_callables(i):
+                    f = self.fn_by_qual[j][qual]
+                    if f.escapes:
+                        where = qual if j == i else f"{self.records[j].rel_path}:{qual}"
+                        escapes.setdefault(
+                            visible, {"positions": list(f.escapes), "where": where}
+                        )
+                    chain = blocking.get((j, qual))
+                    if chain is not None:
+                        blocks.setdefault(visible, chain)
+            if self.cross:
+                for bound, (bm, nm) in self.sym_aliases[i].items():
+                    pos = self._resolve_donor(bm, nm)
+                    if pos:
+                        donors.setdefault(bound, list(pos))
+                for bound, target_mod in self.mod_aliases[i].items():
+                    j = self.by_name.get(target_mod)
+                    if j is None or j == i:
+                        continue
+                    for dn, pos in self.records[j].summary.donors.items():
+                        donors.setdefault(f"{bound}.{dn}", list(pos))
+            if donors:
+                self.donor_aliases[rel] = donors
+            if escapes:
+                self.escape_aliases[rel] = escapes
+            if blocks:
+                self.blocking_aliases[rel] = blocks

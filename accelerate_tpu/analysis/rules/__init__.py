@@ -7,12 +7,14 @@ from .dtype_widen import DtypeWiden
 from .host_sync import HostSyncInTrace
 from .recompile import RecompileHazard
 from .spec_drift import ShardingSpecDrift
+from .transitive_donation import TransitiveDonation
 
 ALL_RULES = [
     HostSyncInTrace,
     RecompileHazard,
     AxisNameMismatch,
     DonationReuse,
+    TransitiveDonation,
     DtypeWiden,
     BlockingInHotLoop,
     ShardingSpecDrift,

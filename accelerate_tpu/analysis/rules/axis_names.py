@@ -50,6 +50,7 @@ def _axis_literals(module, node: ast.AST) -> list[tuple[str, ast.AST]]:
 
 class AxisNameMismatch(Rule):
     id = "axis-name-mismatch"
+    kind = "syntactic"
     description = (
         "collective/PartitionSpec axis name not declared by any mesh "
         "(MESH_AXIS_* constants, Mesh(axis_names=...), make_mesh({...}))"

@@ -6,40 +6,30 @@ step launches) drains to depth 0 every iteration.  Legitimate uses are
 profiling/benchmark timers, so calls under an ``if`` whose condition
 mentions profiling/debug knobs, or inside functions whose name says
 bench/profile/warmup, are exempt.
+
+Direct calls are matched syntactically; *indirect* ones come from the
+whole-program blocking closure (``program.ProgramGraph``): a loop body
+calling ``utils.sync_all(x)`` where ``sync_all`` — in another module —
+unconditionally hits ``block_until_ready`` is the same per-step sync, and
+is flagged with the chain that proves it.
 """
 
 from __future__ import annotations
 
 import ast
-import re
 
-from ..engine import Finding, Rule
+from ..callgraph import dotted_name
+from ..engine import Finding, GUARD_NAME_RE, Rule, is_guard_expr
 
 _BLOCKING_LEAVES = {"block_until_ready", "effects_barrier"}
-_GUARD_NAME_RE = re.compile(
-    r"profil|debug|verbose|bench|warmup|timing|timeit|trace|sync_every|"
-    r"sync_each|log_every|barrier|measure",
-    re.IGNORECASE,
-)
-
-
-def _is_guard(test: ast.AST) -> bool:
-    for node in ast.walk(test):
-        name = None
-        if isinstance(node, ast.Name):
-            name = node.id
-        elif isinstance(node, ast.Attribute):
-            name = node.attr
-        if name and _GUARD_NAME_RE.search(name):
-            return True
-    return False
 
 
 class _LoopVisitor(ast.NodeVisitor):
-    def __init__(self, rule, module, fn_qual):
+    def __init__(self, rule, module, fn_qual, blocking_callables):
         self.rule = rule
         self.module = module
         self.fn_qual = fn_qual
+        self.blocking_callables = blocking_callables  # visible name -> chain
         self.loop_depth = 0
         self.guard_depth = 0
         self.findings: list[Finding] = []
@@ -67,7 +57,7 @@ class _LoopVisitor(ast.NodeVisitor):
 
     def visit_If(self, node):
         self.visit(node.test)
-        guarded = _is_guard(node.test)
+        guarded = is_guard_expr(node.test)
         self.guard_depth += guarded
         for stmt in node.body:
             self.visit(stmt)
@@ -96,6 +86,24 @@ class _LoopVisitor(ast.NodeVisitor):
                         symbol=self.fn_qual,
                     )
                 )
+            else:
+                callee = (
+                    fn.id if isinstance(fn, ast.Name) else (dotted_name(fn) or "")
+                )
+                chain = self.blocking_callables.get(callee)
+                if chain is not None:
+                    self.findings.append(
+                        Finding(
+                            self.rule.id,
+                            self.module.rel_path,
+                            node.lineno,
+                            node.col_offset,
+                            f"'{callee}()' blocks every iteration of this loop "
+                            f"({chain}) — gate it behind a profiling flag or "
+                            "sync once after the loop",
+                            symbol=self.fn_qual,
+                        )
+                    )
         self.generic_visit(node)
 
     def visit_FunctionDef(self, node):
@@ -109,15 +117,17 @@ class BlockingInHotLoop(Rule):
     id = "blocking-in-hot-loop"
     description = (
         "block_until_ready/effects_barrier inside a step loop outside a "
-        "profiling guard"
+        "profiling guard (direct, or through a helper in any module)"
     )
+    kind = "reachability"
 
     def check(self, module, ctx):
+        blocking_callables = ctx.blocking_aliases.get(module.rel_path, {})
         findings = []
         for info in module.callgraph.functions.values():
-            if _GUARD_NAME_RE.search(info.name):
+            if GUARD_NAME_RE.search(info.name):
                 continue  # bench/profiling helpers sync on purpose
-            v = _LoopVisitor(self, module, info.qualname)
+            v = _LoopVisitor(self, module, info.qualname, blocking_callables)
             for stmt in info.node.body:
                 v.visit(stmt)
             findings.extend(v.findings)

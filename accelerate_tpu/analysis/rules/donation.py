@@ -5,7 +5,10 @@ reference points at freed/overwritten device memory.  JAX only *warns* (and
 only sometimes), the read returns garbage or raises much later.  The rule
 tracks, per function body and in execution order, names passed at donated
 positions of a known donating callable; any later read before a rebind is
-flagged.
+flagged.  Donating callables are resolved whole-program: one defined in
+another module and imported (``from .opt import apply_grads``) or called
+through a module alias (``opt.apply_grads(state)``) counts the same as a
+local ``g = jax.jit(f, donate_argnums=...)``.
 
 Loop bodies get a second pass: a read that *precedes* the donation in source
 order is fine on iteration 1 but reads a dead buffer on iteration 2 unless
@@ -18,56 +21,25 @@ caught at all.
 from __future__ import annotations
 
 import ast
-from typing import Optional
 
+from ..callgraph import donating_callables, dotted_name
 from ..engine import Finding, Rule
 
-_JIT_LEAVES = {"jit", "pjit"}
 
-
-def _donated_positions(call: ast.Call) -> Optional[list[int]]:
-    for kw in call.keywords:
-        if kw.arg == "donate_argnums":
-            v = kw.value
-            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
-            out = [
-                e.value
-                for e in elts
-                if isinstance(e, ast.Constant) and isinstance(e.value, int)
-            ]
-            return out or None
-    return None
-
-
-def _donating_callables(module) -> dict[str, list[int]]:
-    """name -> donated positions, for `g = jax.jit(f, donate_argnums=...)`
-    assignments and `@partial(jax.jit, donate_argnums=...)` decorated defs."""
-    out: dict[str, list[int]] = {}
-    for node in ast.walk(module.tree):
-        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-            resolved = module.resolve(node.value.func) or ""
-            if resolved.rsplit(".", 1)[-1] in _JIT_LEAVES:
-                pos = _donated_positions(node.value)
-                if pos:
-                    for t in node.targets:
-                        if isinstance(t, ast.Name):
-                            out[t.id] = pos
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for dec in node.decorator_list:
-                if not isinstance(dec, ast.Call):
-                    continue
-                resolved = module.resolve(dec.func) or ""
-                leaf = resolved.rsplit(".", 1)[-1]
-                is_jit_factory = leaf in _JIT_LEAVES
-                is_partial_jit = leaf == "partial" and any(
-                    (module.resolve(a) or "").rsplit(".", 1)[-1] in _JIT_LEAVES
-                    for a in dec.args
-                )
-                if is_jit_factory or is_partial_jit:
-                    pos = _donated_positions(dec)
-                    if pos:
-                        out[node.name] = pos
-    return out
+def visible_donors(module, ctx) -> dict[str, list[int]]:
+    """Donating callables this module can name: its own (`g = jax.jit(f,
+    donate_argnums=...)` / decorated defs) merged with what the program
+    graph resolved through imports — `from .opt import apply_grads` and
+    `opt.apply_grads` both land here when `apply_grads` donates."""
+    donors = dict(ctx.donor_aliases.get(module.rel_path, {}))
+    # memoized: two rules call this per module, and the engine-driven path
+    # already seeded ctx.donor_aliases from the same walk at summary time
+    local = getattr(module, "_donor_cache", None)
+    if local is None:
+        local = module._donor_cache = donating_callables(module)
+    for name, pos in local.items():
+        donors.setdefault(name, pos)
+    return donors
 
 
 class _LinearScanner(ast.NodeVisitor):
@@ -110,14 +82,21 @@ class _LinearScanner(ast.NodeVisitor):
 
     def visit_Call(self, node):
         fn = node.func
+        donor = None
         if isinstance(fn, ast.Name) and fn.id in self.donors:
+            donor = fn.id
+        elif isinstance(fn, ast.Attribute):
+            d = dotted_name(fn)
+            if d in self.donors:
+                donor = d
+        if donor is not None:
             for arg in node.args:
                 self.visit(arg)
             for kw in node.keywords:
                 self.visit(kw.value)
-            for pos in self.donors[fn.id]:
+            for pos in self.donors[donor]:
                 if pos < len(node.args) and isinstance(node.args[pos], ast.Name):
-                    self.dead[node.args[pos].id] = (fn.id, node.lineno)
+                    self.dead[node.args[pos].id] = (donor, node.lineno)
         else:
             self.generic_visit(node)
 
@@ -175,9 +154,10 @@ class _LinearScanner(ast.NodeVisitor):
 class DonationReuse(Rule):
     id = "donation-reuse"
     description = "buffer read after appearing at a donate_argnums position"
+    kind = "reachability"
 
     def check(self, module, ctx):
-        donors = _donating_callables(module)
+        donors = visible_donors(module, ctx)
         if not donors:
             return []
         findings = []

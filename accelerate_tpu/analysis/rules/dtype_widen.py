@@ -22,6 +22,7 @@ _DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "asarray": 1, "array": 1, "full
 
 class DtypeWiden(Rule):
     id = "dtype-widen"
+    kind = "reachability"
     description = "float64 promotion on a TPU path (jnp dtype, astype, or jax_enable_x64)"
 
     def _is_wide(self, module, node: ast.AST, allow_builtin_float: bool) -> bool:

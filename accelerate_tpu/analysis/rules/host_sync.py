@@ -38,6 +38,7 @@ def _is_static_expr(node: ast.AST) -> bool:
 
 class HostSyncInTrace(Rule):
     id = "host-sync-in-trace"
+    kind = "reachability"
     description = (
         "host transfer (.item()/.tolist()/float()/np.asarray/jax.device_get/"
         ".block_until_ready) reachable from jit/shard_map/compile_step-traced code"

@@ -1,5 +1,5 @@
 """recompile-hazard: python-scalar control flow / shapes inside jit without
-``static_argnums``.
+``static_argnums``, and unbucketed batches fed to a captured step.
 
 A jit argument used in an ``if``/``while`` test, in ``range()``, or as a
 shape raises ConcretizationTypeError at trace time — or, when the caller
@@ -7,12 +7,22 @@ papers over it by passing python ints, silently recompiles the whole program
 for every distinct value (the multi-minute XLA compile, per step).  The fix
 is ``static_argnums``/``static_argnames`` (hashable, cache-keyed) or
 ``lax.cond``/``jnp.where`` for genuinely dynamic branches.
+
+The capture-cache variant: ``CapturedStep.__call__`` keys its program cache
+on ``(treedef, shapes, dtypes, sync_gradients, training)`` — a loop that
+feeds *unpadded, varying-length* batches from a data loader into a
+``compile_step``-captured callable compiles one program per distinct
+sequence length.  The rule flags ``for batch in loader: step(batch)`` when
+the loader shows no ``PaddingCollate`` / ``TPU_PAD_MULTIPLE`` / bucketing
+evidence (a ``collate_fn=`` or a pad/bucket-named helper counts).
 """
 
 from __future__ import annotations
 
 import ast
+import re
 
+from ..callgraph import iter_own_nodes
 from ..engine import Finding, Rule
 
 # module-level constructors: leaf -> positional index of the shape argument
@@ -141,11 +151,109 @@ def _names_in_concretizing_positions(test: ast.AST):
     return out
 
 
+# names whose assignment marks a captured-step callable
+_CAPTURE_LEAVES = {"compile_step", "CapturedStep"}
+# evidence the author already buckets shapes (PaddingCollate pads to
+# TPU_PAD_MULTIPLE; any custom collate_fn is assumed to know its shapes)
+_PAD_EVIDENCE_RE = re.compile(r"pad|bucket|PaddingCollate|TPU_PAD_MULTIPLE", re.IGNORECASE)
+_LOADER_NAME_RE = re.compile(r"loader|batches", re.IGNORECASE)
+# iteration adapters that pass their iterable's items through unchanged —
+# `for i, batch in enumerate(loader)` is the same loader underneath
+_ITER_WRAPPERS = {"enumerate", "zip", "tqdm", "islice", "cycle", "reversed"}
+
+
+def _captured_names(module) -> set[str]:
+    out = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            resolved = module.resolve(node.value.func) or ""
+            if resolved.rsplit(".", 1)[-1] in _CAPTURE_LEAVES:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _subtree_has_pad_evidence(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and _PAD_EVIDENCE_RE.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and _PAD_EVIDENCE_RE.search(sub.attr):
+            return True
+        if isinstance(sub, ast.keyword) and sub.arg and (
+            sub.arg == "collate_fn" or _PAD_EVIDENCE_RE.search(sub.arg)
+        ):
+            return True
+    return False
+
+
+def _scope_params(scope) -> set[str]:
+    a = getattr(scope, "args", None)
+    if a is None:
+        return set()
+    return {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+
+
+def _assignment_in(scope, name: str):
+    """Last assignment to ``name`` among the scope's own statements —
+    ``iter_own_nodes`` stops at nested def/class bodies at any depth, so a
+    function under a module-level ``if`` is never scanned as module code."""
+    assigned = None
+    for node in iter_own_nodes(scope):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    assigned = node.value
+    return assigned
+
+
+def _loader_expr(module, expr: ast.AST, scope, _depth: int = 0):
+    """The loader-construction Call a loop iterates over, chasing assignments
+    in the loop's own scope (a parameter or local binding never resolves to
+    another function's same-named local; unbound names fall back to module
+    level).  Depth-capped: `loader = loader`-style cycles terminate.  None
+    when the iterable is not loader-shaped (ranges, fixed arrays, zips —
+    those can't vary shapes per step)."""
+    if _depth > 8:
+        return None
+    if isinstance(expr, ast.Name):
+        if _PAD_EVIDENCE_RE.search(expr.id):
+            return None  # `padded_loader` names its own mitigation
+        assigned = _assignment_in(scope, expr.id)
+        if (
+            assigned is None
+            and scope is not module.tree
+            and expr.id not in _scope_params(scope)
+        ):
+            assigned = _assignment_in(module.tree, expr.id)
+        if assigned is not None and not (
+            isinstance(assigned, ast.Name) and assigned.id == expr.id
+        ):
+            return _loader_expr(module, assigned, scope, _depth + 1)
+        return expr if _LOADER_NAME_RE.search(expr.id) else None
+    if isinstance(expr, ast.Call):
+        resolved = module.resolve(expr.func) or ""
+        leaf = resolved.rsplit(".", 1)[-1]
+        if _LOADER_NAME_RE.search(leaf) or leaf in ("prepare", "prepare_data_loader"):
+            return expr
+        if leaf in _ITER_WRAPPERS:
+            for a in expr.args:
+                found = _loader_expr(module, a, scope, _depth + 1)
+                if found is not None:
+                    return found
+            return None
+    if isinstance(expr, ast.Attribute) and _LOADER_NAME_RE.search(expr.attr):
+        return expr
+    return None
+
+
 class RecompileHazard(Rule):
     id = "recompile-hazard"
+    kind = "syntactic"
     description = (
         "jit argument used in python control flow / range() / shapes without "
-        "static_argnums, or an unhashable static default"
+        "static_argnums, an unhashable static default, or a captured step fed "
+        "unbucketed loader batches"
     )
 
     def check(self, module, ctx):
@@ -181,6 +289,64 @@ class RecompileHazard(Rule):
                         )
                     )
             findings.extend(self._scan_body(module, info, dynamic))
+        findings.extend(self._scan_capture_loops(module))
+        return findings
+
+    # -- capture-cache hazard ------------------------------------------------
+    def _scan_capture_loops(self, module):
+        """``for batch in loader: step(batch)`` where ``step`` is a
+        compile_step-captured callable and the loader shows no bucketing
+        evidence: every distinct batch shape compiles a fresh program
+        (CapturedStep keys on (treedef, shapes, dtypes, ...))."""
+        captured = _captured_names(module)
+        if not captured:
+            return []
+        findings = []
+        scopes = [module.tree] + [
+            info.node for info in module.callgraph.functions.values()
+        ]
+        for scope in scopes:
+            findings.extend(self._scan_scope_loops(module, scope, captured))
+        return findings
+
+    def _scan_scope_loops(self, module, scope, captured):
+        findings = []
+        for loop in iter_own_nodes(scope):
+            if not isinstance(loop, (ast.For, ast.AsyncFor)):
+                continue
+            loader = _loader_expr(module, loop.iter, scope)
+            if loader is None or _subtree_has_pad_evidence(loader):
+                continue
+            targets = {
+                n.id for n in ast.walk(loop.target) if isinstance(n, ast.Name)
+            }
+            for node in ast.walk(loop):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in captured
+                ):
+                    continue
+                feeds_batch = any(
+                    isinstance(n, ast.Name) and n.id in targets
+                    for a in list(node.args) + [kw.value for kw in node.keywords]
+                    for n in ast.walk(a)
+                )
+                if feeds_batch:
+                    findings.append(
+                        Finding(
+                            self.id,
+                            module.rel_path,
+                            node.lineno,
+                            node.col_offset,
+                            f"loader batches flow into captured step "
+                            f"'{node.func.id}' without PaddingCollate/"
+                            "TPU_PAD_MULTIPLE bucketing — CapturedStep's "
+                            "cache keys on (treedef, shapes, dtypes, "
+                            "sync_gradients, training), so every distinct "
+                            "batch shape compiles a fresh program",
+                        )
+                    )
         return findings
 
     def _scan_body(self, module, info, dynamic):

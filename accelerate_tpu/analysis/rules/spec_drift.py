@@ -13,6 +13,14 @@ against the recorded specs.
 
 Without ``--ckpt-index`` the rule is inert (there is nothing to compare
 against), so it never fires during plain ``make lint``.
+
+Beyond literal ``tp_plan`` edits, the rule also checks the *fsdp strategy*
+against the checkpoint: ``plan_param_spec`` only lays an ``fsdp`` axis onto
+parameters under ``FULL_SHARD`` / ``HYBRID_SHARD``.  A checkpoint whose
+index records fsdp-sharded tensors loaded by source that now says
+``sharding_strategy="NO_SHARD"`` (or ``SHARD_GRAD_OP``) will all-gather and
+re-lay-out every parameter at step one — the same silent cost as a plan
+edit, caught the same way.
 """
 
 from __future__ import annotations
@@ -87,11 +95,43 @@ def _plan_dicts(module):
                 break
 
 
+# strategies under which plan_param_spec does NOT shard parameters
+_NON_SHARDING = {"NO_SHARD", "SHARD_GRAD_OP"}
+
+
+def _strategy_literals(module):
+    """Yield (value, node) for every literal ``sharding_strategy`` binding:
+    a keyword argument (``FullyShardedDataParallelPlugin(sharding_strategy=
+    "NO_SHARD")``) or an assignment whose target name says so."""
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if (
+                    kw.arg == "sharding_strategy"
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                ):
+                    yield kw.value.value, kw.value
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            value = node.value
+            if not (isinstance(value, ast.Constant) and isinstance(value.value, str)):
+                continue
+            for t in targets:
+                name = t.id if isinstance(t, ast.Name) else (
+                    t.attr if isinstance(t, ast.Attribute) else None
+                )
+                if name and name.endswith("sharding_strategy"):
+                    yield value.value, value
+                    break
+
+
 class ShardingSpecDrift(Rule):
     id = "sharding-spec-drift"
+    kind = "syntactic"
     description = (
-        "sharding plan assigns different axes than the checkpoint metadata "
-        "records (needs --ckpt-index)"
+        "sharding plan or fsdp strategy disagrees with the checkpoint "
+        "metadata records (needs --ckpt-index)"
     )
 
     def check(self, module, ctx):
@@ -99,6 +139,7 @@ class ShardingSpecDrift(Rule):
         if not specs:
             return []
         findings: list[Finding] = []
+        findings.extend(self._check_strategy(module, specs))
         for plan_name, dict_node in _plan_dicts(module):
             claimed: set = set()  # first matching pattern wins, like plan_param_spec
             for key_node, value_node in zip(dict_node.keys, dict_node.values):
@@ -168,4 +209,41 @@ class ShardingSpecDrift(Rule):
                             symbol=plan_name,
                         )
                     )
+        return findings
+
+    def _check_strategy(self, module, specs):
+        """The plan_param_spec side of drift: fsdp-sharded records vs a
+        source strategy that no longer shards parameters."""
+        fsdp_tensors = [
+            tensor
+            for tensor, recorded in specs.items()
+            if any("fsdp" in dim for dim in _normalize_spec(recorded))
+        ]
+        if not fsdp_tensors:
+            # no fsdp axis recorded proves nothing: the checkpoint may have
+            # been saved on an fsdp:1 mesh, which canonicalizes the axis away
+            return []
+        findings = []
+        for value, node in _strategy_literals(module):
+            if value in _NON_SHARDING:
+                findings.append(
+                    Finding(
+                        self.id,
+                        module.rel_path,
+                        node.lineno,
+                        node.col_offset,
+                        f"sharding_strategy={value!r} but the checkpoint "
+                        f"records fsdp-sharded tensors (e.g. "
+                        f"'{fsdp_tensors[0]}'"
+                        + (
+                            f", +{len(fsdp_tensors) - 1} more"
+                            if len(fsdp_tensors) > 1
+                            else ""
+                        )
+                        + ") — plan_param_spec will not shard under this "
+                        "strategy, so loading all-gathers and re-lays-out "
+                        "every parameter at step one; restore FULL_SHARD or "
+                        "resave the checkpoint",
+                    )
+                )
         return findings

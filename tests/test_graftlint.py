@@ -30,6 +30,26 @@ def lint(tmp_path, source, rule=None, name="snippet.py"):
     return run_analysis([str(f)], rules=rules)
 
 
+def write_pkg(tmp_path, files, pkg="pkg"):
+    """Materialize a multi-file fixture *package* ({relpath: source})."""
+    root = tmp_path / pkg
+    for rel, source in files.items():
+        f = root / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(source))
+    if not (root / "__init__.py").exists():
+        (root / "__init__.py").write_text("")
+    return root
+
+
+def lint_pkg(tmp_path, files, rule=None, cross_module=True, cache_dir=None):
+    root = write_pkg(tmp_path, files)
+    rules = get_rules([rule]) if rule else None
+    return run_analysis(
+        [str(root)], rules=rules, cross_module=cross_module, cache_dir=cache_dir
+    )
+
+
 # ---------------------------------------------------------------------------
 # good/bad fixture pairs, one per rule
 # ---------------------------------------------------------------------------
@@ -134,6 +154,45 @@ FIXTURES = {
 
         def train(x):
             x = g(x)          # rebinding the name is the blessed pattern
+            return x
+        """,
+    ),
+    "transitive-donation": (
+        """
+        import jax
+
+        _HISTORY = []
+
+        def f(a):
+            return a + 1
+
+        g = jax.jit(f, donate_argnums=(0,))
+
+        def remember(x):
+            _HISTORY.append(x)      # alias escapes into module state
+
+        def train(x):
+            remember(x)
+            x = g(x)                # donation frees the stored alias
+            return x
+        """,
+        1,
+        """
+        import jax
+
+        _HISTORY = []
+
+        def f(a):
+            return a + 1
+
+        g = jax.jit(f, donate_argnums=(0,))
+
+        def remember(x):
+            _HISTORY.append(x.copy())   # a copy escapes, not the buffer
+
+        def train(x):
+            remember(x)
+            x = g(x)
             return x
         """,
     ),
@@ -436,12 +495,14 @@ def test_cli_list_rules():
 
 
 def test_package_is_clean_and_fast():
-    """Acceptance gate: the real package lints clean, within the <15 s budget
-    that lets `make lint` sit in front of every `make test`."""
+    """Acceptance gate: the real package lints clean under COLD whole-program
+    analysis (no cache), within the <15 s budget that lets `make lint-cold`
+    sit in CI in front of every `make test`."""
     proc = _run_cli("accelerate_tpu", "--format", "json")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     data = json.loads(proc.stdout)
     assert data["findings"] == []
+    assert data["cross_module"] is True
     assert data["files_analyzed"] > 100
     assert data["duration_s"] < 15.0, f"analysis took {data['duration_s']}s"
 
@@ -608,3 +669,978 @@ def test_spec_drift_ignores_auto_added_fsdp_axis(tmp_path):
     index = _write_index(tmp_path, {"layers.0.q_proj.weight": ["tp", "fsdp"]})
     res = _lint_with_index(tmp_path, PLAN_SNIPPET, index)
     assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+# ---------------------------------------------------------------------------
+# sharding-spec-drift: plan_param_spec strategy drift (fsdp-sharded
+# checkpoint vs a source strategy that no longer shards)
+# ---------------------------------------------------------------------------
+
+STRATEGY_SNIPPET = """
+from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+plugin = FullyShardedDataParallelPlugin(sharding_strategy={strategy!r})
+"""
+
+
+def test_strategy_drift_flags_no_shard_against_fsdp_checkpoint(tmp_path):
+    index = _write_index(tmp_path, {"layers.0.mlp.weight": ["fsdp", None]})
+    res = _lint_with_index(
+        tmp_path, STRATEGY_SNIPPET.format(strategy="NO_SHARD"), index
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    f = res.new_findings[0]
+    assert "NO_SHARD" in f.message and "mlp" in f.message
+
+
+def test_strategy_drift_silent_when_still_sharding(tmp_path):
+    index = _write_index(tmp_path, {"layers.0.mlp.weight": ["fsdp", None]})
+    res = _lint_with_index(
+        tmp_path, STRATEGY_SNIPPET.format(strategy="FULL_SHARD"), index
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_strategy_drift_silent_without_fsdp_record(tmp_path):
+    """A checkpoint with no fsdp axis recorded proves nothing — it may have
+    been saved on an fsdp:1 mesh, which canonicalizes the axis away."""
+    index = _write_index(tmp_path, {"layers.0.mlp.weight": ["tp", None]})
+    res = _lint_with_index(
+        tmp_path, STRATEGY_SNIPPET.format(strategy="NO_SHARD"), index
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+# ---------------------------------------------------------------------------
+# whole-program mode: cross-module reachability (tentpole)
+# ---------------------------------------------------------------------------
+
+CROSS_HOST_SYNC_BAD = {
+    "ops.py": """
+        import jax
+        from .helpers import summarize
+
+        @jax.jit
+        def step(x):
+            return summarize(x)
+        """,
+    "helpers.py": """
+        def summarize(x):
+            return float(x.mean())      # host sync, traced via ops.step
+        """,
+}
+
+CROSS_HOST_SYNC_GOOD = {
+    "ops.py": CROSS_HOST_SYNC_BAD["ops.py"],
+    "helpers.py": """
+        def summarize(x):
+            return x.mean() * 2         # device op: trace-safe
+        """,
+}
+
+
+def test_cross_module_host_sync_fires_in_whole_program_mode(tmp_path):
+    """Acceptance fixture: a traced ops/-style module calls a host-syncing
+    helper in a utils/-style module — visible only to the whole-program
+    graph."""
+    res = lint_pkg(tmp_path, CROSS_HOST_SYNC_BAD, rule="host-sync-in-trace")
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    f = res.new_findings[0]
+    assert f.path.endswith("helpers.py") and f.symbol == "summarize"
+    assert "ops.py" in f.message  # the reason names the traced caller
+
+
+def test_cross_module_host_sync_silent_without_whole_program(tmp_path):
+    """Same bad package with --no-cross-module: the per-module graph cannot
+    see the import edge, so nothing fires (the historical behavior)."""
+    res = lint_pkg(
+        tmp_path, CROSS_HOST_SYNC_BAD, rule="host-sync-in-trace", cross_module=False
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+    assert res.cross_module is False
+
+
+def test_cross_module_host_sync_good_twin_clean(tmp_path):
+    res = lint_pkg(tmp_path, CROSS_HOST_SYNC_GOOD)
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_reexport_chain_reachability(tmp_path):
+    """`from . import stat` where pkg/__init__.py re-exports stat from a
+    submodule: the chain __init__ → helpers must resolve."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "__init__.py": "from .helpers import stat\n",
+            "helpers.py": """
+                def stat(x):
+                    return x.item()
+                """,
+            "ops.py": """
+                import jax
+                from . import stat
+
+                @jax.jit
+                def step(x):
+                    return stat(x)
+                """,
+        },
+        rule="host-sync-in-trace",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert res.new_findings[0].path.endswith("helpers.py")
+
+
+def test_partial_callback_crosses_module_boundary(tmp_path):
+    """A partial(...)-wrapped callback handed to lax.scan in another module
+    is a trace root there."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "utils.py": """
+                def do_step(cfg, carry, x):
+                    return carry, x.item()
+                """,
+            "ops.py": """
+                import functools
+                import jax
+                from .utils import do_step
+
+                def run(xs, cfg):
+                    return jax.lax.scan(functools.partial(do_step, cfg), None, xs)
+                """,
+        },
+        rule="host-sync-in-trace",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert res.new_findings[0].symbol == "do_step"
+
+
+def test_module_alias_call_crosses_boundary(tmp_path):
+    """Dotted calls through a module alias (`from . import helpers;
+    helpers.summarize(x)`) resolve too."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "helpers.py": CROSS_HOST_SYNC_BAD["helpers.py"],
+            "ops.py": """
+                import jax
+                from . import helpers
+
+                @jax.jit
+                def step(x):
+                    return helpers.summarize(x)
+                """,
+        },
+        rule="host-sync-in-trace",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+
+
+def test_duplicate_module_names_are_not_cross_wired(tmp_path):
+    """Two same-stem files outside any package both claim the module name
+    'train' — the ambiguous name must resolve to NEITHER, not silently wire
+    every import to the first file (review regression: a/train.py's host
+    sync was attributed to b/ops.py's unrelated import)."""
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    (tmp_path / "a" / "train.py").write_text(
+        "def helper(x):\n    return float(x.mean())\n"
+    )
+    (tmp_path / "b" / "train.py").write_text("def helper(x):\n    return x\n")
+    (tmp_path / "b" / "ops.py").write_text(
+        textwrap.dedent(
+            """
+            import jax
+            from train import helper
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+            """
+        )
+    )
+    res = run_analysis([str(tmp_path)], rules=get_rules(["host-sync-in-trace"]))
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_singleton_init_is_reachability_barrier(tmp_path):
+    """Pin of the package triage: a borg-singleton __init__
+    (`self.__dict__ = cls._shared_state`) runs once per process — traced
+    code constructing the class must NOT drag the init body (host-side mesh
+    building, np.asarray) into the traced region."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "state.py": """
+                import numpy as np
+
+                class State:
+                    _shared_state = {}
+
+                    def __init__(self):
+                        self.__dict__ = self._shared_state
+                        if not self.__dict__:
+                            self.topo = np.asarray(enumerate_topology())
+                """,
+            "ops.py": """
+                import jax
+                from .state import State
+
+                @jax.jit
+                def step(x):
+                    scale = State().topo
+                    return x
+                """,
+        },
+        rule="host-sync-in-trace",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_chained_attribute_call_does_not_link_same_name_method(tmp_path):
+    """`self.state.update(x)` dispatches on an unknown receiver type — it
+    must not create an edge to an unrelated same-module Metrics.update
+    (review regression: depth-2 self chains linked by bare leaf name, so any
+    common method name poisoned the traced region)."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        class Metrics:
+            def update(self, v):
+                self.total = float(v)       # host cast: fine, never traced
+
+        class Trainer:
+            @jax.jit
+            def step(self, x):
+                self.state.update(x)
+                return x
+        """,
+        rule="host-sync-in-trace",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+# ---------------------------------------------------------------------------
+# whole-program mode: cross-module donation + transitive-donation
+# ---------------------------------------------------------------------------
+
+def test_cross_module_donation_reuse(tmp_path):
+    """A donating callable imported from another module (bare and through a
+    module alias) participates in donation-reuse."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "opt.py": """
+                import functools
+                import jax
+
+                @functools.partial(jax.jit, donate_argnums=(0,))
+                def apply_update(state, grads):
+                    return state
+                """,
+            "train.py": """
+                from . import opt
+                from .opt import apply_update
+
+                def train(state, grads):
+                    new = apply_update(state, grads)
+                    return state + new          # read after donation
+
+                def train_dotted(state, grads):
+                    new = opt.apply_update(state, grads)
+                    return state + new          # same, via module alias
+                """,
+        },
+        rule="donation-reuse",
+    )
+    assert len(res.new_findings) == 2, [f.render() for f in res.new_findings]
+    assert {f.symbol for f in res.new_findings} == {"train", "train_dotted"}
+
+
+def test_transitive_donation_cross_module(tmp_path):
+    """A helper in another module stores the buffer; donating it afterwards
+    leaves the stored alias dangling — even though the local name was
+    correctly rebound (which is why donation-reuse cannot see it)."""
+    files = {
+        "stash.py": """
+            _HISTORY = []
+
+            def remember(x):
+                _HISTORY.append(x)
+
+            def peek(x):
+                return x.mean()
+            """,
+        "train.py": """
+            import jax
+            from .stash import remember, peek
+
+            def f(a):
+                return a * 2
+
+            g = jax.jit(f, donate_argnums=(0,))
+
+            def train(x):
+                remember(x)
+                x = g(x)
+                return x
+            """,
+    }
+    res = lint_pkg(tmp_path, files, rule="transitive-donation")
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    f = res.new_findings[0]
+    assert "remember" in f.message and "stash.py" in f.message
+    # donation-reuse stays silent (the local name WAS rebound)
+    res = lint_pkg(tmp_path, files, rule="donation-reuse")
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+    # a helper that only reads is fine
+    good = dict(files)
+    good["train.py"] = files["train.py"].replace("remember(x)", "peek(x)")
+    res = lint_pkg(tmp_path, good)
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+# ---------------------------------------------------------------------------
+# whole-program mode: blocking through a helper in another module
+# ---------------------------------------------------------------------------
+
+def test_blocking_through_cross_module_helper(tmp_path):
+    res = lint_pkg(
+        tmp_path,
+        {
+            "syncs.py": """
+                def hard_sync(x):
+                    x.block_until_ready()
+                    return x
+                """,
+            "loop.py": """
+                from .syncs import hard_sync
+
+                def train(step, batches):
+                    for b in batches:
+                        out = step(b)
+                        hard_sync(out)
+                    return out
+                """,
+        },
+        rule="blocking-in-hot-loop",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    f = res.new_findings[0]
+    assert f.path.endswith("loop.py") and "hard_sync" in f.message
+
+
+def test_blocking_helper_with_internal_guard_is_clean(tmp_path):
+    """A helper that only blocks under a profiling guard does not poison its
+    callers — including when the guard sits inside a loop/try in the helper
+    (review regression: the structural scan must honor guards at any depth)."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "syncs.py": """
+                def maybe_sync(x, profile=False):
+                    if profile:
+                        x.block_until_ready()
+                    return x
+
+                def drain(xs, profiling=False):
+                    for x in xs:
+                        if profiling:
+                            x.block_until_ready()
+                    return xs
+
+                def launcher(xs):
+                    def inner(y):
+                        y.block_until_ready()   # nested def: its own function
+                    return [x for x in xs]
+                """,
+            "loop.py": """
+                from .syncs import maybe_sync, drain, launcher
+
+                def train(step, batches):
+                    for b in batches:
+                        out = step(b)
+                        maybe_sync(out)
+                        drain(out)
+                        launcher(out)
+                    return out
+                """,
+        },
+        rule="blocking-in-hot-loop",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_blocking_closure_is_off_without_whole_program(tmp_path):
+    """--no-cross-module is the escape hatch back to the historical linter:
+    only DIRECT blocking calls fire, helper-transitive ones do not — even
+    same-module ones."""
+    src = {
+        "loop.py": """
+            def sync_all(x):
+                x.block_until_ready()
+                return x
+
+            def train(step, batches):
+                for b in batches:
+                    out = step(b)
+                    sync_all(out)
+                return out
+            """,
+    }
+    on = lint_pkg(tmp_path, src, rule="blocking-in-hot-loop")
+    assert len(on.new_findings) == 1, [f.render() for f in on.new_findings]
+    off = lint_pkg(tmp_path, src, rule="blocking-in-hot-loop", cross_module=False)
+    assert off.new_findings == [], [f.render() for f in off.new_findings]
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard: capture-cache awareness (unbucketed loader batches)
+# ---------------------------------------------------------------------------
+
+CAPTURE_LOOP_BAD = """
+from torch.utils.data import DataLoader
+
+def train(accelerator, dataset, step_fn):
+    step = accelerator.compile_step(step_fn)
+    loader = DataLoader(dataset, batch_size=8)
+    for batch in loader:
+        step(batch)
+"""
+
+CAPTURE_LOOP_GOOD = """
+from torch.utils.data import DataLoader
+from accelerate_tpu.data_loader import PaddingCollate
+
+def train(accelerator, dataset, step_fn):
+    step = accelerator.compile_step(step_fn)
+    loader = DataLoader(
+        dataset, batch_size=8, collate_fn=PaddingCollate(pad_to_multiple_of=128)
+    )
+    for batch in loader:
+        step(batch)
+
+def train_fixed(accelerator, ids, step_fn, bs):
+    # fixed-shape slices out of one array: shapes cannot vary per step
+    step = accelerator.compile_step(step_fn)
+    for start in range(0, 128, bs):
+        step(ids[start : start + bs])
+"""
+
+
+def test_capture_cache_recompile_hazard_fires(tmp_path):
+    res = lint(tmp_path, CAPTURE_LOOP_BAD, rule="recompile-hazard")
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert "CapturedStep" in res.new_findings[0].message
+
+
+def test_capture_loop_enumerate_wrapped_loader_is_flagged(tmp_path):
+    """`for i, batch in enumerate(loader)` is the same unbucketed loader
+    underneath (review regression: wrappers hid the loader; its padded twin
+    must stay clean through the wrapper too)."""
+    src = """
+    from torch.utils.data import DataLoader
+    {extra_import}
+
+    def train(accelerator, dataset, step_fn):
+        step = accelerator.compile_step(step_fn)
+        loader = DataLoader(dataset, batch_size=8{collate})
+        for i, batch in enumerate(loader):
+            step(batch)
+    """
+    res = lint(
+        tmp_path,
+        src.format(extra_import="", collate=""),
+        rule="recompile-hazard",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    res = lint(
+        tmp_path,
+        src.format(
+            extra_import="from accelerate_tpu.data_loader import PaddingCollate",
+            collate=", collate_fn=PaddingCollate()",
+        ),
+        rule="recompile-hazard",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_capture_cache_recompile_hazard_good_twin(tmp_path):
+    res = lint(tmp_path, CAPTURE_LOOP_GOOD, rule="recompile-hazard")
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_capture_loop_self_referential_assignment_terminates(tmp_path):
+    """`loader = loader` must not send the assignment chase into infinite
+    recursion (review regression)."""
+    res = lint(
+        tmp_path,
+        """
+        def train(accelerator, loader, step_fn):
+            step = accelerator.compile_step(step_fn)
+            loader = loader
+            for batch in loader:
+                step(batch)
+        """,
+        rule="recompile-hazard",
+    )
+    assert len(res.new_findings) == 1  # still loader-shaped, still flagged
+
+
+def test_capture_loop_loader_resolves_in_enclosing_scope(tmp_path):
+    """Another function's local `loader` must not shadow the loop's own
+    padded binding (review regression: name resolution was module-wide,
+    last-assignment-wins)."""
+    res = lint(
+        tmp_path,
+        """
+        from torch.utils.data import DataLoader
+        from accelerate_tpu.data_loader import PaddingCollate
+
+        def train(accelerator, dataset, step_fn):
+            step = accelerator.compile_step(step_fn)
+            loader = DataLoader(
+                dataset, batch_size=8, collate_fn=PaddingCollate(pad_to_multiple_of=128)
+            )
+            for batch in loader:
+                step(batch)
+
+        def evaluate(dataset):
+            loader = DataLoader(dataset, batch_size=1)
+            return [len(b) for b in loader]
+        """,
+        rule="recompile-hazard",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_capture_loop_under_module_level_guard_reported_once(tmp_path):
+    """A function nested under a top-level `if` is scanned once, as its own
+    scope — the module-scope walk must not descend into it (review
+    regression: the same loop produced duplicate findings)."""
+    res = lint(
+        tmp_path,
+        """
+        from torch.utils.data import DataLoader
+
+        if True:
+            def main(accelerator, dataset, step_fn):
+                step = accelerator.compile_step(step_fn)
+                loader = DataLoader(dataset, batch_size=8)
+                for batch in loader:
+                    step(batch)
+        """,
+        rule="recompile-hazard",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+
+
+def test_capture_loop_module_level_loader_still_resolves(tmp_path):
+    """A name unbound in the loop's function falls back to the module-level
+    binding — the unpadded global loader is still a hazard."""
+    res = lint(
+        tmp_path,
+        """
+        from torch.utils.data import DataLoader
+
+        loader = DataLoader(dataset, batch_size=8)
+
+        def train(accelerator, step_fn):
+            step = accelerator.compile_step(step_fn)
+            for batch in loader:
+                step(batch)
+        """,
+        rule="recompile-hazard",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+
+
+def test_constructor_escape_positions_skip_self(tmp_path):
+    """Escape positions of Cls.__init__ must align with the CALLER's args
+    (self dropped): storing arg 0 means the caller's first argument escapes,
+    not its second (review regression: off-by-one both directions)."""
+    files = {
+        "stash.py": """
+            class Stash:
+                def __init__(self, kept, ignored):
+                    self._kept = kept
+            """,
+        "train.py": """
+            import jax
+            from .stash import Stash
+
+            def f(a):
+                return a * 2
+
+            g = jax.jit(f, donate_argnums=(0,))
+
+            def bad(a, b):
+                s = Stash(a, b)
+                a = g(a)            # donates the STORED buffer
+                return a
+
+            def fine(a, b):
+                s = Stash(a, b)
+                b = g(b)            # donates the unstored one
+                return b
+            """,
+    }
+    res = lint_pkg(tmp_path, files, rule="transitive-donation")
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert res.new_findings[0].symbol == "bad"
+
+
+def test_derived_scalar_store_is_not_an_escape(tmp_path):
+    """A helper that stores x.shape[0] (a python int) does not store the
+    BUFFER — donating x afterwards is safe (review regression: any RHS
+    mentioning the param counted as a store)."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        _STATS = {}
+
+        def record_size(x):
+            _STATS["n"] = x.shape[0]
+
+        g = jax.jit(lambda a: a, donate_argnums=(0,))
+
+        def train(x):
+            record_size(x)
+            x = g(x)
+            return x
+        """,
+        rule="transitive-donation",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_buffer_stored_inside_container_literal_still_escapes(tmp_path):
+    """The bare-Name restriction must not lose `_CACHE[k] = (x, meta)` —
+    a container literal holding the param stores the buffer itself."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        _CACHE = {}
+
+        def remember(x, tag):
+            _CACHE["latest"] = (x, tag)
+
+        g = jax.jit(lambda a: a, donate_argnums=(0,))
+
+        def train(x):
+            remember(x, "step")
+            x = g(x)
+            return x
+        """,
+        rule="transitive-donation",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+
+
+def test_tuple_unpack_pairs_targets_to_values(tmp_path):
+    """`local, STATE[k] = buf, cfg` stores only cfg — buf lands in a plain
+    local and must not count as an escape (review regression: any storing
+    slot marked every RHS name); swapping the slots flips the verdict."""
+    src = """
+    import jax
+
+    _STATE = {{}}
+
+    def helper(buf, cfg):
+        {unpack}
+        return buf
+
+    g = jax.jit(lambda a: a, donate_argnums=(0,))
+
+    def train(x, cfg):
+        helper(x, cfg)
+        x = g(x)
+        return x
+    """
+    res = lint(
+        tmp_path,
+        src.format(unpack='local, _STATE["cfg"] = buf, cfg'),
+        rule="transitive-donation",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+    res = lint(
+        tmp_path,
+        src.format(unpack='_STATE["buf"], local = buf, cfg'),
+        rule="transitive-donation",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+
+
+def test_augassign_accumulator_is_not_an_escape(tmp_path):
+    """`_ACC["sum"] += x` stores old+x — a NEW array, not an alias of x
+    (review regression); `_ACC["log"] += [x]` is list-extend and still
+    keeps the alias."""
+    src = """
+    import jax
+
+    _ACC = {{"sum": 0, "log": []}}
+
+    def helper(x):
+        {stmt}
+
+    g = jax.jit(lambda a: a, donate_argnums=(0,))
+
+    def train(x):
+        helper(x)
+        x = g(x)
+        return x
+    """
+    res = lint(
+        tmp_path, src.format(stmt='_ACC["sum"] += x'), rule="transitive-donation"
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+    res = lint(
+        tmp_path, src.format(stmt='_ACC["log"] += [x]'), rule="transitive-donation"
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+
+
+def test_plain_import_dotted_donor_participates(tmp_path):
+    """`import pkg.opt; pkg.opt.apply_update(x, g)` is the same donor as the
+    from-import spelling (review regression: the fact maps only bound
+    two-part `alias.fn` names, so the fully-dotted call was invisible)."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "opt.py": """
+                import functools
+                import jax
+
+                @functools.partial(jax.jit, donate_argnums=(0,))
+                def apply_update(state, grads):
+                    return state
+                """,
+            "train.py": """
+                import pkg.opt
+
+                def train(state, grads):
+                    new = pkg.opt.apply_update(state, grads)
+                    return state + new      # read after donation
+                """,
+        },
+        rule="donation-reuse",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert res.new_findings[0].symbol == "train"
+
+
+def test_same_module_constructor_escape_detected(tmp_path):
+    """Coverage must not depend on where the class lives: a same-module
+    constructor that stores a buffer is the same escape as an imported one
+    (review regression: _visible_callables skipped own classes)."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        class Stash:
+            def __init__(self, kept, ignored):
+                self._kept = kept
+
+        g = jax.jit(lambda a: a, donate_argnums=(0,))
+
+        def train(a, b):
+            s = Stash(a, b)
+            a = g(a)            # donates the STORED buffer
+            return a
+        """,
+        rule="transitive-donation",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert res.new_findings[0].symbol == "train"
+
+
+def test_transitive_donation_annotated_rebind_still_fires(tmp_path):
+    """`x: Array = g(x)` evaluates the value before rebinding — the scanner
+    must check the donation before clearing the escaped state (review
+    regression: AnnAssign's default target-first field order)."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        _H = []
+
+        def remember(x):
+            _H.append(x)
+
+        g = jax.jit(lambda a: a, donate_argnums=(0,))
+
+        def train(x):
+            remember(x)
+            x: jax.Array = g(x)
+            return x
+        """,
+        rule="transitive-donation",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+
+
+def test_blocking_chain_message_keeps_root_cause(tmp_path):
+    """A depth-2 chain (loop → outer → mid → block) must still name the
+    terminal blocking call in the finding (review regression)."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "a.py": """
+                def leaf_sync(x):
+                    x.block_until_ready()
+                """,
+            "b.py": """
+                from .a import leaf_sync
+
+                def mid(x):
+                    leaf_sync(x)
+                """,
+            "loop.py": """
+                from .b import mid
+
+                def train(step, batches):
+                    for b in batches:
+                        mid(step(b))
+                """,
+        },
+        rule="blocking-in-hot-loop",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert "block_until_ready" in res.new_findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# on-disk analysis cache
+# ---------------------------------------------------------------------------
+
+def test_cache_second_run_hits_and_replays_findings(tmp_path):
+    cache_dir = str(tmp_path / "cache")
+    first = lint_pkg(tmp_path, CROSS_HOST_SYNC_BAD, cache_dir=cache_dir)
+    assert first.cache_misses > 0 and first.cache_hits == 0
+    assert len(first.new_findings) == 1
+    second = lint_pkg(tmp_path, CROSS_HOST_SYNC_BAD, cache_dir=cache_dir)
+    assert second.cache_misses == 0
+    assert second.cache_hits == first.cache_misses
+    assert [f.render() for f in second.new_findings] == [
+        f.render() for f in first.new_findings
+    ]
+
+
+def test_cache_edit_invalidates_only_the_edited_file(tmp_path):
+    cache_dir = str(tmp_path / "cache")
+    root = write_pkg(tmp_path, CROSS_HOST_SYNC_GOOD)
+    run_analysis([str(root)], cache_dir=cache_dir)
+    # a comment-only edit: content hash changes, cross-module facts don't
+    ops = root / "ops.py"
+    ops.write_text(ops.read_text() + "\n# cache probe\n")
+    res = run_analysis([str(root)], cache_dir=cache_dir)
+    assert res.cache_misses == 1, (res.cache_hits, res.cache_misses)
+    assert res.cache_hits == res.files_analyzed - 1
+
+
+def test_cache_cross_module_edit_invalidates_dependents(tmp_path):
+    """Editing helpers.py so its helper becomes host-syncing must re-analyze
+    helpers.py (content) AND change its findings even though ops.py replays
+    — the env hash carries the new cross-module reached set."""
+    cache_dir = str(tmp_path / "cache")
+    root = write_pkg(tmp_path, CROSS_HOST_SYNC_GOOD)
+    clean = run_analysis([str(root)], cache_dir=cache_dir)
+    assert clean.new_findings == []
+    (root / "helpers.py").write_text(
+        textwrap.dedent(CROSS_HOST_SYNC_BAD["helpers.py"])
+    )
+    res = run_analysis([str(root)], cache_dir=cache_dir)
+    assert len(res.new_findings) == 1
+    assert res.new_findings[0].path.endswith("helpers.py")
+
+
+def test_cache_ignores_stale_or_foreign_entries(tmp_path):
+    from accelerate_tpu.analysis.cache import AnalysisCache
+
+    cache = AnalysisCache(str(tmp_path / "c"))
+    cache.store("a.py", "hash1", {"summary": {}, "results": {}})
+    assert cache.load("a.py", "hash1") is not None
+    assert cache.load("a.py", "hash2") is None      # content drift
+    assert cache.load("b.py", "hash1") is None      # different file
+
+
+def test_cache_env_eviction_is_lru_not_fifo(tmp_path):
+    """The steady-state env must survive churn from other env variants: a
+    cache hit refreshes recency, so eviction drops the least-recently-USED
+    variant (review regression: insertion-order FIFO evicted the busiest
+    env first while dead ones survived)."""
+    cache_dir = str(tmp_path / "cache")
+    root = write_pkg(tmp_path, CROSS_HOST_SYNC_GOOD)
+    steady = get_rules(["host-sync-in-trace"])
+    run_analysis([str(root)], rules=steady, cache_dir=cache_dir)  # seed: miss
+    churn = [
+        ["recompile-hazard"],
+        ["axis-name-mismatch"],
+        ["donation-reuse"],
+        ["dtype-widen"],
+        ["blocking-in-hot-loop"],
+        ["transitive-donation"],
+        ["sharding-spec-drift"],
+        ["recompile-hazard", "dtype-widen"],
+    ]
+    for variant in churn:  # 8 variants: enough to overflow the 8-entry cap
+        hit = run_analysis([str(root)], rules=steady, cache_dir=cache_dir)
+        assert hit.cache_misses == 0
+        run_analysis([str(root)], rules=get_rules(variant), cache_dir=cache_dir)
+    final = run_analysis([str(root)], rules=steady, cache_dir=cache_dir)
+    assert final.cache_misses == 0, "steady env was evicted by churn variants"
+
+
+def test_package_warm_cache_run_is_fast(tmp_path):
+    """Whole-program + cache: the warm path replays every module summary and
+    finding without parsing a single file."""
+    cache_dir = str(tmp_path / "cache")
+    cold = run_analysis(["accelerate_tpu"], cache_dir=cache_dir)
+    assert cold.findings == [], [f.render() for f in cold.findings]
+    warm = run_analysis(["accelerate_tpu"], cache_dir=cache_dir)
+    assert warm.findings == []
+    assert warm.cache_hits == warm.files_analyzed
+    assert warm.cache_misses == 0
+    assert warm.duration_s < cold.duration_s
+
+
+# ---------------------------------------------------------------------------
+# CLI: new flags + rule kinds
+# ---------------------------------------------------------------------------
+
+def test_cli_list_rules_shows_kind():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    assert "[reachability" in proc.stdout and "[syntactic" in proc.stdout
+    for line in proc.stdout.splitlines():
+        assert "[reachability" in line or "[syntactic" in line, line
+
+
+def test_cli_no_cross_module_flag(tmp_path):
+    root = write_pkg(tmp_path, CROSS_HOST_SYNC_BAD)
+    proc = _run_cli(str(root))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    proc = _run_cli(str(root), "--no-cross-module")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "cross-module OFF" in proc.stdout
+
+
+def test_cli_cache_flags(tmp_path):
+    root = write_pkg(tmp_path, CROSS_HOST_SYNC_GOOD)
+    cache_dir = str(tmp_path / "cache")
+    proc = _run_cli(str(root), "--cache-dir", cache_dir)
+    assert proc.returncode == 0 and "miss" in proc.stdout
+    proc = _run_cli(str(root), "--cache-dir", cache_dir)
+    assert "hit" in proc.stdout and "/0 miss" in proc.stdout
+    proc = _run_cli(str(root), "--cache-dir", cache_dir, "--no-cache")
+    assert proc.returncode == 0
+    assert "hit" not in proc.stdout  # cache bypassed entirely

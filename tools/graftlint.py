@@ -3,6 +3,8 @@
 
     python tools/graftlint.py accelerate_tpu/                # human output
     python tools/graftlint.py accelerate_tpu/ --format json
+    python tools/graftlint.py accelerate_tpu/ --cache-dir .graftlint_cache
+    python tools/graftlint.py accelerate_tpu/ --no-cross-module
     python tools/graftlint.py --list-rules
     python tools/graftlint.py pkg/ --write-baseline graftlint_baseline.json
     python tools/graftlint.py pkg/ --baseline graftlint_baseline.json
@@ -44,6 +46,23 @@ def main(argv=None):
     parser.add_argument("--list-rules", action="store_true", help="print rules and exit")
     parser.add_argument("--baseline", help="JSON allowlist; baselined findings don't fail the run")
     parser.add_argument(
+        "--no-cross-module",
+        action="store_true",
+        help="escape hatch: per-module analysis only (no import resolution, "
+        "no cross-module reachability) — the pre-whole-program behavior",
+    )
+    parser.add_argument(
+        "--cache-dir",
+        metavar="DIR",
+        help="enable the on-disk per-module cache (content-hash keyed "
+        "summaries + findings); `make lint` points this at .graftlint_cache/",
+    )
+    parser.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="ignore --cache-dir (force a cold run without touching the cache)",
+    )
+    parser.add_argument(
         "--ckpt-index",
         metavar="PATH",
         help="checkpoint *.index.json (or directory of them) whose recorded "
@@ -66,8 +85,11 @@ def main(argv=None):
             print(f"graftlint: {e.args[0]}", file=sys.stderr)
             return 2
     if args.list_rules:
+        # kind tells suppression triage whether a rule's findings can shift
+        # when cross-module analysis is toggled: "reachability" rules consume
+        # the whole-program call graph, "syntactic" rules never move
         for cls in analysis.ALL_RULES:
-            print(f"{cls.id:24s} {cls.description}")
+            print(f"{cls.id:24s} [{cls.kind:12s}] {cls.description}")
         return 0
     if not args.paths:
         parser.print_usage(sys.stderr)
@@ -95,7 +117,12 @@ def main(argv=None):
             return 2
     try:
         result = analysis.run_analysis(
-            args.paths, rules=rules, baseline=baseline, ckpt_index=ckpt_specs
+            args.paths,
+            rules=rules,
+            baseline=baseline,
+            ckpt_index=ckpt_specs,
+            cross_module=not args.no_cross_module,
+            cache_dir=None if args.no_cache else args.cache_dir,
         )
     except FileNotFoundError as e:
         print(f"graftlint: no such path: {e}", file=sys.stderr)
@@ -117,6 +144,10 @@ def main(argv=None):
         baselined = len(result.findings) - len(result.new_findings)
         extra = f", {baselined} baselined" if baselined else ""
         extra += f", {result.suppressed} suppressed" if result.suppressed else ""
+        if result.cache_hits or result.cache_misses:
+            extra += f", cache {result.cache_hits} hit/{result.cache_misses} miss"
+        if not result.cross_module:
+            extra += ", cross-module OFF"
         print(
             f"graftlint: {len(result.new_findings)} finding(s) in "
             f"{result.files_analyzed} file(s) ({result.duration_s:.2f}s{extra})"
