@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip bench
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke bench
 
 # graftlint: whole-program trace-safety & collective-correctness static
 # analysis (docs/graftlint.md). Runs before the suite. The on-disk cache
@@ -25,7 +25,13 @@ multichip:
 	XLA_FLAGS=--xla_force_host_platform_device_count=4 python -m pytest \
 	  tests/test_zero1.py tests/test_zero_sharding.py -q
 
-test: lint multichip
+# telemetry pipeline proof (docs/telemetry.md): tiny model, 3 steps + a
+# forced shape change with telemetry on, JSONL export validated through
+# tools/telemetry_report.py (step phases present, recompile cause attributed)
+telemetry-smoke:
+	JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
+
+test: lint multichip telemetry-smoke
 	python -m pytest tests/ -q
 
 test_core:
@@ -37,7 +43,7 @@ test_core:
 	  tests/test_fp16_capture.py tests/test_autocast.py \
 	  tests/test_comm_hook.py tests/test_powersgd.py \
 	  tests/test_config_knobs.py \
-	  tests/test_tracking.py tests/test_utils_misc.py \
+	  tests/test_tracking.py tests/test_telemetry.py tests/test_utils_misc.py \
 	  tests/test_deepspeed_compat.py tests/test_param_offload.py -q
 
 test_models:
@@ -70,7 +76,7 @@ test_examples:
 	python -m pytest tests/test_examples.py tests/test_external_scripts.py -q
 
 test_analysis:
-	python -m pytest tests/test_graftlint.py -q
+	python -m pytest tests/test_graftlint.py tests/test_outage_summary.py -q
 
 # the slow split: subprocess launches + big compiles, partitioned out of
 # the default suite by the `slow` marker; CI runs both targets

@@ -59,5 +59,6 @@ from .utils.dataclasses import (
     ProfileKwargs,
     ProjectConfiguration,
     SequenceParallelPlugin,
+    TelemetryKwargs,
     TensorParallelPlugin,
 )

@@ -98,12 +98,19 @@ class Accelerator:
         self._comm_hook = None
         self._comm_wrapper = None  # "fp16"/"bf16" factor rounding for powersgd
         self._powersgd_state = None  # per-model {q, err} arrays, capture-threaded
+        self.telemetry_handler = None
         from .utils.dataclasses import FP8RecipeKwargs
 
-        from .utils.dataclasses import AutocastKwargs, DistributedDataParallelKwargs
+        from .utils.dataclasses import (
+            AutocastKwargs,
+            DistributedDataParallelKwargs,
+            TelemetryKwargs,
+        )
 
         for handler in kwargs_handlers or []:
-            if isinstance(handler, AutocastKwargs):
+            if isinstance(handler, TelemetryKwargs):
+                self.telemetry_handler = handler
+            elif isinstance(handler, AutocastKwargs):
                 self.autocast_handler = handler
             elif isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
@@ -208,6 +215,14 @@ class Accelerator:
 
         self.log_with = filter_trackers(log_with, self.logging_dir)
         self.trackers: list = []
+
+        # runtime telemetry (docs/telemetry.md): always constructed (a few
+        # empty deques), OFF unless TelemetryKwargs/$ACCELERATE_TELEMETRY
+        # turns it on — compile_step pins the enabled instance so the
+        # captured path pays one None-check when off
+        from .telemetry import Telemetry
+
+        self.telemetry = Telemetry(self.telemetry_handler)
 
         # seed the nn RNG only when explicitly requested or still unseeded —
         # never clobber a user's earlier manual_seed
@@ -553,6 +568,7 @@ class Accelerator:
         if isinstance(data_loader, DataLoaderShard):
             if data_loader not in self._dataloaders:
                 self._dataloaders.append(data_loader)
+            data_loader._telemetry = self.telemetry if self.telemetry.enabled else None
             return data_loader
         prepared = prepare_data_loader(
             data_loader,
@@ -565,6 +581,10 @@ class Accelerator:
             mesh=self.state.mesh,
             prefetch_size=self.dataloader_config.prefetch_size,
         )
+        # pin this accelerator's telemetry hub: the loader's wait accounting
+        # must survive (and never be rerouted by) later Accelerator
+        # constructions flipping the module-global active slot
+        prepared._telemetry = self.telemetry if self.telemetry.enabled else None
         self._dataloaders.append(prepared)
         return prepared
 
@@ -1198,6 +1218,18 @@ class Accelerator:
         if config is not None:
             for tracker in self.trackers:
                 tracker.store_init_configuration(config)
+        if self.telemetry.enabled and self.trackers:
+            # bridge: every accelerator.log() drains pending telemetry
+            # events (step phases, recompile causes, HBM samples) into the
+            # same backends as the user's metrics (telemetry/export.py).
+            # First in the list: end_training finishes trackers in order,
+            # and the bridge's finish() must flush into delegates that are
+            # still open (a finished WandB run rejects further log calls).
+            from .telemetry.export import TelemetryTracker
+
+            self.trackers.insert(
+                0, TelemetryTracker(self.telemetry, delegates=list(self.trackers))
+            )
 
     def get_tracker(self, name: str, unwrap: bool = False):
         for tracker in self.trackers:
@@ -1223,6 +1255,12 @@ class Accelerator:
         self.wait_for_checkpoint()  # an in-flight async save must land
         for tracker in self.trackers:
             tracker.finish()
+        if self.telemetry.enabled and not any(
+            t.name == "telemetry" for t in self.trackers
+        ):
+            # no-op unless a JSONL dump path was configured; the tracker
+            # bridge, when present, already wrote it in finish()
+            self.telemetry.write_jsonl()
         self.wait_for_everyone()
 
     # --------------------------------------------------------------- contexts

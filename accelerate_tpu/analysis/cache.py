@@ -19,6 +19,13 @@ The cache is best-effort: any IO/parse error on load or store is treated as
 a miss and never surfaces to the caller.  ``ANALYSIS_VERSION`` is baked into
 every entry so an analyzer upgrade starts cold instead of replaying stale
 findings.
+
+Entries live in a **per-branch namespace** under ``cache_dir``
+(``.graftlint_cache/<branch>/``): content hashes differ between two
+long-lived branches, so a shared flat directory ping-pongs — every
+``git switch`` invalidates almost every entry the other branch just wrote.
+The namespace is ``git rev-parse --abbrev-ref HEAD`` (sanitized), falling
+back to ``detached`` on a detached HEAD or outside a repository.
 """
 
 from __future__ import annotations
@@ -26,16 +33,49 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
+import subprocess
 import tempfile
 from typing import Optional
 
 from .engine import ANALYSIS_VERSION
 
 
+def branch_namespace(root: Optional[str] = None) -> str:
+    """Cache namespace for the git branch at ``root`` — the *analyzed* tree,
+    not the process CWD, which may be a different repo (or none) when
+    graftlint targets an out-of-tree path.  'detached' when there is no
+    branch to key on: detached HEAD, outside a work tree, no git."""
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--abbrev-ref", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=10,
+            cwd=root or None,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return "detached"
+    name = proc.stdout.strip()
+    if proc.returncode != 0 or not name or name == "HEAD":
+        return "detached"
+    # branch names may contain path separators and worse; keep the namespace
+    # a single safe path component
+    return re.sub(r"[^A-Za-z0-9._-]", "_", name)[:80] or "detached"
+
+
 class AnalysisCache:
-    def __init__(self, cache_dir: str):
-        self.dir = cache_dir
-        os.makedirs(cache_dir, exist_ok=True)
+    def __init__(
+        self,
+        cache_dir: str,
+        namespace: Optional[str] = None,
+        root: Optional[str] = None,
+    ):
+        if namespace is None:
+            namespace = branch_namespace(root)
+        self.namespace = namespace
+        self.dir = os.path.join(cache_dir, namespace)
+        os.makedirs(self.dir, exist_ok=True)
 
     def _entry_path(self, rel_path: str) -> str:
         key = hashlib.sha1(rel_path.replace(os.sep, "/").encode("utf-8")).hexdigest()

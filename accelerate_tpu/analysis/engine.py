@@ -518,7 +518,10 @@ def run_analysis(
             if isinstance(ckpt_index, dict)
             else load_ckpt_specs(ckpt_index)
         )
-    cache = AnalysisCache(cache_dir) if cache_dir else None
+    # the branch namespace must come from the *analyzed* tree, which need
+    # not be the process CWD (out-of-tree `graftlint /path/to/checkout`)
+    analysis_root = os.path.dirname(files[0]) if files else cwd
+    cache = AnalysisCache(cache_dir, root=analysis_root) if cache_dir else None
 
     # -- pass 1: summaries (cache-replayed or freshly extracted) ------------
     records: list[_FileRecord] = []

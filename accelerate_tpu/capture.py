@@ -26,6 +26,8 @@ import jax.numpy as jnp
 
 from .nn import random as nn_random
 from .nn.tape import Tensor
+from .telemetry.recompile import RecompileEvent, diff_keys, key_id
+from .telemetry.timeline import StepRecord
 
 
 class _CaptureState(threading.local):
@@ -158,6 +160,26 @@ class CapturedStep:
         # `with accelerator.accumulate(...):`; True → __call__ advances the
         # accumulation schedule host-side before each replay
         self._uses_accumulate: Optional[bool] = None
+        # telemetry (docs/telemetry.md): pinned at construction so the
+        # off-path stays a single None-check per call.  When ON, builds go
+        # through jit.lower()/.compile() so trace and compile time are
+        # separately measured and the executable's memory/cost analyses are
+        # recorded; when OFF every line below runs exactly as before.
+        tel = getattr(accelerator, "telemetry", None)
+        self._telemetry = tel if (tel is not None and tel.enabled) else None
+        self._last_key = None  # previous variant key, for recompile forensics
+        self._last_build_ms = (0.0, 0.0)  # (trace_ms, compile_ms) of last build
+        # monotonic build counter for program-record labels: cache size would
+        # repeat a label after a layout-drift retry (pop + rebuild)
+        self._builds_total = 0
+        # per-key layout-drift rebuild count: a second drift on the same key
+        # means layouts alternate, and the AOT path must yield to plain jit
+        # (whose internal cache absorbs the alternation) or thrash a full
+        # trace+compile every step
+        self._layout_rebuilds: dict = {}
+        # key -> key_id memo: the short id is per-variant constant, and
+        # recomputing repr+sha1 every replay would tax the hot path
+        self._key_ids: dict = {}
 
     # -- state threading -----------------------------------------------------
     def _collect_state(self) -> dict:
@@ -218,6 +240,8 @@ class CapturedStep:
     # -- call ----------------------------------------------------------------
     def __call__(self, *args):
         t_call = _time.perf_counter()
+        tel = self._telemetry
+        dl_wait_ms = tel.pop_dataloader_wait_ms() if tel is not None else 0.0
         acc = self.accelerator
         if self._uses_accumulate:
             # body contains `with accelerator.accumulate(...)`: advance the
@@ -241,13 +265,28 @@ class CapturedStep:
         entry = self._cache.get(key)
         state = self._collect_state()
         flat_state, cur_treedef = jax.tree_util.tree_flatten(state)
+        state_cause = None
         if entry is not None and cur_treedef != entry[2]:
             # state structure changed since this entry was built (e.g. more
             # objects prepared): rebuild, exactly where plain jit would
             # silently re-trace
+            if tel is not None:
+                state_cause = (
+                    "state pytree structure changed: "
+                    f"{entry[2].num_leaves} -> {cur_treedef.num_leaves} leaves"
+                )
+                old_host = sum(entry[3])
+                new_host = sum(1 for x in flat_state if _is_offloaded(x))
+                if old_host != new_host:
+                    state_cause += (
+                        f"; donation split moved ({old_host} -> {new_host} "
+                        "host-offloaded leaves)"
+                    )
             entry = None
         built = entry is None
         if built:
+            if tel is not None:
+                self._note_recompile(tel, key, state_cause)
             entry = self._build(key, state, args)
         jitted, ctx, _, host_mask = entry
         dev_leaves = tuple(x for x, h in zip(flat_state, host_mask) if not h)
@@ -255,7 +294,19 @@ class CapturedStep:
         if not built:
             self.host_assembly_ms_total += (_time.perf_counter() - t_call) * 1e3
             self.host_assembly_calls += 1
-        new_state, out = jitted(dev_leaves, host_leaves, *flat_args)
+        self._last_key = key
+        retry_rebuild = False
+        t_dispatch = 0.0
+        if tel is not None:
+            t_dispatch = _time.perf_counter()
+            new_state, out, entry, retry_rebuild = self._dispatch_aot(
+                tel, key, entry, state, args, dev_leaves, host_leaves, flat_args
+            )
+            if retry_rebuild:
+                built = True
+                jitted, ctx, _, host_mask = entry
+        else:
+            new_state, out = jitted(dev_leaves, host_leaves, *flat_args)
         self._writeback(new_state)
         if self._uses_accumulate is None:
             # first ever call: the trace just revealed whether the body
@@ -269,6 +320,17 @@ class CapturedStep:
                 if new_key != key:
                     self._cache[new_key] = entry
                     self._cache.pop(key, None)
+                    # forensics/timeline must follow the re-file: diffing the
+                    # next miss against the popped key would blame the wrong
+                    # baseline, and the build record's key id would never
+                    # match its replays'
+                    key = self._last_key = new_key
+                    if tel is not None:
+                        # the ProgramRecord written in _build carries the
+                        # pre-refile key — which the SECOND variant will
+                        # reuse (the sync flag flips back), cross-wiring the
+                        # per-program HBM/FLOP stats
+                        tel.rekey_last_program(key_id(new_key))
         elif ctx.used_accumulate != self._uses_accumulate:
             # a later variant disagrees with the first trace (e.g. the body
             # enters `accumulate()` only when model.training) — the schedule
@@ -283,9 +345,127 @@ class CapturedStep:
         # deferred scheduler steps run for real, python-side, every replay
         for scheduler, s_args, s_kwargs in ctx.deferred_scheduler_steps:
             scheduler.step(*s_args, _from_capture_replay=True, **s_kwargs)
+        if tel is not None:
+            t_end = _time.perf_counter()
+            trace_ms, compile_ms = self._last_build_ms if built else (0.0, 0.0)
+            assembly_ms = (t_dispatch - t_call) * 1e3
+            dispatch_ms = (t_end - t_dispatch) * 1e3
+            if built and not retry_rebuild:
+                assembly_ms -= trace_ms + compile_ms  # build ran pre-dispatch
+            elif retry_rebuild:
+                dispatch_ms -= trace_ms + compile_ms  # rebuild ran mid-dispatch
+            kid = self._key_ids.get(key)
+            if kid is None:
+                kid = self._key_ids[key] = key_id(key)
+            tel.record_step(
+                StepRecord(
+                    step=tel.next_step_index(),
+                    key=kid,
+                    built=built,
+                    total_ms=(t_end - t_call) * 1e3,
+                    assembly_ms=max(0.0, assembly_ms),
+                    trace_ms=trace_ms,
+                    compile_ms=compile_ms,
+                    dispatch_ms=max(0.0, dispatch_ms),
+                    dataloader_wait_ms=dl_wait_ms,
+                )
+            )
         return out
 
-    def _build(self, key, state_template, args_template):
+    def _dispatch_aot(self, tel, key, entry, state, args, dev_leaves, host_leaves, flat_args):
+        """Telemetry-path dispatch of the AOT-compiled executable.
+
+        Plain jit re-traces *silently* when an input sharding/layout drifts;
+        the AOT executable raises instead.  Keep jit's forgiving behavior —
+        rebuild against the live inputs — but make the event loud: this
+        rebuild is exactly the hidden multi-minute recompile the forensics
+        pillar exists to expose.  Returns (new_state, out, entry,
+        retry_rebuild)."""
+        executable = entry[0]
+        try:
+            with tel.span("atpu/dispatch"):
+                return (*executable(dev_leaves, host_leaves, *flat_args), entry, False)
+        except (TypeError, ValueError) as exc:
+            # TypeError/ValueError is how the executable's *argument
+            # validation* rejects drifted avals/shardings (jaxlib maps
+            # INVALID_ARGUMENT to ValueError) — always before any buffer is
+            # donated.  Runtime failures (OOM et al. are RuntimeError
+            # subclasses) propagate untouched: they are not layout drift and
+            # the inputs may already be consumed.
+            if hasattr(executable, "lower"):
+                # plain-jit fallback entry: jit absorbs layout changes
+                # silently, so a TypeError/ValueError here is a genuine
+                # user/trace error — no spurious layout event, no rebuild
+                raise
+            # ALTERNATING layouts would make this rebuild fire every step
+            # (the AOT path keeps one executable per key where plain jit
+            # memoizes each layout variant): after a repeat event on the
+            # same key, fall back to the jitted callable for that key —
+            # jit's internal cache then absorbs the alternation, at the
+            # cost of the trace/compile split for that variant
+            drifts = self._layout_rebuilds.get(key, 0) + 1
+            self._layout_rebuilds[key] = drifts
+            cause = (
+                "input layout/sharding drift: compiled executable "
+                f"rejected replay inputs ({type(exc).__name__}: "
+                f"{str(exc)[:200]})"
+            )
+            if drifts >= 2:
+                cause += (
+                    "; repeated drift on this variant — falling back to "
+                    "plain jit dispatch (per-step trace/compile split "
+                    "no longer attributed)"
+                )
+            tel.record_recompile(
+                RecompileEvent(
+                    step=tel.steps_total,
+                    key=key_id(key),
+                    prev_key=key_id(key),
+                    causes=[cause],
+                    kind="layout",
+                )
+            )
+            self._cache.pop(key, None)
+            entry = self._build(key, state, args, force_plain=drifts >= 2)
+            # the rebuild recomputed host_mask from the live state — if the
+            # drift moved a leaf between memory spaces, the caller's dev/host
+            # split is stale, so re-split against the new mask
+            flat_state, _ = jax.tree_util.tree_flatten(state)
+            new_mask = entry[3]
+            dev_leaves = tuple(x for x, h in zip(flat_state, new_mask) if not h)
+            host_leaves = tuple(x for x, h in zip(flat_state, new_mask) if h)
+            # argument validation fails BEFORE any buffer is donated, so the
+            # leaves the failed call touched are intact for the retry; an
+            # error from the rebuilt program is real and propagates
+            with tel.span("atpu/dispatch"):
+                new_state, out = entry[0](dev_leaves, host_leaves, *flat_args)  # graftlint: disable=donation-reuse
+            return new_state, out, entry, True
+
+    def _note_recompile(self, tel, key, state_cause: Optional[str]) -> None:
+        """Emit a forensics event for a rebuild (never for the first build:
+        the first compile of a step is expected, not a hazard)."""
+        prev = self._last_key
+        if prev is None:
+            return
+        if state_cause is not None:
+            causes, kind = [state_cause], "state"
+        else:
+            causes, kind = diff_keys(prev, key), "key"
+            if not causes:
+                # key changed in no recognized component (or an evicted
+                # variant was rebuilt): still an event, cause unknown
+                causes = ["cache key changed (no recognized component diff)"]
+        tel.record_recompile(
+            RecompileEvent(
+                step=tel.steps_total,
+                key=key_id(key),
+                prev_key=key_id(prev),
+                causes=causes,
+                kind=kind,
+            )
+        )
+
+    def _build(self, key, state_template, args_template, force_plain: bool = False):
         acc = self.accelerator
         _, args_treedef = jax.tree_util.tree_flatten(args_template)
         captured_ctx = CaptureContext(
@@ -356,7 +536,13 @@ class CapturedStep:
             try:
                 self._bind_state(state)
                 nn_random.default_rng.set_key(state["rng"])
-                out = self.fn(*call_args)
+                if self._telemetry is not None:
+                    # HLO op metadata carries the scope name, so xprof's op
+                    # profile groups the user's step body under one span
+                    with jax.named_scope("atpu_captured_body"):
+                        out = self.fn(*call_args)
+                else:
+                    out = self.fn(*call_args)
                 out = _unwrap_tree(out)
                 new_state = _pin_layout(self._snapshot_state())
                 return new_state, out
@@ -366,7 +552,40 @@ class CapturedStep:
                 nn_random.default_rng.set_state(prev_rng_state)
 
         jitted = jax.jit(traced, donate_argnums=(0,))
-        entry = (jitted, captured_ctx, state_treedef, host_mask)
+        tel = self._telemetry
+        if tel is not None and not force_plain:
+            # AOT capture: lower and compile explicitly so (a) trace vs
+            # compile time are separately attributable, (b) the executable's
+            # memory_analysis/cost_analysis are recordable at capture time.
+            # The compiled object is call-compatible with the jitted one and
+            # honors the same donation; the one behavioral difference (it
+            # *rejects* drifted input layouts instead of silently re-tracing)
+            # is handled — and surfaced as a telemetry event — in __call__.
+            flat_state, _ = jax.tree_util.tree_flatten(state_template)
+            dev_leaves = tuple(x for x, h in zip(flat_state, host_mask) if not h)
+            host_leaves = tuple(x for x, h in zip(flat_state, host_mask) if h)
+            flat_args, _ = jax.tree_util.tree_flatten(args_template)
+            t0 = _time.perf_counter()
+            with tel.span("atpu/trace"):
+                lowered = jitted.lower(dev_leaves, host_leaves, *flat_args)
+            t1 = _time.perf_counter()
+            with tel.span("atpu/compile"):
+                compiled = lowered.compile()
+            t2 = _time.perf_counter()
+            self._last_build_ms = ((t1 - t0) * 1e3, (t2 - t1) * 1e3)
+            label = f"capture:{self._builds_total}"
+            self._builds_total += 1
+            tel.record_program(key, label, compiled)
+            if tel.resource_sampling:
+                tel.sample_resources(label)
+            entry = (compiled, captured_ctx, state_treedef, host_mask)
+        else:
+            if tel is not None:
+                # plain-jit fallback after repeated layout drift: the build
+                # cost lands inside the first dispatch, so do not carry a
+                # stale trace/compile split into this build's step record
+                self._last_build_ms = (0.0, 0.0)
+            entry = (jitted, captured_ctx, state_treedef, host_mask)
         self._cache[key] = entry
         return entry
 

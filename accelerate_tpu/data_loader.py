@@ -776,6 +776,9 @@ class DataLoaderStateMixin:
         self.gradient_state._remove_dataloader(self)
 
 
+_TELEMETRY_UNPINNED = object()  # DataLoaderShard._telemetry default sentinel
+
+
 class DataLoaderShard(DataLoaderStateMixin):
     """The SPMD data loader: one global sharded batch per step.
 
@@ -817,6 +820,10 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.end_of_dataloader = False
         self.remainder = -1
         self._iteration = 0
+        # telemetry hub pinned by Accelerator.prepare_data_loader (None =
+        # prepared with telemetry off); _TELEMETRY_UNPINNED = never prepared
+        # through an accelerator, fall back to the module-global active hub
+        self._telemetry = _TELEMETRY_UNPINNED
         # streaming-mode settings (used when global_batch_sampler is None)
         self._stream_global_batch = kwargs.pop("stream_global_batch", 1)
         self._stream_drop_last = _drop_last
@@ -942,6 +949,17 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.set_epoch(self.epoch)
         self._iteration = self.skip_batches  # in-epoch position (for resume)
         prefetcher = None
+        # telemetry (docs/telemetry.md): when enabled, the host time this
+        # loader spends producing + device-placing each yielded batch is
+        # reported as that step's dataloader-wait phase.  The hub pinned at
+        # prepare() time wins — a later Accelerator construction must not
+        # reroute (or sever) this loader's wait accounting; the module-global
+        # slot only serves loaders never prepared through an accelerator
+        telemetry = self._telemetry
+        if telemetry is _TELEMETRY_UNPINNED:
+            from .telemetry import current_telemetry
+
+            telemetry = current_telemetry()
         try:
             if self.num_workers > 0:
                 prefetcher = _BackgroundPrefetcher(
@@ -956,12 +974,15 @@ class DataLoaderShard(DataLoaderStateMixin):
             for _ in range(self.skip_batches):
                 next(batches, None)
 
-            # double-buffered device feed
-            pending: list[tuple[Any, int]] = []
+            # double-buffered device feed; each pending entry carries its own
+            # produce+place cost so a multi-batch queue refill is never
+            # lumped onto the one step that happened to trigger it
+            pending: list[tuple[Any, int, float]] = []
             exhausted = False
             host_iter = iter(batches)
             while True:
                 while not exhausted and len(pending) < self.prefetch_size:
+                    t_batch = time.perf_counter() if telemetry is not None else 0.0
                     try:
                         host_batch, remainder = next(host_iter)
                     except StopIteration:
@@ -971,10 +992,17 @@ class DataLoaderShard(DataLoaderStateMixin):
                         placed = batch_to_global_array(host_batch, mesh=self.mesh)
                     else:
                         placed = host_batch
-                    pending.append((placed, remainder))
+                    produce_ms = (
+                        (time.perf_counter() - t_batch) * 1e3
+                        if telemetry is not None
+                        else 0.0
+                    )
+                    pending.append((placed, remainder, produce_ms))
                 if not pending:
                     break
-                batch, remainder = pending.pop(0)
+                batch, remainder, produce_ms = pending.pop(0)
+                if telemetry is not None:
+                    telemetry.record_dataloader_wait(produce_ms)
                 if exhausted and not pending:
                     self.end_of_dataloader = True
                     self.remainder = remainder
@@ -1076,6 +1104,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             stream_global_batch=dataloader._stream_global_batch,
         )
         new.epoch = dataloader.epoch
+        new._telemetry = dataloader._telemetry  # keep the prepare-time pin
         return new
     # generic iterable fallback
     def _gen():

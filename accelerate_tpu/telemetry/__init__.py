@@ -1,0 +1,234 @@
+"""Runtime telemetry for the capture path (``accelerator.telemetry``).
+
+Four pillars, all default-OFF and zero-overhead when off:
+
+1. **Step-phase timing** (`timeline.py`) — every ``CapturedStep.__call__``
+   records dataloader-wait / assembly / trace / compile / dispatch ms into a
+   ring-buffered :class:`~.timeline.StepTimeline`, with
+   ``jax.profiler.TraceAnnotation`` spans around each phase so xprof traces
+   collected through ``accelerator.profile()`` show named capture phases.
+2. **Recompile forensics** (`recompile.py`) — every new compiled variant is
+   diffed against the previous cache key and emits a
+   :class:`~.recompile.RecompileEvent` naming exactly what moved (arg
+   shape/dtype, treedef, ``sync_gradients``, training mode, state structure /
+   donation split, input-layout drift).
+3. **Resource accounting** (`resources.py`) — per-device live HBM bytes from
+   ``jax.live_arrays()`` plus per-program ``memory_analysis()`` /
+   ``cost_analysis()`` (FLOPs, bytes accessed, collective bytes) sampled at
+   capture and on demand.
+4. **Export** (`export.py`) — events flow to the existing ``GeneralTracker``
+   fleet through :class:`TelemetryTracker`, or to a schema'd JSONL file that
+   ``tools/telemetry_report.py`` renders.
+
+Enable with ``ACCELERATE_TELEMETRY=1`` or
+``Accelerator(kwargs_handlers=[TelemetryKwargs(enabled=True)])``.  With the
+knob off (the default), ``CapturedStep.__call__`` executes the identical code
+path as before this subsystem existed — the only cost anywhere is a
+``None``-check.  Docs: docs/telemetry.md.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Optional
+
+from .recompile import RecompileEvent, diff_keys, key_id
+from .resources import ProgramRecord, ResourceSample, program_stats, sample_live
+from .timeline import PHASES, StepRecord, StepTimeline
+
+SCHEMA_VERSION = 1
+
+# the active enabled Telemetry instance — fallback wait-time sink for data
+# loaders never prepared through an Accelerator (prepared loaders carry a
+# pinned hub instead); None when telemetry is off — every producer-side hook
+# is gated on that None
+_ACTIVE: Optional["Telemetry"] = None
+
+
+def current_telemetry() -> Optional["Telemetry"]:
+    return _ACTIVE
+
+
+def _set_active(telemetry: Optional["Telemetry"]) -> None:
+    global _ACTIVE
+    _ACTIVE = telemetry
+
+
+class Telemetry:
+    """Per-Accelerator telemetry hub; the enabled instance is also published
+    module-wide for producers (data loader) that have no accelerator handle."""
+
+    def __init__(self, handler=None):
+        if handler is None:
+            from ..utils.dataclasses import TelemetryKwargs
+
+            handler = TelemetryKwargs()
+        self.enabled = bool(handler.enabled)
+        self.annotate_spans = bool(handler.annotate_spans)
+        self.resource_sampling = bool(handler.sample_resources)
+        self.jsonl_path = handler.jsonl_path
+        self.timeline = StepTimeline(capacity=handler.timeline_size)
+        self.recompile_events: deque[RecompileEvent] = deque(maxlen=handler.max_events)
+        self.program_records: deque[ProgramRecord] = deque(maxlen=handler.max_events)
+        self.resource_samples: deque[ResourceSample] = deque(maxlen=handler.max_events)
+        self.recompiles_total = 0
+        self.steps_total = 0
+        self._dataloader_wait_ms = 0.0
+        # export queue: every record lands here once, drained by the
+        # TelemetryTracker bridge / flush(); bounded so an undrained run
+        # cannot grow without limit
+        self._export_queue: deque[dict] = deque(maxlen=4096)
+        # latest-constructed wins the module slot: a later telemetry-off
+        # Accelerator must clear it, or its data loaders keep crediting
+        # wait time to the previous run's (possibly defunct) instance
+        _set_active(self if self.enabled else None)
+
+    # -- spans ---------------------------------------------------------------
+    @contextmanager
+    def span(self, name: str):
+        """xprof-visible phase span (``jax.profiler.TraceAnnotation``); a
+        no-op region when span annotation is off."""
+        if not self.annotate_spans:
+            yield
+            return
+        import jax
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+    # -- producers -----------------------------------------------------------
+    def record_dataloader_wait(self, ms: float) -> None:
+        self._dataloader_wait_ms += ms
+
+    def pop_dataloader_wait_ms(self) -> float:
+        ms, self._dataloader_wait_ms = self._dataloader_wait_ms, 0.0
+        return ms
+
+    def next_step_index(self) -> int:
+        """Global captured-call counter (across every CapturedStep)."""
+        index = self.steps_total
+        self.steps_total += 1
+        return index
+
+    def record_step(self, record: StepRecord) -> None:
+        self.timeline.append(record)
+        self._export_queue.append(record.to_dict())
+
+    def record_recompile(self, event: RecompileEvent) -> None:
+        self.recompiles_total += 1
+        self.recompile_events.append(event)
+        self._export_queue.append(event.to_dict())
+
+    def record_program(self, key, label: str, compiled) -> ProgramRecord:
+        record = ProgramRecord(key=key_id(key), label=label, stats=program_stats(compiled))
+        self.program_records.append(record)
+        self._export_queue.append(record.to_dict())
+        return record
+
+    def rekey_last_program(self, new_key: str) -> None:
+        """Re-key the most recent program record (and its not-yet-drained
+        export dict) — the capture path calls this when a first-call
+        accumulate re-files the variant under the traced sync flag, so the
+        per-program HBM/FLOP stats join to the right variant."""
+        if not self.program_records:
+            return
+        record = self.program_records[-1]
+        old_key = record.key
+        record.key = new_key
+        for pending in reversed(self._export_queue):
+            if pending.get("kind") == "program" and pending.get("key") == old_key:
+                pending["key"] = new_key
+                break
+
+    def sample_resources(self, tag: str) -> ResourceSample:
+        """Per-device live-bytes snapshot, on demand or at capture time."""
+        sample = sample_live(tag)
+        self.resource_samples.append(sample)
+        self._export_queue.append(sample.to_dict())
+        return sample
+
+    # -- consumers -----------------------------------------------------------
+    def drain(self) -> list[dict]:
+        """Pop every not-yet-exported record (tracker-bridge feed)."""
+        out = list(self._export_queue)
+        self._export_queue.clear()
+        return out
+
+    def summary(self) -> dict:
+        out = self.timeline.summary()
+        out["recompiles_total"] = self.recompiles_total
+        out["schema_version"] = SCHEMA_VERSION
+        return out
+
+    def all_records(self) -> list[dict]:
+        """Full retained history in schema order (JSONL dump feed)."""
+        records: list[dict] = [
+            {
+                "kind": "meta",
+                "schema_version": SCHEMA_VERSION,
+                "time": time.time(),
+                "steps_total": self.steps_total,
+                "recompiles_total": self.recompiles_total,
+            }
+        ]
+        records += [r.to_dict() for r in self.timeline.records()]
+        records += [e.to_dict() for e in self.recompile_events]
+        records += [p.to_dict() for p in self.program_records]
+        records += [s.to_dict() for s in self.resource_samples]
+        records.append(self.summary())
+        return records
+
+    def write_jsonl(self, path: Optional[str] = None) -> Optional[str]:
+        from .export import write_jsonl
+
+        path = path or self.jsonl_path
+        if path is None:
+            return None
+        from ..state import PartialState
+
+        if PartialState._shared_state and not PartialState().is_main_process:
+            # one writer per run: every process resolves the same path, and
+            # concurrent mode-'w' writers would interleave a corrupt dump
+            return None
+        try:
+            return write_jsonl(self, path)
+        except OSError as exc:
+            # telemetry is best-effort: a bad dump path (missing dir,
+            # permissions) must not crash end_training or leave the
+            # remaining trackers unfinished
+            from ..logging import get_logger
+
+            get_logger(__name__).warning(
+                "telemetry JSONL dump to %r failed: %s", path, exc
+            )
+            return None
+
+
+def __getattr__(name):
+    # lazy: export.py imports tracking.py (the tracker fleet), which must not
+    # load just because the data loader imported this package for the
+    # current_telemetry() gate
+    if name == "TelemetryTracker":
+        from .export import TelemetryTracker
+
+        return TelemetryTracker
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "PHASES",
+    "ProgramRecord",
+    "RecompileEvent",
+    "ResourceSample",
+    "SCHEMA_VERSION",
+    "StepRecord",
+    "StepTimeline",
+    "Telemetry",
+    "TelemetryTracker",
+    "current_telemetry",
+    "diff_keys",
+    "key_id",
+    "program_stats",
+]

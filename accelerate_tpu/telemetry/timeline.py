@@ -1,0 +1,129 @@
+"""Pillar 1 — step-phase timing.
+
+One :class:`StepRecord` per ``CapturedStep.__call__``, held in a
+pre-allocated ring buffer (:class:`StepTimeline`).  The in-call phases
+(assembly/trace/compile/dispatch) partition the wall clock of a
+captured-step call (``total_ms``):
+
+* ``dataloader_wait_ms`` — host time spent inside the prepared loader
+  producing + device-placing the batch consumed since the previous step
+  (recorded per produced batch by ``DataLoaderShard.__iter__``, popped per
+  step; measured *between* step calls, so it rides alongside ``total_ms``
+  rather than inside it).
+* ``assembly_ms`` — host argument assembly: unwrap/flatten the args, compute
+  the cache key, collect + split the carried state.
+* ``trace_ms`` — Python trace + StableHLO lowering of the step body (build
+  calls only; ``jit.lower`` under telemetry's AOT capture path).
+* ``compile_ms`` — XLA compilation of the lowered program (build calls only).
+* ``dispatch_ms`` — launching the compiled program plus state writeback and
+  replayed scheduler steps.  Under JAX's async dispatch this is *launch*
+  latency, not device execution time — the device step overlaps the next
+  call's host work, which is exactly what the capture path promises.
+
+The ring buffer is allocated once at construction so the telemetry-off
+assertion ("no per-step allocations") is testable: a disabled run leaves
+``len(timeline) == 0`` and the slot list untouched.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+from typing import Iterator, Optional
+
+PHASES = (
+    "dataloader_wait_ms",
+    "assembly_ms",
+    "trace_ms",
+    "compile_ms",
+    "dispatch_ms",
+)
+
+
+@dataclass
+class StepRecord:
+    step: int  # global captured-call index across all CapturedSteps
+    key: str  # short stable id of the compiled-variant cache key
+    built: bool  # True when this call traced+compiled a new variant
+    total_ms: float  # wall clock of the whole __call__
+    assembly_ms: float
+    trace_ms: float
+    compile_ms: float
+    dispatch_ms: float
+    dataloader_wait_ms: float
+
+    @property
+    def phase_sum_ms(self) -> float:
+        """Sum of the in-call phases, which partition ``total_ms``.
+        ``dataloader_wait_ms`` is excluded: it is measured *between* step
+        calls (loader-side) and rides alongside the call's wall clock."""
+        return self.assembly_ms + self.trace_ms + self.compile_ms + self.dispatch_ms
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["kind"] = "step"
+        return d
+
+
+class StepTimeline:
+    """Fixed-capacity ring of the most recent :class:`StepRecord`s."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(1, int(capacity))
+        self._slots: list[Optional[StepRecord]] = [None] * self.capacity
+        self._appended = 0
+
+    def append(self, record: StepRecord) -> None:
+        self._slots[self._appended % self.capacity] = record
+        self._appended += 1
+
+    def __len__(self) -> int:
+        return min(self._appended, self.capacity)
+
+    @property
+    def total_appended(self) -> int:
+        """Lifetime count, including records the ring has already evicted."""
+        return self._appended
+
+    def __iter__(self) -> Iterator[StepRecord]:
+        return iter(self.records())
+
+    def records(self) -> list[StepRecord]:
+        """Oldest → newest among the retained window."""
+        n = len(self)
+        start = self._appended - n
+        return [self._slots[(start + i) % self.capacity] for i in range(n)]
+
+    def last(self) -> Optional[StepRecord]:
+        if self._appended == 0:
+            return None
+        return self._slots[(self._appended - 1) % self.capacity]
+
+    def first_build(self) -> Optional[StepRecord]:
+        for rec in self.records():
+            if rec.built:
+                return rec
+        return None
+
+    def summary(self) -> dict:
+        """Aggregate view for export/reporting: per-phase mean/max over
+        replay steps, plus build totals (builds are compile events, not
+        steady state — averaging them into replays would hide both)."""
+        records = self.records()
+        replays = [r for r in records if not r.built]
+        builds = [r for r in records if r.built]
+        out: dict = {
+            "kind": "summary",
+            "steps_recorded": len(records),
+            "steps_total": self._appended,
+            "builds": len(builds),
+            "build_trace_ms_total": round(sum(r.trace_ms for r in builds), 3),
+            "build_compile_ms_total": round(sum(r.compile_ms for r in builds), 3),
+        }
+        if replays:
+            for phase in PHASES:
+                values = [getattr(r, phase) for r in replays]
+                out[f"replay_{phase}_mean"] = round(sum(values) / len(values), 3)
+                out[f"replay_{phase}_max"] = round(max(values), 3)
+            totals = [r.total_ms for r in replays]
+            out["replay_total_ms_mean"] = round(sum(totals) / len(totals), 3)
+        return out

@@ -33,6 +33,7 @@ from .dataclasses import (
     RNGType,
     SaveFormat,
     SequenceParallelPlugin,
+    TelemetryKwargs,
     TensorParallelPlugin,
 )
 from .fp8 import FP8Linear, convert_to_float8_training

@@ -158,6 +158,39 @@ class ProfileKwargs(KwargsHandler):
 
 
 @dataclass
+class TelemetryKwargs(KwargsHandler):
+    """Runtime-telemetry knobs (``accelerator.telemetry``, docs/telemetry.md).
+
+    No reference counterpart — the observability layer is TPU-native.  When
+    ``enabled`` is left ``None`` it resolves from ``$ACCELERATE_TELEMETRY``
+    (default off); off means the capture path runs its pre-telemetry code
+    byte-for-byte (no timers, no ring-buffer writes).
+
+    ``timeline_size`` bounds the per-step ring buffer; ``max_events`` bounds
+    each event stream (recompiles / program stats / resource samples);
+    ``sample_resources`` additionally snapshots per-device live bytes at
+    every capture; ``annotate_spans`` wraps each phase in a
+    ``jax.profiler.TraceAnnotation`` so xprof traces show named capture
+    phases; ``jsonl_path`` (or ``$ACCELERATE_TELEMETRY_JSONL``) auto-dumps
+    the full history at ``end_training``/tracker ``finish``.
+    """
+
+    enabled: Optional[bool] = None  # None → $ACCELERATE_TELEMETRY, default off
+    timeline_size: int = 256
+    max_events: int = 256
+    sample_resources: bool = True
+    annotate_spans: bool = True
+    jsonl_path: Optional[str] = None
+
+    def __post_init__(self):
+        if self.enabled is None:
+            value = os.environ.get("ACCELERATE_TELEMETRY")
+            self.enabled = bool(str_to_bool(value)) if value is not None else False
+        if self.jsonl_path is None:
+            self.jsonl_path = os.environ.get("ACCELERATE_TELEMETRY_JSONL")
+
+
+@dataclass
 class DistributedDataParallelKwargs(KwargsHandler):
     """Accepted for API parity with the reference (dataclasses.py:149).
 

@@ -364,11 +364,13 @@ def _llama_fsdp_workload(on_accel: bool) -> dict:
 def _timed_steps(step, batches: list, steps: int, warmup: int):
     """The one timing methodology every GPT-throughput row uses: compile on
     batch 0, warm across rotated batches, then time `steps` rotated calls.
-    Returns (compile_s, dt, final_loss, recompiled_during_timing,
-    arg_assembly_ms) — the last is the mean host-side argument-assembly
-    time per replay during the timed window (CapturedStep accounting;
-    the zero-beyond-argument-assembly host work the capture docstring
-    promises, now measured)."""
+    Returns (compile_s, dt, final_loss, recompile, arg_assembly_ms):
+    ``recompile`` is ``{"count", "first_cause", "recompiled"}`` — from the
+    telemetry forensics stream (accelerate_tpu.telemetry, cause strings
+    naming what changed) when the accelerator runs with telemetry on, else
+    derived from the capture-cache size (legacy detection, no cause);
+    ``arg_assembly_ms`` is the mean host-side argument-assembly time per
+    replay during the timed window (CapturedStep accounting)."""
     t0 = time.perf_counter()
     loss = step(batches[0])
     float(loss)
@@ -377,6 +379,8 @@ def _timed_steps(step, batches: list, steps: int, warmup: int):
         loss = step(batches[(i + 1) % len(batches)])
     float(loss)  # force full sync before timing
     n_cached = len(step._cache)
+    tel = getattr(step, "_telemetry", None)
+    events0 = tel.recompiles_total if tel is not None else 0
     asm_ms0 = getattr(step, "host_assembly_ms_total", 0.0)
     asm_n0 = getattr(step, "host_assembly_calls", 0)
     t0 = time.perf_counter()
@@ -390,7 +394,22 @@ def _timed_steps(step, batches: list, steps: int, warmup: int):
         if asm_calls
         else None
     )
-    return compile_s, dt, final_loss, len(step._cache) != n_cached, asm_ms
+    if tel is not None:
+        count = tel.recompiles_total - events0
+        new_events = list(tel.recompile_events)[-count:] if count else []
+        recompile = {
+            "count": count,
+            "first_cause": new_events[0].cause if new_events else None,
+            "recompiled": count > 0,
+        }
+    else:
+        recompiled = len(step._cache) != n_cached
+        recompile = {
+            "count": int(recompiled),
+            "first_cause": None,
+            "recompiled": recompiled,
+        }
+    return compile_s, dt, final_loss, recompile, asm_ms
 
 
 def _fp8_ab_workload(on_accel: bool) -> dict:
@@ -411,13 +430,18 @@ def _fp8_ab_workload(on_accel: bool) -> dict:
 
     import accelerate_tpu.nn as nn
     import accelerate_tpu.optim as optim
-    from accelerate_tpu import Accelerator
+    from accelerate_tpu import Accelerator, TelemetryKwargs
     from accelerate_tpu.data_loader import batch_to_global_array
     from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
 
     Accelerator._reset_state()
     nn.manual_seed(0)
-    acc = Accelerator(mixed_precision="fp8")
+    # telemetry ON to match the primary bf16 row: both sides must pay the
+    # same instrumentation (AOT dispatch, per-step records) or the ratio
+    # compares methodologies instead of datapaths
+    acc = Accelerator(
+        mixed_precision="fp8", kwargs_handlers=[TelemetryKwargs(enabled=True)]
+    )
     n_dev = len(jax.devices())
     cfg = GPTConfig.small() if on_accel else GPTConfig.tiny()
     batch, seq, steps = (BATCH * n_dev, SEQ, 20) if on_accel else (2, 128, 2)
@@ -443,7 +467,7 @@ def _fp8_ab_workload(on_accel: bool) -> dict:
     ]
     # same methodology as the primary bf16 row (rotated batches, WARMUP,
     # recompile detection) so the ratio is apples-to-apples
-    compile_s, dt, final_loss, recompiled, _ = _timed_steps(
+    compile_s, dt, final_loss, recompile, _ = _timed_steps(
         step, batches, steps, WARMUP if on_accel else 1
     )
     tokens_per_sec = batch * seq * steps / dt / n_dev
@@ -451,7 +475,7 @@ def _fp8_ab_workload(on_accel: bool) -> dict:
         "fp8_train_tokens_per_sec_per_chip": round(tokens_per_sec, 1),
         "fp8_compile_s": round(compile_s, 1),
         "fp8_final_loss": round(final_loss, 3),
-        "fp8_recompiled_during_timing": recompiled,
+        "fp8_recompiled_during_timing": recompile["recompiled"],
     }
     bf16 = _PRIMARY_RESULT.get("value")
     if bf16:
@@ -678,7 +702,17 @@ def main() -> None:
     on_accel = platform in ("tpu", "axon")
 
     nn.manual_seed(0)
-    acc = Accelerator(mixed_precision="bf16")
+    # telemetry ON for the primary workload: the forensics stream turns the
+    # old recompiled-during-timing bool into counted, attributed events, and
+    # the timeline gives the trace/compile split for the first build
+    # (docs/telemetry.md; the AOT capture path is loss-bitwise-identical to
+    # the plain jit path, asserted in tests/test_telemetry.py)
+    from accelerate_tpu import TelemetryKwargs
+
+    acc = Accelerator(
+        mixed_precision="bf16",
+        kwargs_handlers=[TelemetryKwargs(enabled=True)],
+    )
     cfg = GPTConfig.small() if on_accel else GPTConfig.tiny()
     model = GPTLMHeadModel(cfg)
     opt = optim.AdamW(model.parameters(), lr=3e-4, weight_decay=0.1)
@@ -705,9 +739,11 @@ def main() -> None:
         return batch_to_global_array(jnp.asarray(ids), mesh=acc.mesh)
 
     batches = [make_batch(i) for i in range(4)]
-    compile_s, dt, final_loss, recompiled, arg_assembly_ms = _timed_steps(
+    compile_s, dt, final_loss, recompile, arg_assembly_ms = _timed_steps(
         step, batches, steps, warmup
     )
+    # trace/compile split of the first build, from the telemetry timeline
+    first_build = acc.telemetry.timeline.first_build()
 
     n_devices = len(jax.devices())
     # the Accelerator dp-shards the batch over every visible chip: divide the
@@ -733,7 +769,14 @@ def main() -> None:
         "model_tflops": round(model_flops / 1e12, 2),
         "mfu_pct": round(model_flops / TPU_PEAK_FLOPS * 100, 1) if on_accel else None,
         "final_loss": round(final_loss, 3),
-        "recompiled_during_timing": recompiled,
+        # recompile forensics (telemetry pillar 2): count + first attributed
+        # cause during the timed window; the old bool stays as a derived
+        # field for trajectory continuity with BENCH_r0*.json
+        "recompile_events": recompile["count"],
+        "recompile_first_cause": recompile["first_cause"],
+        "recompiled_during_timing": recompile["recompiled"],
+        "trace_ms": round(first_build.trace_ms, 1) if first_build else None,
+        "compile_ms": round(first_build.compile_ms, 1) if first_build else None,
         # ZeRO-1 accounting: per-replica optimizer-state residency (moments
         # + fp32 masters; ~1/dp of the replicated figure when the sharded
         # update kicked in) and host-side argument-assembly ms per replay

@@ -1644,3 +1644,106 @@ def test_cli_cache_flags(tmp_path):
     proc = _run_cli(str(root), "--cache-dir", cache_dir, "--no-cache")
     assert proc.returncode == 0
     assert "hit" not in proc.stdout  # cache bypassed entirely
+
+
+# ---------------------------------------------------------------------------
+# per-branch cache namespace
+# ---------------------------------------------------------------------------
+
+def _git(cwd, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=cwd, check=True, capture_output=True,
+    )
+
+
+def test_cache_namespace_is_per_git_branch(tmp_path, monkeypatch):
+    """Two long-lived branches must not ping-pong-invalidate each other's
+    entries: each branch gets its own subdirectory under cache_dir, keyed on
+    `git rev-parse --abbrev-ref HEAD` (ROADMAP open item)."""
+    from accelerate_tpu.analysis.cache import AnalysisCache, branch_namespace
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    (repo / "f.txt").write_text("x")
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-qm", "seed")
+    _git(repo, "checkout", "-q", "-b", "feature/one")
+    monkeypatch.chdir(repo)
+
+    assert branch_namespace() == "feature_one"  # path-safe sanitization
+    cache_dir = str(tmp_path / "cache")
+    cache = AnalysisCache(cache_dir)
+    cache.store("a.py", "h1", {"summary": {}, "results": {}})
+    assert cache.load("a.py", "h1") is not None
+    assert os.path.isdir(os.path.join(cache_dir, "feature_one"))
+
+    # a second branch sees a cold namespace, not the first branch's entries
+    _git(repo, "checkout", "-q", "-b", "feature/two")
+    other = AnalysisCache(cache_dir)
+    assert other.namespace == "feature_two"
+    assert other.load("a.py", "h1") is None
+    other.store("a.py", "h2", {"summary": {}, "results": {}})
+
+    # switching back: the original entries are intact (no ping-pong)
+    _git(repo, "checkout", "-q", "feature/one")
+    again = AnalysisCache(cache_dir)
+    assert again.load("a.py", "h1") is not None
+    assert again.load("a.py", "h2") is None
+
+
+def test_cache_namespace_follows_analyzed_tree_not_cwd(tmp_path, monkeypatch):
+    """Out-of-tree `graftlint /path/to/checkout`: the namespace must come
+    from the *target* checkout's branch, not whatever repo (or non-repo)
+    the process happens to run from."""
+    from accelerate_tpu.analysis.cache import AnalysisCache, branch_namespace
+
+    repo = tmp_path / "target"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    (repo / "f.txt").write_text("x")
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-qm", "seed")
+    _git(repo, "checkout", "-q", "-b", "target-branch")
+
+    elsewhere = tmp_path / "elsewhere"
+    elsewhere.mkdir()
+    monkeypatch.chdir(elsewhere)
+    assert branch_namespace() == "detached"  # CWD is no repo
+    assert branch_namespace(str(repo)) == "target-branch"
+    cache = AnalysisCache(str(tmp_path / "cache"), root=str(repo))
+    assert cache.namespace == "target-branch"
+
+
+def test_cache_namespace_detached_fallback(tmp_path, monkeypatch):
+    from accelerate_tpu.analysis.cache import AnalysisCache, branch_namespace
+
+    # outside any work tree
+    outside = tmp_path / "plain"
+    outside.mkdir()
+    monkeypatch.chdir(outside)
+    assert branch_namespace() == "detached"
+
+    # detached HEAD inside a repo
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    (repo / "f.txt").write_text("x")
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-qm", "seed")
+    _git(repo, "checkout", "-q", "--detach")
+    monkeypatch.chdir(repo)
+    assert branch_namespace() == "detached"
+    cache = AnalysisCache(str(tmp_path / "cache"))
+    assert cache.namespace == "detached"
+
+
+def test_cache_second_run_still_hits_across_instances_same_branch(tmp_path):
+    """run_analysis-level: the namespacing must not break warm reuse within
+    one branch (the repo itself is the 'branch' here — both runs share it)."""
+    cache_dir = str(tmp_path / "cache")
+    first = lint_pkg(tmp_path, CROSS_HOST_SYNC_GOOD, cache_dir=cache_dir)
+    assert first.cache_misses > 0
+    second = lint_pkg(tmp_path, CROSS_HOST_SYNC_GOOD, cache_dir=cache_dir)
+    assert second.cache_misses == 0 and second.cache_hits == first.cache_misses

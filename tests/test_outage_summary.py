@@ -1,0 +1,86 @@
+"""tools/outage_summary.py: probe-log parsing and up/down accounting."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.graftlint  # pure stdlib, no tracing — same split
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from outage_summary import parse_log, summarize  # noqa: E402
+
+LOG = """\
+1000 TPU_UP PROBE_OK tpu 1
+1300 TPU_UP PROBE_OK tpu 1
+1600 DOWN WARNING: something broke
+1900 DOWN WARNING: still broken
+2500 TPU_UP PROBE_OK tpu 1
+2800 DOWN WARNING: broke again
+3100 DOWN WARNING: remains broken
+garbage line without a timestamp
+3400 TPU_UP PROBE_OK tpu 1
+"""
+
+
+def _write(tmp_path, text=LOG, name="TPU_OUTAGE_test.log"):
+    path = tmp_path / name
+    path.write_text(text)
+    return str(path)
+
+
+def test_parse_skips_malformed_lines(tmp_path):
+    probes = parse_log(_write(tmp_path))
+    assert len(probes) == 8  # the garbage line is dropped
+    assert probes[0] == (1000, True)
+    assert probes[2] == (1600, False)
+
+
+def test_summarize_up_down_and_longest_window(tmp_path):
+    s = summarize(parse_log(_write(tmp_path)))
+    # intervals attributed to the earlier probe's state:
+    # up: 1000→1600 (600) + 2500→2800 (300) = 900
+    # down: 1600→2500 (900) + 2800→3400 (600) = 1500
+    assert s["up_s"] == 900
+    assert s["down_s"] == 1500
+    assert s["observed_s"] == 2400
+    # longest DOWN window runs from its first DOWN probe to the next UP probe
+    assert s["longest_down_s"] == 900
+    assert s["longest_down_start"] == 1600
+    assert s["longest_down_end"] == 2500
+    assert s["transitions"] == 4
+    assert s["probes_up"] == 4 and s["probes_down"] == 4
+
+
+def test_trailing_down_run_counts_to_last_probe(tmp_path):
+    text = "1000 TPU_UP ok\n1600 DOWN err\n2600 DOWN err\n"
+    s = summarize(parse_log(_write(tmp_path, text)))
+    assert s["down_s"] == 1000
+    assert s["longest_down_s"] == 1000
+    assert s["longest_down_end"] == 2600
+
+
+def test_cli_json_on_real_repo_logs(tmp_path):
+    path = _write(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "outage_summary.py"),
+         "--json", path],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload[path]["availability_pct"] == round(100 * 900 / 2400, 1)
+
+
+def test_cli_exits_2_when_nothing_parseable(tmp_path):
+    path = _write(tmp_path, "no probes here\n", name="empty.log")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "outage_summary.py"), path],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 2

@@ -1,0 +1,409 @@
+"""Telemetry subsystem (docs/telemetry.md): phases recorded per step on CPU,
+recompile forensics attribute the right cause, the disabled path touches
+nothing, the tracker bridge writes valid JSONL, and the telemetry AOT
+capture path is loss-bitwise-identical to the plain jit path."""
+
+import json
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator, TelemetryKwargs
+from accelerate_tpu.data_loader import batch_to_global_array
+from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+from accelerate_tpu.telemetry import (
+    StepRecord,
+    StepTimeline,
+    Telemetry,
+    _set_active,
+    current_telemetry,
+    diff_keys,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_active_telemetry():
+    yield
+    _set_active(None)
+
+
+def _tiny_cfg():
+    return GPTConfig(vocab_size=256, n_positions=64, n_embd=32, n_layer=1, n_head=2)
+
+
+def _make_step(enabled=True, acc_kwargs=None, **tel_kwargs):
+    nn.manual_seed(0)
+    acc = Accelerator(
+        kwargs_handlers=[TelemetryKwargs(enabled=enabled, **tel_kwargs)],
+        **(acc_kwargs or {}),
+    )
+    model = GPTLMHeadModel(_tiny_cfg())
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    return acc, model, acc.compile_step(step_fn)
+
+
+def _batch(acc, seq=32, seed=0):
+    ids = np.random.default_rng(seed).integers(0, 256, (8, seq), dtype=np.int32)
+    return batch_to_global_array(jnp.asarray(ids), mesh=acc.mesh)
+
+
+# ---------------------------------------------------------------------------
+# pillar 1: step-phase timing
+# ---------------------------------------------------------------------------
+
+def test_phases_recorded_per_step_and_cover_wall_clock():
+    acc, _, step = _make_step()
+    batch = _batch(acc)
+    for _ in range(3):
+        loss = step(batch)
+    assert np.isfinite(float(loss))
+    records = acc.telemetry.timeline.records()
+    assert len(records) == 3
+    build, *replays = records
+    assert build.built and not any(r.built for r in replays)
+    assert build.trace_ms > 0 and build.compile_ms > 0
+    for rec in records:
+        assert rec.total_ms > 0
+        for phase in ("assembly_ms", "trace_ms", "compile_ms", "dispatch_ms",
+                      "dataloader_wait_ms"):
+            assert getattr(rec, phase) >= 0.0
+        # the phases partition __call__: their sum accounts for the wall
+        # clock (acceptance: within 20%)
+        assert rec.phase_sum_ms <= rec.total_ms * 1.001
+        assert rec.phase_sum_ms >= rec.total_ms * 0.8, (
+            rec.phase_sum_ms,
+            rec.total_ms,
+        )
+    # replays share the build's variant key and do not re-trace
+    assert {r.key for r in records} == {build.key}
+    assert len(step._cache) == 1
+
+
+def test_dataloader_wait_phase_flows_from_prepared_loader():
+    acc, _, step = _make_step()
+
+    data = np.random.default_rng(0).integers(0, 256, (128, 32)).astype(np.int32)
+
+    class Dataset:
+        def __len__(self):
+            return len(data)
+
+        def __getitem__(self, i):
+            return data[i]
+
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    loader = prepare_data_loader(Dataset(), batch_size=8, mesh=acc.mesh)
+    waits = []
+    for batch in loader:
+        step(batch)
+        waits.append(acc.telemetry.timeline.last().dataloader_wait_ms)
+    assert len(waits) == 2
+    assert all(w > 0 for w in waits), waits
+
+
+def test_prepared_loader_keeps_pinned_hub_after_later_accelerator():
+    acc, _, step = _make_step()
+
+    data = np.random.default_rng(0).integers(0, 256, (128, 32)).astype(np.int32)
+
+    class Dataset:
+        def __len__(self):
+            return len(data)
+
+        def __getitem__(self, i):
+            return data[i]
+
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    loader = acc.prepare_data_loader(
+        prepare_data_loader(Dataset(), batch_size=8, mesh=acc.mesh)
+    )
+    assert loader._telemetry is acc.telemetry
+    # a later telemetry-off Accelerator clears the module-global slot …
+    acc2 = Accelerator()
+    assert current_telemetry() is None
+    # … but the prepared loader's wait accounting survives via its pin
+    for batch in loader:
+        step(batch)
+    assert acc.telemetry.timeline.last().dataloader_wait_ms > 0
+
+
+def test_program_labels_stay_unique_across_rebuilds():
+    acc, _, step = _make_step()
+    step(_batch(acc, seq=32))
+    step(_batch(acc, seq=48))
+    # evict a variant and replay it: the rebuild (the layout-drift retry
+    # shape — pop + rebuild) must get a fresh label, not reuse an old one
+    step._cache.clear()
+    step(_batch(acc, seq=32))
+    labels = [p.label for p in acc.telemetry.program_records]
+    assert labels == ["capture:0", "capture:1", "capture:2"]
+
+
+def test_telemetry_losses_bitwise_equal_to_disabled_path():
+    def run(enabled):
+        Accelerator._reset_state()
+        _set_active(None)
+        acc, _, step = _make_step(enabled=enabled)
+        batch = _batch(acc)
+        return [float(step(batch)) for _ in range(3)]
+
+    assert run(True) == run(False)
+
+
+# ---------------------------------------------------------------------------
+# pillar 2: recompile forensics
+# ---------------------------------------------------------------------------
+
+def test_shape_change_emits_recompile_event_naming_the_argument():
+    acc, _, step = _make_step()
+    step(_batch(acc, seq=32))
+    assert len(acc.telemetry.recompile_events) == 0  # first build: expected
+    step(_batch(acc, seq=48))
+    events = list(acc.telemetry.recompile_events)
+    assert len(events) == 1
+    assert "arg[0] shape changed" in events[0].cause
+    assert "(8, 32)" in events[0].cause and "(8, 48)" in events[0].cause
+    assert events[0].kind == "key"
+    assert acc.telemetry.recompiles_total == 1
+
+
+def test_train_eval_flip_emits_recompile_event():
+    acc, model, step = _make_step()
+    batch = _batch(acc)
+    step(batch)
+    model.eval()
+    step(batch)
+    events = list(acc.telemetry.recompile_events)
+    assert len(events) == 1
+    assert "training changed" in events[0].cause
+
+
+def test_accumulate_refile_keeps_forensics_baseline():
+    """First-call accumulate re-files the cache entry under the traced
+    sync_gradients flag; forensics must diff later misses against the
+    re-filed key, or the flagship accumulation-boundary recompile loses
+    its cause attribution."""
+    from accelerate_tpu.nn import F, Tensor
+
+    nn.manual_seed(0)
+    acc = Accelerator(
+        gradient_accumulation_steps=2,
+        kwargs_handlers=[TelemetryKwargs(enabled=True)],
+    )
+    model = nn.Linear(4, 1)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(xb, yb):
+        with acc.accumulate(model):
+            pred = model(Tensor(xb)).squeeze(-1)
+            loss = F.mse_loss(pred, Tensor(yb))
+            acc.backward(loss)
+            opt.step()
+            opt.zero_grad()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 4)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(2,)).astype(np.float32))
+    step(x, y)  # builds + re-files under the traced sync flag
+    step(x, y)  # sync flips at the accumulation boundary → second variant
+    events = list(acc.telemetry.recompile_events)
+    assert len(events) == 1
+    assert "sync_gradients flipped" in events[0].cause, events[0].cause
+    # the build's record key matches its variant's replays, not the
+    # popped pre-advance key
+    records = acc.telemetry.timeline.records()
+    step(x, y)  # replay of variant 1
+    assert acc.telemetry.timeline.last().key == records[0].key
+    # program records follow the re-file too: each variant's HBM/FLOP
+    # stats join to its own key, with no cross-variant collision
+    prog_keys = [p.key for p in acc.telemetry.program_records]
+    assert prog_keys == [records[0].key, records[1].key]
+    assert len(set(prog_keys)) == 2
+
+
+def test_repeated_layout_drift_falls_back_to_plain_jit():
+    """One layout drift rebuilds AOT (loud event, fresh executable); a
+    second drift on the same variant means layouts alternate — the AOT
+    path must yield to plain jit or it would trace+compile every step."""
+    acc, _, step = _make_step()
+    batch = _batch(acc)
+    loss0 = float(step(batch))
+    key = next(iter(step._cache))
+
+    class _Rejecting:
+        def __call__(self, *a, **k):
+            raise ValueError("simulated sharding/layout mismatch")
+
+    def _inject():
+        entry = step._cache[key]
+        step._cache[key] = (_Rejecting(), *entry[1:])
+
+    _inject()  # drift 1 → loud event, rebuilt still AOT (no .lower on Compiled)
+    step(batch)
+    assert acc.telemetry.recompile_events[-1].kind == "layout"
+    assert not hasattr(step._cache[key][0], "lower")
+
+    _inject()  # drift 2 on the same key → plain-jit fallback (jitted has .lower)
+    loss2 = float(step(batch))
+    assert "falling back to plain jit" in acc.telemetry.recompile_events[-1].cause
+    assert hasattr(step._cache[key][0], "lower")
+    assert np.isfinite(loss2) and loss2 != loss0  # training kept moving
+
+    events_before = len(acc.telemetry.recompile_events)
+    step(batch)  # jit dispatch absorbs further calls: no new events, no rebuild
+    assert len(acc.telemetry.recompile_events) == events_before
+    rec = acc.telemetry.timeline.last()
+    assert not rec.built and rec.trace_ms == 0.0 and rec.compile_ms == 0.0
+
+
+def test_diff_keys_names_every_moved_component():
+    prev = ("treeA", (((4, 32), "int32"),), True, (True,))
+    new = ("treeA", (((4, 48), "int32"),), False, (False,))
+    causes = diff_keys(prev, new)
+    text = "\n".join(causes)
+    assert "arg[0] shape changed" in text
+    assert "sync_gradients flipped" in text
+    assert "model[0].training changed" in text
+
+
+# ---------------------------------------------------------------------------
+# pillar 3: resource accounting
+# ---------------------------------------------------------------------------
+
+def test_capture_records_program_stats_and_resource_sample():
+    acc, _, step = _make_step()
+    step(_batch(acc))
+    programs = list(acc.telemetry.program_records)
+    assert len(programs) == 1
+    # CPU backend exposes both analyses; at minimum the FLOP count must land
+    assert programs[0].stats.get("flops", 0) > 0
+    samples = list(acc.telemetry.resource_samples)
+    assert len(samples) == 1
+    assert samples[0].total_bytes > 0
+    # on-demand sampling works outside capture too
+    sample = acc.telemetry.sample_resources("manual")
+    assert sample.total_bytes > 0 and sample.tag == "manual"
+
+
+# ---------------------------------------------------------------------------
+# telemetry off: identical path, no allocations
+# ---------------------------------------------------------------------------
+
+def test_disabled_leaves_ring_buffer_and_counters_untouched(monkeypatch):
+    monkeypatch.delenv("ACCELERATE_TELEMETRY", raising=False)
+    nn.manual_seed(0)
+    acc = Accelerator()  # no handler, env unset → default off
+    model = GPTLMHeadModel(_tiny_cfg())
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    assert step._telemetry is None
+    assert current_telemetry() is None
+    slots_before = list(acc.telemetry.timeline._slots)
+    batch = _batch(acc)
+    for _ in range(3):
+        step(batch)
+    assert len(acc.telemetry.timeline) == 0
+    assert acc.telemetry.timeline._slots == slots_before  # ring untouched
+    assert acc.telemetry.steps_total == 0
+    assert acc.telemetry.recompiles_total == 0
+    assert len(acc.telemetry._export_queue) == 0
+    # the pre-telemetry host-assembly counters still tick (replays only)
+    assert step.host_assembly_calls == 2
+
+
+def test_ring_buffer_capacity_bounds_retention():
+    timeline = StepTimeline(capacity=4)
+    for i in range(10):
+        timeline.append(
+            StepRecord(
+                step=i, key="k", built=False, total_ms=1.0, assembly_ms=0.2,
+                trace_ms=0.0, compile_ms=0.0, dispatch_ms=0.8,
+                dataloader_wait_ms=0.0,
+            )
+        )
+    assert len(timeline) == 4
+    assert timeline.total_appended == 10
+    assert [r.step for r in timeline.records()] == [6, 7, 8, 9]
+    assert timeline.last().step == 9
+
+
+# ---------------------------------------------------------------------------
+# pillar 4: export
+# ---------------------------------------------------------------------------
+
+def test_tracker_bridge_writes_valid_jsonl(tmp_path):
+    acc, _, step = _make_step(
+        acc_kwargs={"log_with": "jsonl", "project_dir": str(tmp_path)}
+    )
+    acc.init_trackers("run", config={"lr": 1e-3}, init_kwargs={})
+    # the bridge was auto-inserted FIRST so end_training's in-order finish()
+    # flushes it into delegates that are still open
+    names = [t.name for t in acc.trackers]
+    assert names == ["telemetry", "jsonl"]
+    assert acc.get_tracker("telemetry").tracker is acc.telemetry
+
+    step(_batch(acc, seq=32))
+    step(_batch(acc, seq=48))  # recompile event
+    acc.log({"loss": 1.0}, step=0)  # piggyback drain
+    acc.end_training()
+
+    path = os.path.join(str(tmp_path), "run", "metrics.jsonl")
+    records = [json.loads(line) for line in open(path)]
+    assert all(isinstance(r, dict) for r in records)
+    keys = {k for r in records for k in r}
+    assert "telemetry/step/total_ms" in keys
+    assert "telemetry/recompile/cause" in keys
+    assert any(k.startswith("telemetry/program/") for k in keys)
+    # the drain is one-shot: nothing pending after flush
+    assert len(acc.telemetry._export_queue) == 0
+
+
+def test_write_jsonl_roundtrips_through_report_tool(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from telemetry_report import load_records, render, validate
+    finally:
+        sys.path.pop(0)
+
+    acc, _, step = _make_step()
+    for _ in range(3):
+        step(_batch(acc))
+    path = str(tmp_path / "run.jsonl")
+    acc.telemetry.write_jsonl(path)
+    records = load_records(path)
+    assert validate(records, min_steps=3) == []
+    kinds = {r["kind"] for r in records}
+    assert {"meta", "step", "program", "resources", "summary"} <= kinds
+    report = render(records)
+    assert "step-time breakdown" in report
+    assert "steady state" in report  # no recompiles in this run

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""outage_summary — aggregate tools/tpu_when_up.sh probe logs.
+
+    python tools/outage_summary.py TPU_OUTAGE_r*.log
+    python tools/outage_summary.py --json TPU_OUTAGE_r05.log
+
+The watcher writes one line per probe: ``<epoch-seconds> <STATE> <detail>``
+where STATE is ``TPU_UP`` (probe saw a healthy accelerator) or ``DOWN``
+(probe failed; detail is the last stderr line).  The raw logs were
+write-only; this renders what the round verdicts actually need: total
+up/down time, availability, and the longest DOWN window per log.
+
+Interval attribution: the span between consecutive probes belongs to the
+*earlier* probe's state (the probe cadence is ~4-6 min, so this is the
+finest resolution the data supports).  The span after the final probe is
+unknown and excluded.  Exit 0 on success, 2 when no parseable probe lines
+were found in any input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def parse_log(path: str) -> list[tuple[int, bool]]:
+    """[(epoch_seconds, is_up), ...] in file order; unparseable lines skipped."""
+    probes: list[tuple[int, bool]] = []
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            parts = line.split(None, 2)
+            if len(parts) < 2 or not parts[0].isdigit():
+                continue
+            state = parts[1].upper()
+            if state not in ("TPU_UP", "UP", "DOWN"):
+                continue
+            probes.append((int(parts[0]), state != "DOWN"))
+    return probes
+
+
+def summarize(probes: list[tuple[int, bool]]) -> dict:
+    up_s = down_s = 0
+    transitions = 0
+    longest_down = {"seconds": 0, "start": None, "end": None}
+    run_start: int | None = None  # start epoch of the current DOWN run
+    for (t0, state0), (t1, state1) in zip(probes, probes[1:]):
+        span = max(0, t1 - t0)
+        if state0:
+            up_s += span
+        else:
+            down_s += span
+            if run_start is None:
+                run_start = t0
+        if state0 != state1:
+            transitions += 1
+        # a DOWN run ends when the *next* probe is up (or at the last probe)
+        if run_start is not None and (state1 or (t1, state1) == probes[-1]):
+            if t1 - run_start > longest_down["seconds"]:
+                longest_down = {"seconds": t1 - run_start, "start": run_start, "end": t1}
+            if state1:
+                run_start = None
+    observed = up_s + down_s
+    return {
+        "probes": len(probes),
+        "probes_up": sum(1 for _, up in probes if up),
+        "probes_down": sum(1 for _, up in probes if not up),
+        "first_probe": probes[0][0] if probes else None,
+        "last_probe": probes[-1][0] if probes else None,
+        "observed_s": observed,
+        "up_s": up_s,
+        "down_s": down_s,
+        "availability_pct": round(100.0 * up_s / observed, 1) if observed else None,
+        "transitions": transitions,
+        "longest_down_s": longest_down["seconds"],
+        "longest_down_start": longest_down["start"],
+        "longest_down_end": longest_down["end"],
+    }
+
+
+def _hms(seconds) -> str:
+    if not seconds:
+        return "0m"
+    h, rem = divmod(int(seconds), 3600)
+    m = rem // 60
+    return f"{h}h{m:02d}m" if h else f"{m}m"
+
+
+def _utc(epoch) -> str:
+    if epoch is None:
+        return "-"
+    return time.strftime("%Y-%m-%d %H:%MZ", time.gmtime(epoch))
+
+
+def render(path: str, s: dict) -> str:
+    avail = f"{s['availability_pct']}%" if s["availability_pct"] is not None else "n/a"
+    lines = [
+        f"{path}: {s['probes']} probes "
+        f"({_utc(s['first_probe'])} → {_utc(s['last_probe'])})",
+        f"  up   {_hms(s['up_s']):>7}   down {_hms(s['down_s']):>7}   "
+        f"availability {avail}   transitions {s['transitions']}",
+        f"  longest DOWN window: {_hms(s['longest_down_s'])}"
+        + (
+            f" ({_utc(s['longest_down_start'])} → {_utc(s['longest_down_end'])})"
+            if s["longest_down_start"] is not None
+            else ""
+        ),
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="outage_summary", description=__doc__)
+    parser.add_argument("logs", nargs="+", help="TPU_OUTAGE_r*.log files")
+    parser.add_argument("--json", action="store_true", help="machine output")
+    args = parser.parse_args(argv)
+
+    summaries = {}
+    for path in args.logs:
+        try:
+            probes = parse_log(path)
+        except OSError as e:
+            print(f"outage_summary: cannot read {path}: {e}", file=sys.stderr)
+            continue
+        if not probes:
+            print(f"outage_summary: no probe lines in {path}", file=sys.stderr)
+            continue
+        summaries[path] = summarize(probes)
+
+    if not summaries:
+        return 2
+    if args.json:
+        print(json.dumps(summaries, indent=2))
+    else:
+        for path, s in summaries.items():
+            print(render(path, s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""telemetry_report — render a telemetry JSONL run (docs/telemetry.md).
+
+    python tools/telemetry_report.py run.jsonl
+    python tools/telemetry_report.py run.jsonl --json
+
+Input: the ``kind``-tagged JSONL that ``Telemetry.write_jsonl`` /
+``ACCELERATE_TELEMETRY_JSONL`` produces (one JSON object per line; kinds:
+``meta``/``step``/``recompile``/``program``/``resources``/``summary``).
+Output: a step-time breakdown table (build steps split out from replays —
+averaging a compile into replay dispatch would hide both), the recompile
+history with attributed causes, and per-program HBM/FLOP accounting.
+
+``validate()`` is the well-formedness check behind ``make telemetry-smoke``:
+it returns a list of schema errors (empty = valid).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+STEP_PHASES = (
+    "dataloader_wait_ms",
+    "assembly_ms",
+    "trace_ms",
+    "compile_ms",
+    "dispatch_ms",
+)
+STEP_FIELDS = ("step", "key", "built", "total_ms") + STEP_PHASES
+
+
+def load_records(path: str) -> list[dict]:
+    records = []
+    with open(path, encoding="utf-8") as f:
+        for n, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"{path}:{n}: not JSON: {e}") from None
+            if not isinstance(record, dict):
+                raise ValueError(f"{path}:{n}: record is not an object")
+            records.append(record)
+    return records
+
+
+def validate(records: list[dict], min_steps: int = 0) -> list[str]:
+    """Schema errors for a telemetry run; [] when well-formed."""
+    errors: list[str] = []
+    kinds = [r.get("kind") for r in records]
+    if "meta" not in kinds:
+        errors.append("no meta record")
+    steps = [r for r in records if r.get("kind") == "step"]
+    if len(steps) < min_steps:
+        errors.append(f"expected >= {min_steps} step records, got {len(steps)}")
+    for i, record in enumerate(steps):
+        record_ok = True
+        for field in STEP_FIELDS:
+            if field not in record:
+                errors.append(f"step record {i} missing field {field!r}")
+                record_ok = False
+            elif field.endswith("_ms") and (
+                not isinstance(record[field], (int, float)) or record[field] < 0
+            ):
+                errors.append(f"step record {i}: {field}={record[field]!r}")
+                record_ok = False
+        if record_ok and record["total_ms"] > 0:
+            # the in-call phases partition total_ms; dataloader_wait_ms is
+            # measured *between* calls (loader-side) and sits outside it, so
+            # it is excluded.  A large hole means a timer went missing
+            # (>100% means one double-counted).
+            in_call = (p for p in STEP_PHASES if p != "dataloader_wait_ms")
+            covered = sum(record[p] for p in in_call) / record["total_ms"]
+            if not 0.5 <= covered <= 1.5:
+                errors.append(
+                    f"step record {i}: phases cover {covered:.0%} of total_ms"
+                )
+    for i, record in enumerate(r for r in records if r.get("kind") == "recompile"):
+        if not record.get("cause"):
+            errors.append(f"recompile record {i} has no cause")
+    return errors
+
+
+def _mean(values):
+    return sum(values) / len(values) if values else 0.0
+
+
+def render(records: list[dict]) -> str:
+    steps = [r for r in records if r.get("kind") == "step"]
+    recompiles = [r for r in records if r.get("kind") == "recompile"]
+    programs = [r for r in records if r.get("kind") == "program"]
+    resources = [r for r in records if r.get("kind") == "resources"]
+    replays = [r for r in steps if not r.get("built")]
+    builds = [r for r in steps if r.get("built")]
+
+    lines = [f"telemetry run: {len(steps)} steps ({len(builds)} builds), "
+             f"{len(recompiles)} recompile event(s)"]
+
+    lines.append("")
+    lines.append("step-time breakdown (ms)")
+    header = f"  {'phase':<18}{'replay mean':>12}{'replay max':>12}{'build mean':>12}"
+    lines.append(header)
+    lines.append("  " + "-" * (len(header) - 2))
+    # .get with 0.0: a record missing a phase field already produced a
+    # validate() warning — the report must degrade, not crash
+    for phase in STEP_PHASES:
+        lines.append(
+            f"  {phase[:-3]:<18}"
+            f"{_mean([r.get(phase, 0.0) for r in replays]):>12.3f}"
+            f"{max([r.get(phase, 0.0) for r in replays], default=0.0):>12.3f}"
+            f"{_mean([r.get(phase, 0.0) for r in builds]):>12.3f}"
+        )
+    lines.append(
+        f"  {'total':<18}"
+        f"{_mean([r.get('total_ms', 0.0) for r in replays]):>12.3f}"
+        f"{max([r.get('total_ms', 0.0) for r in replays], default=0.0):>12.3f}"
+        f"{_mean([r.get('total_ms', 0.0) for r in builds]):>12.3f}"
+    )
+
+    lines.append("")
+    if recompiles:
+        lines.append("recompile history")
+        for r in recompiles:
+            lines.append(f"  step {r.get('step', '?'):>4}  [{r.get('recompile_kind', 'key')}] {r.get('cause')}")
+    else:
+        lines.append("recompile history: none (steady state)")
+
+    if programs:
+        lines.append("")
+        lines.append("captured programs")
+        for r in programs:
+            flops = r.get("flops")
+            arg_mb = r.get("argument_size_bytes", 0) / 1e6
+            tmp_mb = r.get("temp_size_bytes", 0) / 1e6
+            lines.append(
+                f"  {r.get('label', '?'):<12} {r.get('key', '?'):<13}"
+                f" args {arg_mb:8.1f} MB  temps {tmp_mb:8.1f} MB"
+                + (f"  {flops / 1e9:8.2f} GFLOP" if flops else "")
+            )
+    if resources:
+        lines.append("")
+        lines.append("live-bytes samples")
+        for r in resources:
+            lines.append(
+                f"  {r.get('tag', '?'):<12} total {r.get('total_bytes', 0) / 1e6:8.1f} MB"
+                f" over {len(r.get('devices', {}))} device(s)"
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="telemetry_report", description=__doc__)
+    parser.add_argument("run", help="telemetry JSONL file")
+    parser.add_argument("--json", action="store_true", help="summary as JSON")
+    parser.add_argument(
+        "--validate",
+        type=int,
+        metavar="N",
+        default=None,
+        help="validate only: require >= N step records, exit 1 on schema errors",
+    )
+    args = parser.parse_args(argv)
+    try:
+        records = load_records(args.run)
+    except (OSError, ValueError) as e:
+        print(f"telemetry_report: {e}", file=sys.stderr)
+        return 2
+    errors = validate(records, min_steps=args.validate or 0)
+    if args.validate is not None:
+        for error in errors:
+            print(f"telemetry_report: {error}", file=sys.stderr)
+        print(
+            f"telemetry_report: {args.run}: "
+            + ("INVALID" if errors else "ok")
+            + f" ({len([r for r in records if r.get('kind') == 'step'])} steps)"
+        )
+        return 1 if errors else 0
+    if errors:
+        for error in errors:
+            print(f"telemetry_report: warning: {error}", file=sys.stderr)
+    if args.json:
+        summaries = [r for r in records if r.get("kind") == "summary"]
+        print(json.dumps(summaries[-1] if summaries else {}, indent=2))
+    else:
+        print(render(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

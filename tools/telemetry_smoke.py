@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""telemetry_smoke — `make telemetry-smoke`: prove the telemetry pipeline
+end-to-end on CPU in seconds.
+
+Tiny model, 3 captured steps with telemetry on, full export to JSONL, then
+schema validation through tools/telemetry_report.py (the same validator a
+user would run on a real run's dump).  Exit 0 = a well-formed telemetry
+JSONL with >= 3 step records, a build with nonzero trace/compile time, and
+a recompile event attributing a forced shape change.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    import numpy as np
+    import jax.numpy as jnp
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator, TelemetryKwargs
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    from telemetry_report import load_records, validate
+
+    path = os.path.join(tempfile.mkdtemp(prefix="atpu_telemetry_"), "run.jsonl")
+    nn.manual_seed(0)
+    acc = Accelerator(
+        kwargs_handlers=[TelemetryKwargs(enabled=True, jsonl_path=path)]
+    )
+    model = GPTLMHeadModel(
+        GPTConfig(vocab_size=256, n_positions=64, n_embd=32, n_layer=1, n_head=2)
+    )
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    rng = np.random.default_rng(0)
+
+    def batch(seq):
+        ids = rng.integers(0, 256, (4, seq), dtype=np.int32)
+        return batch_to_global_array(jnp.asarray(ids), mesh=acc.mesh)
+
+    for _ in range(3):
+        loss = step(batch(32))
+    float(loss)
+    step(batch(48))  # forced shape change → recompile event with a cause
+    acc.end_training()  # writes the JSONL dump
+
+    records = load_records(path)
+    errors = validate(records, min_steps=4)
+    builds = [r for r in records if r.get("kind") == "step" and r.get("built")]
+    if not any(r["trace_ms"] > 0 and r["compile_ms"] > 0 for r in builds):
+        errors.append("no build step with nonzero trace/compile time")
+    recompiles = [r for r in records if r.get("kind") == "recompile"]
+    if not any("arg[0] shape changed" in (r.get("cause") or "") for r in recompiles):
+        errors.append(f"shape-change recompile cause missing: {recompiles}")
+    for error in errors:
+        print(f"telemetry-smoke: FAIL: {error}", file=sys.stderr)
+    if errors:
+        return 1
+    steps = [r for r in records if r.get("kind") == "step"]
+    print(
+        f"telemetry-smoke: ok — {len(steps)} steps, {len(builds)} builds, "
+        f"{len(recompiles)} recompile event(s), JSONL at {path}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
