@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke bench
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke bench
 
 # graftlint: whole-program trace-safety & collective-correctness static
 # analysis (docs/graftlint.md). Runs before the suite. The on-disk cache
@@ -31,7 +31,13 @@ multichip:
 telemetry-smoke:
 	JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 
-test: lint multichip telemetry-smoke
+# preemption-path proof (docs/resilience.md): tiny model, injected SIGTERM
+# at step 2, asserts the loop drains a COMPLETE checkpoint and a fresh
+# accelerator resumes bitwise-equal to the uninterrupted run
+resilience-smoke:
+	JAX_PLATFORMS=cpu python tools/resilience_smoke.py
+
+test: lint multichip telemetry-smoke resilience-smoke
 	python -m pytest tests/ -q
 
 test_core:
@@ -70,7 +76,7 @@ test_big_modeling:
 
 test_checkpoint:
 	python -m pytest tests/test_sharded_checkpoint.py tests/test_fsdp_utils.py \
-	  tests/test_async_checkpoint.py -q
+	  tests/test_async_checkpoint.py tests/test_resilience.py -q
 
 test_examples:
 	python -m pytest tests/test_examples.py tests/test_external_scripts.py -q

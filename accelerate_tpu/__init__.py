@@ -58,6 +58,7 @@ from .utils.dataclasses import (
     ParallelismConfig,
     ProfileKwargs,
     ProjectConfiguration,
+    ResilienceKwargs,
     SequenceParallelPlugin,
     TelemetryKwargs,
     TensorParallelPlugin,

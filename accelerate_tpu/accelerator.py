@@ -99,17 +99,21 @@ class Accelerator:
         self._comm_wrapper = None  # "fp16"/"bf16" factor rounding for powersgd
         self._powersgd_state = None  # per-model {q, err} arrays, capture-threaded
         self.telemetry_handler = None
+        self.resilience_handler = None
         from .utils.dataclasses import FP8RecipeKwargs
 
         from .utils.dataclasses import (
             AutocastKwargs,
             DistributedDataParallelKwargs,
+            ResilienceKwargs,
             TelemetryKwargs,
         )
 
         for handler in kwargs_handlers or []:
             if isinstance(handler, TelemetryKwargs):
                 self.telemetry_handler = handler
+            elif isinstance(handler, ResilienceKwargs):
+                self.resilience_handler = handler
             elif isinstance(handler, AutocastKwargs):
                 self.autocast_handler = handler
             elif isinstance(handler, GradScalerKwargs):
@@ -223,6 +227,15 @@ class Accelerator:
         from .telemetry import Telemetry
 
         self.telemetry = Telemetry(self.telemetry_handler)
+
+        # resilience (docs/resilience.md): always constructed, OFF unless
+        # ResilienceKwargs/$ACCELERATE_RESILIENCE turns it on — compile_step
+        # pins the enabled instance so the captured path pays one None-check
+        # when off; enabled, it installs the preemption guard and arms the
+        # dispatch retrier
+        from .resilience import Resilience
+
+        self.resilience = Resilience(self.resilience_handler, telemetry=self.telemetry)
 
         # seed the nn RNG only when explicitly requested or still unseeded —
         # never clobber a user's earlier manual_seed
@@ -1060,6 +1073,8 @@ class Accelerator:
         if not async_save:
             write_accelerator_save(plan)
             finalize_accelerator_save(plan)
+            if self.resilience.enabled:
+                self.resilience.note_checkpoint(output_dir)
             return output_dir
 
         import threading as _threading
@@ -1072,6 +1087,7 @@ class Accelerator:
 
         self._async_save_error = None
         self._async_save_plan = plan
+        self._async_save_dir = output_dir
         # non-daemon: a normal interpreter exit joins this thread, so a
         # script that ends right after save_state still gets a complete
         # checkpoint instead of a silently truncated one.  The collective
@@ -1144,10 +1160,12 @@ class Accelerator:
         self._async_save_error = None
         plan = getattr(self, "_async_save_plan", None)
         self._async_save_plan = None
+        saved_dir = getattr(self, "_async_save_dir", None)
+        self._async_save_dir = None
+        failed = error is not None
         if plan is not None:
             from .checkpointing import finalize_accelerator_save
 
-            failed = error is not None
             if self.num_processes > 1:
                 # cleanup must be all-or-nothing: a writer failure on ANY
                 # rank means some new artifact is missing/truncated there,
@@ -1159,6 +1177,10 @@ class Accelerator:
             finalize_accelerator_save(plan, cleanup=not failed)
         if error is not None:
             raise error
+        if self.resilience.enabled and saved_dir is not None and not failed:
+            # only a save that landed error-free ON EVERY RANK is a valid
+            # rollback target
+            self.resilience.note_checkpoint(saved_dir)
 
     def load_state(self, input_dir: Optional[str] = None, **kwargs) -> None:
         from .checkpointing import load_accelerator_state
@@ -1166,13 +1188,20 @@ class Accelerator:
         self.wait_for_checkpoint()
         if input_dir is None and self.project_configuration.automatic_checkpoint_naming:
             base = os.path.join(self.project_dir or ".", "checkpoints")
-            folders = sorted(
-                (f for f in os.listdir(base) if f.startswith("checkpoint_")),
-                key=lambda f: int(f.split("_")[-1]),
-            )
-            if not folders:
-                raise FileNotFoundError(f"no checkpoints in {base}")
-            input_dir = os.path.join(base, folders[-1])
+            # prefer the newest COMPLETE checkpoint (meta sentinel present):
+            # a preempted run killed mid-write leaves a truncated newest
+            # folder, and resuming must not load half a state
+            from .checkpointing import latest_checkpoint
+
+            input_dir = latest_checkpoint(base)
+            if input_dir is None:
+                folders = sorted(
+                    (f for f in os.listdir(base) if f.startswith("checkpoint_")),
+                    key=lambda f: int(f.split("_")[-1]),
+                )
+                if not folders:
+                    raise FileNotFoundError(f"no checkpoints in {base}")
+                input_dir = os.path.join(base, folders[-1])
         # pre-hooks see (models, input_dir) and may remove entries from the
         # list to take over loading for those models (reference
         # accelerator.py:3365); the loader restores whatever remains
@@ -1253,6 +1282,7 @@ class Accelerator:
 
     def end_training(self) -> None:
         self.wait_for_checkpoint()  # an in-flight async save must land
+        self.resilience.close()  # restore default signal handling
         for tracker in self.trackers:
             tracker.finish()
         if self.telemetry.enabled and not any(

@@ -167,6 +167,12 @@ class CapturedStep:
         # recorded; when OFF every line below runs exactly as before.
         tel = getattr(accelerator, "telemetry", None)
         self._telemetry = tel if (tel is not None and tel.enabled) else None
+        # resilience (docs/resilience.md): same pinning discipline — when
+        # OFF the dispatch below is byte-identical to the pre-resilience
+        # path; when ON, dispatch faults are classified/retried and the
+        # fault injector's hooks fire
+        res = getattr(accelerator, "resilience", None)
+        self._resilience = res if (res is not None and res.enabled) else None
         self._last_key = None  # previous variant key, for recompile forensics
         self._last_build_ms = (0.0, 0.0)  # (trace_ms, compile_ms) of last build
         # monotonic build counter for program-record labels: cache size would
@@ -297,14 +303,35 @@ class CapturedStep:
         self._last_key = key
         retry_rebuild = False
         t_dispatch = 0.0
+        res = self._resilience
+        retrier = res.retrier if res is not None else None
+        if res is not None:
+            # counts this dispatch on the fault plan's step axis and delivers
+            # any scheduled (injected) SIGTERM — "mid-step" preemption
+            res.begin_dispatch()
         if tel is not None:
             t_dispatch = _time.perf_counter()
-            new_state, out, entry, retry_rebuild = self._dispatch_aot(
-                tel, key, entry, state, args, dev_leaves, host_leaves, flat_args
-            )
+            if retrier is None:
+                new_state, out, entry, retry_rebuild = self._dispatch_aot(
+                    tel, key, entry, state, args, dev_leaves, host_leaves, flat_args
+                )
+            else:
+                new_state, out, entry, retry_rebuild = retrier.run_dispatch(
+                    self,
+                    lambda dev, host, e: self._dispatch_aot(
+                        tel, key, e, state, args, dev, host, flat_args
+                    ),
+                    entry, dev_leaves, host_leaves, host_mask,
+                )
             if retry_rebuild:
                 built = True
                 jitted, ctx, _, host_mask = entry
+        elif retrier is not None:
+            new_state, out, _, _ = retrier.run_dispatch(
+                self,
+                lambda dev, host, e: (*e[0](dev, host, *flat_args), e, False),
+                entry, dev_leaves, host_leaves, host_mask,
+            )
         else:
             new_state, out = jitted(dev_leaves, host_leaves, *flat_args)
         self._writeback(new_state)

@@ -344,23 +344,32 @@ def write_accelerator_save(plan: SavePlan) -> None:
             else:
                 with open(os.path.join(plan.output_dir, name), "wb") as f:
                     pickle.dump(payload, f)
-        with open(os.path.join(plan.output_dir, "accelerator_meta.json"), "w") as f:
-            json.dump(plan.meta, f)
     with open(os.path.join(plan.output_dir, plan.rng_filename), "wb") as f:
         pickle.dump(plan.rng_payload, f)
+    # NOTE: accelerator_meta.json — the completion sentinel — is written in
+    # finalize_accelerator_save, AFTER the cross-process barrier: only then
+    # have EVERY rank's shard/rng writes landed, so its presence proves the
+    # whole checkpoint (not just this rank's slice) is durable.
 
 
 def finalize_accelerator_save(plan: SavePlan, cleanup: bool = True) -> None:
-    """Collective epilogue: barrier all processes past their writes, then
-    drop PREEXISTING artifacts this save did not overwrite (e.g. shard files
-    from a different world size, or a stale index.json after a
-    sharded→full transition).  Runs on the main thread — for async saves,
-    from ``wait_for_checkpoint`` after the writer joins; ``cleanup=False``
-    (writer failed) keeps whatever older checkpoint files exist."""
+    """Collective epilogue: barrier all processes past their writes, write
+    the completion sentinel, then drop PREEXISTING artifacts this save did
+    not overwrite (e.g. shard files from a different world size, or a stale
+    index.json after a sharded→full transition).  Runs on the main thread —
+    for async saves, from ``wait_for_checkpoint`` after the writer joins;
+    ``cleanup=False`` (a writer failed on some rank) skips BOTH — the folder
+    stays detectably incomplete and older checkpoint files stay loadable."""
     import glob as _glob
 
     state = PartialState()
     state.wait_for_everyone()
+    if cleanup and plan.is_main:
+        # the sentinel: past the barrier above, every rank's writes are on
+        # disk, so accelerator_meta.json's presence proves the WHOLE
+        # checkpoint complete (is_complete_checkpoint/latest_checkpoint)
+        with open(os.path.join(plan.output_dir, "accelerator_meta.json"), "w") as f:
+            json.dump(plan.meta, f)
     if cleanup and getattr(state, "is_local_main_process", state.is_main_process):
         world = state.num_processes
         valid: set[str] = set()
@@ -514,6 +523,36 @@ def load_accelerator_state(
             _restore_rng_states(pickle.load(f))
     logger.info(f"Loaded accelerator state from {input_dir}")
     return overrides
+
+
+def is_complete_checkpoint(path: str) -> bool:
+    """True when ``path`` holds a checkpoint whose save finished everywhere.
+
+    ``accelerator_meta.json`` is written by ``finalize_accelerator_save``
+    after the cross-process barrier, so its presence proves every rank's
+    model/optimizer/scheduler/RNG artifacts landed — the sentinel the
+    resilience subsystem (rollback targets, preemption resume) keys on.
+    """
+    return os.path.isfile(os.path.join(path, "accelerator_meta.json"))
+
+
+def latest_checkpoint(base_dir: str) -> Optional[str]:
+    """Newest COMPLETE ``checkpoint_N`` folder under ``base_dir`` (the
+    automatic-checkpoint-naming layout), or ``None``.  Skips folders whose
+    completion sentinel is missing — a save killed mid-write must not be
+    chosen over the older checkpoint it was about to supersede."""
+    if not os.path.isdir(base_dir):
+        return None
+    folders = [
+        f
+        for f in os.listdir(base_dir)
+        if f.startswith("checkpoint_") and f.split("_")[-1].isdigit()
+    ]
+    for folder in sorted(folders, key=lambda f: int(f.split("_")[-1]), reverse=True):
+        path = os.path.join(base_dir, folder)
+        if is_complete_checkpoint(path):
+            return path
+    return None
 
 
 def save_custom_state(obj, path: str, index: int = 0) -> None:

@@ -1,0 +1,146 @@
+"""Pillar 4 — deterministic fault injection (test-only).
+
+The resilience subsystem exists because of failures that cannot be scheduled:
+hung PJRT clients, spot reclamation SIGTERMs, transient XLA runtime errors.
+This module makes them schedulable so the whole subsystem is testable on CPU
+with no flaky hardware: a :class:`FaultPlan` names exactly which fault fires
+when, and a :class:`FaultInjector` replays it deterministically.
+
+Plan grammar (``ACCELERATE_FAULT_PLAN`` or ``ResilienceKwargs.fault_plan``) —
+semicolon-separated directives, ``key=int`` options after a colon:
+
+* ``init_hang`` / ``init_hang:times=2`` — the next N backend-init probes fail
+  as if the PJRT client hung (no real subprocess, no real timeout wait).
+* ``dispatch:step=2`` / ``dispatch:step=2,times=3`` — the captured-step
+  dispatch with global index ``step`` raises an
+  :class:`InjectedTransientError` N times (retries of the same call keep
+  faulting until ``times`` is exhausted, which is how rollback exhaustion is
+  driven in tests).
+* ``sigterm:step=2`` — deliver a real ``SIGTERM`` to this process right
+  before the dispatch of global step ``step`` (mid-step preemption).
+
+Injection points are reached only when resilience is enabled AND a plan is
+configured — production runs never pay for (or trip over) this module.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from dataclasses import dataclass, field
+from typing import Optional
+
+ENV_FAULT_PLAN = "ACCELERATE_FAULT_PLAN"
+
+
+class InjectedTransientError(RuntimeError):
+    """Simulated transient runtime failure (classified retryable by
+    :func:`~.retry.classify_failure`, exactly like an UNAVAILABLE status)."""
+
+
+@dataclass
+class _Directive:
+    kind: str  # "init_hang" | "dispatch" | "sigterm"
+    step: Optional[int] = None  # dispatch index (dispatch/sigterm)
+    times: int = 1  # how many firings remain
+    fired: int = 0
+
+
+@dataclass
+class FaultPlan:
+    directives: list[_Directive] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        directives: list[_Directive] = []
+        for raw in spec.split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            kind, _, opts_raw = raw.partition(":")
+            kind = kind.strip()
+            if kind not in ("init_hang", "dispatch", "sigterm"):
+                raise ValueError(
+                    f"unknown fault directive {kind!r} in {spec!r}; use "
+                    "init_hang / dispatch / sigterm"
+                )
+            opts: dict[str, int] = {}
+            for pair in opts_raw.split(","):
+                pair = pair.strip()
+                if not pair:
+                    continue
+                key, _, value = pair.partition("=")
+                try:
+                    opts[key.strip()] = int(value)
+                except ValueError:
+                    raise ValueError(
+                        f"fault option {pair!r} in {spec!r} is not key=int"
+                    ) from None
+            unknown = set(opts) - {"step", "times"}
+            if unknown:
+                raise ValueError(f"unknown fault options {sorted(unknown)} in {raw!r}")
+            if kind in ("dispatch", "sigterm") and "step" not in opts:
+                raise ValueError(f"{kind!r} directive needs step=N ({raw!r})")
+            directives.append(
+                _Directive(
+                    kind=kind, step=opts.get("step"), times=opts.get("times", 1)
+                )
+            )
+        return cls(directives)
+
+
+class FaultInjector:
+    """Replays a :class:`FaultPlan`; every hook is deterministic and cheap."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str]) -> Optional["FaultInjector"]:
+        spec = spec if spec is not None else os.environ.get(ENV_FAULT_PLAN)
+        if not spec:
+            return None
+        return cls(FaultPlan.parse(spec))
+
+    def _pending(self, kind: str, step: Optional[int] = None):
+        for d in self.plan.directives:
+            if d.kind != kind or d.fired >= d.times:
+                continue
+            if step is not None and d.step != step:
+                continue
+            return d
+        return None
+
+    # -- hooks ---------------------------------------------------------------
+    def maybe_init_fault(self, timeout_s: float) -> Optional[str]:
+        """Simulate one hung init probe; returns the failure detail, or None
+        to let the real probe run."""
+        directive = self._pending("init_hang")
+        if directive is None:
+            return None
+        directive.fired += 1
+        return (
+            f"backend init exceeded {timeout_s:.0f}s (hung PJRT client) "
+            "[injected]"
+        )
+
+    def maybe_sigterm(self, dispatch_index: int) -> None:
+        """Deliver a real SIGTERM before the given dispatch (the handler the
+        preemption guard installed sets its sticky flag synchronously)."""
+        directive = self._pending("sigterm", step=dispatch_index)
+        if directive is None:
+            return
+        directive.fired += 1
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_dispatch_fault(self, dispatch_index: int) -> None:
+        """Raise a transient fault for the given dispatch; retries of the same
+        call keep hitting this until ``times`` is exhausted."""
+        directive = self._pending("dispatch", step=dispatch_index)
+        if directive is None:
+            return
+        directive.fired += 1
+        raise InjectedTransientError(
+            f"UNAVAILABLE: injected transient dispatch fault at step "
+            f"{dispatch_index} (firing {directive.fired}/{directive.times})"
+        )

@@ -1,0 +1,125 @@
+"""Pillar 2 — preemption-safe checkpointing.
+
+TPU fleets are preemptible by design: maintenance events and spot
+reclamation deliver SIGTERM and expect the process gone shortly after.  The
+standard answer (PyTorch/XLA's preemption handling, torchelastic's
+checkpoint-on-signal — PAPERS.md) is a *sticky flag*, not an exception: the
+signal handler must do nothing but record, because the training loop may be
+mid-dispatch, mid-collective, or mid-checkpoint when it fires.  The loop
+then reads the flag at its own safe point (``resilience.should_save`` /
+``should_exit``, the ``accelerator.check_trigger()`` idiom) and drains
+through the existing async ``save_state``/``wait_for_checkpoint`` machinery
+so the run always exits with a COMPLETE checkpoint.
+
+An optional wall-clock deadline covers scheduled maintenance windows ("save
+and exit N seconds from now") with the same flags — no signal needed.
+"""
+
+from __future__ import annotations
+
+import signal
+import time
+from typing import Callable, Iterable, Optional
+
+# the installed guard (latest-wins, like telemetry's _ACTIVE slot): a later
+# Accelerator's guard replaces — and uninstalls — the previous one, so the
+# chain of "previous handlers" never points into a dead hub
+_INSTALLED: Optional["PreemptionGuard"] = None
+
+
+class PreemptionGuard:
+    """Sticky-flag signal handler + optional wall-clock deadline."""
+
+    def __init__(
+        self,
+        signals: Optional[Iterable[int]] = None,
+        deadline_s: Optional[float] = None,
+        on_trigger: Optional[Callable[[int], None]] = None,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        self.signals = tuple(signals) if signals else (signal.SIGTERM, signal.SIGINT)
+        self._time = time_fn
+        self._deadline_at = (
+            self._time() + float(deadline_s) if deadline_s is not None else None
+        )
+        self._on_trigger = on_trigger
+        self._triggered = False
+        self._signum: Optional[int] = None
+        self._prev: dict[int, object] = {}
+        self.installed = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def install(self) -> bool:
+        """Register the handlers; returns False (and stays inert) off the
+        main thread, where CPython forbids ``signal.signal``."""
+        global _INSTALLED
+        if self.installed:
+            return True
+        if _INSTALLED is not None:
+            _INSTALLED.uninstall()
+        try:
+            for signum in self.signals:
+                self._prev[signum] = signal.signal(signum, self._handle)
+        except ValueError:  # not the main thread
+            for signum, prev in self._prev.items():
+                try:  # pragma: no cover — restore is also main-thread-only
+                    signal.signal(signum, prev)
+                except ValueError:
+                    pass
+            self._prev.clear()
+            return False
+        self.installed = True
+        _INSTALLED = self
+        return True
+
+    def uninstall(self) -> None:
+        global _INSTALLED
+        if not self.installed:
+            return
+        for signum, prev in self._prev.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, TypeError):
+                pass
+        self._prev.clear()
+        self.installed = False
+        if _INSTALLED is self:
+            _INSTALLED = None
+
+    def _handle(self, signum, frame) -> None:
+        # record-only: the loop may be mid-dispatch/mid-collective — raising
+        # here would corrupt the very state the drain exists to save
+        repeat = self._triggered and self._signum == signum
+        self._triggered = True
+        self._signum = signum
+        if self._on_trigger is not None:
+            try:
+                self._on_trigger(signum)
+            except Exception:  # a telemetry hiccup must not eat the flag
+                pass
+        if repeat and signum == signal.SIGINT:
+            # a second Ctrl-C means NOW: a loop that never polls the sticky
+            # flag (or a wedged dispatch) must still be interruptible
+            raise KeyboardInterrupt
+
+    # -- flags ---------------------------------------------------------------
+    @property
+    def triggered(self) -> bool:
+        return self._triggered
+
+    @property
+    def signal_name(self) -> Optional[str]:
+        if self._signum is None:
+            return None
+        try:
+            return signal.Signals(self._signum).name
+        except ValueError:
+            return str(self._signum)
+
+    def deadline_reached(self) -> bool:
+        return self._deadline_at is not None and self._time() >= self._deadline_at
+
+    def seconds_to_deadline(self) -> Optional[float]:
+        if self._deadline_at is None:
+            return None
+        return max(0.0, self._deadline_at - self._time())

@@ -1,0 +1,194 @@
+"""Pillar 3 — step retry with rollback.
+
+A captured-step dispatch can fail for two very different reasons and they
+must not be handled alike:
+
+* **transient runtime faults** — the PJRT/XLA runtime path to the device
+  hiccuped (UNAVAILABLE, DEADLINE_EXCEEDED, a dropped tunnel connection).
+  The program and its inputs are fine; trying again is both safe and the
+  right move.  Safe because of the donation guarantee the capture layer
+  already relies on (capture.py ``_dispatch_aot``): argument validation —
+  where these failures surface — happens BEFORE any buffer is donated, so a
+  failed call leaves every donated leaf intact for the retry.
+* **user/program errors** — a shape mismatch, a NaN assert, an OOM
+  (RESOURCE_EXHAUSTED).  Retrying re-runs the same wrong program; these
+  propagate immediately.
+
+On retry exhaustion the step is rolled back: restore the last good
+checkpoint (``Resilience.note_checkpoint`` records every successful
+``save_state``), rebind the freshly restored state into the SAME compiled
+entry (the cache key didn't change, so zero extra recompiles), and replay
+the dispatch.  Every attempt/rollback is a kind-tagged telemetry event.
+
+Two hard edges, handled explicitly:
+
+* a fault that fires MID-EXECUTION (past argument validation) may already
+  have consumed donated input buffers — re-invoking with the same leaves
+  would die on "Array has been deleted".  The loop checks for deleted
+  donated leaves before retrying and escalates straight to rollback (the
+  restore rebinds fresh buffers) instead of burning retries it cannot win;
+* rollback is single-process only: ``load_state`` is collective, and one
+  rank restoring while its peers proceed to the next step's collectives
+  would deadlock the mesh.  Multi-process exhaustion propagates (the
+  elastic-restart coordination is a ROADMAP item).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional
+
+from .backend import backoff_delay
+from .inject import InjectedTransientError
+
+# substrings of transient PJRT/XLA status codes and transport failures; a
+# dispatch error carrying one of these is worth retrying.  RESOURCE_EXHAUSTED
+# (OOM) is deliberately absent — the same program will exhaust the same HBM.
+TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "aborted",
+    "cancelled",
+    "connection reset",
+    "socket closed",
+    "failed to connect",
+    "transient",
+)
+
+# errors that are the user's program talking, never the runtime flaking
+_USER_ERROR_TYPES = (TypeError, ValueError, KeyError, AttributeError, AssertionError)
+
+
+def classify_failure(exc: BaseException) -> str:
+    """``"transient"`` (retry) or ``"user"`` (propagate)."""
+    if isinstance(exc, InjectedTransientError):
+        return "transient"
+    if isinstance(exc, _USER_ERROR_TYPES):
+        return "user"
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if any(marker in text for marker in TRANSIENT_MARKERS):
+        return "transient"
+    return "user"
+
+
+class StepRetrier:
+    """Bounded-backoff retry around a captured-step dispatch, with one
+    checkpoint rollback when retries run dry."""
+
+    def __init__(
+        self,
+        hub,
+        max_retries: int = 2,
+        backoff_s: float = 0.5,
+        backoff_cap_s: float = 8.0,
+        jitter: float = 0.25,
+        rollback: bool = True,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+    ):
+        self.hub = hub
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_s = float(backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.jitter = float(jitter)
+        self.rollback = bool(rollback)
+        self.sleep = sleep
+        self._rng = rng if rng is not None else random.Random()
+        self.retries_total = 0
+        self.rollbacks_total = 0
+
+    def _delay(self, attempt: int) -> float:
+        return backoff_delay(
+            attempt, self.backoff_s, self.backoff_cap_s, self.jitter, self._rng
+        )
+
+    def _rollback_allowed(self) -> bool:
+        if not self.rollback:
+            return False
+        from ..state import PartialState
+
+        if PartialState._shared_state and PartialState().num_processes > 1:
+            # load_state is collective; a single rank restoring while its
+            # peers run the next step's collectives would hang the mesh
+            return False
+        return True
+
+    def run_dispatch(self, step, dispatch, entry, dev_leaves, host_leaves, host_mask):
+        """Drive ``dispatch(dev_leaves, host_leaves, entry)`` to completion.
+
+        ``dispatch`` returns the capture layer's ``(new_state, out, entry,
+        rebuilt)`` tuple.  ``step`` is the owning CapturedStep — needed to
+        re-collect state after a rollback restore.  The injector's dispatch
+        faults fire inside this loop so retries are exercised end-to-end.
+        """
+        hub = self.hub
+        call_index = hub.dispatch_calls - 1  # begin_dispatch already counted
+        attempt = 0
+        rolled_back = False
+        while True:
+            try:
+                if hub.injector is not None:
+                    hub.injector.maybe_dispatch_fault(call_index)
+                return dispatch(dev_leaves, host_leaves, entry)
+            except Exception as exc:  # noqa: BLE001 — classified right below
+                if classify_failure(exc) != "transient":
+                    raise
+                error = f"{type(exc).__name__}: {exc}"[:200]
+                # a mid-execution fault may have consumed the donated input
+                # buffers (validation-time faults never do) — re-invoking
+                # with deleted leaves cannot succeed, so skip the retry
+                # budget and go straight to the rollback decision
+                consumed = any(
+                    leaf.is_deleted()
+                    for leaf in dev_leaves
+                    if hasattr(leaf, "is_deleted")
+                )
+                if attempt < self.max_retries and not consumed:
+                    delay = self._delay(attempt)
+                    attempt += 1
+                    self.retries_total += 1
+                    hub.record_event(
+                        "dispatch_retry",
+                        step=call_index,
+                        attempt=attempt,
+                        max_retries=self.max_retries,
+                        delay_s=round(delay, 3),
+                        error=error,
+                    )
+                    self.sleep(delay)
+                    continue
+                checkpoint = hub.last_checkpoint
+                if not self._rollback_allowed() or rolled_back or checkpoint is None:
+                    hub.record_event(
+                        "dispatch_exhausted",
+                        step=call_index,
+                        attempts=attempt + 1,
+                        rolled_back=rolled_back,
+                        donated_consumed=consumed,
+                        error=error,
+                    )
+                    raise
+                # rollback: restore the last good checkpoint and replay this
+                # call against the SAME compiled entry — the cache key is a
+                # function of arg shapes and flags, none of which the restore
+                # moved, so the replay costs zero recompiles
+                self.rollbacks_total += 1
+                hub.record_event(
+                    "rollback",
+                    step=call_index,
+                    checkpoint=checkpoint,
+                    donated_consumed=consumed,
+                    error=error,
+                )
+                step.accelerator.load_state(checkpoint)
+                import jax
+
+                flat_state, _ = jax.tree_util.tree_flatten(step._collect_state())
+                dev_leaves = tuple(
+                    x for x, h in zip(flat_state, host_mask) if not h
+                )
+                host_leaves = tuple(x for x, h in zip(flat_state, host_mask) if h)
+                rolled_back = True
+                attempt = 0

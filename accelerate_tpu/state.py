@@ -132,6 +132,16 @@ class PartialState:
                     "state before any jax.devices()/jit call."
                 ) from e
         _maybe_init_jax_distributed(init_kwargs)
+        if not cpu and parse_flag_from_env("ACCELERATE_RESILIENCE_INIT"):
+            # hardened backend init (docs/resilience.md): a subprocess probe
+            # with retry/backoff and a platform fallback chain runs BEFORE
+            # the in-process jax.devices() below, so a hung PJRT client
+            # can't wedge this trainer — it either comes up, or the chain
+            # pins a platform that does.  Default-off: the flag-check is the
+            # entire cost.
+            from .resilience.backend import init_backend
+
+            self.init_report = init_backend()
         self.devices = jax.devices()
         self.local_devices = jax.local_devices()
         self.backend = self.devices[0].platform

@@ -73,13 +73,21 @@ class Telemetry:
         self.recompile_events: deque[RecompileEvent] = deque(maxlen=handler.max_events)
         self.program_records: deque[ProgramRecord] = deque(maxlen=handler.max_events)
         self.resource_samples: deque[ResourceSample] = deque(maxlen=handler.max_events)
+        # resilience subsystem events (init/retry/rollback/preemption),
+        # already kind-tagged dicts — see resilience/__init__.py
+        self.resilience_events: deque[dict] = deque(maxlen=handler.max_events)
         self.recompiles_total = 0
         self.steps_total = 0
         self._dataloader_wait_ms = 0.0
         # export queue: every record lands here once, drained by the
         # TelemetryTracker bridge / flush(); bounded so an undrained run
-        # cannot grow without limit
+        # cannot grow without limit.  Only the bridge consumes it, so
+        # enqueueing (and the per-record to_dict()) is skipped entirely
+        # until one attaches — sink-less runs like bench's primary loop pay
+        # zero per-step export work (ROADMAP item)
         self._export_queue: deque[dict] = deque(maxlen=4096)
+        self._export_sink = False
+        self._drains_total = 0
         # latest-constructed wins the module slot: a later telemetry-off
         # Accelerator must clear it, or its data loaders keep crediting
         # wait time to the previous run's (possibly defunct) instance
@@ -114,18 +122,33 @@ class Telemetry:
 
     def record_step(self, record: StepRecord) -> None:
         self.timeline.append(record)
-        self._export_queue.append(record.to_dict())
+        if self._export_sink:
+            self._export_queue.append(record.to_dict())
 
     def record_recompile(self, event: RecompileEvent) -> None:
         self.recompiles_total += 1
         self.recompile_events.append(event)
-        self._export_queue.append(event.to_dict())
+        if self._export_sink:
+            self._export_queue.append(event.to_dict())
 
     def record_program(self, key, label: str, compiled) -> ProgramRecord:
         record = ProgramRecord(key=key_id(key), label=label, stats=program_stats(compiled))
         self.program_records.append(record)
-        self._export_queue.append(record.to_dict())
+        if self._export_sink:
+            self._export_queue.append(record.to_dict())
         return record
+
+    def record_resilience(self, payload: dict) -> None:
+        """Resilience event (init report, dispatch retry, rollback,
+        preemption, drain) — kind-tagged into the same retained history and
+        export stream as the capture-path records."""
+        if not self.enabled:
+            return
+        record = dict(payload)
+        record["kind"] = "resilience"
+        self.resilience_events.append(record)
+        if self._export_sink:
+            self._export_queue.append(dict(record))
 
     def rekey_last_program(self, new_key: str) -> None:
         """Re-key the most recent program record (and its not-yet-drained
@@ -146,12 +169,28 @@ class Telemetry:
         """Per-device live-bytes snapshot, on demand or at capture time."""
         sample = sample_live(tag)
         self.resource_samples.append(sample)
-        self._export_queue.append(sample.to_dict())
+        if self._export_sink:
+            self._export_queue.append(sample.to_dict())
         return sample
 
     # -- consumers -----------------------------------------------------------
+    def attach_export_sink(self) -> None:
+        """Called by the TelemetryTracker bridge: start feeding the export
+        queue, and backfill it with the retained history recorded before the
+        bridge existed (records were not enqueued then — sink-less gating)."""
+        if self._export_sink:
+            return
+        self._export_sink = True
+        if self._drains_total == 0 and not self._export_queue:
+            for record in self.all_records():
+                if record.get("kind") in (
+                    "step", "recompile", "program", "resources", "resilience"
+                ):
+                    self._export_queue.append(record)
+
     def drain(self) -> list[dict]:
         """Pop every not-yet-exported record (tracker-bridge feed)."""
+        self._drains_total += 1
         out = list(self._export_queue)
         self._export_queue.clear()
         return out
@@ -177,6 +216,7 @@ class Telemetry:
         records += [e.to_dict() for e in self.recompile_events]
         records += [p.to_dict() for p in self.program_records]
         records += [s.to_dict() for s in self.resource_samples]
+        records += [dict(e) for e in self.resilience_events]
         records.append(self.summary())
         return records
 

@@ -58,6 +58,9 @@ class TelemetryTracker(GeneralTracker):
         super().__init__()
         self.telemetry = telemetry
         self.delegates = [t for t in delegates if not isinstance(t, TelemetryTracker)]
+        # the bridge is the only export-queue consumer; enqueueing starts
+        # (and the pre-bridge history backfills) the moment one attaches
+        telemetry.attach_export_sink()
 
     @property
     def name(self) -> str:

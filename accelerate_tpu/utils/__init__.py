@@ -30,6 +30,7 @@ from .dataclasses import (
     PrecisionType,
     ProfileKwargs,
     ProjectConfiguration,
+    ResilienceKwargs,
     RNGType,
     SaveFormat,
     SequenceParallelPlugin,

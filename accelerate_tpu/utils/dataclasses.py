@@ -191,6 +191,57 @@ class TelemetryKwargs(KwargsHandler):
 
 
 @dataclass
+class ResilienceKwargs(KwargsHandler):
+    """Resilience-subsystem knobs (``accelerator.resilience``,
+    docs/resilience.md).
+
+    No reference counterpart — preemption handling lives in PyTorch/XLA and
+    torchelastic externally; here it is library behavior.  When ``enabled``
+    is left ``None`` it resolves from ``$ACCELERATE_RESILIENCE`` (default
+    off); off means the capture hot path runs its pre-resilience code
+    byte-for-byte (one ``None``-check, matching the telemetry precedent).
+
+    ``preemption`` installs SIGTERM/SIGINT sticky-flag handlers read via
+    ``resilience.should_save``/``should_exit``; ``deadline_s`` additionally
+    trips those flags N seconds after construction (maintenance windows).
+    ``retry``/``max_retries``/``retry_backoff_s`` bound the transient-fault
+    retry around captured-step dispatch; ``rollback`` restores the last good
+    checkpoint on exhaustion and replays.  ``checkpoint_dir`` is the default
+    ``resilience.drain()`` target.  ``fault_plan`` wires the test-only
+    deterministic injector (``$ACCELERATE_FAULT_PLAN``).  Backend-init
+    hardening is its own entry point (``resilience.backend.init_backend`` +
+    ``$ACCELERATE_RESILIENCE_INIT`` at state construction) because it must
+    run before any jax device call.
+    """
+
+    enabled: Optional[bool] = None  # None → $ACCELERATE_RESILIENCE, default off
+    preemption: bool = True
+    deadline_s: Optional[float] = None  # $ACCELERATE_RESILIENCE_DEADLINE_S
+    retry: bool = True
+    max_retries: int = 2  # $ACCELERATE_RESILIENCE_MAX_RETRIES
+    retry_backoff_s: float = 0.5  # $ACCELERATE_RESILIENCE_RETRY_BACKOFF_S
+    rollback: bool = True
+    checkpoint_dir: Optional[str] = None  # $ACCELERATE_RESILIENCE_CHECKPOINT_DIR
+    fault_plan: Optional[str] = None  # $ACCELERATE_FAULT_PLAN (test-only)
+
+    def __post_init__(self):
+        env = os.environ
+        if self.enabled is None:
+            value = env.get("ACCELERATE_RESILIENCE")
+            self.enabled = bool(str_to_bool(value)) if value is not None else False
+        if self.deadline_s is None and "ACCELERATE_RESILIENCE_DEADLINE_S" in env:
+            self.deadline_s = float(env["ACCELERATE_RESILIENCE_DEADLINE_S"])
+        if "ACCELERATE_RESILIENCE_MAX_RETRIES" in env:
+            self.max_retries = int(env["ACCELERATE_RESILIENCE_MAX_RETRIES"])
+        if "ACCELERATE_RESILIENCE_RETRY_BACKOFF_S" in env:
+            self.retry_backoff_s = float(env["ACCELERATE_RESILIENCE_RETRY_BACKOFF_S"])
+        if self.checkpoint_dir is None:
+            self.checkpoint_dir = env.get("ACCELERATE_RESILIENCE_CHECKPOINT_DIR")
+        if self.fault_plan is None:
+            self.fault_plan = env.get("ACCELERATE_FAULT_PLAN")
+
+
+@dataclass
 class DistributedDataParallelKwargs(KwargsHandler):
     """Accepted for API parity with the reference (dataclasses.py:149).
 

@@ -10,16 +10,17 @@ bf16 at ~40% MFU over ~6N FLOPs/token; BASELINE.json publishes no number,
 so the denominator is this documented estimate).
 
 Robustness (round-1 postmortem: the whole round's perf story died on one
-flaky backend init): platform init runs with retries + backoff, each attempt
-hard-capped by a watchdog subprocess so a hung PJRT client cannot eat the
-round; on exhaustion the benchmark falls back to CPU and says so in the JSON
-rather than exiting non-zero.  All MFU/geometry/diagnostic fields land in the
-JSON itself, not stderr.
+flaky backend init): platform init goes through the library's resilience
+subsystem (accelerate_tpu/resilience/backend.py, docs/resilience.md) —
+retries with exponential backoff + jitter, each attempt hard-capped by a
+watchdog subprocess so a hung PJRT client cannot eat the round; on
+exhaustion the fallback chain lands on CPU and the JSON says so rather than
+exiting non-zero.  All MFU/geometry/diagnostic fields land in the JSON
+itself, not stderr.
 """
 
 import json
 import os
-import subprocess
 import sys
 import threading
 import time
@@ -122,55 +123,6 @@ def _arm_deadline() -> None:
     t = threading.Timer(TOTAL_TIMEOUT_S, _expire)
     t.daemon = True
     t.start()
-
-
-def _probe_backend_once(timeout_s: float) -> tuple[bool, str]:
-    """Try initializing the default JAX backend in a THROWAWAY subprocess.
-
-    A hung PJRT client can't be cancelled in-process (the C++ init holds the
-    GIL-adjacent runtime lock), so the probe must be a separate interpreter.
-    Returns (ok, detail).
-    """
-    # the container sitecustomize pins the TPU plugin regardless of the
-    # JAX_PLATFORMS env var; config.update after import is what actually
-    # selects the backend — without it the CPU-fallback probe still dials
-    # the (possibly wedged) TPU tunnel and hangs
-    code = (
-        "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
-        "p and jax.config.update('jax_platforms', p); "
-        "d = jax.devices(); print(d[0].platform, len(d))"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            env=os.environ.copy(),
-        )
-    except subprocess.TimeoutExpired:
-        return False, f"backend init exceeded {timeout_s:.0f}s (hung PJRT client)"
-    if proc.returncode != 0:
-        tail = (proc.stderr or "").strip().splitlines()
-        return False, tail[-1][:300] if tail else f"rc={proc.returncode}"
-    return True, proc.stdout.strip()
-
-
-def _init_backend() -> dict:
-    """Probe + retry; fall back to CPU when the accelerator never comes up."""
-    diag = {"init_attempts": 0, "init_detail": "", "platform_requested": os.environ.get("JAX_PLATFORMS", "(default)")}
-    for attempt in range(INIT_ATTEMPTS):
-        diag["init_attempts"] = attempt + 1
-        ok, detail = _probe_backend_once(INIT_TIMEOUT_S)
-        diag["init_detail"] = detail
-        if ok:
-            return diag
-        if attempt < INIT_ATTEMPTS - 1:
-            time.sleep(min(30.0, 5.0 * (attempt + 1)))
-    # fall back to CPU so the round still records a benchmark artifact
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    diag["fallback"] = "cpu"
-    return diag
 
 
 def _bert_mrpc_workload(on_accel: bool) -> dict:
@@ -679,7 +631,16 @@ def _sliding_window_workload(on_accel: bool) -> dict:
 
 def main() -> None:
     _arm_deadline()
-    diag = _init_backend()
+    # hardened backend init now lives in the library (docs/resilience.md):
+    # subprocess-isolated probe, retry with exponential backoff + jitter,
+    # requested → cpu fallback chain.  The InitReport serializes to the same
+    # diagnostic keys this JSON has carried since r02
+    # (init_attempts/init_detail/platform_requested/fallback), plus init_ts
+    # so tools/outage_summary.py --bench-json can join it against probe-log
+    # DOWN windows.
+    from accelerate_tpu.resilience.backend import init_backend
+
+    diag = init_backend(attempts=INIT_ATTEMPTS, timeout_s=INIT_TIMEOUT_S).to_bench_diag()
 
     import jax
 

@@ -84,3 +84,80 @@ def test_cli_exits_2_when_nothing_parseable(tmp_path):
         text=True,
     )
     assert proc.returncode == 2
+
+
+# ------------------------------------------------------- --bench-json join
+from outage_summary import down_windows, join_bench, load_bench_diag  # noqa: E402
+
+
+def test_down_windows_match_summarize_attribution(tmp_path):
+    windows = down_windows(parse_log(_write(tmp_path)))
+    assert [(w["start"], w["end"], w["seconds"]) for w in windows] == [
+        (1600, 2500, 900),
+        (2800, 3400, 600),
+    ]
+
+
+def _write_bench(tmp_path, payload, name="BENCH_test.json", wrap=False):
+    path = tmp_path / name
+    path.write_text(json.dumps({"parsed": payload} if wrap else payload))
+    return str(path)
+
+
+def test_bench_join_inside_down_window(tmp_path):
+    windows = down_windows(parse_log(_write(tmp_path)))
+    diag = load_bench_diag(
+        _write_bench(
+            tmp_path,
+            {"init_attempts": 5, "init_detail": "backend init exceeded 120s",
+             "fallback": "cpu", "init_ts": 2000},
+            wrap=True,  # the driver's {"parsed": {...}} wrapper form
+        )
+    )
+    joined = join_bench("b.json", diag, windows)
+    assert joined["init_failed"] is True
+    assert joined["in_down_window"] is True
+    assert joined["down_window"]["start"] == 1600
+
+
+def test_bench_join_outside_window_and_unknown_without_ts(tmp_path):
+    windows = down_windows(parse_log(_write(tmp_path)))
+    outside = join_bench(
+        "b.json",
+        load_bench_diag(
+            _write_bench(tmp_path, {"init_attempts": 1, "init_detail": "cpu 1",
+                                    "init_ts": 1100})
+        ),
+        windows,
+    )
+    assert outside["init_failed"] is False and outside["in_down_window"] is False
+    # r02-r05 artifacts predate init_ts: overlap must report unknown, not False
+    legacy = join_bench(
+        "r05.json",
+        load_bench_diag(
+            _write_bench(tmp_path, {"init_attempts": 5, "fallback": "cpu"},
+                         name="r05.json")
+        ),
+        windows,
+    )
+    assert legacy["init_failed"] is True and legacy["in_down_window"] is None
+
+
+def test_cli_bench_json_join(tmp_path):
+    log = _write(tmp_path)
+    bench = _write_bench(
+        tmp_path,
+        {"init_attempts": 3, "init_detail": "hung", "fallback": "cpu",
+         "init_ts": 3000},
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "outage_summary.py"),
+         "--json", log, "--bench-json", bench],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    (joined,) = payload["bench_join"]
+    assert joined["in_down_window"] is True
+    assert joined["down_window"] == {"start": 2800, "end": 3400, "seconds": 600}

@@ -407,3 +407,40 @@ def test_write_jsonl_roundtrips_through_report_tool(tmp_path):
     report = render(records)
     assert "step-time breakdown" in report
     assert "steady state" in report  # no recompiles in this run
+
+
+def test_export_queue_skipped_without_sink():
+    """ROADMAP item: with no tracker bridge attached, per-step records skip
+    the export queue (and its to_dict()) entirely — sink-less runs like
+    bench's primary loop pay zero per-step export work.  The retained
+    history (timeline, JSONL dump) is unaffected."""
+    acc, _, step = _make_step()
+    for _ in range(3):
+        step(_batch(acc))
+    assert len(acc.telemetry.timeline) == 3  # retained history intact
+    assert len(acc.telemetry.program_records) == 1
+    assert len(acc.telemetry._export_queue) == 0  # nothing enqueued
+    # the JSONL dump feed reads the retained history, not the queue
+    kinds = {r["kind"] for r in acc.telemetry.all_records()}
+    assert {"step", "program"} <= kinds
+
+
+def test_bridge_attach_backfills_pre_attach_records(tmp_path):
+    """Records produced BEFORE init_trackers (no sink yet → not enqueued)
+    still reach the delegates: the bridge backfills from retained history
+    when it attaches."""
+    acc, _, step = _make_step(
+        acc_kwargs={"log_with": "jsonl", "project_dir": str(tmp_path)}
+    )
+    step(_batch(acc, seq=32))  # pre-attach: queue stays empty
+    assert len(acc.telemetry._export_queue) == 0
+    acc.init_trackers("run", config=None, init_kwargs={})
+    assert len(acc.telemetry._export_queue) > 0  # backfilled on attach
+    step(_batch(acc, seq=48))  # post-attach: normal enqueue (recompile too)
+    acc.log({"loss": 1.0}, step=0)
+    acc.end_training()
+    path = os.path.join(str(tmp_path), "run", "metrics.jsonl")
+    keys = {k for line in open(path) for k in json.loads(line)}
+    # both the pre-attach step and the post-attach recompile were exported
+    assert "telemetry/step/total_ms" in keys
+    assert "telemetry/recompile/cause" in keys

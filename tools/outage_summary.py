@@ -3,6 +3,7 @@
 
     python tools/outage_summary.py TPU_OUTAGE_r*.log
     python tools/outage_summary.py --json TPU_OUTAGE_r05.log
+    python tools/outage_summary.py TPU_OUTAGE_r05.log --bench-json BENCH_r05.json
 
 The watcher writes one line per probe: ``<epoch-seconds> <STATE> <detail>``
 where STATE is ``TPU_UP`` (probe saw a healthy accelerator) or ``DOWN``
@@ -15,6 +16,14 @@ Interval attribution: the span between consecutive probes belongs to the
 finest resolution the data supports).  The span after the final probe is
 unknown and excluded.  Exit 0 on success, 2 when no parseable probe lines
 were found in any input.
+
+``--bench-json`` joins the logs' DOWN windows against a benchmark
+artifact's init diagnostics (init_attempts/init_detail/fallback — emitted
+by bench.py via resilience.backend.InitReport): was the recorded init
+failure inside a DOWN window the watcher independently observed?  Accepts
+both raw bench output and the driver-wrapped ``{"parsed": {...}}`` form;
+the time join needs the ``init_ts`` key (emitted since the library init
+path landed) — older artifacts without it report the overlap as unknown.
 """
 
 from __future__ import annotations
@@ -79,6 +88,79 @@ def summarize(probes: list[tuple[int, bool]]) -> dict:
     }
 
 
+def down_windows(probes: list[tuple[int, bool]]) -> list[dict]:
+    """Every DOWN window as {start, end, seconds}: from its first DOWN probe
+    to the next UP probe (or the last probe for a trailing run) — the same
+    attribution summarize() uses for longest_down."""
+    windows: list[dict] = []
+    run_start: int | None = None
+    last = probes[-1] if probes else None
+    for (t0, state0), (t1, state1) in zip(probes, probes[1:]):
+        if not state0 and run_start is None:
+            run_start = t0
+        if run_start is not None and (state1 or (t1, state1) == last):
+            windows.append({"start": run_start, "end": t1, "seconds": t1 - run_start})
+            run_start = None
+    return windows
+
+
+def load_bench_diag(path: str) -> dict:
+    """Init diagnostics out of a bench JSON artifact (raw bench.py output or
+    the driver's {"parsed": {...}} wrapper)."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    parsed = data
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        parsed = data["parsed"]
+    if not isinstance(parsed, dict):
+        return {}
+    keys = (
+        "init_attempts", "init_detail", "platform_requested", "fallback",
+        "init_ts", "platform",
+    )
+    return {k: parsed[k] for k in keys if parsed.get(k) is not None}
+
+
+def join_bench(path: str, diag: dict, windows: list[dict]) -> dict:
+    """Did this bench's init failure land inside an observed DOWN window?"""
+    out = {"bench": path, **diag}
+    out["init_failed"] = bool(diag.get("fallback")) or (
+        (diag.get("init_attempts") or 0) > 1
+    )
+    ts = diag.get("init_ts")
+    if ts is None:
+        out["in_down_window"] = None  # pre-init_ts artifact: overlap unknown
+        return out
+    for window in windows:
+        if window["start"] <= ts <= window["end"]:
+            out["in_down_window"] = True
+            out["down_window"] = window
+            return out
+    out["in_down_window"] = False
+    return out
+
+
+def render_bench_join(joined: dict) -> str:
+    label = "init failed" if joined["init_failed"] else "init ok"
+    detail = (
+        f"{joined['bench']}: {label} "
+        f"(attempts={joined.get('init_attempts', '?')}"
+        + (f", fallback={joined['fallback']}" if joined.get("fallback") else "")
+        + ")"
+    )
+    if joined["in_down_window"] is None:
+        verdict = "overlap unknown (no init_ts in bench JSON)"
+    elif joined["in_down_window"]:
+        w = joined["down_window"]
+        verdict = (
+            f"inside DOWN window {_utc(w['start'])} → {_utc(w['end'])} "
+            f"({_hms(w['seconds'])})"
+        )
+    else:
+        verdict = "NOT inside any observed DOWN window"
+    return f"{detail}\n  {verdict}"
+
+
 def _hms(seconds) -> str:
     if not seconds:
         return "0m"
@@ -114,9 +196,17 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="outage_summary", description=__doc__)
     parser.add_argument("logs", nargs="+", help="TPU_OUTAGE_r*.log files")
     parser.add_argument("--json", action="store_true", help="machine output")
+    parser.add_argument(
+        "--bench-json",
+        nargs="+",
+        default=[],
+        metavar="BENCH",
+        help="BENCH_r*.json artifacts to join against the logs' DOWN windows",
+    )
     args = parser.parse_args(argv)
 
     summaries = {}
+    all_windows: list[dict] = []
     for path in args.logs:
         try:
             probes = parse_log(path)
@@ -127,14 +217,30 @@ def main(argv=None) -> int:
             print(f"outage_summary: no probe lines in {path}", file=sys.stderr)
             continue
         summaries[path] = summarize(probes)
+        all_windows.extend(down_windows(probes))
 
     if not summaries:
         return 2
+
+    bench_joins: list[dict] = []
+    for path in args.bench_json:
+        try:
+            diag = load_bench_diag(path)
+        except (OSError, ValueError) as e:
+            print(f"outage_summary: cannot read bench {path}: {e}", file=sys.stderr)
+            continue
+        bench_joins.append(join_bench(path, diag, all_windows))
+
     if args.json:
-        print(json.dumps(summaries, indent=2))
+        payload: dict = dict(summaries)
+        if bench_joins:
+            payload["bench_join"] = bench_joins
+        print(json.dumps(payload, indent=2))
     else:
         for path, s in summaries.items():
             print(render(path, s))
+        for joined in bench_joins:
+            print(render_bench_join(joined))
     return 0
 
 

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""resilience_smoke — `make resilience-smoke`: prove the preemption path
+end-to-end on CPU in seconds (docs/resilience.md).
+
+Tiny model, resilience on with an injected SIGTERM scheduled right before
+step 2's dispatch.  The training loop finishes that step, reads the sticky
+``should_exit`` flag, drains a checkpoint through the async
+save_state/wait_for_checkpoint machinery and stops — then a fresh
+accelerator resumes from that checkpoint and must reproduce the
+uninterrupted run's remaining losses BITWISE.  Exit 0 = complete checkpoint
+(meta sentinel present), bitwise-equal resume, and preemption/drain events
+in the resilience stream.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEPS = 5
+SIGTERM_AT = 2
+
+
+def main() -> int:
+    import jax.numpy as jnp
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator, ResilienceKwargs
+    from accelerate_tpu.checkpointing import is_complete_checkpoint
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+    from accelerate_tpu.data_loader import batch_to_global_array
+
+    errors: list[str] = []
+    ckpt = os.path.join(tempfile.mkdtemp(prefix="atpu_resilience_"), "preempted")
+
+    def build(res_kwargs=None):
+        Accelerator._reset_state()
+        nn.manual_seed(0)
+        acc = Accelerator(kwargs_handlers=[res_kwargs] if res_kwargs else None)
+        model = GPTLMHeadModel(
+            GPTConfig(vocab_size=256, n_positions=64, n_embd=32, n_layer=1, n_head=2)
+        )
+        opt = optim.AdamW(model.parameters(), lr=1e-3)
+        model, opt = acc.prepare(model, opt)
+
+        def step_fn(ids):
+            opt.zero_grad()
+            out = model(ids, labels=ids)
+            acc.backward(out["loss"])
+            opt.step()
+            return out["loss"]
+
+        rng = np.random.default_rng(0)
+        batches = [
+            batch_to_global_array(
+                jnp.asarray(rng.integers(0, 256, (8, 32), dtype=np.int32)),
+                mesh=acc.mesh,
+            )
+            for _ in range(STEPS)
+        ]
+        return acc, acc.compile_step(step_fn), batches
+
+    # uninterrupted reference
+    _, step, batches = build()
+    reference = [float(step(b)) for b in batches]
+
+    # preempted run: injected SIGTERM right before step 2's dispatch
+    acc, step, batches = build(
+        ResilienceKwargs(
+            enabled=True, fault_plan=f"sigterm:step={SIGTERM_AT}", retry=False
+        )
+    )
+    seen = []
+    for batch in batches:
+        seen.append(float(step(batch)))
+        if acc.resilience.should_exit:
+            acc.resilience.drain(acc, ckpt)
+            break
+    acc.resilience.close()
+    events = [e["event"] for e in acc.resilience.events]
+    if len(seen) != SIGTERM_AT + 1:
+        errors.append(f"expected to stop after step {SIGTERM_AT}, ran {len(seen)}")
+    if "preemption" not in events or "drain" not in events:
+        errors.append(f"missing preemption/drain events: {events}")
+    if not is_complete_checkpoint(ckpt):
+        errors.append(f"checkpoint at {ckpt} is not complete")
+
+    # resume and finish the run
+    acc2, step2, batches = build()
+    acc2.load_state(ckpt)
+    resumed = [float(step2(b)) for b in batches[len(seen):]]
+    if seen + resumed != reference:
+        errors.append(
+            f"resume not bitwise-equal: interrupted {seen} + resumed {resumed} "
+            f"!= reference {reference}"
+        )
+
+    for error in errors:
+        print(f"resilience-smoke: FAIL: {error}", file=sys.stderr)
+    if errors:
+        return 1
+    print(
+        f"resilience-smoke: ok — SIGTERM at step {SIGTERM_AT}, complete "
+        f"checkpoint, resume bitwise-equal over {len(resumed)} remaining steps"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
