@@ -20,10 +20,14 @@ lint-cold:
 # dp>1 sharded-update proof on a DIFFERENT mesh extent than the default
 # suite (which forces 8 virtual devices): ZeRO-1 numerics/memory/stability
 # at dp=4, so a divisibility or reshard bug that happens to vanish at 8
-# still fails CI (docs/zero1.md)
+# still fails CI (docs/zero1.md).  The compression suite rides along: the
+# ISSUE acceptance row (int8/fp8/powersgd vs none at dp=4 — loss parity,
+# 1/dp residual sharding, zero recompiles, ≥1.8x byte drop) runs here
+# (docs/compression.md)
 multichip:
 	XLA_FLAGS=--xla_force_host_platform_device_count=4 python -m pytest \
-	  tests/test_zero1.py tests/test_zero_sharding.py -q
+	  tests/test_zero1.py tests/test_zero_sharding.py \
+	  tests/test_compression.py -q
 
 # telemetry pipeline proof (docs/telemetry.md): tiny model, 3 steps + a
 # forced shape change with telemetry on, JSONL export validated through
@@ -62,7 +66,7 @@ test_models:
 
 test_parallel:
 	python -m pytest tests/test_sharding_plan.py tests/test_zero_sharding.py \
-	  tests/test_zero1.py \
+	  tests/test_zero1.py tests/test_compression.py \
 	  tests/test_pipeline.py tests/test_1f1b.py tests/test_ring_attention.py \
 	  tests/test_flash_attention.py tests/test_sliding_window.py -q
 

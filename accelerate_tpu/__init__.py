@@ -49,6 +49,7 @@ from .utils.modeling import (
 )
 from .utils.random import set_seed, synchronize_rng_states
 from .utils.dataclasses import (
+    CompressionKwargs,
     DataLoaderConfiguration,
     DataParallelPlugin,
     DistributedType,

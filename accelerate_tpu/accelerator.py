@@ -100,10 +100,12 @@ class Accelerator:
         self._powersgd_state = None  # per-model {q, err} arrays, capture-threaded
         self.telemetry_handler = None
         self.resilience_handler = None
+        self.compression_handler = None
         from .utils.dataclasses import FP8RecipeKwargs
 
         from .utils.dataclasses import (
             AutocastKwargs,
+            CompressionKwargs,
             DistributedDataParallelKwargs,
             ResilienceKwargs,
             TelemetryKwargs,
@@ -112,6 +114,8 @@ class Accelerator:
         for handler in kwargs_handlers or []:
             if isinstance(handler, TelemetryKwargs):
                 self.telemetry_handler = handler
+            elif isinstance(handler, CompressionKwargs):
+                self.compression_handler = handler
             elif isinstance(handler, ResilienceKwargs):
                 self.resilience_handler = handler
             elif isinstance(handler, AutocastKwargs):
@@ -155,6 +159,41 @@ class Accelerator:
                             "use 'fp16' or 'bf16'"
                         )
                     self._comm_wrapper = wrapper
+
+        # dp-axis collective compression (docs/compression.md): ONE policy
+        # surface for the quantized ZeRO-1 collectives (int8/fp8) and the
+        # PowerSGD comm hook — CompressionKwargs/$ACCELERATE_COMPRESSION
+        # selects it, and the legacy ddp comm_hook="powersgd" spelling
+        # resolves to the same PowerSGDCompression object
+        from .parallel.compress import powersgd_from_ddp, resolve_policy
+
+        self._compression = resolve_policy(
+            self.compression_handler, ddp_handler=self.ddp_handler
+        )
+        # the sync-boundary hook policy: the compression policy itself when
+        # it IS a hook (powersgd), else the legacy ddp spelling (which also
+        # lets powersgd compose with an int8/fp8 collective policy)
+        self._hook_policy = (
+            self._compression
+            if self._compression.hook_name is not None
+            else powersgd_from_ddp(self.ddp_handler)
+        )
+        if self._hook_policy is not None:
+            if self._comm_hook in ("fp16", "bf16"):
+                raise ValueError(
+                    f"comm_hook={self._comm_hook!r} and compression policy "
+                    f"{self._hook_policy.name!r} both claim the gradient sync "
+                    "boundary; pick one (the fp16/bf16 cast is the PowerSGD "
+                    "comm_wrapper option, not a separate hook)"
+                )
+            self._comm_hook = self._hook_policy.hook_name
+            if self._hook_policy.wrapper_dtype is None and self._comm_wrapper:
+                # powersgd selected via CompressionKwargs alongside a legacy
+                # ddp comm_wrapper: honor the wrapper rather than silently
+                # dropping the requested factor rounding
+                from .parallel.compress import _wrapper_dtype
+
+                self._hook_policy.wrapper_dtype = _wrapper_dtype(self._comm_wrapper)
 
         if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "false").lower() in ("1", "true"):
             fsdp_plugin = FullyShardedDataParallelPlugin()
@@ -213,6 +252,9 @@ class Accelerator:
         self.flag_tensor = None
         self._capture_cache: dict = {}
         self._capture_ctx: Optional[dict] = None
+        # (param, sharding) pairs for the ZeRO-2 accumulated-grad layout;
+        # empty (one falsy check in backward) unless prepare() armed it
+        self._zero2_grads: list = []
 
         # trackers
         from .tracking import filter_trackers
@@ -449,6 +491,10 @@ class Accelerator:
                 offload_to_host=offload_opt,
                 offload_params=offload_params,
                 zero1_mesh=zero1_mesh,
+                # quantized dp collectives + ZeRO-2 grad-accumulation layout
+                # (docs/compression.md); both no-ops unless armed
+                compression=self._compression,
+                zero2=self.state.zero2_enabled,
             )
         if offload_params:
             from .hooks import ParamOffloadHook, add_hook_to_module
@@ -458,7 +504,31 @@ class Accelerator:
                     add_hook_to_module(model, ParamOffloadHook(), append=True)
                     model._atpu_param_offload = True
         self._ensure_powersgd_state()
+        self._refresh_zero2_grads()
+        self._record_collectives()
         return result[0] if len(result) == 1 else tuple(result)
+
+    def _refresh_zero2_grads(self) -> None:
+        """Collect the (param, accumulation-sharding) pairs ZeRO-2 armed at
+        relayout time, so ``backward`` pays one cheap loop (empty when off)."""
+        self._zero2_grads = [
+            (p, p._grad_sharding)
+            for opt in self._optimizers
+            for p in opt.optimizer.param_list
+            if getattr(p, "_grad_sharding", None) is not None
+        ]
+
+    def _record_collectives(self) -> None:
+        """dp-axis collective-bytes attribution (telemetry
+        ``kind="collectives"``): the analytic per-step wire bytes of the
+        ZeRO-1 reduce-scatter/all-gather pair under the active compression
+        policy — the denominator bench.py's A/B compares across policies."""
+        if not self.telemetry.enabled:
+            return
+        for opt in self._optimizers:
+            summary = opt.optimizer.compression_summary()
+            if summary is not None:
+                self.telemetry.record_collectives(summary)
 
     def _prepare_one(self, obj):
         from .utils.torch_bridge import (
@@ -610,6 +680,18 @@ class Accelerator:
         if self.scaler is not None:
             loss = loss * self.scaler.scale
         loss.backward(**kwargs)
+        if self._zero2_grads:
+            # ZeRO-2 (docs/compression.md): keep the accumulated grads
+            # reduce-scattered between micro-steps so the accumulation
+            # buffer is ~1/dp per replica.  Layout-only — the value is the
+            # same global array, and compressing a running fp32 sum every
+            # micro-step would round it num_steps times (same reason the
+            # comm hook below runs only at the sync boundary).
+            from .parallel.compress import shard_accumulation
+
+            for p, s in self._zero2_grads:
+                if p.grad is not None:
+                    p.grad = shard_accumulation(p.grad, s)
         if self.gradient_state.sync_gradients:
             # only at the sync boundary: re-quantizing the running fp32
             # accumulation every micro-step would pass the sum through
@@ -650,32 +732,21 @@ class Accelerator:
                 if p.grad is not None and p.grad.dtype != dtype:
                     p.grad = p.grad.astype(dtype)
 
-    # -- PowerSGD machinery ---------------------------------------------------
-    def _powersgd_options(self) -> dict:
-        opts = dict(getattr(self.ddp_handler, "comm_state_option", None) or {})
-        return {
-            "rank": int(opts.get("matrix_approximation_rank", 1)),
-            "use_error_feedback": bool(opts.get("use_error_feedback", True)),
-            "warm_start": bool(opts.get("warm_start", True)),
-        }
-
+    # -- PowerSGD machinery (delegates to the CompressionPolicy) -------------
     def _ensure_powersgd_state(self) -> None:
-        """Build (Q, error) buffers for every prepared model that lacks them.
+        """Build (Q, error) hook buffers for every prepared model that lacks
+        them, through the active :class:`PowerSGDCompression` policy — hook
+        selection, eligibility and error-feedback state are one code path
+        with the quantized-collective policies (parallel/compress.py).
 
         Runs eagerly at ``prepare()`` so the captured-step state pytree is
         structurally complete before the first trace (a mid-trace
         structure change would force a second compile)."""
-        if self._comm_hook not in ("powersgd", "batched_powersgd"):
+        policy = self._hook_policy
+        if policy is None:
             return
         from .nn import random as nn_random
-        from .utils import powersgd as psgd
 
-        opts = self._powersgd_options()
-        init = (
-            psgd.init_batched_powersgd_state
-            if self._comm_hook == "batched_powersgd"
-            else psgd.init_powersgd_state
-        )
         if self._powersgd_state is None:
             self._powersgd_state = []
         if self.scaler is not None and not getattr(self, "_powersgd_fp16_warned", False):
@@ -691,12 +762,12 @@ class Accelerator:
             model = self._models[len(self._powersgd_state)]
             named = dict(model.named_parameters())
             shapes = {n: tuple(p.shape) for n, p in named.items()}
-            state = init(shapes, opts["rank"], nn_random.next_key())
+            state = policy.init_hook_state(shapes, nn_random.next_key())
             # shard each error buffer like its parameter: it is grad-shaped
             # and grad-sized, and an unsharded fp32 copy would undo ZeRO's
             # memory savings (per-tensor mode; the batched buffer has no
             # per-param layout to inherit)
-            if self._comm_hook == "powersgd":
+            if not policy.batched:
                 for n, err in state["err"].items():
                     s = getattr(named[n].data, "sharding", None)
                     if isinstance(s, jax.sharding.NamedSharding):
@@ -707,26 +778,16 @@ class Accelerator:
 
     def _apply_powersgd_hook(self) -> None:
         from .nn import random as nn_random
-        from .utils import powersgd as psgd
 
+        policy = self._hook_policy
         self._ensure_powersgd_state()
-        opts = self._powersgd_options()
-        wrapper_dtype = None
-        if self._comm_wrapper is not None:
-            wrapper_dtype = jnp.float16 if self._comm_wrapper == "fp16" else jnp.bfloat16
-        apply = (
-            psgd.apply_batched_powersgd
-            if self._comm_hook == "batched_powersgd"
-            else psgd.apply_powersgd
-        )
-        batched = self._comm_hook == "batched_powersgd"
         for i, model in enumerate(self._models):
             named = dict(model.named_parameters())
-            if batched:
+            if policy.batched:
                 # the batched error buffer is a FLAT layout over the whole
                 # param set — the name set must be identical every call, so
                 # zero-fill params without grads and only write back to the
-                # ones that had one (utils/powersgd.py contract)
+                # ones that had one (parallel/compress.py contract)
                 had_grad = {n for n, p in named.items() if p.grad is not None}
                 grads = {
                     n: (p.grad if p.grad is not None else jnp.zeros_like(p.data))
@@ -735,13 +796,10 @@ class Accelerator:
             else:
                 had_grad = None
                 grads = {n: p.grad for n, p in named.items() if p.grad is not None}
-            new_grads, new_state = apply(
+            new_grads, new_state = policy.apply_hook(
                 grads,
                 self._powersgd_state[i],
-                use_error_feedback=opts["use_error_feedback"],
-                warm_start=opts["warm_start"],
-                rng_key=None if opts["warm_start"] else nn_random.next_key(),
-                wrapper_dtype=wrapper_dtype,
+                rng_key=None if policy.warm_start else nn_random.next_key(),
             )
             for n, g in new_grads.items():
                 if had_grad is None or n in had_grad:
@@ -1228,6 +1286,10 @@ class Accelerator:
         self._dataloaders.clear()
         self._custom_objects.clear()
         self._capture_cache.clear()
+        # the ZeRO-2 pairs hold (param, sharding) references — leaving them
+        # would keep every released param's device buffers reachable AND
+        # re-layout stale grads on the next backward
+        self._zero2_grads.clear()
         self.step = 0
         import gc
 

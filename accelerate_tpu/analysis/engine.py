@@ -30,8 +30,8 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 
 # Bumping this invalidates every on-disk cache entry (cache.py keys on it):
 # bump whenever a rule or the graph machinery changes what it reports for
-# unchanged source.
-ANALYSIS_VERSION = "2"
+# unchanged source.  v3: dtype-widen gained the quantized-payload check.
+ANALYSIS_VERSION = "3"
 
 # Names that mark a branch/function as profiling/benchmark plumbing, where a
 # deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the

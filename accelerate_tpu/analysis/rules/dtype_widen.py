@@ -1,4 +1,5 @@
-"""dtype-widen: accidental float64 on TPU paths.
+"""dtype-widen: accidental float64 on TPU paths, and bare widening of
+quantized wire payloads.
 
 TPUs have no f64 ALU: with x64 enabled, every float64 op is emulated at a
 fraction of peak FLOPs and doubles HBM traffic; with x64 off (the JAX
@@ -6,6 +7,16 @@ default), a float64 dtype request silently truncates to f32 — either way the
 author didn't get what they wrote.  Flagged: float64/double dtypes handed to
 jnp constructors, ``.astype(jnp.float64)``, ``jnp.float64(...)`` casts, and
 library code flipping ``jax_enable_x64`` globally.
+
+The compression layer (``parallel/compress.py``) adds a second widening
+hazard: a value returned by ``compress.quantize`` is a *wire payload* whose
+magnitudes only mean anything together with its per-block scales — a stray
+``payload.astype(float32)`` silently drops the scales and hands downstream
+consumers garbage-scaled gradients.  Casts INSIDE the compression layer are
+the sanctioned quantize/dequantize boundary, so the check is suppressed for
+that module by policy (``_POLICY_MODULES`` — a rule-level scope, not inline
+comments); everywhere else, widening a tracked payload local with
+``.astype`` fires, and ``compress.dequantize(payload, scales)`` is the fix.
 """
 
 from __future__ import annotations
@@ -18,6 +29,11 @@ _WIDE_ATTRS = {"jax.numpy.float64", "jax.numpy.double", "numpy.float64", "numpy.
 _WIDE_STRS = {"float64", "double", "f8", "<f8", ">f8"}
 # jnp constructors whose dtype can also arrive positionally
 _DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "asarray": 1, "array": 1, "full": 2}
+
+# modules where quantize/dequantize casts are the sanctioned policy boundary:
+# the payload-widening check below never fires inside them (policy-scoped
+# suppression — the layer itself IS the dequantize implementation)
+_POLICY_MODULES = ("parallel/compress.py",)
 
 
 class DtypeWiden(Rule):
@@ -35,6 +51,86 @@ class DtypeWiden(Rule):
             return True  # dtype=float means float64 under x64
         return False
 
+    def _is_policy_module(self, module) -> bool:
+        rel = module.rel_path.replace("\\", "/")
+        return any(rel.endswith(p) for p in _POLICY_MODULES)
+
+    @staticmethod
+    def _scope_walk(root: ast.AST, skip_functions: bool):
+        """Descendants of ``root``; with ``skip_functions`` the bodies of
+        nested function defs are excluded (module scope must not see
+        function locals — a same-named local elsewhere is NOT the payload)."""
+        stack = list(ast.iter_child_nodes(root))
+        while stack:
+            node = stack.pop()
+            if skip_functions and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_payloads(self, module) -> list[Finding]:
+        """Flag ``compress.quantize`` payload locals widened with a bare
+        ``.astype`` — per SCOPE, so an unrelated same-named local in another
+        function never fires.  A function scope includes its closures (an
+        outer payload cast inside a nested def is still the payload); the
+        resulting double visit of nested nodes is de-duplicated."""
+        if self._is_policy_module(module):
+            return []
+        findings: list[Finding] = []
+        seen: set[int] = set()
+        scopes: list[tuple[ast.AST, bool]] = [(module.tree, True)] + [
+            (node, False)
+            for node in ast.walk(module.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope, skip_functions in scopes:
+            nodes = list(self._scope_walk(scope, skip_functions))
+            payloads: set[str] = set()
+            for node in nodes:
+                if not (
+                    isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)
+                ):
+                    continue
+                resolved = module.resolve(node.value.func) or ""
+                if not resolved.endswith("compress.quantize"):
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        payloads.add(target.id)
+                    elif (
+                        isinstance(target, (ast.Tuple, ast.List))
+                        and target.elts
+                        and isinstance(target.elts[0], ast.Name)
+                    ):
+                        payloads.add(target.elts[0].id)
+            if not payloads:
+                continue
+            for node in nodes:
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and node.args
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in payloads
+                    and id(node) not in seen
+                ):
+                    seen.add(id(node))
+                    findings.append(
+                        Finding(
+                            self.id,
+                            module.rel_path,
+                            node.lineno,
+                            node.col_offset,
+                            "quantized wire payload cast with .astype() outside "
+                            "the compression layer — the per-block scales are "
+                            "discarded; use compress.dequantize(payload, scales)",
+                        )
+                    )
+        return findings
+
     def check(self, module, ctx):
         findings = []
 
@@ -43,6 +139,7 @@ class DtypeWiden(Rule):
                 Finding(self.id, module.rel_path, node.lineno, node.col_offset, msg)
             )
 
+        findings.extend(self._check_payloads(module))
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
                 continue

@@ -144,6 +144,19 @@ def _zeros_like_on_device(x):
     return jnp.zeros_like(x)
 
 
+def _grad_placeholder(p):
+    """Zero grad for a param that has none this call.  When ZeRO-2 armed an
+    accumulation layout on the param (``optim.relayout`` sets
+    ``_grad_sharding``), the placeholder is built dp-sharded so the carried
+    grad leaf is ~1/dp resident from the first micro-step AND the grad
+    layout is a fixed point across captured variants (the layout pin would
+    otherwise re-replicate what the body reduce-scattered)."""
+    s = getattr(p, "_grad_sharding", None)
+    if s is not None:
+        return jax.device_put(jnp.zeros(tuple(p.shape), p.data.dtype), s)
+    return _zeros_like_on_device(p.data)
+
+
 class CapturedStep:
     """Callable produced by ``accelerator.compile_step``."""
 
@@ -197,7 +210,7 @@ class CapturedStep:
             "buffers": [m.buffer_pytree() for m in models],
             "grads": [
                 {
-                    name: (p.grad if p.grad is not None else _zeros_like_on_device(p.data))
+                    name: (p.grad if p.grad is not None else _grad_placeholder(p))
                     for name, p in m.named_parameters()
                 }
                 for m in models
@@ -233,7 +246,7 @@ class CapturedStep:
             "buffers": [m.buffer_pytree() for m in acc._models],
             "grads": [
                 {
-                    name: (p.grad if p.grad is not None else _zeros_like_on_device(p.data))
+                    name: (p.grad if p.grad is not None else _grad_placeholder(p))
                     for name, p in m.named_parameters()
                 }
                 for m in acc._models
@@ -381,6 +394,11 @@ class CapturedStep:
                 assembly_ms -= trace_ms + compile_ms  # build ran pre-dispatch
             elif retry_rebuild:
                 dispatch_ms -= trace_ms + compile_ms  # rebuild ran mid-dispatch
+            # resilience backoff sleeps happened inside the dispatch window —
+            # split them out so retries don't inflate dispatch timing in A/B
+            # comparisons (docs/resilience.md, StepRecord.retry_wait_ms)
+            retry_wait_ms = retrier.last_wait_ms if retrier is not None else 0.0
+            dispatch_ms -= retry_wait_ms
             kid = self._key_ids.get(key)
             if kid is None:
                 kid = self._key_ids[key] = key_id(key)
@@ -395,6 +413,7 @@ class CapturedStep:
                     compile_ms=compile_ms,
                     dispatch_ms=max(0.0, dispatch_ms),
                     dataloader_wait_ms=dl_wait_ms,
+                    retry_wait_ms=retry_wait_ms,
                 )
             )
         return out

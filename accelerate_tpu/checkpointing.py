@@ -483,9 +483,19 @@ def load_accelerator_state(
             inner = opt.optimizer if hasattr(opt, "optimizer") else opt
             with open(os.path.join(input_dir, f"{oname}.meta.bin"), "rb") as f:
                 meta = pickle.load(f)
-            arrays = load_sharded_resharded(
-                inner.sharded_state_targets(), input_dir, name=oname
-            )
+            targets = inner.sharded_state_targets()
+            with open(sharded_index_path(input_dir, oname)) as f:
+                stored = json.load(f).get("tensors", {})
+            # EF residual targets (docs/compression.md) are OPTIONAL: a
+            # checkpoint saved before the compression layer, or under a
+            # different policy, doesn't carry them — the residual then
+            # restarts at zero instead of failing the whole restore
+            targets = {
+                k: v
+                for k, v in targets.items()
+                if k in stored or not k.startswith("comp_rs_")
+            }
+            arrays = load_sharded_resharded(targets, input_dir, name=oname)
             inner.load_sharded_state_arrays(arrays, meta)
             continue
         name = f"{OPTIMIZER_NAME}.bin" if i == 0 else f"{OPTIMIZER_NAME}_{i}.bin"

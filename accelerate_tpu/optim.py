@@ -95,18 +95,68 @@ class Optimizer:
         if grad_scale is not None:
             grads = [g * grad_scale for g in grads]
         grads = [g.astype(jnp.float32) for g in grads]
+        comp = getattr(self, "_compression", None)
+        if comp is not None:
+            # quantized reduce-scatter (parallel/compress.py): the grad's
+            # trip to the dp-sharded update crosses the wire in int8/fp8
+            # with a shard-local error-feedback residual
+            grads = self._compress_reduce_scatter(grads)
         updates, self.opt_state = self.tx.update(grads, self.opt_state, params)
         new_params = optax.apply_updates(params, updates)
         for i, (p, new) in enumerate(zip(self.param_list, new_params)):
             if self.master_params[i] is not None:
                 self.master_params[i] = new
-                # under ZeRO-1 `new` is the dp-sharded master; the param must
-                # come back on ITS layout (replicated under pure DP) — this
-                # constraint is the all-gather of the sharded update
-                p.data = self._on_param_layout(new.astype(p.dtype), i)
+                if comp is not None and self._comp_axis[i] is not None:
+                    # quantized all-gather: the master stays exact (sharded);
+                    # only the transported delta rides the wire dtype
+                    p.data = self._compress_all_gather(new, i)
+                else:
+                    # under ZeRO-1 `new` is the dp-sharded master; the param
+                    # must come back on ITS layout (replicated under pure DP)
+                    # — this constraint is the all-gather of the sharded
+                    # update
+                    p.data = self._on_param_layout(new.astype(p.dtype), i)
             else:
+                # no fp32 master (fp32 params): the replica's param is the
+                # ONLY copy, so the quantized-delta transport's implicit
+                # error feedback has no exact base to lean on — each step's
+                # rounding would accumulate as an uncorrected random walk.
+                # Gather exactly instead (the grad side stays quantized);
+                # _comp_ag_ok keeps the bytes accounting honest about it.
                 p.data = self._on_param_layout(new, i)
         self._step_count += 1
+
+    # -- quantized dp collectives (docs/compression.md) ----------------------
+    def _compress_reduce_scatter(self, grads: list) -> list:
+        """Route each eligible fp32 gradient through the policy's quantized
+        reduce-scatter; residuals update in place.  Under ZeRO-2 the grads
+        already arrived dp-sharded (the scatter happened layout-only during
+        accumulation — no wire crossing left to compress), so this is a
+        no-op there."""
+        if getattr(self, "_zero2", False):
+            return grads
+        comp = self._compression
+        out = list(grads)
+        for i, g in enumerate(grads):
+            axis = self._comp_axis[i]
+            s = self._state_shardings[i]
+            if axis is None or not isinstance(s, jax.sharding.NamedSharding):
+                continue
+            out[i], self._comp_rs_err[i] = comp.reduce_scatter(
+                g, s, axis, self._comp_rs_err[i]
+            )
+        return out
+
+    def _compress_all_gather(self, new32, i: int):
+        """Updated dp-sharded fp32 value → replica-layout param through the
+        policy's quantized all-gather (delta against the current param,
+        implicitly error-feedback — no residual to manage)."""
+        comp = self._compression
+        p = self.param_list[i]
+        full32 = comp.all_gather(
+            new32, p.data, self._state_shardings[i], self._comp_axis[i]
+        )
+        return self._on_param_layout(full32.astype(p.dtype), i)
 
     def _on_param_layout(self, arr, i):
         """Constrain an updated param back to the param's own sharding.
@@ -236,6 +286,8 @@ class Optimizer:
         offload_to_host: bool = False,
         offload_params: bool = False,
         zero1_mesh=None,
+        compression=None,
+        zero2: bool = False,
     ) -> None:
         """Move optimizer state + fp32 masters onto the params' shardings.
 
@@ -267,6 +319,11 @@ class Optimizer:
         ]
         state_shardings = list(shardings)
         self._zero1 = zero1_mesh is not None
+        # which axis each param's ZeRO-1 state gained the dp entry on (None =
+        # replicated fallback → no dp traffic for that tensor): drives the
+        # quantized-collective routing, the ZeRO-2 grad layout, and the
+        # dp-collective-bytes accounting
+        self._dp_state_axis: list[Optional[int]] = [None] * len(self.param_list)
         if zero1_mesh is not None:
             from .parallel.sharding import zero1_state_spec
 
@@ -274,7 +331,16 @@ class Optimizer:
                 if isinstance(s, jax.sharding.NamedSharding):
                     spec = zero1_state_spec(tuple(p.shape), zero1_mesh, s.spec)
                     state_shardings[i] = jax.sharding.NamedSharding(zero1_mesh, spec)
+                    for j, entry in enumerate(spec):
+                        in_entry = (
+                            entry == "dp"
+                            or (isinstance(entry, (tuple, list)) and "dp" in entry)
+                        )
+                        if in_entry:
+                            self._dp_state_axis[i] = j
+                            break
         self._state_shardings = state_shardings
+        self._init_compression(compression, zero2)
 
         def to_param_layout(leaf, i):
             s = state_shardings[i]
@@ -304,14 +370,105 @@ class Optimizer:
         # back per step
         self.reoffload_params_to_host()
 
+    def _init_compression(self, compression, zero2: bool) -> None:
+        """Arm the dp-collective compression policy and the ZeRO-2 grad
+        layout for this optimizer (called from relayout; docs/compression.md).
+
+        The error-feedback residuals are built HERE, eagerly, with the SAME
+        ``NamedSharding`` as the ZeRO-1 state (1/dp bytes per replica), so
+        the captured-step state pytree is structurally complete before the
+        first trace — they thread through ``CapturedStep`` like optax
+        moments and replays never recompile."""
+        n = len(self.param_list)
+        self._compression = None
+        self._comp_axis: list[Optional[int]] = [None] * n
+        self._comp_rs_err: list = [None] * n
+        # the quantized all-gather needs an exact fp32 master as its delta
+        # base (implicit error feedback); fp32 params keep no master, so
+        # their gather stays exact — recorded here for honest accounting
+        self._comp_ag_ok = [m is not None for m in self.master_params]
+        self._zero2 = bool(zero2) and self._zero1
+        if self._zero2:
+            for i, p in enumerate(self.param_list):
+                s = self._state_shardings[i]
+                if self._dp_state_axis[i] is not None and isinstance(
+                    s, jax.sharding.NamedSharding
+                ):
+                    # the capture layer builds grad placeholders (and pins
+                    # grad layouts) on this sharding, so the accumulation
+                    # buffer is 1/dp resident from the first micro-step
+                    p._grad_sharding = s
+        else:
+            # a model re-prepared into a zero2-off run must not keep stale
+            # accumulation layouts from a previous relayout
+            for p in self.param_list:
+                if getattr(p, "_grad_sharding", None) is not None:
+                    p._grad_sharding = None
+        if (
+            compression is None
+            or not getattr(compression, "quantizes_collectives", False)
+            or not self._zero1
+        ):
+            return
+        self._compression = compression
+        for i, p in enumerate(self.param_list):
+            axis = self._dp_state_axis[i]
+            s = self._state_shardings[i]
+            if axis is None or not isinstance(s, jax.sharding.NamedSharding):
+                continue
+            # min-size / dtype / block-geometry gates live on the policy —
+            # the grad crosses the wire in fp32, so gate on that
+            if not compression.eligible(tuple(p.shape), jnp.float32, axis):
+                continue
+            self._comp_axis[i] = axis
+            # ZeRO-2 runs would never consume an RS residual (the scatter is
+            # layout-only during accumulation — _compress_reduce_scatter is a
+            # no-op there), so don't allocate or thread dead state; the
+            # all-gather side carries no explicit residual at all (the delta
+            # transport is implicitly error-feedback, see compress.all_gather)
+            if compression.error_feedback and not self._zero2:
+                self._comp_rs_err[i] = compression.init_residual(tuple(p.shape), s)
+
+    def compression_summary(self, policy=None) -> Optional[dict]:
+        """Analytic dp-axis collective-bytes attribution for this
+        optimizer's update (telemetry ``kind="collectives"``; bench A/B).
+        ``None`` when ZeRO-1 is not active (no dp collective pair exists)."""
+        if not getattr(self, "_zero1", False):
+            return None
+        from .parallel.compress import NoneCompression, collective_bytes
+
+        if policy is None:
+            policy = getattr(self, "_compression", None) or NoneCompression()
+        entries = [
+            (
+                tuple(p.shape),
+                self._dp_state_axis[i],
+                jnp.dtype(p.dtype).itemsize,
+                self._comp_ag_ok[i],
+            )
+            for i, p in enumerate(self.param_list)
+        ]
+        summary = collective_bytes(policy, entries)
+        summary["zero2"] = bool(getattr(self, "_zero2", False))
+        return summary
+
     # -- functional bridge (used by Accelerator's step capture) --------------
     def capture_state(self) -> dict:
         self._ensure_master()
-        return {"opt_state": self.opt_state, "master": list(self.master_params)}
+        state = {"opt_state": self.opt_state, "master": list(self.master_params)}
+        if getattr(self, "_compression", None) is not None:
+            # error-feedback residuals ride the captured state like moments;
+            # absent entirely under policy "none" so the default capture
+            # pytree is byte-identical to the pre-compression library
+            state["compress"] = {"rs_err": list(self._comp_rs_err)}
+        return state
 
     def bind_capture_state(self, state: dict) -> None:
         self.opt_state = state["opt_state"]
         self.master_params = list(state["master"])
+        comp = state.get("compress")
+        if comp is not None:
+            self._comp_rs_err = list(comp["rs_err"])
 
     # -- checkpointing -------------------------------------------------------
     def sharded_state_arrays(self) -> tuple[dict, dict]:
@@ -338,6 +495,13 @@ class Optimizer:
         for i, m in enumerate(self.master_params):
             if m is not None:
                 arrays[f"master_{i}"] = m
+        # quantized-collective error-feedback residuals (docs/compression.md):
+        # saved so a resume continues the telescoping EF sum exactly instead
+        # of re-injecting one step of delayed error; restore paths treat them
+        # as optional (older checkpoints / other policies lack the keys)
+        for i, e in enumerate(getattr(self, "_comp_rs_err", []) or []):
+            if e is not None:
+                arrays[f"comp_rs_{i}"] = e
         meta = {
             "n_leaves": len(flat),
             "non_array_leaves": non_array,
@@ -388,6 +552,10 @@ class Optimizer:
             key = f"master_{i}"
             if key in arrays:
                 self.master_params[i] = arrays[key]
+        for i, e in enumerate(getattr(self, "_comp_rs_err", []) or []):
+            key = f"comp_rs_{i}"
+            if e is not None and key in arrays and arrays[key].shape == e.shape:
+                self._comp_rs_err[i] = arrays[key]
         self._step_count = meta.get("step_count", 0)
         self.defaults.update(meta.get("defaults", {}))
 
@@ -400,6 +568,13 @@ class Optimizer:
         }
         targets.update(
             {f"master_{i}": m for i, m in enumerate(self.master_params) if m is not None}
+        )
+        targets.update(
+            {
+                f"comp_rs_{i}": e
+                for i, e in enumerate(getattr(self, "_comp_rs_err", []) or [])
+                if e is not None
+            }
         )
         return targets
 
@@ -420,6 +595,14 @@ class Optimizer:
             "opt_state_leaves": [jax.device_get(x) for x in flat],
             "master_params": [
                 None if m is None else jax.device_get(m) for m in self.master_params
+            ],
+            # quantized-collective EF residuals (docs/compression.md): full
+            # host arrays like the masters, so a resume under the same policy
+            # continues the telescoping sum exactly; absent/None entries are
+            # ignored on load (other policies, older checkpoints)
+            "compress_rs_err": [
+                None if e is None else jax.device_get(e)
+                for e in getattr(self, "_comp_rs_err", []) or []
             ],
             "step_count": self._step_count,
             "defaults": dict(self.defaults),
@@ -474,6 +657,19 @@ class Optimizer:
             if isinstance(s, jax.sharding.NamedSharding):
                 arr = jax.device_put(arr, s)
             self.master_params[i] = arr
+        own_rs = getattr(self, "_comp_rs_err", None)
+        for i, e in enumerate(state.get("compress_rs_err", []) or []):
+            if (
+                own_rs is None
+                or i >= len(own_rs)
+                or own_rs[i] is None
+                or e is None
+                or tuple(e.shape) != tuple(own_rs[i].shape)
+            ):
+                continue  # policy/shape mismatch: residual restarts at zero
+            # re-commit onto THIS run's dp-sharded layout (same reshard-on-
+            # restore rule as the moments above)
+            own_rs[i] = jax.device_put(jnp.asarray(e), own_rs[i].sharding)
         self._step_count = state.get("step_count", 0)
         self.defaults.update(state.get("defaults", {}))
 

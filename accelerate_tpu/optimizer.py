@@ -207,6 +207,11 @@ class AcceleratedOptimizer:
         params_before = [p.data for p in opt.param_list]
         masters_before = list(opt.master_params)
         opt_state_before = opt.opt_state
+        # quantized-collective error-feedback residuals (docs/compression.md)
+        # are state too: an overflow-skipped step must not carry the
+        # speculative update's residual forward
+        comp_active = getattr(opt, "_compression", None) is not None
+        rs_before = list(opt._comp_rs_err) if comp_active else []
         # sanitize so the speculative update never poisons Adam moments
         for p in opt.param_list:
             if p.grad is not None:
@@ -224,6 +229,11 @@ class AcceleratedOptimizer:
             if opt.master_params[i] is not None and masters_before[i] is not None:
                 opt.master_params[i] = _sel(opt.master_params[i], masters_before[i])
         opt.opt_state = jax.tree_util.tree_map(_sel, opt.opt_state, opt_state_before)
+        if comp_active:
+            opt._comp_rs_err = [
+                _sel(new, old) if old is not None else new
+                for new, old in zip(opt._comp_rs_err, rs_before)
+            ]
         self.scaler.update_traced(finite)
         try:
             self._is_overflow = bool(~finite)  # eager: concrete immediately

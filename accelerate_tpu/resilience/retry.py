@@ -98,6 +98,10 @@ class StepRetrier:
         self._rng = rng if rng is not None else random.Random()
         self.retries_total = 0
         self.rollbacks_total = 0
+        # backoff sleep spent inside the most recent run_dispatch, so the
+        # capture layer can split retry waits out of dispatch_ms (telemetry
+        # StepRecord.retry_wait_ms) — retries must not inflate A/B timings
+        self.last_wait_ms = 0.0
 
     def _delay(self, attempt: int) -> float:
         return backoff_delay(
@@ -127,6 +131,7 @@ class StepRetrier:
         call_index = hub.dispatch_calls - 1  # begin_dispatch already counted
         attempt = 0
         rolled_back = False
+        self.last_wait_ms = 0.0
         while True:
             try:
                 if hub.injector is not None:
@@ -157,7 +162,9 @@ class StepRetrier:
                         delay_s=round(delay, 3),
                         error=error,
                     )
+                    t_sleep = time.perf_counter()
                     self.sleep(delay)
+                    self.last_wait_ms += (time.perf_counter() - t_sleep) * 1e3
                     continue
                 checkpoint = hub.last_checkpoint
                 if not self._rollback_allowed() or rolled_back or checkpoint is None:

@@ -519,6 +519,21 @@ class AcceleratorState:
         return True
 
     @property
+    def zero2_enabled(self) -> bool:
+        """ZeRO-2-style sharded gradient accumulation over the dp axis.
+
+        Strictly opt-in (``DataParallelPlugin(zero2=True)`` /
+        ``ACCELERATE_ZERO2=1``) because it changes the ``.grad`` layout
+        contract between micro-steps, and only meaningful when ZeRO-1 owns
+        a dp-sharded update for the sharded grads to feed
+        (docs/compression.md).
+        """
+        plugin = self.__dict__.get("dp_plugin")
+        if plugin is None or not plugin.zero2:
+            return False
+        return self.zero1_enabled
+
+    @property
     def use_tp(self) -> bool:
         return self.parallelism_config.tp_size > 1
 

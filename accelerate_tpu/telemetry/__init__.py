@@ -35,7 +35,13 @@ from contextlib import contextmanager
 from typing import Optional
 
 from .recompile import RecompileEvent, diff_keys, key_id
-from .resources import ProgramRecord, ResourceSample, program_stats, sample_live
+from .resources import (
+    CollectiveRecord,
+    ProgramRecord,
+    ResourceSample,
+    program_stats,
+    sample_live,
+)
 from .timeline import PHASES, StepRecord, StepTimeline
 
 SCHEMA_VERSION = 1
@@ -73,6 +79,11 @@ class Telemetry:
         self.recompile_events: deque[RecompileEvent] = deque(maxlen=handler.max_events)
         self.program_records: deque[ProgramRecord] = deque(maxlen=handler.max_events)
         self.resource_samples: deque[ResourceSample] = deque(maxlen=handler.max_events)
+        # per-policy dp-collective-bytes attribution (parallel/compress.py),
+        # recorded at prepare() time — the bench A/B denominator
+        self.collective_records: deque[CollectiveRecord] = deque(
+            maxlen=handler.max_events
+        )
         # resilience subsystem events (init/retry/rollback/preemption),
         # already kind-tagged dicts — see resilience/__init__.py
         self.resilience_events: deque[dict] = deque(maxlen=handler.max_events)
@@ -138,6 +149,17 @@ class Telemetry:
             self._export_queue.append(record.to_dict())
         return record
 
+    def record_collectives(self, summary: dict) -> CollectiveRecord:
+        """dp-axis collective-bytes attribution for one optimizer's update
+        (``parallel.compress.collective_bytes`` output), kind-tagged
+        ``"collectives"`` into the retained history and export stream."""
+        stats = dict(summary)
+        record = CollectiveRecord(policy=stats.pop("policy", "none"), stats=stats)
+        self.collective_records.append(record)
+        if self._export_sink:
+            self._export_queue.append(record.to_dict())
+        return record
+
     def record_resilience(self, payload: dict) -> None:
         """Resilience event (init report, dispatch retry, rollback,
         preemption, drain) — kind-tagged into the same retained history and
@@ -184,7 +206,8 @@ class Telemetry:
         if self._drains_total == 0 and not self._export_queue:
             for record in self.all_records():
                 if record.get("kind") in (
-                    "step", "recompile", "program", "resources", "resilience"
+                    "step", "recompile", "program", "collectives",
+                    "resources", "resilience",
                 ):
                     self._export_queue.append(record)
 
@@ -215,6 +238,7 @@ class Telemetry:
         records += [r.to_dict() for r in self.timeline.records()]
         records += [e.to_dict() for e in self.recompile_events]
         records += [p.to_dict() for p in self.program_records]
+        records += [c.to_dict() for c in self.collective_records]
         records += [s.to_dict() for s in self.resource_samples]
         records += [dict(e) for e in self.resilience_events]
         records.append(self.summary())
@@ -259,6 +283,7 @@ def __getattr__(name):
 
 __all__ = [
     "PHASES",
+    "CollectiveRecord",
     "ProgramRecord",
     "RecompileEvent",
     "ResourceSample",

@@ -109,6 +109,23 @@ class ProgramRecord:
 
 
 @dataclass
+class CollectiveRecord:
+    """dp-axis collective-bytes attribution under a compression policy
+    (``parallel.compress.collective_bytes``): the analytic per-step wire
+    bytes of the ZeRO-1 reduce-scatter/all-gather pair, recorded once per
+    ``prepare()``.  Complements ``cost_analysis`` — the backend reports
+    collective bytes only on some platforms (the keys ``program_stats``
+    scrapes), while this figure exists on every backend, CPU mesh included,
+    so bench.py can A/B ``none`` vs ``int8`` vs ``fp8`` anywhere."""
+
+    policy: str
+    stats: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"kind": "collectives", "policy": self.policy, **self.stats}
+
+
+@dataclass
 class ResourceSample:
     tag: str
     time: float = field(default_factory=time.time)

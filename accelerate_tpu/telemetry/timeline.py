@@ -19,6 +19,11 @@ captured-step call (``total_ms``):
   replayed scheduler steps.  Under JAX's async dispatch this is *launch*
   latency, not device execution time — the device step overlaps the next
   call's host work, which is exactly what the capture path promises.
+* ``retry_wait_ms`` — backoff sleeps the resilience retrier spent inside
+  this call's dispatch (docs/resilience.md).  Split OUT of ``dispatch_ms``
+  so a run that weathered transient faults stays comparable to a clean run
+  in A/B benches — before the split, retries silently inflated dispatch
+  timing.  Zero on every call without resilience retries.
 
 The ring buffer is allocated once at construction so the telemetry-off
 assertion ("no per-step allocations") is testable: a disabled run leaves
@@ -36,6 +41,7 @@ PHASES = (
     "trace_ms",
     "compile_ms",
     "dispatch_ms",
+    "retry_wait_ms",
 )
 
 
@@ -50,13 +56,20 @@ class StepRecord:
     compile_ms: float
     dispatch_ms: float
     dataloader_wait_ms: float
+    retry_wait_ms: float = 0.0  # resilience backoff sleeps, split from dispatch
 
     @property
     def phase_sum_ms(self) -> float:
         """Sum of the in-call phases, which partition ``total_ms``.
         ``dataloader_wait_ms`` is excluded: it is measured *between* step
         calls (loader-side) and rides alongside the call's wall clock."""
-        return self.assembly_ms + self.trace_ms + self.compile_ms + self.dispatch_ms
+        return (
+            self.assembly_ms
+            + self.trace_ms
+            + self.compile_ms
+            + self.dispatch_ms
+            + self.retry_wait_ms
+        )
 
     def to_dict(self) -> dict:
         d = asdict(self)

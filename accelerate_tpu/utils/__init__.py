@@ -12,6 +12,7 @@ from .constants import (
 from .dataclasses import (
     AutocastKwargs,
     BaseEnum,
+    CompressionKwargs,
     ComputeBackend,
     DataLoaderConfiguration,
     DataParallelPlugin,

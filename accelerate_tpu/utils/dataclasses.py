@@ -242,6 +242,44 @@ class ResilienceKwargs(KwargsHandler):
 
 
 @dataclass
+class CompressionKwargs(KwargsHandler):
+    """dp-axis collective compression knobs (docs/compression.md).
+
+    One surface for BOTH compression stories: ``policy`` selects a
+    ``parallel.compress.CompressionPolicy`` —
+
+    * ``"none"`` (default) — every path byte-identical to the
+      pre-compression library;
+    * ``"int8"`` / ``"fp8"`` — quantize the ZeRO-1 reduce-scatter /
+      all-gather pair inside the captured step (per-block scales, dp-sharded
+      error-feedback residuals threaded like optax moments);
+    * ``"powersgd"`` / ``"batched_powersgd"`` — rank-k + error-feedback
+      compression at the backward sync boundary (the reference comm hook,
+      now policy-selected; legacy ``DistributedDataParallelKwargs(
+      comm_hook=...)`` resolves to the same policy object).
+
+    ``min_size``/``min_block`` are the eligibility gates (tensors below
+    them pass through uncompressed); ``error_feedback`` toggles the
+    residual; the ``powersgd_*`` knobs mirror torch's ``PowerSGDState``
+    options.  When ``policy`` is left ``None`` it resolves from
+    ``$ACCELERATE_COMPRESSION`` (default ``"none"``).
+    """
+
+    policy: Optional[str] = None  # None → $ACCELERATE_COMPRESSION, default none
+    min_size: int = 2048
+    min_block: int = 8
+    error_feedback: bool = True
+    powersgd_rank: int = 1
+    powersgd_warm_start: bool = True
+    powersgd_wrapper: Optional[str] = None  # "fp16" | "bf16"
+
+    def __post_init__(self):
+        if self.policy is None:
+            self.policy = os.environ.get("ACCELERATE_COMPRESSION", "none")
+        self.policy = str(self.policy).lower()
+
+
+@dataclass
 class DistributedDataParallelKwargs(KwargsHandler):
     """Accepted for API parity with the reference (dataclasses.py:149).
 
@@ -421,13 +459,25 @@ class DataParallelPlugin:
     ``None`` (default) = automatic: on whenever dp > 1 and no ``fsdp`` axis
     already owns the params (FULL_SHARD/HYBRID_SHARD state follows the
     params, making ZeRO-1 redundant there).  Env: ACCELERATE_ZERO1.
+
+    ``zero2`` additionally keeps the *accumulated gradients* reduce-
+    scattered between micro-steps under gradient accumulation, so the
+    accumulation buffer is also ~1/dp per replica (docs/compression.md).
+    Opt-in (default off) because it changes the ``.grad`` layout contract:
+    between micro-steps ``param.grad`` is a dp-sharded global array (same
+    values, 1/dp resident bytes) rather than a replicated one.  Requires
+    ZeRO-1 to be active (sharded grads feed the sharded update directly).
+    Env: ACCELERATE_ZERO2.
     """
 
     zero1: Optional[bool] = None
+    zero2: Optional[bool] = None
 
     def __post_init__(self):
         if self.zero1 is None and "ACCELERATE_ZERO1" in os.environ:
             self.zero1 = bool(str_to_bool(os.environ["ACCELERATE_ZERO1"]))
+        if self.zero2 is None and "ACCELERATE_ZERO2" in os.environ:
+            self.zero2 = bool(str_to_bool(os.environ["ACCELERATE_ZERO2"]))
 
 
 @dataclass

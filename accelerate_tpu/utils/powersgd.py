@@ -1,43 +1,33 @@
-"""PowerSGD gradient compression: rank-k approximation + error feedback.
+"""PowerSGD gradient compression — MOVED to the unified compression layer.
 
-Reference surface: ``DDPCommunicationHookType.POWER_SGD`` /
-``BATCHED_POWER_SGD`` wiring torch's ``powerSGD_hook`` with a
-``PowerSGDState`` (reference utils/dataclasses.py:137-215,
-accelerator.py register_comm_hook).  TPU-native redesign of the same
-algorithm (Vogels et al., arXiv:1905.13727):
+As of the quantized-collectives PR the rank-k + error-feedback algorithm
+lives in :mod:`accelerate_tpu.parallel.compress` (class
+``PowerSGDCompression`` plus the ``init_/apply_powersgd`` functions), the
+one code path that also owns the int8/fp8 quantized ZeRO-1 collectives:
+hook selection, eligibility gates and error-feedback state management are
+policy methods there, selected via ``CompressionKwargs(policy="powersgd")``
+/ ``ACCELERATE_COMPRESSION=powersgd`` — or the legacy
+``DistributedDataParallelKwargs(comm_hook=...)`` spelling, which resolves
+to the same policy object (see ``parallel.compress.resolve_policy``).
 
-- per sync boundary each eligible gradient, viewed as an (n, m) matrix, is
-  replaced by the rank-k product P·Qᵀ where P = orth(M·Q_prev) and
-  Q = Mᵀ·P (one warm-started subspace iteration), with the residual
-  M − P·Qᵀ carried into the next step's gradient (error feedback — what
-  makes low-rank SGD converge);
-- under GSPMD the gradients entering the boundary are already dp-reduced
-  (XLA inserts the psum inside the backward), so unlike torch there is no
-  separate all-reduce to replace: every rank runs the identical
-  deterministic recurrence on identical inputs.  What compression buys
-  here is the same thing the fp16/bf16 hooks buy — a low-rank (P, Q)
-  representation for any cross-slice DCN gradient traffic issued after
-  this point, plus the documented convergence semantics of the reference
-  hook so training recipes port unchanged;
-- state (Q per tensor, error buffer) consists of plain jax arrays, so the
-  whole recurrence traces into a captured step and the buffers thread
-  through CapturedStep exactly like optimizer state.
-
-torch-parity notes: ``warm_start=False`` re-draws Q from the threaded RNG
-every application; ``use_error_feedback=False`` skips the residual;
-``start_powerSGD_iter`` is accepted but ignored (a step-count branch would
-force a second compiled variant of every captured step — compression is
-active from step 0, which only makes the early steps MORE compressed than
-torch's vanilla-allreduce warmup, never less correct).
+This module remains as a delegating import surface so existing code and
+tests keep their ``utils.powersgd`` spelling.  Reference surface:
+``DDPCommunicationHookType.POWER_SGD`` / ``BATCHED_POWER_SGD`` (reference
+utils/dataclasses.py:137-215); algorithm: Vogels et al., arXiv:1905.13727.
+torch-parity notes (``warm_start``, ``use_error_feedback``,
+``start_powerSGD_iter`` accepted-but-ignored) are documented on the moved
+functions in ``parallel/compress.py`` and in docs/compression.md.
 """
 
 from __future__ import annotations
 
-import math
-from typing import Optional
-
-import jax
-import jax.numpy as jnp
+from ..parallel.compress import (  # noqa: F401 — delegating re-exports
+    apply_batched_powersgd,
+    apply_powersgd,
+    eligible_matrix_shape,
+    init_batched_powersgd_state,
+    init_powersgd_state,
+)
 
 __all__ = [
     "eligible_matrix_shape",
@@ -46,165 +36,3 @@ __all__ = [
     "init_batched_powersgd_state",
     "apply_batched_powersgd",
 ]
-
-
-def eligible_matrix_shape(shape, rank: int) -> Optional[tuple[int, int]]:
-    """(n, m) matrix view for tensors PowerSGD compresses, else None.
-
-    Mirrors torch's rule: tensors are viewed as ``(shape[0], rest)``; only
-    tensors where the rank-k factors are actually smaller than the matrix
-    (both dims > rank) are compressed — 1-D tensors (biases, norms) and
-    tiny matrices pass through uncompressed.
-    """
-    if len(shape) < 2:
-        return None
-    n = int(shape[0])
-    m = int(math.prod(shape[1:]))
-    if n <= rank or m <= rank:
-        return None
-    return n, m
-
-
-def _orthonormalize(p):
-    # torch orthogonalizes with modified Gram-Schmidt; reduced QR spans the
-    # same subspace (up to column signs, which cancel in P·Qᵀ) and maps to
-    # one fused XLA op
-    q, _ = jnp.linalg.qr(p)
-    return q
-
-
-def _compress_matrix(m32, q_prev, err, *, use_error_feedback: bool, wrapper_dtype=None):
-    """One warm-started subspace iteration on fp32 matrix ``m32``.
-
-    ``wrapper_dtype`` rounds the transported factors (the reference's
-    fp16/bf16 comm wrappers): the decompressed gradient AND the error
-    residual are computed from the rounded factors, so error feedback also
-    carries the rounding error forward.  The warm-start Q stays unrounded
-    (state quality is a local concern, not wire traffic)."""
-    if use_error_feedback:
-        m32 = m32 + err
-    p = _orthonormalize(m32 @ q_prev)
-    q_new = m32.T @ p
-    if wrapper_dtype is not None:
-        p_used = p.astype(wrapper_dtype).astype(jnp.float32)
-        q_used = q_new.astype(wrapper_dtype).astype(jnp.float32)
-    else:
-        p_used, q_used = p, q_new
-    approx = p_used @ q_used.T
-    new_err = m32 - approx if use_error_feedback else err
-    return approx, q_new, new_err
-
-
-def init_powersgd_state(named_shapes: dict, rank: int, key) -> dict:
-    """Per-tensor state: warm-start Q (m, k) gaussian + fp32 error buffer.
-
-    ``named_shapes`` maps param name → shape; ineligible tensors get no
-    entry (and pass through uncompressed at apply time).  Built eagerly at
-    ``prepare()`` so the captured-step state pytree is structurally stable
-    from the first call.
-    """
-    qs, errs = {}, {}
-    names = sorted(n for n in named_shapes if eligible_matrix_shape(named_shapes[n], rank))
-    keys = jax.random.split(key, max(len(names), 1))
-    for sub, name in zip(keys, names):
-        n, m = eligible_matrix_shape(named_shapes[name], rank)
-        qs[name] = jax.random.normal(sub, (m, rank), jnp.float32)
-        errs[name] = jnp.zeros((n, m), jnp.float32)
-    return {"q": qs, "err": errs}
-
-
-def apply_powersgd(
-    named_grads: dict,
-    state: dict,
-    *,
-    use_error_feedback: bool = True,
-    warm_start: bool = True,
-    rng_key=None,
-    wrapper_dtype=None,
-) -> tuple[dict, dict]:
-    """Compress every eligible gradient in place of its full-rank value.
-
-    Returns ``(new_named_grads, new_state)`` — pure function of arrays, so
-    it works identically eagerly and inside a captured trace.
-    ``wrapper_dtype`` emulates the reference's fp16/bf16 comm wrappers: the
-    transported factors P/Q are rounded through that dtype before
-    decompression.
-    """
-    new_grads = dict(named_grads)
-    qs, errs = dict(state["q"]), dict(state["err"])
-    names = sorted(qs)
-    if not warm_start:
-        if rng_key is None:
-            raise ValueError("warm_start=False needs an rng_key to re-draw Q")
-        subkeys = dict(zip(names, jax.random.split(rng_key, max(len(names), 1))))
-    for name in names:
-        g = named_grads.get(name)
-        if g is None:
-            continue
-        shape, dtype = g.shape, g.dtype
-        m32 = g.reshape(shape[0], -1).astype(jnp.float32)
-        q_prev = qs[name]
-        if not warm_start:
-            q_prev = jax.random.normal(subkeys[name], q_prev.shape, jnp.float32)
-        approx, q_new, err_new = _compress_matrix(
-            m32, q_prev, errs[name],
-            use_error_feedback=use_error_feedback, wrapper_dtype=wrapper_dtype,
-        )
-        new_grads[name] = approx.reshape(shape).astype(dtype)
-        qs[name] = q_new
-        errs[name] = err_new
-    return new_grads, {"q": qs, "err": errs}
-
-
-def init_batched_powersgd_state(named_shapes: dict, rank: int, key) -> dict:
-    """Batched variant: ONE square matrix over the concatenation of every
-    gradient (torch batched_powerSGD_hook): flat length padded up to
-    side², side = ceil(sqrt(total))."""
-    total = sum(int(math.prod(s)) for s in named_shapes.values())
-    side = int(math.ceil(math.sqrt(max(total, 1))))
-    return {
-        "q": jax.random.normal(key, (side, rank), jnp.float32),
-        "err": jnp.zeros((side, side), jnp.float32),
-    }
-
-
-def apply_batched_powersgd(
-    named_grads: dict,
-    state: dict,
-    *,
-    use_error_feedback: bool = True,
-    warm_start: bool = True,
-    rng_key=None,
-    wrapper_dtype=None,
-) -> tuple[dict, dict]:
-    """Compress the whole gradient set as one padded square matrix.
-
-    CONTRACT: the caller must pass the SAME name set on every call (the
-    accelerator passes every parameter, zero-filling absent grads) — the
-    error buffer is a flat layout over the concatenation, so a name set
-    that varies between calls would shift the offsets and add one tensor's
-    residual into another's gradient region."""
-    names = sorted(named_grads)
-    flats = [named_grads[n].astype(jnp.float32).ravel() for n in names]
-    sizes = [f.shape[0] for f in flats]
-    flat = jnp.concatenate(flats) if flats else jnp.zeros((0,), jnp.float32)
-    side = state["q"].shape[0]
-    pad = side * side - flat.shape[0]
-    m32 = jnp.pad(flat, (0, pad)).reshape(side, side)
-    q_prev = state["q"]
-    if not warm_start:
-        if rng_key is None:
-            raise ValueError("warm_start=False needs an rng_key to re-draw Q")
-        q_prev = jax.random.normal(rng_key, q_prev.shape, jnp.float32)
-    approx, q_new, err_new = _compress_matrix(
-        m32, q_prev, state["err"],
-        use_error_feedback=use_error_feedback, wrapper_dtype=wrapper_dtype,
-    )
-    out_flat = approx.ravel()[: flat.shape[0]]
-    new_grads = dict(named_grads)
-    off = 0
-    for name, size in zip(names, sizes):
-        g = named_grads[name]
-        new_grads[name] = out_flat[off : off + size].reshape(g.shape).astype(g.dtype)
-        off += size
-    return new_grads, {"q": q_new, "err": err_new}

@@ -435,6 +435,90 @@ def _fp8_ab_workload(on_accel: bool) -> dict:
     return out
 
 
+def _compression_ab_block(on_accel: bool) -> dict:
+    """Compression A/B rows for the primary workload JSON (docs/compression.md):
+    the SAME GPT geometry trained under ``none`` / ``int8`` / ``fp8``
+    dp-collective compression, reporting per-policy ``step_ms``,
+    ``dp_collective_bytes`` (telemetry ``kind="collectives"`` accounting) and
+    final loss — so the first on-TPU run after the tunnel returns captures
+    the EQuARX-style bandwidth win without a new bench build.
+
+    Skipped (with a reason row) when dp == 1: the policies quantize the
+    ZeRO-1 dp collective pair, and a single chip has no dp traffic to
+    compress.  ``BENCH_COMPRESSION=0`` disables the block."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator, CompressionKwargs, TelemetryKwargs
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    n_dev = len(jax.devices())
+    out: dict = {}
+    if n_dev <= 1:
+        out["compression_ab_skipped"] = "dp=1: no dp-axis collectives to compress"
+        return out
+    cfg = GPTConfig.small() if on_accel else GPTConfig.tiny()
+    # batch is PER-CHIP × n_dev in both branches so the global batch always
+    # divides the dp axis (this block only runs at dp > 1)
+    batch, seq, steps = (BATCH * n_dev, SEQ, 20) if on_accel else (2 * n_dev, 128, 2)
+    for policy in ("none", "int8", "fp8"):
+        try:
+            Accelerator._reset_state()
+            nn.manual_seed(0)
+            acc = Accelerator(
+                mixed_precision="bf16",
+                kwargs_handlers=[
+                    TelemetryKwargs(enabled=True),
+                    CompressionKwargs(policy=policy),
+                ],
+            )
+            model = GPTLMHeadModel(cfg)
+            opt = optim.AdamW(model.parameters(), lr=3e-4, weight_decay=0.1)
+            model, opt = acc.prepare(model, opt)
+
+            def step_fn(ids):
+                opt.zero_grad()
+                loss_out = model(ids, labels=ids)
+                acc.backward(loss_out["loss"])
+                opt.step()
+                return loss_out["loss"]
+
+            step = acc.compile_step(step_fn)
+            rng = np.random.default_rng(0)
+            batches = [
+                batch_to_global_array(
+                    jnp.asarray(
+                        rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32
+                    ),
+                    mesh=acc.mesh,
+                )
+                for _ in range(4)
+            ]
+            compile_s, dt, final_loss, recompile, _ = _timed_steps(
+                step, batches, steps, WARMUP if on_accel else 1
+            )
+            records = list(acc.telemetry.collective_records)
+            bytes_total = (
+                records[-1].stats.get("dp_collective_bytes") if records else None
+            )
+            out[f"compression_{policy}_step_ms"] = round(dt / steps * 1e3, 2)
+            out[f"compression_{policy}_dp_collective_bytes"] = bytes_total
+            out[f"compression_{policy}_final_loss"] = round(final_loss, 3)
+            out[f"compression_{policy}_recompile_events"] = recompile["count"]
+            out[f"compression_{policy}_compile_s"] = round(compile_s, 1)
+        except Exception as exc:  # fail-soft: keep the other policies' rows
+            out[f"compression_{policy}_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    none_ms = out.get("compression_none_step_ms")
+    int8_ms = out.get("compression_int8_step_ms")
+    if none_ms and int8_ms:
+        out["compression_int8_speedup"] = round(none_ms / int8_ms, 3)
+    return out
+
+
 def _opt_inference_workload(on_accel: bool) -> dict:
     """BASELINE.json config 5: OPT device_map='auto'-style sharded inference
     (reference benchmarks/big_model_inference/README.md:31-37 form: load
@@ -746,8 +830,24 @@ def main() -> None:
         "arg_assembly_ms": (
             round(arg_assembly_ms, 3) if arg_assembly_ms is not None else None
         ),
+        # dp-collective compression (docs/compression.md): the primary run's
+        # active policy + its analytic per-step dp-axis wire bytes (None when
+        # zero1/dp>1 is off — no dp collective pair exists)
+        "compression_policy": acc._compression.name,
         **diag,
     }
+    summary = opt.optimizer.compression_summary()
+    result["dp_collective_bytes"] = (
+        summary["dp_collective_bytes"] if summary else None
+    )
+    if os.environ.get("BENCH_COMPRESSION", "1") != "0":
+        # per-policy A/B rows (none/int8/fp8 on the same geometry) — the
+        # quantized-collective win lands in the JSON the moment a dp>1
+        # window is back; fail-soft like the extras
+        try:
+            result.update(_compression_ab_block(on_accel))
+        except Exception as exc:
+            result["compression_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
     _PRIMARY_RESULT.update(result)
     # secondary BASELINE.md workloads, gated so the default driver run stays
     # inside its time budget (each adds a multi-minute cold compile)

@@ -8,9 +8,13 @@ to the compression dtype at the backward boundary (half-width grad buffers
 and downstream consumers; see Accelerator._apply_comm_hook for exactly what
 this does and does not change about XLA's collective dtypes).  The
 ``powersgd``/``batched_powersgd`` values run rank-k compression with error
-feedback instead of a cast (the reference's POWER_SGD hook, redesigned in
-utils/powersgd.py).  Lines marked `# New Code #` are what this feature adds
-to nlp_example.py.
+feedback instead of a cast (the reference's POWER_SGD hook, now living in
+the unified compression layer ``parallel/compress.py`` behind the same
+``CompressionPolicy`` surface as the quantized ZeRO-1 collectives — the
+modern spelling is ``CompressionKwargs(policy="powersgd")`` /
+``ACCELERATE_COMPRESSION=powersgd``, and this legacy kwarg resolves to the
+identical policy object; docs/compression.md).  Lines marked `# New Code #`
+are what this feature adds to nlp_example.py.
 """
 
 from __future__ import annotations

@@ -1,0 +1,512 @@
+"""Quantized dp-axis collectives (parallel/compress.py, docs/compression.md).
+
+The contract under test: with ZeRO-1 active and a quantizing policy
+(int8/fp8) selected via ``CompressionKwargs``/``ACCELERATE_COMPRESSION``,
+the captured step's dp reduce-scatter/all-gather pair rides the wire dtype
+with per-block scales and error feedback, and
+
+* losses match the uncompressed (``none``) run within the documented
+  tolerance (docs/compression.md: |Δloss| ≤ 1e-3 on the toy parity suite);
+* the error-feedback residuals are dp-sharded exactly like the ZeRO-1
+  optimizer state (~1/dp resident bytes per replica);
+* recompile forensics shows ZERO recompiles across replays;
+* telemetry's ``kind="collectives"`` accounting reports ≥ 1.8x fewer
+  dp-collective bytes than ``none`` (the ISSUE acceptance bound);
+* the default ``none`` path stays byte-identical (no residual state in the
+  capture pytree, no behavior change — the ZeRO-1 bitwise suite pins that).
+
+Runs on any virtual CPU mesh extent: the default suite forces 8 devices
+(tests/conftest.py) and ``make multichip`` re-runs this file at dp=4.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator, CompressionKwargs, DataParallelPlugin, TelemetryKwargs
+from accelerate_tpu.data_loader import batch_to_global_array
+from accelerate_tpu.nn import F
+from accelerate_tpu.parallel import compress
+
+DIM = 64  # divides both multichip extents (4 and 8) exactly
+# docs/compression.md "documented tolerance": per-step loss divergence of a
+# quantized run vs `none` on this parity suite
+LOSS_TOL = 1e-3
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    yield
+    Accelerator._reset_state()
+
+
+# ---------------------------------------------------------------------------
+# quantization primitives
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("wire", [jnp.int8, jnp.float8_e4m3fn])
+def test_quantize_roundtrip_bounds_error_per_block(wire):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 32)), jnp.float32) * jnp.asarray(
+        rng.uniform(0.1, 100.0, size=(16, 1)), jnp.float32
+    )  # wildly different block magnitudes: per-block scales must absorb them
+    payload, scales = compress.quantize(x, 0, wire)
+    assert payload.dtype == jnp.dtype(wire)
+    assert scales.shape == (16, 1)
+    back = compress.dequantize(payload, scales)
+    amax = np.abs(np.asarray(x)).max(axis=1, keepdims=True)
+    # int8 grid: half a step of amax/127; fp8 e4m3: ~2^-3 relative
+    bound = amax / 127.0 if wire == jnp.int8 else amax * 0.13
+    assert np.all(np.abs(np.asarray(back - x)) <= bound + 1e-7)
+
+
+def test_quantize_zero_block_is_exact():
+    x = jnp.zeros((8, 16), jnp.float32)
+    payload, scales = compress.quantize(x, 0, jnp.int8)
+    np.testing.assert_array_equal(np.asarray(compress.dequantize(payload, scales)), 0.0)
+
+
+def test_collective_bytes_ratio_meets_acceptance_bound():
+    """int8 must report ≥ 1.8x fewer dp-collective bytes than none on the
+    parity model's geometry (bf16 params: fp32 grads + bf16 params raw)."""
+    entries = [((DIM, DIM), 0, 2), ((DIM,), 0, 2)] * 2
+    none = compress.collective_bytes(compress.NoneCompression(), entries)
+    int8 = compress.collective_bytes(compress.Int8Compression(min_size=1), entries)
+    assert none["compression_ratio"] == 1.0
+    assert none["dp_collective_bytes"] >= 1.8 * int8["dp_collective_bytes"]
+    assert int8["tensors_compressed"] == 2  # weights; biases fail min_block
+
+
+# ---------------------------------------------------------------------------
+# policy resolution / config surface
+# ---------------------------------------------------------------------------
+def test_policy_resolves_from_env(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_COMPRESSION", "int8")
+    acc = Accelerator()
+    assert acc._compression.name == "int8"
+
+
+def test_explicit_kwargs_beat_env(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_COMPRESSION", "int8")
+    acc = Accelerator(kwargs_handlers=[CompressionKwargs(policy="fp8")])
+    assert acc._compression.name == "fp8"
+
+
+def test_unknown_policy_fails_at_construction():
+    with pytest.raises(ValueError, match="compression policy"):
+        Accelerator(kwargs_handlers=[CompressionKwargs(policy="int4")])
+
+
+def test_default_none_keeps_capture_state_byte_identical():
+    acc, _, opt, _ = _build(None)
+    state = opt.optimizer.capture_state()
+    assert sorted(state.keys()) == ["master", "opt_state"]
+    assert acc._compression.name == "none"
+    assert acc._comm_hook is None
+
+
+# ---------------------------------------------------------------------------
+# quantized ZeRO-1 inside the captured step
+# ---------------------------------------------------------------------------
+def _build(policy, zero2=False, accum=1, min_size=None, telemetry=True):
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    handlers = []
+    if telemetry:
+        handlers.append(TelemetryKwargs(enabled=True))
+    if policy is not None:
+        kwargs = {"policy": policy}
+        if min_size is not None:
+            kwargs["min_size"] = min_size
+        handlers.append(CompressionKwargs(**kwargs))
+    acc = Accelerator(
+        mixed_precision="bf16",
+        gradient_accumulation_steps=accum,
+        dp_plugin=DataParallelPlugin(zero2=zero2),
+        kwargs_handlers=handlers,
+    )
+    model = nn.Sequential(nn.Linear(DIM, DIM), nn.ReLU(), nn.Linear(DIM, DIM))
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(x, y):
+        opt.zero_grad()
+        loss = F.mse_loss(model(x), y)
+        acc.backward(loss)
+        opt.step()
+        return loss
+
+    return acc, model, opt, acc.compile_step(step_fn)
+
+
+def _batches(acc, n=2):
+    rng = np.random.default_rng(0)
+
+    def mk():
+        return batch_to_global_array(
+            jnp.asarray(rng.normal(size=(8, DIM)).astype(np.float32)), mesh=acc.mesh
+        )
+
+    return [(mk(), mk()) for _ in range(n)]
+
+
+def _losses(step, batches, steps):
+    return [float(step(*batches[i % len(batches)])) for i in range(steps)]
+
+
+@pytest.mark.parametrize("policy", ["int8", "fp8"])
+def test_quantized_zero1_loss_parity_and_zero_recompiles(policy, monkeypatch):
+    """The ISSUE acceptance row, driven through $ACCELERATE_COMPRESSION:
+    collective bytes drop ≥ 1.8x vs none (telemetry accounting), losses
+    match none within the documented tolerance, zero recompiles after
+    capture (recompile forensics)."""
+    monkeypatch.setenv("ACCELERATE_COMPRESSION", policy)
+    acc_on, _, opt_on, step_on = _build(None)
+    assert acc_on.state.zero1_enabled and acc_on._compression.name == policy
+    on = _losses(step_on, _batches(acc_on), 12)
+    monkeypatch.delenv("ACCELERATE_COMPRESSION")
+
+    acc_off, _, opt_off, step_off = _build(None)
+    off = _losses(step_off, _batches(acc_off), 12)
+
+    diffs = [abs(a - b) for a, b in zip(on, off)]
+    assert max(diffs) <= LOSS_TOL, f"loss divergence {diffs}"
+
+    # zero recompiles across replays, via the forensics stream (events fire
+    # only on REBUILDS — the first build of the one variant is not one)
+    assert acc_on.telemetry.recompiles_total == 0
+    assert len(step_on._cache) == 1
+
+    # telemetry collective accounting: ≥ 1.8x fewer dp bytes than none
+    (rec_on,) = acc_on.telemetry.collective_records
+    (rec_off,) = acc_off.telemetry.collective_records
+    assert rec_on.policy == policy and rec_off.policy == "none"
+    on_bytes = rec_on.stats["dp_collective_bytes"]
+    off_bytes = rec_off.stats["dp_collective_bytes"]
+    assert off_bytes >= 1.8 * on_bytes, (off_bytes, on_bytes)
+    assert rec_on.stats["dp_collective_bytes_uncompressed"] == off_bytes
+
+
+def test_error_feedback_residual_sharded_one_over_dp():
+    acc, _, opt, step = _build("int8")
+    dp = acc.mesh.shape["dp"]
+    inner = opt.optimizer
+    _losses(step, _batches(acc), 4)  # layouts must HOLD after captured steps
+    active = [i for i, a in enumerate(inner._comp_axis) if a is not None]
+    assert active, "no parameter took the quantized path"
+    for i in active:
+        err = inner._comp_rs_err[i]
+        assert "dp" in str(err.sharding.spec), err.sharding.spec
+        # the residual matches the ZeRO-1 state sharding exactly
+        assert err.sharding.spec == inner._state_shardings[i].spec
+        shard = err.addressable_shards[0].data
+        assert shard.nbytes * dp == err.nbytes  # ~1/dp resident per replica
+
+
+def test_error_feedback_residual_evolves_through_replays():
+    """The residuals are threaded state, not baked constants: they must
+    change across captured replays (quantization error is nonzero)."""
+    acc, _, opt, step = _build("int8")
+    inner = opt.optimizer
+    batches = _batches(acc)
+    _losses(step, batches, 1)
+    i = next(i for i, a in enumerate(inner._comp_axis) if a is not None)
+    rs0 = np.asarray(inner._comp_rs_err[i])
+    _losses(step, batches, 2)
+    rs1 = np.asarray(inner._comp_rs_err[i])
+    assert np.abs(rs0).sum() > 0, "residual never populated"
+    assert not np.array_equal(rs0, rs1), "residual frozen across replays"
+
+
+def test_residuals_survive_checkpoint_roundtrip(tmp_path):
+    """A save/restore under the same policy must continue the telescoping
+    EF sum exactly: losses after restore match the uninterrupted run, and
+    both checkpoint formats carry the residual arrays."""
+    import pickle
+
+    acc, model, opt, step = _build("int8")
+    batches = _batches(acc)
+    _losses(step, batches, 3)
+    inner = opt.optimizer
+    i = next(j for j, a in enumerate(inner._comp_axis) if a is not None)
+    assert np.abs(np.asarray(inner._comp_rs_err[i])).sum() > 0
+    for fmt, sharded in (("sharded", True), ("pickle", False)):
+        # the run keeps advancing between formats — snapshot at THIS save
+        rs_saved = np.asarray(inner._comp_rs_err[i]).copy()
+        ckpt = str(tmp_path / fmt)
+        acc.save_state(ckpt, sharded_state=sharded)
+        ref = _losses(step, batches, 2)
+
+        acc2, model2, opt2, step2 = _build("int8")
+        acc2.load_state(ckpt)
+        restored = np.asarray(opt2.optimizer._comp_rs_err[i])
+        np.testing.assert_allclose(restored, rs_saved, rtol=0, atol=0)
+        got = _losses(step2, _batches(acc2), 2)
+        diffs = [abs(a - b) for a, b in zip(ref, got)]
+        assert max(diffs) <= 1e-6, (fmt, diffs)
+
+
+def test_old_checkpoint_without_residuals_still_restores(tmp_path):
+    """Residual entries are OPTIONAL on restore: a checkpoint saved under
+    `none` loads into an int8 run (residuals restart at zero)."""
+    acc, model, opt, step = _build(None)
+    _losses(step, _batches(acc), 2)
+    ckpt = str(tmp_path / "none_ckpt")
+    acc.save_state(ckpt, sharded_state=True)
+
+    acc2, _, opt2, step2 = _build("int8")
+    acc2.load_state(ckpt)
+    inner = opt2.optimizer
+    i = next(j for j, a in enumerate(inner._comp_axis) if a is not None)
+    np.testing.assert_array_equal(np.asarray(inner._comp_rs_err[i]), 0.0)
+    losses = _losses(step2, _batches(acc2), 2)
+    assert all(np.isfinite(losses)), losses
+
+
+def test_eager_matches_captured():
+    """The compression math is pure jnp: the eager step must track the
+    captured one (same quantization grid, same EF recurrence)."""
+    acc, model, opt, step = _build("int8")
+    batches = _batches(acc)
+    captured = _losses(step, batches, 4)
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc2, model2, opt2, _ = _build("int8")
+
+    def eager(x, y):
+        opt2.zero_grad()
+        loss = F.mse_loss(model2(x), y)
+        acc2.backward(loss)
+        opt2.step()
+        return loss
+
+    eagerly = [float(eager(*batches[i % 2])) for i in range(4)]
+    diffs = [abs(a - b) for a, b in zip(captured, eagerly)]
+    assert max(diffs) <= LOSS_TOL, diffs
+
+
+def test_fp32_params_skip_quantized_all_gather_but_keep_rs():
+    """fp32 params keep no master, so the quantized-delta transport has no
+    exact base for its implicit error feedback — the gather must stay exact
+    (no random-walk drift) while the grad side stays quantized + EF'd, and
+    the bytes accounting must say so."""
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        mixed_precision="no",
+        kwargs_handlers=[TelemetryKwargs(enabled=True), CompressionKwargs(policy="int8")],
+    )
+    model = nn.Sequential(nn.Linear(DIM, DIM), nn.ReLU(), nn.Linear(DIM, DIM))
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+    inner = opt.optimizer
+    i = next(j for j, a in enumerate(inner._comp_axis) if a is not None)
+    assert inner._comp_ag_ok[i] is False  # no master → exact gather
+    assert inner._comp_rs_err[i] is not None  # grad side still EF'd
+
+    def step_fn(x, y):
+        opt.zero_grad()
+        loss = F.mse_loss(model(x), y)
+        acc.backward(loss)
+        opt.step()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    losses = _losses(step, _batches(acc), 6)
+    assert all(np.isfinite(losses)), losses
+    (rec,) = acc.telemetry.collective_records
+    # RS compressed, AG raw: still a real saving, but less than the bf16 row
+    assert rec.stats["dp_collective_bytes"] < rec.stats["dp_collective_bytes_uncompressed"]
+    assert rec.stats["dp_rs_bytes"] < rec.stats["dp_ag_bytes"]
+
+
+def test_legacy_comm_wrapper_reaches_policy_selected_powersgd():
+    """CompressionKwargs(policy='powersgd') + legacy ddp comm_wrapper: the
+    wrapper's factor rounding must be honored, not silently dropped."""
+    from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs
+
+    Accelerator._reset_state()
+    acc = Accelerator(
+        kwargs_handlers=[
+            CompressionKwargs(policy="powersgd"),
+            DistributedDataParallelKwargs(comm_wrapper="bf16"),
+        ]
+    )
+    assert acc._hook_policy.wrapper_dtype == jnp.bfloat16
+
+
+def test_free_memory_clears_zero2_pairs():
+    acc, model, opt, step = _build_accumulating(zero2=True)
+    assert acc._zero2_grads
+    acc.free_memory()
+    assert acc._zero2_grads == []
+
+
+def test_min_size_gate_passes_small_tensors_through():
+    acc, _, opt, step = _build("int8", min_size=10**9)
+    inner = opt.optimizer
+    assert all(a is None for a in inner._comp_axis)
+    # and the step still runs + replays without recompiling
+    _losses(step, _batches(acc), 3)
+    assert len(step._cache) == 1
+
+
+# ---------------------------------------------------------------------------
+# PowerSGD through the same policy surface
+# ---------------------------------------------------------------------------
+def test_powersgd_selected_via_compression_kwargs():
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        kwargs_handlers=[CompressionKwargs(policy="powersgd", powersgd_rank=2)]
+    )
+    assert acc._comm_hook == "powersgd"
+    assert acc._hook_policy is acc._compression
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = optim.SGD(model.parameters(), lr=0.05)
+    model, opt = acc.prepare(model, opt)
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(64, 4)), jnp.float32)
+
+    def fn(xb, yb):
+        opt.zero_grad()
+        loss = ((model(xb) - yb) ** 2).mean()
+        acc.backward(loss)
+        opt.step()
+        return loss
+
+    step = acc.compile_step(fn)
+    losses = [float(step(nn.Tensor(x), nn.Tensor(y))) for _ in range(20)]
+    assert losses[-1] < losses[0]
+    # the hook state threads through capture (Q evolves)
+    assert acc._powersgd_state is not None and acc._powersgd_state[0]["q"]
+
+
+def test_powersgd_hook_composes_with_int8_collectives():
+    """Legacy ddp comm_hook=powersgd + CompressionKwargs(int8): the hook
+    compresses grads at the sync boundary AND the ZeRO-1 pair rides int8."""
+    from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        mixed_precision="bf16",
+        kwargs_handlers=[
+            CompressionKwargs(policy="int8"),
+            DistributedDataParallelKwargs(
+                comm_hook="powersgd",
+                comm_state_option={"matrix_approximation_rank": 2},
+            ),
+        ],
+    )
+    assert acc._compression.name == "int8"
+    assert acc._comm_hook == "powersgd"
+    model = nn.Sequential(nn.Linear(DIM, DIM), nn.ReLU(), nn.Linear(DIM, DIM))
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(x, y):
+        opt.zero_grad()
+        loss = F.mse_loss(model(x), y)
+        acc.backward(loss)
+        opt.step()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    losses = _losses(step, _batches(acc), 4)
+    assert all(np.isfinite(losses)), losses
+    assert any(a is not None for a in opt.optimizer._comp_axis)
+
+
+def test_conflicting_hook_and_policy_raise():
+    from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs
+
+    with pytest.raises(ValueError, match="sync\\s+boundary|boundary"):
+        Accelerator(
+            kwargs_handlers=[
+                CompressionKwargs(policy="powersgd"),
+                DistributedDataParallelKwargs(comm_hook="fp16"),
+            ]
+        )
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-2: sharded gradient accumulation (carried item from docs/zero1.md)
+# ---------------------------------------------------------------------------
+def _build_accumulating(zero2: bool):
+    """The canonical ``with accelerator.accumulate(model):`` loop at 2
+    micro-steps — the body the ZeRO-2 layout exists for."""
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        mixed_precision="bf16",
+        gradient_accumulation_steps=2,
+        dp_plugin=DataParallelPlugin(zero2=zero2),
+        kwargs_handlers=[TelemetryKwargs(enabled=True)],
+    )
+    model = nn.Sequential(nn.Linear(DIM, DIM), nn.ReLU(), nn.Linear(DIM, DIM))
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(x, y):
+        with acc.accumulate(model):
+            loss = F.mse_loss(model(x), y)
+            acc.backward(loss)
+            opt.step()
+            if acc.gradient_state.sync_gradients:
+                opt.zero_grad()
+        return loss
+
+    return acc, model, opt, acc.compile_step(step_fn)
+
+
+def test_zero2_shards_accumulation_buffer_between_micro_steps():
+    acc, model, opt, step = _build_accumulating(zero2=True)
+    assert acc.state.zero2_enabled
+    dp = acc.mesh.shape["dp"]
+    batches = _batches(acc)
+    _losses(step, batches, 3)  # odd count: the last call is a MICRO step
+    assert not acc.gradient_state.sync_gradients  # mid-accumulation
+    g = dict(model.named_parameters())["0.weight"].grad
+    assert "dp" in str(g.sharding.spec), g.sharding.spec
+    shard = g.addressable_shards[0].data
+    assert shard.nbytes * dp == g.nbytes  # accumulation buffer ~1/dp resident
+
+
+def test_zero2_losses_match_and_variants_stay_pinned():
+    accz, _, _, stepz = _build_accumulating(zero2=True)
+    bz = _batches(accz)
+    lz = _losses(stepz, bz, 8)
+
+    accn, _, _, stepn = _build_accumulating(zero2=False)
+    ln = _losses(stepn, _batches(accn), 8)
+
+    diffs = [abs(a - b) for a, b in zip(lz, ln)]
+    assert max(diffs) <= LOSS_TOL, diffs
+    # one variant per sync_gradients value, and neither re-traced
+    assert len(stepz._cache) == 2
+    assert accz.telemetry.recompiles_total <= 1  # the 2nd VARIANT build only
+    for entry in stepz._cache.values():
+        if hasattr(entry[0], "_cache_size"):
+            assert entry[0]._cache_size() == 1
+
+
+def test_zero2_requires_zero1():
+    Accelerator._reset_state()
+    acc = Accelerator(dp_plugin=DataParallelPlugin(zero1=False, zero2=True))
+    assert not acc.state.zero2_enabled
+
+
+def test_zero2_rides_compression_summary():
+    acc, _, opt, step = _build("int8", zero2=True, accum=2)
+    summary = opt.optimizer.compression_summary()
+    assert summary["zero2"] is True
+    _losses(step, _batches(acc), 4)

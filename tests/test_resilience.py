@@ -269,6 +269,40 @@ def test_transient_dispatch_fault_retried_with_zero_extra_recompiles():
     assert any(r["event"] == "dispatch_retry" for r in tele)
 
 
+def test_retry_wait_split_out_of_dispatch_timing():
+    """ROADMAP carried item: resilience backoff sleeps must land in the step
+    record's ``retry_wait_ms``, NOT in ``dispatch_ms`` — before the split a
+    retried run's dispatch timing was inflated by the whole backoff, making
+    A/B bench comparisons lie about the hot path."""
+    backoff_s = 0.05
+    acc, _, step = _make_step(
+        ResilienceKwargs(
+            enabled=True, preemption=False,
+            fault_plan="dispatch:step=2,times=1", retry_backoff_s=backoff_s,
+        ),
+        tel=True,
+    )
+    x = _batches(1)[0]
+    for _ in range(4):
+        float(step(x))
+    records = acc.telemetry.timeline.records()
+    waits = [r.retry_wait_ms for r in records]
+    # exactly the faulted call (index 2) slept; backoff_delay jitters
+    # SYMMETRICALLY (±25%), so the measured sleep lives in
+    # [0.75·backoff, 1.25·backoff] plus scheduler slack
+    assert waits[0] == waits[1] == waits[3] == 0.0, waits
+    assert backoff_s * 1e3 * 0.7 <= waits[2] <= backoff_s * 1e3 * 1.3 + 50, waits
+    faulted = records[2]
+    # dispatch no longer swallows the sleep: the clean replay's dispatch is
+    # the honest scale, and the faulted call's dispatch must be within an
+    # order of it rather than backoff-sized
+    assert faulted.dispatch_ms < waits[2], (faulted.dispatch_ms, waits[2])
+    # the split still partitions the call's wall clock
+    assert faulted.phase_sum_ms <= faulted.total_ms * 1.5
+    # schema: the field exports with the record
+    assert faulted.to_dict()["retry_wait_ms"] == waits[2]
+
+
 def test_exhausted_retries_roll_back_to_last_checkpoint_and_replay(tmp_path):
     acc, _, step = _make_step(
         ResilienceKwargs(

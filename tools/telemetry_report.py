@@ -74,7 +74,12 @@ def validate(records: list[dict], min_steps: int = 0) -> list[str]:
             # it is excluded.  A large hole means a timer went missing
             # (>100% means one double-counted).
             in_call = (p for p in STEP_PHASES if p != "dataloader_wait_ms")
-            covered = sum(record[p] for p in in_call) / record["total_ms"]
+            # retry_wait_ms (split out of dispatch by the resilience PR) is
+            # optional: older artifacts predate the field
+            covered = (
+                sum(record[p] for p in in_call)
+                + record.get("retry_wait_ms", 0.0)
+            ) / record["total_ms"]
             if not 0.5 <= covered <= 1.5:
                 errors.append(
                     f"step record {i}: phases cover {covered:.0%} of total_ms"
@@ -93,6 +98,7 @@ def render(records: list[dict]) -> str:
     steps = [r for r in records if r.get("kind") == "step"]
     recompiles = [r for r in records if r.get("kind") == "recompile"]
     programs = [r for r in records if r.get("kind") == "program"]
+    collectives = [r for r in records if r.get("kind") == "collectives"]
     resources = [r for r in records if r.get("kind") == "resources"]
     replays = [r for r in steps if not r.get("built")]
     builds = [r for r in steps if r.get("built")]
@@ -106,8 +112,10 @@ def render(records: list[dict]) -> str:
     lines.append(header)
     lines.append("  " + "-" * (len(header) - 2))
     # .get with 0.0: a record missing a phase field already produced a
-    # validate() warning — the report must degrade, not crash
-    for phase in STEP_PHASES:
+    # validate() warning — the report must degrade, not crash.
+    # retry_wait_ms is rendered but NOT in STEP_FIELDS: pre-split artifacts
+    # lack it, and a missing optional field is not a schema error
+    for phase in STEP_PHASES + ("retry_wait_ms",):
         lines.append(
             f"  {phase[:-3]:<18}"
             f"{_mean([r.get(phase, 0.0) for r in replays]):>12.3f}"
@@ -140,6 +148,19 @@ def render(records: list[dict]) -> str:
                 f"  {r.get('label', '?'):<12} {r.get('key', '?'):<13}"
                 f" args {arg_mb:8.1f} MB  temps {tmp_mb:8.1f} MB"
                 + (f"  {flops / 1e9:8.2f} GFLOP" if flops else "")
+            )
+    if collectives:
+        lines.append("")
+        lines.append("dp-collective bytes (per step, analytic)")
+        for r in collectives:
+            total = r.get("dp_collective_bytes", 0)
+            raw = r.get("dp_collective_bytes_uncompressed", 0)
+            lines.append(
+                f"  policy {r.get('policy', '?'):<18} {total / 1e6:8.2f} MB"
+                f"  (uncompressed {raw / 1e6:8.2f} MB,"
+                f" ratio {r.get('compression_ratio', 1.0):.2f}x,"
+                f" {r.get('tensors_compressed', 0)}/{r.get('tensors_total', 0)}"
+                " tensors)"
             )
     if resources:
         lines.append("")
