@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke bench
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke bench
 
 # graftlint: whole-program trace-safety & collective-correctness static
 # analysis (docs/graftlint.md). Runs before the suite. The on-disk cache
@@ -27,7 +27,7 @@ lint-cold:
 multichip:
 	XLA_FLAGS=--xla_force_host_platform_device_count=4 python -m pytest \
 	  tests/test_zero1.py tests/test_zero_sharding.py \
-	  tests/test_compression.py -q
+	  tests/test_compression.py tests/test_serving.py -q
 
 # telemetry pipeline proof (docs/telemetry.md): tiny model, 3 steps + a
 # forced shape change with telemetry on, JSONL export validated through
@@ -41,7 +41,15 @@ telemetry-smoke:
 resilience-smoke:
 	JAX_PLATFORMS=cpu python tools/resilience_smoke.py
 
-test: lint multichip telemetry-smoke resilience-smoke
+# serving-path proof (docs/serving.md): tiny GPT, 8 mixed-length staggered
+# requests through the continuous-batching service on CPU — asserts every
+# request's greedy tokens match a single-request generate(), zero recompile
+# events after warmup (CompileWatcher forensics), no leaked KV blocks, and
+# kind="serving" telemetry records present
+serve-smoke:
+	JAX_PLATFORMS=cpu python tools/serving_smoke.py
+
+test: lint multichip telemetry-smoke resilience-smoke serve-smoke
 	python -m pytest tests/ -q
 
 test_core:

@@ -35,6 +35,7 @@ from .big_modeling import (
     materialize_meta_module,
     shard_for_inference,
 )
+from .serving import DecodeService, ServingConfig
 from .state import AcceleratorState, GradientState, PartialState
 from .logging import get_logger
 from .data_loader import PaddingCollate, prepare_data_loader, skip_first_batches

@@ -31,7 +31,9 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 # Bumping this invalidates every on-disk cache entry (cache.py keys on it):
 # bump whenever a rule or the graph machinery changes what it reports for
 # unchanged source.  v3: dtype-widen gained the quantized-payload check.
-ANALYSIS_VERSION = "3"
+# v4: recompile-hazard gained the serving bucketing contract (raw request
+# lengths into run_prefill/run_decode).
+ANALYSIS_VERSION = "4"
 
 # Names that mark a branch/function as profiling/benchmark plumbing, where a
 # deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the

@@ -15,6 +15,13 @@ feeds *unpadded, varying-length* batches from a data loader into a
 sequence length.  The rule flags ``for batch in loader: step(batch)`` when
 the loader shows no ``PaddingCollate`` / ``TPU_PAD_MULTIPLE`` / bucketing
 evidence (a ``collate_fn=`` or a pad/bucket-named helper counts).
+
+The serving variant (docs/serving.md): the captured serving/decode entries
+(``serving/engine.py``'s ``run_prefill``/``run_decode``) pin one program
+per bucketed geometry — an argument built straight from ``len(prompt)`` /
+``.shape`` with no bucket/pad evidence in the call compiles one program
+per distinct request length, the per-request analog of the unbucketed
+loader loop.
 """
 
 from __future__ import annotations
@@ -153,6 +160,10 @@ def _names_in_concretizing_positions(test: ast.AST):
 
 # names whose assignment marks a captured-step callable
 _CAPTURE_LEAVES = {"compile_step", "CapturedStep"}
+# captured serving/decode entry points (serving/engine.py): their ids/table
+# arguments become program SHAPES, so request-derived lengths must pass
+# through the bucketing helper (kv_blocks.bucket_length / generation.bucket_up)
+_SERVING_ENTRY_LEAVES = {"run_prefill", "run_decode", "_prefill_jit", "_decode_jit"}
 # evidence the author already buckets shapes (PaddingCollate pads to
 # TPU_PAD_MULTIPLE; any custom collate_fn is assumed to know its shapes)
 _PAD_EVIDENCE_RE = re.compile(r"pad|bucket|PaddingCollate|TPU_PAD_MULTIPLE", re.IGNORECASE)
@@ -172,6 +183,22 @@ def _captured_names(module) -> set[str]:
                     if isinstance(t, ast.Name):
                         out.add(t.id)
     return out
+
+
+def _has_raw_length_source(expr: ast.AST) -> bool:
+    """Does the expression derive from a per-request length — ``len(...)``
+    or a ``.shape``/``.size`` read?  Those are exactly the values that must
+    go through the bucketing helper before becoming a serving-program shape."""
+    for sub in ast.walk(expr):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id == "len"
+        ):
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "size"):
+            return True
+    return False
 
 
 def _subtree_has_pad_evidence(node: ast.AST) -> bool:
@@ -290,6 +317,40 @@ class RecompileHazard(Rule):
                     )
             findings.extend(self._scan_body(module, info, dynamic))
         findings.extend(self._scan_capture_loops(module))
+        findings.extend(self._scan_serving_calls(module))
+        return findings
+
+    # -- serving bucketing contract -------------------------------------------
+    def _scan_serving_calls(self, module):
+        """Raw request-length shapes flowing into a captured serving/decode
+        entry: the serving programs pin ONE variant per bucketed geometry,
+        so an argument built straight from ``len(prompt)`` / ``x.shape``
+        without bucket/pad evidence compiles a fresh program per distinct
+        request length — exactly the explosion the service exists to avoid."""
+        findings = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = module.resolve(node.func) or ""
+            if resolved.rsplit(".", 1)[-1] not in _SERVING_ENTRY_LEAVES:
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if any(_subtree_has_pad_evidence(a) for a in args):
+                continue
+            if any(_has_raw_length_source(a) for a in args):
+                findings.append(
+                    Finding(
+                        self.id,
+                        module.rel_path,
+                        node.lineno,
+                        node.col_offset,
+                        "raw request-length shape flows into captured serving "
+                        f"entry '{resolved.rsplit('.', 1)[-1]}' without "
+                        "bucketing — route lengths through "
+                        "serving.bucket_length (or pad to a bucket) or every "
+                        "distinct request length compiles a fresh program",
+                    )
+                )
         return findings
 
     # -- capture-cache hazard ------------------------------------------------

@@ -206,13 +206,16 @@ def _dequant_layer(plain_l: dict, q_l: dict, s_l: dict, bits: int, dtype) -> dic
 @partial(
     jax.jit,
     static_argnames=("family", "cfg", "max_new", "cache_len", "temperature",
-                     "qbits"),
+                     "qbits", "has_eos"),
 )
 def _generate_jit(
     g,
     layers,
-    ids,  # (b, prompt_len) int32
+    ids,  # (b, bucketed_prompt_len) int32, padded with pad_token_id
+    prompt_len,  # () int32 TRUE prompt length — traced, NOT a cache key
     rng,
+    eos_id,  # () int32 — traced so distinct stop tokens share one program
+    pad_id,  # () int32
     *,
     family: DecoderFamily,
     cfg,
@@ -220,27 +223,35 @@ def _generate_jit(
     cache_len: int,
     temperature: float,
     qbits: int = 0,
+    has_eos: bool = False,
 ):
-    b, prompt_len = ids.shape
+    b, padded_len = ids.shape
     plain_layers, q_layers, s_layers = layers
 
-    # ---- prefill: full prompt through a scan over stacked layers ----------
-    positions = jnp.arange(prompt_len)
+    # ---- prefill: full (bucketed) prompt through a scan over stacked layers.
+    # The TRUE length rides as a traced scalar, so every prompt in a bucket
+    # replays ONE program; pad positions are invisible — the causal mask
+    # (`t <= q_pos`) hides their keys from every real query, and the decode
+    # loop overwrites their cache entries before they ever unmask ----------
+    positions = jnp.arange(padded_len)
 
     def prefill_layer(x, layer_in):
         l = _dequant_layer(*layer_in, qbits, x.dtype)
         q, k, v = family.attn_in(l, x, positions, cfg)
-        # attend over the unpadded prompt keys (no wasted MXU work on the
+        # attend over the bucketed prompt keys (no wasted MXU work on the
         # not-yet-written cache region), then pad out to the decode length
         att = cached_attention(q, k, v, positions, cfg)
-        pad = [(0, 0), (0, 0), (0, cache_len - prompt_len), (0, 0)]
+        pad = [(0, 0), (0, 0), (0, cache_len - padded_len), (0, 0)]
         return family.attn_out(l, x, att, cfg), (jnp.pad(k, pad), jnp.pad(v, pad))
 
     x = family.embed(g, ids, positions, cfg)
     x, (k_cache, v_cache) = jax.lax.scan(
         prefill_layer, x, (plain_layers, q_layers, s_layers)
     )
-    logits = family.finalize(g, x, cfg)
+    # logits at the TRUE last prompt position (finalize reads x[:, -1], so
+    # hand it the one dynamically gathered position)
+    x_last = jax.lax.dynamic_slice_in_dim(x, prompt_len - 1, 1, axis=1)
+    logits = family.finalize(g, x_last, cfg)
 
     def sample(logits, key):
         if temperature == 0.0:
@@ -251,10 +262,11 @@ def _generate_jit(
 
     rng, key = jax.random.split(rng)
     next_tok = sample(logits, key)
+    done = next_tok == eos_id if has_eos else jnp.zeros_like(next_tok, bool)
 
     # ---- decode: one token per scan step, cache updated in place ----------
     def decode_step(carry, _):
-        k_cache, v_cache, tok, position, rng = carry
+        k_cache, v_cache, tok, position, rng, done = carry
         q_pos = position[None]
         x = family.embed(g, tok[:, None], q_pos, cfg)
 
@@ -273,16 +285,34 @@ def _generate_jit(
         logits = family.finalize(g, x, cfg)
         rng, key = jax.random.split(rng)
         nxt = sample(logits, key)
-        return (k_cache, v_cache, nxt, position + 1, rng), nxt
+        if has_eos:
+            # per-sequence stop: a finished row emits (and feeds) pad from
+            # the step AFTER its eos.  Rows are computationally independent
+            # and the rng split count is unchanged, so unfinished rows'
+            # outputs are bitwise identical to the eos-free program
+            nxt = jnp.where(done, pad_id, nxt)
+            done = done | (nxt == eos_id)
+        return (k_cache, v_cache, nxt, position + 1, rng, done), nxt
 
-    (_, _, _, _, _), toks = jax.lax.scan(
+    (_, _, _, _, _, _), toks = jax.lax.scan(
         decode_step,
-        (k_cache, v_cache, next_tok, jnp.int32(prompt_len), rng),
+        (k_cache, v_cache, next_tok, prompt_len.astype(jnp.int32), rng, done),
         None,
         length=max_new - 1,
     )
-    new_tokens = jnp.concatenate([next_tok[None], toks], axis=0).T  # (b, max_new)
-    return jnp.concatenate([ids, new_tokens], axis=1)
+    return jnp.concatenate([next_tok[None], toks], axis=0).T  # (b, max_new)
+
+
+def bucket_up(n: int, multiple: int, cap: Optional[int] = None) -> int:
+    """Round ``n`` up to a multiple (clamped to ``cap`` when given, never
+    below ``n``) — the ONE shape-bucketing implementation every captured
+    decode entry sits behind (``serving.bucket_length`` delegates here)."""
+    if multiple < 1:
+        raise ValueError(f"bucket multiple must be >= 1, got {multiple}")
+    b = ((n + multiple - 1) // multiple) * multiple
+    if cap is not None:
+        b = min(b, cap)
+    return max(b, n)
 
 
 def generate(
@@ -292,12 +322,31 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
     quantize_weights: Optional[int] = None,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+    prompt_bucket: Optional[int] = None,
+    new_tokens_bucket: Optional[int] = None,
 ):
     """Greedy (``temperature=0``) or sampled decode with a KV cache.
 
-    One jitted program per (prompt_len, max_new_tokens) pair; the cache is
-    sized ``prompt + max_new`` (must fit the model's positional capacity).
-    Works for any model exposing ``_decoder_spec()``.
+    One jitted program per **bucketed** (prompt_len, max_new_tokens) pair:
+    both lengths round up to configurable multiples (``prompt_bucket`` /
+    ``new_tokens_bucket``, env ``ACCELERATE_GENERATE_PROMPT_BUCKET`` /
+    ``ACCELERATE_GENERATE_NEW_BUCKET``, default 32; 1 disables), so repeated
+    calls with nearby lengths replay ONE program instead of compiling per
+    shape.  Pad prompt tokens are masked out of attention via ``q_pos`` and
+    the extra decode steps are sliced off the result — outputs (and, for
+    sampling, the per-step rng split sequence of the returned tokens) are
+    identical to the unbucketed program.  Buckets degrade gracefully near
+    the model's positional capacity; a genuinely over-long request still
+    raises.
+
+    ``eos_token_id`` enables per-sequence stopping: a row that sampled eos
+    emits ``pad_token_id`` from the next step on, while unfinished rows'
+    greedy outputs stay bitwise identical (rows are independent and rng
+    consumption is shared per step, not per row).  The cache is sized
+    ``bucketed_prompt + bucketed_new`` (must fit the model's positional
+    capacity).  Works for any model exposing ``_decoder_spec()``.
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -307,29 +356,51 @@ def generate(
     )
     if ids.ndim == 1:
         ids = ids[None]
-    cache_len = ids.shape[1] + max_new_tokens
-    if cache_len > spec.max_len:
+    prompt_len = ids.shape[1]
+    if prompt_len + max_new_tokens > spec.max_len:
         raise ValueError(
-            f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the model's positional capacity ({spec.max_len})"
         )
     if quantize_weights not in (None, 4, 8):
         raise ValueError(
             f"quantize_weights={quantize_weights!r}: use None, 8 or 4"
         )
+    from ..utils.environment import get_int_from_env
+
+    if prompt_bucket is None:
+        prompt_bucket = get_int_from_env(["ACCELERATE_GENERATE_PROMPT_BUCKET"], 32)
+    if new_tokens_bucket is None:
+        new_tokens_bucket = get_int_from_env(["ACCELERATE_GENERATE_NEW_BUCKET"], 32)
+    padded_len = bucket_up(prompt_len, prompt_bucket, spec.max_len - max_new_tokens)
+    bucket_new = bucket_up(max_new_tokens, new_tokens_bucket, spec.max_len - padded_len)
+    if padded_len > prompt_len:
+        ids_in = jnp.pad(
+            ids, ((0, 0), (0, padded_len - prompt_len)),
+            constant_values=pad_token_id,
+        )
+    else:
+        ids_in = ids
     qbits = quantize_weights or 0
     g, layer_parts = stacked_params_for_mode(model, qbits, spec.stack)
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    return _generate_jit(
+    new_tokens = _generate_jit(
         g,
         layer_parts,
-        ids,
+        ids_in,
+        jnp.asarray(prompt_len, jnp.int32),
         rng,
+        # traced scalars: distinct stop/pad ids replay ONE program; only
+        # the presence of a stop token is a (boolean) cache-key component
+        jnp.asarray(eos_token_id if eos_token_id is not None else 0, jnp.int32),
+        jnp.asarray(pad_token_id, jnp.int32),
         family=spec.family,
         cfg=spec.cfg,
-        max_new=max_new_tokens,
-        cache_len=cache_len,
+        max_new=bucket_new,
+        cache_len=padded_len + bucket_new,
         temperature=float(temperature),
         qbits=qbits,
+        has_eos=eos_token_id is not None,
     )
+    return jnp.concatenate([ids, new_tokens[:, :max_new_tokens]], axis=1)

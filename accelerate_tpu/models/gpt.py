@@ -254,12 +254,12 @@ class GPTLMHeadModel(nn.Module):
         return {"logits": self.lm_head(x)}
 
     def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0,
-                 rng=None, quantize_weights=None):
+                 rng=None, quantize_weights=None, **kwargs):
         """KV-cache greedy/sampled decode — see models/generation.py."""
         from .generation import generate
 
         return generate(self, input_ids, max_new_tokens, temperature, rng,
-                        quantize_weights=quantize_weights)
+                        quantize_weights=quantize_weights, **kwargs)
 
     def _decoder_spec(self):
         """Hooks for the generic KV-cache engine (models/generation.py) —

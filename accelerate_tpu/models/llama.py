@@ -481,11 +481,11 @@ class LlamaForCausalLM(nn.Module):
         return {"logits": self.lm_head(x)}
 
     def generate(self, input_ids, max_new_tokens: int, temperature: float = 0.0,
-                 rng=None, quantize_weights=None):
+                 rng=None, quantize_weights=None, **kwargs):
         from .generation import generate
 
         return generate(self, input_ids, max_new_tokens, temperature, rng,
-                        quantize_weights=quantize_weights)
+                        quantize_weights=quantize_weights, **kwargs)
 
     @property
     def num_flops_per_token(self) -> float:

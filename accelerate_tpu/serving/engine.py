@@ -1,0 +1,259 @@
+"""Captured prefill/decode programs over the paged KV pool (docs/serving.md).
+
+Two separately jitted programs, split so a long incoming prompt never
+stalls token streaming for in-flight sequences:
+
+* ``run_prefill`` — ONE request's bucket-padded prompt through the layer
+  scan; writes its k/v into the pool blocks the scheduler reserved and
+  samples the request's first token.  One compiled variant per
+  ``(bucket_len, mode)`` — prompt lengths are bucketed by the scheduler
+  (``kv_blocks.bucket_length``), the TRUE length rides as a traced scalar.
+* ``run_decode`` — the WHOLE slot batch one token forward: per-slot embed
+  at the slot's own position, scatter the new k/v into the pool
+  (``block_tables[slot][pos // bs]`` at offset ``pos % bs``), gather each
+  slot's pages back as a virtually contiguous cache and reuse
+  ``cached_attention`` unchanged.  Every shape is fixed at service
+  construction, so the steady state is exactly one program, replayed.
+
+Both reuse the single-request engine's contracts wholesale: the
+``DecoderFamily`` pure math, ``stacked_params_for_mode`` (so int8/int4
+quantized weight modes compose — the stacks are shared with ``generate()``),
+``_dequant_layer`` widening inside the scan, and ``cached_attention`` — the
+one attention implementation, which is what makes serving greedy tokens
+per-sequence identical to a single-request ``generate()``: same per-token
+math, same true positions, same mask formula; only the (masked, zero-prob)
+padding width differs.
+
+Pools are DONATED through both programs — the update is in-place at the XLA
+level, never a pool-sized copy per token.
+
+Zero-recompile forensics: the scheduler routes every call through
+:class:`CompileWatcher`, which diffs the jit cache size around the call.
+First compiles of a not-yet-seen signature are warmup; any growth on a seen
+signature is an anomaly, counted and emitted as a ``kind="serving"``
+:class:`~..telemetry.RecompileEvent` through the telemetry hub — the
+regression guard the bench/smoke assertions read.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generation import (
+    DecoderFamily,
+    _dequant_layer,
+    cached_attention,
+)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("family", "cfg", "qbits", "temperature"),
+    donate_argnums=(0, 1),
+)
+def _prefill_jit(
+    k_pool,
+    v_pool,
+    g,
+    layers,
+    padded_ids,  # (1, bucket_len) int32, prompt padded to its bucket
+    block_row,  # (blocks_per_slot,) int32 — this slot's pool blocks
+    prompt_len,  # () int32 TRUE length; dynamic, so one program per bucket
+    rng,
+    *,
+    family: DecoderFamily,
+    cfg,
+    qbits: int,
+    temperature: float,
+):
+    bucket_len = padded_ids.shape[1]
+    block_size = k_pool.shape[3]
+    n_blocks = bucket_len // block_size  # scheduler guarantees divisibility
+    positions = jnp.arange(bucket_len)
+    plain_layers, q_layers, s_layers = layers
+
+    def prefill_layer(x, layer_in):
+        l_parts, kp_l, vp_l = layer_in
+        l = _dequant_layer(*l_parts, qbits, x.dtype)
+        q, k, v = family.attn_in(l, x, positions, cfg)
+        att = cached_attention(q, k, v, positions, cfg)
+        # the bucket covers whole blocks: write them with one scatter each.
+        # Positions >= prompt_len hold pad-token k/v — invisible behind the
+        # causal mask until the decode loop overwrites them with real tokens
+        kb = k[0].transpose(1, 0, 2).reshape(n_blocks, block_size, k.shape[1], k.shape[3])
+        vb = v[0].transpose(1, 0, 2).reshape(n_blocks, block_size, v.shape[1], v.shape[3])
+        kp_l = kp_l.at[block_row[:n_blocks]].set(kb.transpose(0, 2, 1, 3).astype(kp_l.dtype))
+        vp_l = vp_l.at[block_row[:n_blocks]].set(vb.transpose(0, 2, 1, 3).astype(vp_l.dtype))
+        return family.attn_out(l, x, att, cfg), (kp_l, vp_l)
+
+    x = family.embed(g, padded_ids, positions, cfg)
+    x, (k_pool, v_pool) = jax.lax.scan(
+        prefill_layer, x, ((plain_layers, q_layers, s_layers), k_pool, v_pool)
+    )
+    # logits at the TRUE last prompt position (finalize reads x[:, -1], so
+    # hand it the one gathered position) — identical math to an unpadded
+    # prefill's last position
+    x_last = jax.lax.dynamic_slice_in_dim(x, prompt_len - 1, 1, axis=1)
+    logits = family.finalize(g, x_last, cfg)  # (1, V)
+    if temperature == 0.0:
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        rng_out = rng
+    else:
+        rng_out, key = jax.random.split(rng)
+        tok = jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+    return k_pool, v_pool, tok[0], rng_out
+
+
+@partial(
+    jax.jit,
+    static_argnames=("family", "cfg", "qbits", "temperature"),
+    donate_argnums=(0, 1),
+)
+def _decode_jit(
+    k_pool,
+    v_pool,
+    g,
+    layers,
+    block_tables,  # (slots, blocks_per_slot) int32
+    positions,  # (slots,) int32 — position of the token being fed
+    tokens,  # (slots,) int32 — last sampled token per slot
+    rngs,  # (slots, 2) uint32 — per-slot RNG streams
+    *,
+    family: DecoderFamily,
+    cfg,
+    qbits: int,
+    temperature: float,
+):
+    block_size = k_pool.shape[3]
+    plain_layers, q_layers, s_layers = layers
+
+    # per-slot embed at the slot's OWN position (family.embed broadcasts one
+    # position vector over the batch, which is exactly wrong here)
+    x = jax.vmap(lambda t, p: family.embed(g, t[None, None], p[None], cfg)[0])(
+        tokens, positions
+    )  # (slots, 1, c)
+
+    def decode_layer(x, layer_in):
+        l_parts, kp_l, vp_l = layer_in
+        l = _dequant_layer(*l_parts, qbits, x.dtype)
+        q, k, v = jax.vmap(
+            lambda x_s, p_s: family.attn_in(l, x_s[None], p_s[None], cfg)
+        )(x, positions)
+        q, k, v = q[:, 0], k[:, 0], v[:, 0]  # (slots, H|Hkv, 1, d)
+        # scatter each slot's new k/v into its current block.  Inactive
+        # slots' tables point at trash block 0, so the unconditional write
+        # (and any duplicate trash indices) never touches live cache
+        blk = jnp.take_along_axis(
+            block_tables, (positions // block_size)[:, None], axis=1
+        )[:, 0]
+        off = positions % block_size
+        kp_l = kp_l.at[blk, :, off].set(k[:, :, 0, :].astype(kp_l.dtype))
+        vp_l = vp_l.at[blk, :, off].set(v[:, :, 0, :].astype(vp_l.dtype))
+
+        def attend_one(q_s, row, p_s):
+            # gather this slot's pages: table order IS logical order, so the
+            # flattened view is a virtually contiguous cache and the plain
+            # causal mask applies unchanged
+            kc = kp_l[row].transpose(1, 0, 2, 3).reshape(kp_l.shape[1], -1, kp_l.shape[3])
+            vc = vp_l[row].transpose(1, 0, 2, 3).reshape(vp_l.shape[1], -1, vp_l.shape[3])
+            return cached_attention(q_s[None], kc[None], vc[None], p_s[None], cfg)[0]
+
+        att = jax.vmap(attend_one)(q, block_tables, positions)  # (slots, H, 1, d)
+        x = jax.vmap(lambda x_s, a_s: family.attn_out(l, x_s[None], a_s[None], cfg)[0])(
+            x, att
+        )
+        return x, (kp_l, vp_l)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        decode_layer, x, ((plain_layers, q_layers, s_layers), k_pool, v_pool)
+    )
+    logits = family.finalize(g, x, cfg)  # (slots, V)
+    if temperature == 0.0:
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        rngs_out = rngs
+    else:
+        # per-slot streams: a request's sampled tokens depend only on its
+        # own key, never on which neighbours share the batch or finish
+        def sample_one(key_data, lg):
+            nk, sk = jax.random.split(key_data)
+            return nk, jax.random.categorical(sk, lg / temperature).astype(jnp.int32)
+
+        rngs_out, nxt = jax.vmap(sample_one)(rngs, logits)
+    return k_pool, v_pool, nxt, rngs_out
+
+
+class CompileWatcher:
+    """Recompile forensics for the module-level jitted serving entries.
+
+    The capture path's telemetry hooks live in ``CapturedStep``; the serving
+    programs are plain ``jax.jit`` functions, so the watcher reconstructs
+    the same signal from the jit cache: cache growth on a signature's FIRST
+    call is warmup, growth on a SEEN signature is a steady-state recompile —
+    counted, and emitted as a ``kind="serving"`` RecompileEvent through the
+    telemetry hub when one is attached.  ``recompile_events == 0`` after
+    warmup is the serving acceptance contract (ISSUE 7 / bench / smoke).
+    """
+
+    def __init__(self, hub=None):
+        self.hub = hub
+        self.compiles_total = 0
+        self.recompile_events = 0
+        self._seen: set = set()
+        self._calls = 0
+
+    def call(self, label: str, signature, jit_fn, *args, **kwargs):
+        self._calls += 1
+        seen = signature in self._seen
+        before = jit_fn._cache_size()
+        out = jit_fn(*args, **kwargs)
+        if jit_fn._cache_size() > before:
+            self.compiles_total += 1
+            if seen:
+                self.recompile_events += 1
+                if self.hub is not None:
+                    from ..telemetry import RecompileEvent, key_id
+
+                    self.hub.record_recompile(
+                        RecompileEvent(
+                            step=self._calls,
+                            key=key_id(signature),
+                            prev_key=key_id(signature),
+                            causes=[
+                                f"serving {label} compiled a new program for an "
+                                f"already-warm signature {signature!r} — the "
+                                "zero-recompile steady-state contract is broken"
+                            ],
+                            kind="serving",
+                        )
+                    )
+        self._seen.add(signature)
+        return out
+
+
+def run_prefill(k_pool, v_pool, g, layers, padded_ids, block_row, prompt_len,
+                rng, *, family, cfg, qbits, temperature, watcher: Optional[CompileWatcher] = None):
+    """One request's bucketed prefill; see ``_prefill_jit``.  ``padded_ids``
+    must already be bucket-padded (``kv_blocks.bucket_length``) — raw
+    request-length shapes here compile one program per distinct length
+    (graftlint: recompile-hazard serving contract)."""
+    args = (k_pool, v_pool, g, layers, padded_ids, block_row, prompt_len, rng)
+    statics = dict(family=family, cfg=cfg, qbits=qbits, temperature=temperature)
+    if watcher is None:
+        return _prefill_jit(*args, **statics)
+    sig = ("prefill", padded_ids.shape[1], qbits, float(temperature))
+    return watcher.call("prefill", sig, _prefill_jit, *args, **statics)
+
+
+def run_decode(k_pool, v_pool, g, layers, block_tables, positions, tokens,
+               rngs, *, family, cfg, qbits, temperature, watcher: Optional[CompileWatcher] = None):
+    """One token for the whole slot batch; see ``_decode_jit``."""
+    args = (k_pool, v_pool, g, layers, block_tables, positions, tokens, rngs)
+    statics = dict(family=family, cfg=cfg, qbits=qbits, temperature=temperature)
+    if watcher is None:
+        return _decode_jit(*args, **statics)
+    sig = ("decode", block_tables.shape, qbits, float(temperature))
+    return watcher.call("decode", sig, _decode_jit, *args, **statics)

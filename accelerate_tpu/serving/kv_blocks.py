@@ -1,0 +1,138 @@
+"""Block/paged KV cache for the serving engine (docs/serving.md).
+
+The single-request decode engine (models/generation.py) allocates one
+contiguous ``(b, n_kv, prompt+max_new, d)`` cache per call — the cache
+*shape* encodes the request geometry, so every distinct length compiles a
+fresh program and two requests can never share a batch.  Serving inverts
+that: the cache is ONE preallocated pool of fixed-size blocks
+
+    ``k_pool, v_pool : (L, num_blocks, n_kv_head, block_size, head_dim)``
+
+plus an int32 **block table** per batch slot mapping logical position
+``p`` to pool block ``table[slot, p // block_size]``.  Every shape the
+captured programs see (pool, tables, per-slot scalars) is fixed at service
+construction, so slots holding a 7-token and a 900-token sequence replay
+the SAME pinned program — the zero-recompile contract continuous batching
+needs (PAPERS.md #1: serving economics are batch occupancy + recompile
+avoidance).
+
+Block 0 is the **trash block**: it is never handed to a request, and empty
+slots' table rows point at it, so the decode program's unconditional
+scatter (writing every slot's current-token k/v) lands harmlessly for
+inactive slots instead of corrupting a neighbour's cache.  Allocation is
+host-side and O(blocks) — the pool itself never moves; only tables do.
+
+Blocks for a request are reserved up front at admission
+(``ceil(max(bucket_len, prompt_len + max_new) / block_size)``) and freed
+the step the request finishes, so a full pool back-pressures admission
+(requests wait in the queue) rather than failing mid-decode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+def bucket_length(n: int, multiple: int, cap: Optional[int] = None) -> int:
+    """Round ``n`` up to a multiple of ``multiple`` (optionally clamped to
+    ``cap``, never below ``n``) — the shape-bucketing helper every captured
+    serving/decode entry must sit behind (graftlint's recompile-hazard rule
+    checks the contract): feeding raw request-length shapes into a pinned
+    program compiles one variant per distinct length.  Delegates to the one
+    rounding implementation (``models.generation.bucket_up``) so serving
+    and one-shot ``generate()`` can never bucket differently."""
+    if n < 1:
+        raise ValueError(f"bucket_length({n}, {multiple}): n must be >= 1")
+    from ..models.generation import bucket_up
+
+    return bucket_up(n, multiple, cap)
+
+
+@dataclasses.dataclass
+class BlockPool:
+    """Host-side allocator over the device block pool.
+
+    ``num_blocks`` INCLUDES the reserved trash block 0; requests draw from
+    ids ``1..num_blocks-1``.  Per-slot allocations keep logical order —
+    ``rows[slot][j]`` covers logical positions ``[j*bs, (j+1)*bs)`` — so a
+    gathered table row reads back as a contiguous (virtually addressed)
+    cache and the causal mask stays the plain ``t <= q_pos`` formula.
+    """
+
+    num_blocks: int
+    block_size: int
+    max_slots: int
+    blocks_per_slot: int
+
+    def __post_init__(self):
+        if self.num_blocks < 2:
+            raise ValueError("BlockPool needs >= 2 blocks (block 0 is trash)")
+        self._free: list[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._rows: dict[int, list[int]] = {}
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1
+
+    def can_alloc(self, n_blocks: int) -> bool:
+        return n_blocks <= len(self._free)
+
+    def alloc(self, slot: int, n_blocks: int) -> list[int]:
+        """Reserve ``n_blocks`` for ``slot``; the returned ids are in logical
+        order.  Raises when the pool is short — the scheduler must gate
+        admission on :meth:`can_alloc` (back-pressure, not failure)."""
+        if slot in self._rows:
+            raise ValueError(f"slot {slot} already holds an allocation")
+        if n_blocks > self.blocks_per_slot:
+            raise ValueError(
+                f"request needs {n_blocks} blocks > blocks_per_slot "
+                f"({self.blocks_per_slot}) — raise max_request_len or block_size"
+            )
+        if not self.can_alloc(n_blocks):
+            raise ValueError(
+                f"pool exhausted: need {n_blocks}, free {len(self._free)}"
+            )
+        row = [self._free.pop() for _ in range(n_blocks)]
+        self._rows[slot] = row
+        return row
+
+    def free_slot(self, slot: int) -> int:
+        """Return ``slot``'s blocks to the free list (eviction/completion);
+        returns how many were freed.  Freed ids are immediately reusable —
+        stale pool contents are masked by the causal ``t <= q_pos`` until
+        the new owner overwrites them."""
+        row = self._rows.pop(slot, None)
+        if row is None:
+            return 0
+        self._free.extend(reversed(row))
+        return len(row)
+
+    def row(self, slot: int) -> list[int]:
+        return list(self._rows.get(slot, ()))
+
+    def check_no_leaks(self) -> None:
+        """Invariant: every non-trash block is exactly once free or owned."""
+        owned = [b for row in self._rows.values() for b in row]
+        seen = set(owned) | set(self._free)
+        if len(owned) + len(self._free) != self.usable_blocks or len(seen) != self.usable_blocks or 0 in seen:
+            raise AssertionError(
+                f"block accounting broken: {len(owned)} owned + "
+                f"{len(self._free)} free != {self.usable_blocks} usable"
+            )
+
+
+def make_pools(n_layers: int, num_blocks: int, n_kv_head: int,
+               block_size: int, head_dim: int, dtype):
+    """Zero-initialised device pools ``(L, NB, n_kv, bs, d)`` — zeros (not
+    empty) so never-written trash/stale positions stay finite: masked
+    attention multiplies their probs by exactly 0.0, and 0 * finite is 0
+    while 0 * inf would poison the row with NaN."""
+    import jax.numpy as jnp
+
+    shape = (n_layers, num_blocks, n_kv_head, block_size, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)

@@ -1,0 +1,445 @@
+"""Continuous-batching request scheduler over the paged decode engine.
+
+``DecodeService`` is the serving front end (docs/serving.md): construct it
+from any model exposing ``_decoder_spec()``, ``submit()`` requests with
+arbitrary prompt lengths and token budgets, and drive ``step()`` (or
+``run()``).  One ``step()`` is one engine iteration:
+
+1. **Admit** — pop the queue FIFO while a batch slot AND enough pool blocks
+   are free: bucket-pad the prompt (``kv_blocks.bucket_length``), reserve
+   the request's blocks up front, run the captured prefill (which writes
+   the prompt's k/v into the reserved blocks and samples the first token —
+   that token's latency is the request's TTFT).
+2. **Decode** — one captured call steps EVERY occupied slot one token.
+   Admission happens only at these step boundaries, so a joining prompt
+   never stalls streaming for in-flight sequences beyond one token.
+3. **Evict** — sequences that hit their token budget or per-request stop
+   token free their slot and blocks IMMEDIATELY (the freed slot is
+   re-admissible next step), instead of riding out the batch.
+
+The host side owns small int mirrors (block tables, positions, last
+tokens); the pools live on device and are donated through every call.
+Telemetry: when a hub is attached, every step emits a ``kind="serving"``
+occupancy record and every completion a per-request TTFT/TPOT record
+(docs/telemetry.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..logging import get_logger
+from .kv_blocks import BlockPool, bucket_length, make_pools
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Service geometry — every field is baked into the captured programs'
+    shapes at construction, which is the zero-recompile contract: nothing a
+    request carries (length, budget, arrival time) reaches a shape.
+
+    ``prompt_bucket`` must be a multiple of ``block_size`` so a bucketed
+    prefill writes whole blocks.  ``max_request_len`` caps prompt+new per
+    request (defaults to the model's positional capacity); ``num_blocks``
+    sizes the shared pool (default: full reservation — every slot can hold
+    a max-length request; set it lower to oversubscribe and exercise
+    queue back-pressure)."""
+
+    max_slots: int = 8
+    block_size: int = 16
+    prompt_bucket: int = 32
+    num_blocks: Optional[int] = None
+    max_request_len: Optional[int] = None
+    temperature: float = 0.0
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    quantize_weights: Optional[int] = None
+    rng_seed: int = 0
+    # retained completed Requests in service.results (oldest evicted past
+    # the bound): a long-running service must not grow host memory with its
+    # request history — streaming consumers take step()'s return value or
+    # pop_result() and the bound never bites
+    max_retained_results: int = 4096
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray  # (prompt_len,) int32
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    bucket_len: int
+    blocks_needed: int
+    state: str = "queued"  # queued -> running -> done
+    tokens: list = dataclasses.field(default_factory=list)
+    submitted_t: float = 0.0
+    first_token_t: Optional[float] = None
+    done_t: Optional[float] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def output_ids(self) -> np.ndarray:
+        """prompt + generated tokens (truncated at the stop token, which is
+        itself emitted — matching ``generate()``'s convention)."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)]
+        )
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return (self.first_token_t - self.submitted_t) * 1e3
+
+    @property
+    def tpot_ms(self) -> Optional[float]:
+        """Mean per-output-token latency after the first token."""
+        if self.done_t is None or self.first_token_t is None or len(self.tokens) < 2:
+            return None
+        return (self.done_t - self.first_token_t) / (len(self.tokens) - 1) * 1e3
+
+
+class DecodeService:
+    """Continuous-batching decode front end for one model (docs/serving.md).
+
+    Composes with everything the single-request engine composes with: the
+    stacked per-mode param cache is SHARED with ``generate()`` (alternating
+    serving and one-shot decode never restacks), int8/int4 weight modes ride
+    ``quantize_weights``, and params prepared through ``shard_for_inference``
+    keep their GSPMD layouts — pools and activations inherit them.
+    """
+
+    def __init__(self, model, config: Optional[ServingConfig] = None, telemetry=None):
+        from ..models.generation import stacked_params_for_mode
+
+        self.config = cfg = config or ServingConfig()
+        if cfg.block_size < 1 or cfg.max_slots < 1:
+            raise ValueError("block_size and max_slots must be >= 1")
+        if cfg.prompt_bucket % cfg.block_size:
+            raise ValueError(
+                f"prompt_bucket ({cfg.prompt_bucket}) must be a multiple of "
+                f"block_size ({cfg.block_size}) so bucketed prefills write "
+                "whole blocks"
+            )
+        if cfg.quantize_weights not in (None, 4, 8):
+            raise ValueError(
+                f"quantize_weights={cfg.quantize_weights!r}: use None, 8 or 4"
+            )
+        self.spec = spec = model._decoder_spec()
+        self._qbits = cfg.quantize_weights or 0
+        self._g, self._layers = stacked_params_for_mode(
+            model, self._qbits, spec.stack
+        )
+        cap = min(cfg.max_request_len or spec.max_len, spec.max_len)
+        self.capacity = (cap // cfg.block_size) * cfg.block_size
+        if self.capacity < cfg.prompt_bucket:
+            raise ValueError(
+                f"usable capacity ({self.capacity}) < prompt_bucket "
+                f"({cfg.prompt_bucket}): shrink the bucket or the block size"
+            )
+        blocks_per_slot = self.capacity // cfg.block_size
+        num_blocks = cfg.num_blocks or (cfg.max_slots * blocks_per_slot + 1)
+        self.pool = BlockPool(
+            num_blocks, cfg.block_size, cfg.max_slots, blocks_per_slot
+        )
+
+        import jax
+        import jax.numpy as jnp
+
+        from .engine import CompileWatcher
+
+        dcfg = spec.cfg
+        n_layers = next(iter(self._layers[0].values())).shape[0]
+        # activation dtype drives the pool dtype: one tiny eager embed
+        # (params may be bf16 under a mixed-precision prepare)
+        act_dtype = spec.family.embed(
+            self._g, jnp.zeros((1, 1), jnp.int32), jnp.zeros((1,), jnp.int32), dcfg
+        ).dtype
+        self._k_pool, self._v_pool = make_pools(
+            n_layers, num_blocks, dcfg.n_kv_head, cfg.block_size,
+            dcfg.head_dim, act_dtype,
+        )
+        # GSPMD-stable pools: when the params carry a NamedSharding (a
+        # prepared / shard_for_inference model), commit the pools replicated
+        # on the SAME mesh up front.  Fresh jnp.zeros are uncommitted
+        # single-device arrays, and the first captured call would return
+        # them re-committed onto the params' mesh — flipping the input
+        # sharding for call 2 of the same bucket and silently recompiling
+        # the one program the service exists to pin (caught by the
+        # CompileWatcher; regression-pinned in test_serving)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        param_sharding = next(
+            (
+                leaf.sharding
+                for leaf in jax.tree_util.tree_leaves((self._g, self._layers))
+                if isinstance(getattr(leaf, "sharding", None), NamedSharding)
+            ),
+            None,
+        )
+        if param_sharding is not None:
+            replicated = NamedSharding(param_sharding.mesh, PartitionSpec())
+            self._k_pool = jax.device_put(self._k_pool, replicated)
+            self._v_pool = jax.device_put(self._v_pool, replicated)
+        self._pool_sharding = (
+            replicated if param_sharding is not None else None
+        )
+        slots = cfg.max_slots
+        self._tables = np.zeros((slots, blocks_per_slot), np.int32)
+        self._positions = np.zeros(slots, np.int32)
+        self._tokens = np.full(slots, cfg.pad_token_id, np.int32)
+        self._slot_req: list[Optional[Request]] = [None] * slots
+        self._base_rng = jax.random.PRNGKey(cfg.rng_seed)
+        self._rngs = jnp.stack(
+            [jax.random.fold_in(self._base_rng, i) for i in range(slots)]
+        )
+        if self._pool_sharding is not None:
+            # same stability argument as the pools: the sampled-decode
+            # program returns the per-slot streams re-committed
+            self._rngs = jax.device_put(self._rngs, self._pool_sharding)
+        self._queue: deque[Request] = deque()
+        self._next_rid = 0
+        self.results: dict[int, Request] = {}
+        if telemetry is None:
+            from ..telemetry import current_telemetry
+
+            telemetry = current_telemetry()
+        self._hub = telemetry if (telemetry is not None and telemetry.enabled) else None
+        self.watcher = CompileWatcher(hub=self._hub)
+        self.stats = {
+            "steps": 0,
+            "admitted": 0,
+            "completed": 0,
+            "occupancy_sum": 0.0,
+            "queue_peak": 0,
+        }
+
+    # -- request intake ------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               eos_token_id: Optional[int] = None,
+               arrival_t: Optional[float] = None) -> int:
+        """Queue one request; returns its id.  Validation happens here so a
+        request that can NEVER be admitted fails loudly at submit time
+        instead of deadlocking the queue.
+
+        ``arrival_t`` (a ``time.perf_counter()`` timestamp) backdates the
+        TTFT clock to when the request actually ARRIVED rather than when
+        the driver got around to calling submit — an open-loop load
+        generator must pass it or its p99 TTFT silently excludes the
+        queueing delay it exists to measure (coordinated omission)."""
+        prompt = np.asarray(
+            prompt.data if hasattr(prompt, "data") else prompt, np.int32
+        ).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        p_len = int(prompt.size)
+        if p_len + max_new_tokens > self.capacity:
+            raise ValueError(
+                f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"the service's per-request capacity ({self.capacity})"
+            )
+        blen = bucket_length(p_len, self.config.prompt_bucket, cap=self.capacity)
+        needed = -(-max(blen, p_len + max_new_tokens) // self.config.block_size)
+        if needed > self.pool.usable_blocks:
+            raise ValueError(
+                f"request needs {needed} blocks but the pool only has "
+                f"{self.pool.usable_blocks}: raise num_blocks"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(
+            rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+            eos_token_id=(
+                eos_token_id if eos_token_id is not None
+                else self.config.eos_token_id
+            ),
+            bucket_len=blen, blocks_needed=needed,
+            submitted_t=arrival_t if arrival_t is not None else time.perf_counter(),
+        )
+        self._queue.append(req)
+        self.stats["queue_peak"] = max(self.stats["queue_peak"], len(self._queue))
+        return rid
+
+    # -- scheduling ----------------------------------------------------------
+    @property
+    def active_slots(self) -> int:
+        return sum(r is not None for r in self._slot_req)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue) or self.active_slots > 0
+
+    def _free_slot(self) -> Optional[int]:
+        for i, r in enumerate(self._slot_req):
+            if r is None:
+                return i
+        return None
+
+    def _admit(self) -> list[Request]:
+        """FIFO head-of-line admission: the oldest queued request is always
+        next (no shorter request overtakes it — predictable tail latency),
+        gated on a free slot AND its block reservation fitting the pool."""
+        import jax
+        import jax.numpy as jnp
+
+        from .engine import run_prefill
+
+        admitted = []
+        while self._queue:
+            req = self._queue[0]
+            slot = self._free_slot()
+            if slot is None or not self.pool.can_alloc(req.blocks_needed):
+                break
+            self._queue.popleft()
+            row = self.pool.alloc(slot, req.blocks_needed)
+            table_row = np.zeros(self.pool.blocks_per_slot, np.int32)
+            table_row[: len(row)] = row
+            padded_ids = np.full((1, req.bucket_len), self.config.pad_token_id, np.int32)
+            padded_ids[0, : req.prompt_len] = req.prompt
+            self._k_pool, self._v_pool, tok, rng_out = run_prefill(
+                self._k_pool, self._v_pool, self._g, self._layers,
+                jnp.asarray(padded_ids), jnp.asarray(table_row),
+                jnp.asarray(req.prompt_len, jnp.int32),
+                jax.random.fold_in(self._base_rng, 2 * req.rid + 1),
+                family=self.spec.family, cfg=self.spec.cfg,
+                qbits=self._qbits,
+                temperature=float(self.config.temperature),
+                watcher=self.watcher,
+            )
+            first = int(tok)
+            req.first_token_t = time.perf_counter()
+            req.tokens.append(first)
+            req.state = "running"
+            self.stats["admitted"] += 1
+            admitted.append(req)
+            if req.max_new_tokens == 1 or (
+                req.eos_token_id is not None and first == req.eos_token_id
+            ):
+                # one-token request (or instant stop): never occupies the
+                # decode batch — blocks go straight back
+                self.pool.free_slot(slot)
+                self._finish(req)
+                continue
+            self._slot_req[slot] = req
+            self._tables[slot] = table_row
+            self._positions[slot] = req.prompt_len
+            self._tokens[slot] = first
+            self._rngs = self._rngs.at[slot].set(rng_out)
+        return admitted
+
+    def _evict(self, slot: int) -> None:
+        """Free the slot the moment its request finishes: table back to the
+        trash block, blocks back to the pool — next step's admission can
+        hand them to a queued request."""
+        self.pool.free_slot(slot)
+        self._slot_req[slot] = None
+        self._tables[slot] = 0
+        self._positions[slot] = 0
+        self._tokens[slot] = self.config.pad_token_id
+
+    def pop_result(self, rid: int) -> Optional[Request]:
+        """Take (and drop) one finished request — the streaming-consumer
+        API; ``step()``'s return value is the push-style equivalent."""
+        return self.results.pop(rid, None)
+
+    def _finish(self, req: Request) -> None:
+        req.done_t = time.perf_counter()
+        req.state = "done"
+        self.results[req.rid] = req
+        while len(self.results) > self.config.max_retained_results:
+            self.results.pop(next(iter(self.results)))
+        self.stats["completed"] += 1
+        if self._hub is not None:
+            self._hub.record_serving({
+                "event": "complete", "rid": req.rid,
+                "prompt_len": req.prompt_len,
+                "new_tokens": len(req.tokens),
+                "ttft_ms": req.ttft_ms,
+                "tpot_ms": req.tpot_ms,
+            })
+
+    def step(self) -> list[Request]:
+        """One engine iteration (admit → decode one token → evict); returns
+        the requests that completed during it."""
+        import jax.numpy as jnp
+
+        from .engine import run_decode
+
+        admitted = self._admit()
+        completed = [r for r in admitted if r.state == "done"]
+        slot_evictions = 0
+        active = [i for i, r in enumerate(self._slot_req) if r is not None]
+        if active:
+            (self._k_pool, self._v_pool, nxt, self._rngs) = run_decode(
+                self._k_pool, self._v_pool, self._g, self._layers,
+                jnp.asarray(self._tables), jnp.asarray(self._positions),
+                jnp.asarray(self._tokens), self._rngs,
+                family=self.spec.family, cfg=self.spec.cfg,
+                qbits=self._qbits,
+                temperature=float(self.config.temperature),
+                watcher=self.watcher,
+            )
+            nxt_host = np.asarray(nxt)
+            for slot in active:
+                req = self._slot_req[slot]
+                tok = int(nxt_host[slot])
+                req.tokens.append(tok)
+                self._positions[slot] += 1
+                self._tokens[slot] = tok
+                if len(req.tokens) >= req.max_new_tokens or (
+                    req.eos_token_id is not None and tok == req.eos_token_id
+                ):
+                    self._evict(slot)
+                    self._finish(req)
+                    completed.append(req)
+                    slot_evictions += 1
+        self.stats["steps"] += 1
+        occupancy = len(active) / self.config.max_slots
+        self.stats["occupancy_sum"] += occupancy
+        if self._hub is not None:
+            self._hub.record_serving({
+                "event": "step", "step": self.stats["steps"],
+                "occupancy": occupancy, "active": len(active),
+                "queue_depth": len(self._queue),
+                "admitted": len(admitted),
+                # true slot evictions only — a one-token request completing
+                # inside _admit never held a decode slot and is visible in
+                # "completed", not here (slot-churn consumers cross-check
+                # evicted against occupancy)
+                "evicted": slot_evictions,
+                "completed": len(completed),
+            })
+        return completed
+
+    def run(self, max_steps: Optional[int] = None) -> dict[int, Request]:
+        """Drive ``step()`` until the queue and every slot drain (or
+        ``max_steps``); returns ``{rid: Request}`` for everything finished."""
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return dict(self.results)
+
+    # -- accounting ----------------------------------------------------------
+    @property
+    def mean_batch_occupancy(self) -> float:
+        return self.stats["occupancy_sum"] / max(1, self.stats["steps"])
+
+    @property
+    def recompile_events(self) -> int:
+        """Post-warmup program builds — 0 is the steady-state contract."""
+        return self.watcher.recompile_events

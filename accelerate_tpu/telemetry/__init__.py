@@ -87,6 +87,9 @@ class Telemetry:
         # resilience subsystem events (init/retry/rollback/preemption),
         # already kind-tagged dicts — see resilience/__init__.py
         self.resilience_events: deque[dict] = deque(maxlen=handler.max_events)
+        # serving subsystem events (per-step occupancy/queue depth, per-
+        # request TTFT/TPOT completions) — see serving/scheduler.py
+        self.serving_events: deque[dict] = deque(maxlen=handler.max_events)
         self.recompiles_total = 0
         self.steps_total = 0
         self._dataloader_wait_ms = 0.0
@@ -172,6 +175,18 @@ class Telemetry:
         if self._export_sink:
             self._export_queue.append(dict(record))
 
+    def record_serving(self, payload: dict) -> None:
+        """Serving event (step occupancy, request completion, admission
+        stall) from the decode service — kind-tagged ``"serving"`` into the
+        same retained history and export stream as the capture records."""
+        if not self.enabled:
+            return
+        record = dict(payload)
+        record["kind"] = "serving"
+        self.serving_events.append(record)
+        if self._export_sink:
+            self._export_queue.append(dict(record))
+
     def rekey_last_program(self, new_key: str) -> None:
         """Re-key the most recent program record (and its not-yet-drained
         export dict) — the capture path calls this when a first-call
@@ -207,7 +222,7 @@ class Telemetry:
             for record in self.all_records():
                 if record.get("kind") in (
                     "step", "recompile", "program", "collectives",
-                    "resources", "resilience",
+                    "resources", "resilience", "serving",
                 ):
                     self._export_queue.append(record)
 
@@ -241,6 +256,7 @@ class Telemetry:
         records += [c.to_dict() for c in self.collective_records]
         records += [s.to_dict() for s in self.resource_samples]
         records += [dict(e) for e in self.resilience_events]
+        records += [dict(e) for e in self.serving_events]
         records.append(self.summary())
         return records
 

@@ -519,6 +519,105 @@ def _compression_ab_block(on_accel: bool) -> dict:
     return out
 
 
+def _serving_block(on_accel: bool) -> dict:
+    """Serving rows for the primary JSON (docs/serving.md): the continuous-
+    batching decode service on the flagship GPT geometry under a synthetic
+    Poisson request trace — p50/p99 TTFT, p50/p99 per-token latency,
+    aggregate generated tokens/s, mean batch occupancy, and
+    ``serving_recompile_events`` (the zero-recompile steady-state contract,
+    counted by the engine's CompileWatcher forensics; must be 0 after
+    warmup).  ``BENCH_SERVING=0`` disables the block."""
+    import time as _time
+
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    from accelerate_tpu import Accelerator, DecodeService, ServingConfig
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(mixed_precision="bf16" if on_accel else "no")
+    cfg = GPTConfig.small() if on_accel else GPTConfig.tiny()
+    model = GPTLMHeadModel(cfg)
+    model = acc.prepare(model)
+    model.eval()
+
+    if on_accel:
+        n_requests, max_new, rate_per_s = 32, 64, 8.0
+        scfg = ServingConfig(max_slots=8, block_size=32, prompt_bucket=64)
+        prompt_lens = (24, 57, 128, 200, 96, 33, 160, 80)
+    else:
+        n_requests, max_new, rate_per_s = 8, 8, 200.0
+        scfg = ServingConfig(max_slots=4, block_size=16, prompt_bucket=16)
+        prompt_lens = (3, 9, 17, 30)
+    service = DecodeService(model, scfg, telemetry=acc.telemetry)
+
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_per_s, size=n_requests))
+    prompts = [
+        rng.integers(0, cfg.vocab_size, (prompt_lens[i % len(prompt_lens)],), dtype=np.int32)
+        for i in range(n_requests)
+    ]
+    # warmup: compile the decode program + every prefill bucket the trace
+    # uses BEFORE the clock starts, so the latency percentiles measure the
+    # steady state and the recompile counter's warmup set is primed
+    from accelerate_tpu.serving import bucket_length
+
+    buckets = sorted({bucket_length(len(p), scfg.prompt_bucket) for p in prompts})
+    warm_rids = {
+        service.submit(np.ones(blen, np.int32), max_new_tokens=2)
+        for blen in buckets
+    }
+    service.run()
+    warm_compiles = service.watcher.compiles_total
+    # occupancy statistics restart at the measured trace (the warmup
+    # requests ran near-solo and would dilute the mean)
+    service.stats.update(steps=0, occupancy_sum=0.0)
+
+    t0 = _time.perf_counter()
+    submitted = 0
+    while submitted < n_requests or service.has_work:
+        now = _time.perf_counter() - t0
+        while submitted < n_requests and arrivals[submitted] <= now:
+            # backdate the TTFT clock to the Poisson ARRIVAL: several
+            # arrivals can come due during one decode step, and starting
+            # their clocks at submit would exclude exactly the queueing
+            # tail the p99 row exists to expose (coordinated omission)
+            service.submit(
+                prompts[submitted], max_new_tokens=max_new,
+                arrival_t=t0 + arrivals[submitted],
+            )
+            submitted += 1
+        if service.has_work:
+            service.step()
+        elif submitted < n_requests:
+            _time.sleep(min(0.001, arrivals[submitted] - now))
+    dt = _time.perf_counter() - t0
+
+    reqs = [r for r in service.results.values() if r.rid not in warm_rids]
+    ttft = sorted(r.ttft_ms for r in reqs)
+    tpot = sorted(r.tpot_ms for r in reqs if r.tpot_ms is not None)
+
+    def pct(vals, q):
+        return round(vals[min(len(vals) - 1, int(q * len(vals)))], 2) if vals else None
+
+    total_tokens = sum(len(r.tokens) for r in reqs)
+    return {
+        "serving_requests": len(reqs),
+        "serving_ttft_p50_ms": pct(ttft, 0.50),
+        "serving_ttft_p99_ms": pct(ttft, 0.99),
+        "serving_tpot_p50_ms": pct(tpot, 0.50),
+        "serving_tpot_p99_ms": pct(tpot, 0.99),
+        "serving_tokens_per_sec": round(total_tokens / dt, 1),
+        "serving_mean_occupancy": round(service.mean_batch_occupancy, 3),
+        "serving_recompile_events": service.recompile_events,
+        "serving_warmup_compiles": warm_compiles,
+        "serving_max_slots": scfg.max_slots,
+        "serving_block_size": scfg.block_size,
+    }
+
+
 def _opt_inference_workload(on_accel: bool) -> dict:
     """BASELINE.json config 5: OPT device_map='auto'-style sharded inference
     (reference benchmarks/big_model_inference/README.md:31-37 form: load
@@ -848,6 +947,14 @@ def main() -> None:
             result.update(_compression_ab_block(on_accel))
         except Exception as exc:
             result["compression_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    if os.environ.get("BENCH_SERVING", "1") != "0":
+        # continuous-batching decode service under a Poisson trace
+        # (docs/serving.md): TTFT/TPOT percentiles, throughput, occupancy,
+        # and the zero-recompile steady-state assertion — fail-soft
+        try:
+            result.update(_serving_block(on_accel))
+        except Exception as exc:
+            result["serving_error"] = f"{type(exc).__name__}: {exc}"[:300]
     _PRIMARY_RESULT.update(result)
     # secondary BASELINE.md workloads, gated so the default driver run stays
     # inside its time budget (each adds a multi-minute cold compile)

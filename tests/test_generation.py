@@ -58,6 +58,75 @@ def test_sampled_decode_shapes_and_determinism(tiny_model):
     assert a.shape == (2, 9)
 
 
+def test_mixed_lengths_share_one_bucketed_program(tiny_model):
+    """The per-shape program explosion fix (ISSUE 7 satellite): nearby
+    prompt lengths and token budgets bucket to ONE compiled program —
+    forensics-counted via the jit cache across mixed geometries."""
+    from accelerate_tpu.models import generation as gen
+
+    gen._generate_jit.clear_cache()
+    rng = np.random.default_rng(1)
+    for p_len, n_new in ((5, 3), (7, 5), (9, 3), (16, 12), (30, 7)):
+        ids = rng.integers(0, 1024, size=(1, p_len), dtype=np.int32)
+        out = tiny_model.generate(ids, max_new_tokens=n_new)
+        assert out.shape == (1, p_len + n_new)
+    # every call bucketed to (32, 32): exactly one compile
+    assert gen._generate_jit._cache_size() == 1
+    # stop/pad ids are traced scalars: distinct values share one MORE
+    # program (the has_eos variant), not one per id
+    ids = rng.integers(0, 1024, size=(1, 6), dtype=np.int32)
+    tiny_model.generate(ids, max_new_tokens=4, eos_token_id=5)
+    tiny_model.generate(ids, max_new_tokens=4, eos_token_id=7, pad_token_id=1)
+    assert gen._generate_jit._cache_size() == 2
+
+
+def test_bucketed_matches_unbucketed_bitwise(tiny_model):
+    """Pad tokens are masked out of attention via q_pos, so the bucketed
+    program's outputs are identical to the exact-shape program's."""
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 1024, size=(2, 11), dtype=np.int32)
+    bucketed = tiny_model.generate(ids, max_new_tokens=5)
+    exact = tiny_model.generate(
+        ids, max_new_tokens=5, prompt_bucket=1, new_tokens_bucket=1
+    )
+    np.testing.assert_array_equal(np.asarray(bucketed), np.asarray(exact))
+    # sampled decode: the returned tokens' rng split sequence is unchanged
+    a = tiny_model.generate(
+        ids, max_new_tokens=5, temperature=1.0, rng=jax.random.PRNGKey(3)
+    )
+    b = tiny_model.generate(
+        ids, max_new_tokens=5, temperature=1.0, rng=jax.random.PRNGKey(3),
+        prompt_bucket=1, new_tokens_bucket=1,
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_eos_token_stops_per_sequence(tiny_model):
+    """Per-sequence stop (ISSUE 7 satellite): a row that sampled eos emits
+    pad from the next step on; rows that never hit it are BITWISE unchanged
+    from the eos-free program (rows are independent, rng sharing is
+    per-step not per-row)."""
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 1024, size=(3, 7), dtype=np.int32)
+    want = np.asarray(tiny_model.generate(ids, max_new_tokens=8))
+    # an eos that row 0 definitely hits (its 2nd generated token)
+    eos = int(want[0, 7 + 1])
+    got = np.asarray(
+        tiny_model.generate(ids, max_new_tokens=8, eos_token_id=eos, pad_token_id=0)
+    )
+    for row in range(3):
+        gen_want, gen_got = want[row, 7:], got[row, 7:]
+        hits = np.flatnonzero(gen_want == eos)
+        if hits.size == 0:
+            # unfinished row: bitwise identical to the eos-free decode
+            np.testing.assert_array_equal(gen_got, gen_want)
+        else:
+            stop = int(hits[0])
+            np.testing.assert_array_equal(gen_got[: stop + 1], gen_want[: stop + 1])
+            assert (gen_got[stop + 1:] == 0).all()
+    assert (want[0, 7:] == eos).any()  # the scenario actually exercised a stop
+
+
 def test_generate_rejects_overflow_and_moe():
     nn.manual_seed(0)
     model = GPTLMHeadModel(GPTConfig.tiny())

@@ -288,6 +288,51 @@ def test_shape_control_flow_is_trace_static(tmp_path):
     assert res.new_findings == [], [f.render() for f in res.new_findings]
 
 
+def test_serving_entry_raw_length_fires(tmp_path):
+    """Serving bucketing contract (docs/serving.md): a raw request-length
+    shape (`len(req.prompt)`-shaped arg) flowing into a captured serving
+    entry compiles one program per distinct length — recompile-hazard
+    fires when no bucket/pad evidence appears in the call."""
+    res = lint(
+        tmp_path,
+        """
+        import numpy as np
+        from accelerate_tpu.serving.engine import run_prefill
+
+        def serve(pools, g, layers, req):
+            ids = np.asarray(req.prompt, np.int32)[None]
+            return run_prefill(*pools, g, layers, ids, req.row,
+                               len(req.prompt), req.rng)
+        """,
+        rule="recompile-hazard",
+    )
+    assert len(res.new_findings) == 1
+    assert "bucket" in res.new_findings[0].message
+
+
+def test_serving_entry_bucketed_is_silent(tmp_path):
+    """The good twin: the ids ride through the bucketing helper (and a
+    pad-named intermediate) — the TRUE length may still flow raw, it is a
+    traced scalar, not a shape."""
+    res = lint(
+        tmp_path,
+        """
+        import numpy as np
+        from accelerate_tpu.serving import bucket_length
+        from accelerate_tpu.serving.engine import run_prefill
+
+        def serve(pools, g, layers, req):
+            bucket_len = bucket_length(len(req.prompt), 32)
+            padded_ids = np.zeros((1, bucket_len), np.int32)
+            padded_ids[0, : len(req.prompt)] = req.prompt
+            return run_prefill(*pools, g, layers, padded_ids, req.row,
+                               len(req.prompt), req.rng)
+        """,
+        rule="recompile-hazard",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
 def test_blocking_in_while_test_is_flagged(tmp_path):
     """A While test re-evaluates every iteration — a blocking call there is
     a per-step sync, same as in the body."""

@@ -1,0 +1,363 @@
+"""Decode service: continuous batching + paged KV cache (docs/serving.md).
+
+The acceptance contract (ISSUE 7): mixed-length concurrent requests through
+the service produce greedy tokens identical to single-request ``generate()``,
+with zero recompile events after warmup, FIFO admission, immediate eviction,
+and leak-free block accounting — all on the CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+from accelerate_tpu.serving import (
+    BlockPool,
+    DecodeService,
+    ServingConfig,
+    bucket_length,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    nn.manual_seed(0)
+    model = GPTLMHeadModel(GPTConfig.tiny())
+    model.eval()
+    return model
+
+
+def _prompts(lengths, vocab=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (n,), dtype=np.int32) for n in lengths]
+
+
+# ---------------------------------------------------------------------------
+# kv_blocks: allocator + bucketing
+# ---------------------------------------------------------------------------
+
+def test_bucket_length_rounds_up_and_clamps():
+    assert bucket_length(1, 16) == 16
+    assert bucket_length(16, 16) == 16
+    assert bucket_length(17, 16) == 32
+    assert bucket_length(60, 16, cap=64) == 64
+    # never below n, even past the cap
+    assert bucket_length(70, 16, cap=64) == 70
+    with pytest.raises(ValueError):
+        bucket_length(0, 16)
+
+
+def test_block_pool_alloc_free_no_leaks():
+    pool = BlockPool(num_blocks=9, block_size=4, max_slots=2, blocks_per_slot=4)
+    assert pool.usable_blocks == 8
+    a = pool.alloc(0, 3)
+    b = pool.alloc(1, 4)
+    assert len(set(a) | set(b)) == 7 and 0 not in a + b
+    assert pool.free_blocks == 1
+    assert not pool.can_alloc(2)
+    pool.check_no_leaks()
+    assert pool.free_slot(0) == 3
+    assert pool.free_blocks == 4
+    # freed blocks are reusable; double-free is a no-op
+    assert pool.free_slot(0) == 0
+    c = pool.alloc(0, 4)
+    assert 0 not in c
+    pool.check_no_leaks()
+    pool.free_slot(0)
+    pool.free_slot(1)
+    assert pool.free_blocks == pool.usable_blocks
+    pool.check_no_leaks()
+
+
+def test_block_pool_rejects_oversized_and_double_alloc():
+    pool = BlockPool(num_blocks=9, block_size=4, max_slots=2, blocks_per_slot=4)
+    with pytest.raises(ValueError, match="blocks_per_slot"):
+        pool.alloc(0, 5)
+    pool.alloc(0, 2)
+    with pytest.raises(ValueError, match="already holds"):
+        pool.alloc(0, 1)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance contract: continuous batching == single-request generate()
+# ---------------------------------------------------------------------------
+
+def test_continuous_batch_matches_single_request_generate(tiny_model):
+    """8 concurrent mixed-length requests with staggered arrivals: every
+    request's greedy tokens are identical to a lone generate() of the same
+    prompt, and the steady state is zero recompiles (ISSUE 7 acceptance)."""
+    service = DecodeService(
+        tiny_model, ServingConfig(max_slots=4, block_size=16, prompt_bucket=16)
+    )
+    lengths = [3, 9, 17, 30, 5, 24, 12, 40]
+    budgets = [6, 4, 8, 3, 7, 5, 6, 4]
+    prompts = _prompts(lengths)
+    # stagger arrivals: two submissions per step while earlier requests are
+    # mid-decode — sequences genuinely join an in-flight batch
+    rids, pending = [], list(zip(prompts, budgets))
+    while pending or service.has_work:
+        for _ in range(2):
+            if pending:
+                p, b = pending.pop(0)
+                rids.append(service.submit(p, max_new_tokens=b))
+        service.step()
+    for rid, p, b in zip(rids, prompts, budgets):
+        want = np.asarray(tiny_model.generate(p[None], max_new_tokens=b))[0]
+        got = service.results[rid].output_ids
+        np.testing.assert_array_equal(got, want, err_msg=f"request {rid}")
+    # eviction returned every block
+    service.pool.check_no_leaks()
+    assert service.pool.free_blocks == service.pool.usable_blocks
+
+
+def test_zero_recompiles_in_steady_state(tiny_model):
+    """After one decode build + one prefill build per prompt bucket, every
+    further call replays — the CompileWatcher forensics count stays 0."""
+    from accelerate_tpu.serving import engine
+
+    engine._prefill_jit.clear_cache()
+    engine._decode_jit.clear_cache()
+    service = DecodeService(
+        tiny_model, ServingConfig(max_slots=4, block_size=16, prompt_bucket=16)
+    )
+    # warmup: both buckets + the decode program
+    for n in (4, 20):
+        service.submit(np.ones(n, np.int32), max_new_tokens=3)
+    service.run()
+    warm = service.watcher.compiles_total
+    assert warm >= 3  # 2 prefill buckets + 1 decode program
+    # a second wave over the same buckets, different lengths/budgets
+    for p, b in zip(_prompts([5, 9, 17, 31, 2, 26], seed=1), [4, 2, 5, 3, 6, 2]):
+        service.submit(p, max_new_tokens=b)
+    service.run()
+    assert service.watcher.compiles_total == warm
+    assert service.recompile_events == 0
+
+
+def test_zero_recompiles_with_prepared_model():
+    """Regression: a PREPARED model's params carry a NamedSharding, and the
+    first captured call used to return the (uncommitted, single-device)
+    pools re-committed onto that mesh — flipping the input sharding and
+    silently recompiling every program on its second call.  The service now
+    commits pools/rng streams replicated on the params' mesh up front."""
+    from accelerate_tpu import Accelerator
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator()
+    model = acc.prepare(GPTLMHeadModel(GPTConfig.tiny()))
+    model.eval()
+    service = DecodeService(
+        model, ServingConfig(max_slots=4, block_size=16, prompt_bucket=16)
+    )
+    for n in (4, 20):
+        service.submit(np.ones(n, np.int32), max_new_tokens=3)
+    service.run()
+    warm = service.watcher.compiles_total
+    for p, b in zip(_prompts([5, 17, 9, 30], seed=9), [4, 6, 3, 5]):
+        service.submit(p, max_new_tokens=b)
+    service.run()
+    assert service.watcher.compiles_total == warm
+    assert service.recompile_events == 0
+
+
+def test_admission_fifo_and_immediate_eviction(tiny_model):
+    """Admission is FIFO; a finished sequence frees its slot immediately and
+    the next queued request takes it while others are still mid-decode."""
+    service = DecodeService(
+        tiny_model, ServingConfig(max_slots=2, block_size=16, prompt_bucket=16)
+    )
+    prompts = _prompts([4, 5, 6, 7], seed=2)
+    # r0 finishes after 3 tokens, r1 is long; r2/r3 wait in the queue
+    r0 = service.submit(prompts[0], max_new_tokens=3)
+    r1 = service.submit(prompts[1], max_new_tokens=12)
+    r2 = service.submit(prompts[2], max_new_tokens=3)
+    r3 = service.submit(prompts[3], max_new_tokens=3)
+    service.step()  # admits r0 + r1 (FIFO), decodes one token
+    assert [r.rid for r in service._slot_req if r is not None] == [r0, r1]
+    assert [r.rid for r in service._queue] == [r2, r3]
+    done = service.step()  # r0 hits its budget -> evicted this step
+    assert [r.rid for r in done] == [r0]
+    service.step()  # r2 takes r0's slot NEXT step, r1 still running
+    assert r2 in [r.rid for r in service._slot_req if r is not None]
+    assert service.results.keys() >= {r0}
+    service.run()
+    # completion order respects arrival for equal budgets: r2 before r3
+    assert list(service.results) == sorted(
+        service.results, key=lambda rid: service.results[rid].done_t
+    )
+    assert service.results[r2].done_t < service.results[r3].done_t
+    assert (r1 in service.results) and (r3 in service.results)
+    service.pool.check_no_leaks()
+
+
+def test_queue_backpressure_on_block_exhaustion(tiny_model):
+    """An undersized pool gates admission (requests wait) instead of
+    failing: with blocks for ~one max request, the service degrades to
+    near-serial but still completes everything."""
+    service = DecodeService(
+        tiny_model,
+        ServingConfig(
+            max_slots=4, block_size=16, prompt_bucket=16, num_blocks=5
+        ),
+    )
+    prompts = _prompts([17, 20, 25], seed=3)
+    rids = [service.submit(p, max_new_tokens=4) for p in prompts]
+    service.step()
+    # only the head fit (needs 2 blocks of the 4 usable... the second also
+    # fits; the third waits)
+    assert service.active_slots <= 2 and len(service._queue) >= 1
+    service.run()
+    for rid, p in zip(rids, prompts):
+        want = np.asarray(tiny_model.generate(p[None], max_new_tokens=4))[0]
+        np.testing.assert_array_equal(service.results[rid].output_ids, want)
+    service.pool.check_no_leaks()
+
+
+def test_submit_validation(tiny_model):
+    service = DecodeService(
+        tiny_model, ServingConfig(max_slots=2, block_size=16, prompt_bucket=16)
+    )
+    with pytest.raises(ValueError, match="capacity"):
+        service.submit(np.ones(250, np.int32), max_new_tokens=20)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        service.submit(np.ones(4, np.int32), max_new_tokens=0)
+    with pytest.raises(ValueError, match="empty"):
+        service.submit(np.zeros(0, np.int32), max_new_tokens=2)
+    with pytest.raises(ValueError, match="multiple"):
+        DecodeService(
+            tiny_model, ServingConfig(block_size=16, prompt_bucket=24)
+        )
+
+
+def test_per_request_stop_token(tiny_model):
+    """A request with eos stops the step its sampled token hits it (the eos
+    itself is emitted, matching generate()); others run to budget."""
+    prompts = _prompts([6, 8], seed=4)
+    # the greedy continuation's 3rd token plays the "eos"; it may repeat
+    # earlier in the stream, so the expected stop is its FIRST occurrence
+    p_len = len(prompts[0])
+    ref = np.asarray(tiny_model.generate(prompts[0][None], max_new_tokens=8))[0]
+    eos = int(ref[p_len + 2])
+    first_hit = int(np.argmax(ref[p_len:] == eos))
+    service = DecodeService(
+        tiny_model, ServingConfig(max_slots=2, block_size=16, prompt_bucket=16)
+    )
+    r0 = service.submit(prompts[0], max_new_tokens=8, eos_token_id=eos)
+    r1 = service.submit(prompts[1], max_new_tokens=8)
+    service.run()
+    got = service.results[r0].output_ids
+    # stopped at the stop token, which is itself emitted
+    assert got.shape[0] == p_len + first_hit + 1 and got[-1] == eos
+    np.testing.assert_array_equal(got, ref[: len(got)])
+    want1 = np.asarray(tiny_model.generate(prompts[1][None], max_new_tokens=8))[0]
+    np.testing.assert_array_equal(service.results[r1].output_ids, want1)
+    service.pool.check_no_leaks()
+
+
+def test_quantized_mode_composes(tiny_model):
+    """int8 weight mode rides the SAME stacked-param cache as generate():
+    serving outputs match quantized single-request decode token for token."""
+    service = DecodeService(
+        tiny_model,
+        ServingConfig(
+            max_slots=4, block_size=16, prompt_bucket=16, quantize_weights=8
+        ),
+    )
+    prompts = _prompts([5, 11, 19], seed=5)
+    rids = [service.submit(p, max_new_tokens=5) for p in prompts]
+    service.run()
+    for rid, p in zip(rids, prompts):
+        want = np.asarray(
+            tiny_model.generate(p[None], max_new_tokens=5, quantize_weights=8)
+        )[0]
+        np.testing.assert_array_equal(service.results[rid].output_ids, want)
+    # both modes live side by side in the per-model stack cache
+    assert set(tiny_model._generation_param_cache[1]) >= {8}
+
+
+def test_serving_telemetry_records(tiny_model):
+    """With a hub attached, every step emits a kind='serving' occupancy
+    record and every completion a TTFT/TPOT record; the JSONL dump carries
+    them (docs/telemetry.md schema)."""
+    from accelerate_tpu.telemetry import Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryKwargs
+
+    hub = Telemetry(TelemetryKwargs(enabled=True))
+    service = DecodeService(
+        tiny_model,
+        ServingConfig(max_slots=2, block_size=16, prompt_bucket=16),
+        telemetry=hub,
+    )
+    rids = [service.submit(p, max_new_tokens=3) for p in _prompts([4, 7, 9], seed=6)]
+    service.run()
+    records = [r for r in hub.all_records() if r.get("kind") == "serving"]
+    steps = [r for r in records if r["event"] == "step"]
+    completes = [r for r in records if r["event"] == "complete"]
+    assert steps and all(
+        0.0 <= r["occupancy"] <= 1.0 and "queue_depth" in r for r in steps
+    )
+    assert {r["rid"] for r in completes} == set(rids)
+    assert all(r["ttft_ms"] is not None and r["ttft_ms"] >= 0 for r in completes)
+    # multi-token requests report a per-token latency
+    assert all(r["tpot_ms"] is not None for r in completes if r["new_tokens"] > 1)
+    # occupancy statistic matches the recorded stream
+    assert service.mean_batch_occupancy == pytest.approx(
+        sum(r["occupancy"] for r in steps) / len(steps)
+    )
+
+
+def test_one_token_request_completes_at_admission(tiny_model):
+    """max_new_tokens=1 finishes inside _admit (prefill samples the only
+    token) and never occupies a decode slot."""
+    service = DecodeService(
+        tiny_model, ServingConfig(max_slots=2, block_size=16, prompt_bucket=16)
+    )
+    p = _prompts([6], seed=7)[0]
+    rid = service.submit(p, max_new_tokens=1)
+    done = service.step()
+    assert [r.rid for r in done] == [rid]
+    assert service.active_slots == 0
+    want = np.asarray(tiny_model.generate(p[None], max_new_tokens=1))[0]
+    np.testing.assert_array_equal(service.results[rid].output_ids, want)
+    service.pool.check_no_leaks()
+
+
+def test_result_retention_is_bounded(tiny_model):
+    """A long-running service must not grow host memory with its request
+    history: results retains the newest max_retained_results, and
+    pop_result is the streaming-consumer take-and-drop API."""
+    service = DecodeService(
+        tiny_model,
+        ServingConfig(
+            max_slots=2, block_size=16, prompt_bucket=16,
+            max_retained_results=2,
+        ),
+    )
+    rids = [service.submit(p, max_new_tokens=2) for p in _prompts([4, 5, 6, 7], seed=10)]
+    service.run()
+    assert list(service.results) == rids[-2:]  # oldest two evicted
+    taken = service.pop_result(rids[-1])
+    assert taken is not None and taken.rid == rids[-1]
+    assert service.pop_result(rids[-1]) is None
+    assert service.pop_result(rids[0]) is None
+
+
+def test_sampled_serving_is_slot_independent(tiny_model):
+    """Per-slot RNG streams: a request's sampled tokens don't depend on
+    which neighbours share the batch (solo run == batched run, same rid)."""
+    def run(lengths, budgets, seed_rid_of_interest):
+        service = DecodeService(
+            tiny_model,
+            ServingConfig(
+                max_slots=4, block_size=16, prompt_bucket=16, temperature=1.0
+            ),
+        )
+        prompts = _prompts(lengths, seed=8)
+        rids = [service.submit(p, max_new_tokens=b) for p, b in zip(prompts, budgets)]
+        service.run()
+        return service.results[rids[seed_rid_of_interest]].output_ids
+
+    solo = run([9], [5], 0)
+    crowded = run([9, 4, 17, 30], [5, 6, 4, 3], 0)
+    np.testing.assert_array_equal(solo, crowded)

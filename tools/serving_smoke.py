@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""serving_smoke — `make serve-smoke`: prove the decode service end-to-end
+on CPU in seconds (docs/serving.md, ISSUE 7 acceptance).
+
+Tiny GPT, 8 concurrent requests with mixed prompt lengths and staggered
+arrivals through the continuous-batching service.  Exit 0 requires:
+
+* every request completes, and its greedy tokens are IDENTICAL to a
+  single-request ``generate()`` of the same prompt (the parity contract —
+  one attention implementation, true positions, same mask);
+* ZERO recompile events after warmup (CompileWatcher forensics: one decode
+  program + one prefill program per prompt bucket, then pure replays);
+* the block pool drains with no leaked blocks;
+* telemetry (on for the run) retained ``kind="serving"`` step records with
+  occupancy and per-request completion records with TTFT/TPOT.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    from accelerate_tpu import DecodeService, ServingConfig
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+    from accelerate_tpu.telemetry import Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryKwargs
+
+    nn.manual_seed(0)
+    model = GPTLMHeadModel(GPTConfig.tiny())
+    model.eval()
+    hub = Telemetry(TelemetryKwargs(enabled=True))
+    service = DecodeService(
+        model,
+        ServingConfig(max_slots=4, block_size=16, prompt_bucket=16),
+        telemetry=hub,
+    )
+
+    rng = np.random.default_rng(0)
+    lengths = [3, 9, 17, 30, 5, 24, 12, 40]
+    budgets = [6, 4, 8, 3, 7, 5, 6, 4]
+    prompts = [
+        rng.integers(0, model.config.vocab_size, (n,), dtype=np.int32)
+        for n in lengths
+    ]
+
+    # warmup: one request per prefill bucket + the decode program
+    from accelerate_tpu.serving import bucket_length
+
+    buckets = sorted({bucket_length(n, 16) for n in lengths})
+    for b in buckets:
+        service.submit(np.ones(b, np.int32), max_new_tokens=2)
+    service.run()
+    warm_compiles = service.watcher.compiles_total
+
+    # staggered arrivals: a few requests join per step while earlier ones
+    # are mid-decode — the continuous-batching path, not a static batch
+    rids = []
+    pending = list(zip(prompts, budgets))
+    while pending or service.has_work:
+        for _ in range(2):
+            if pending:
+                p, b = pending.pop(0)
+                rids.append(service.submit(p, max_new_tokens=b))
+        service.step()
+
+    failures = []
+    if service.recompile_events != 0:
+        failures.append(
+            f"{service.recompile_events} recompile event(s) after warmup "
+            f"(warmup compiled {warm_compiles})"
+        )
+    for rid, p, b in zip(rids, prompts, budgets):
+        want = np.asarray(model.generate(p[None], max_new_tokens=b))[0]
+        got = service.results[rid].output_ids
+        if not np.array_equal(got, want):
+            failures.append(f"request {rid}: tokens diverge from generate()")
+    try:
+        service.pool.check_no_leaks()
+        if service.pool.free_blocks != service.pool.usable_blocks:
+            failures.append("pool did not drain: blocks still reserved")
+    except AssertionError as exc:
+        failures.append(str(exc))
+    records = [r for r in hub.all_records() if r.get("kind") == "serving"]
+    steps = [r for r in records if r.get("event") == "step"]
+    completes = [r for r in records if r.get("event") == "complete"]
+    if not steps or any("occupancy" not in r for r in steps):
+        failures.append("no kind='serving' step records with occupancy")
+    if len(completes) < len(rids) or any(
+        r.get("ttft_ms") is None for r in completes
+    ):
+        failures.append("missing kind='serving' completion records with TTFT")
+
+    n_done = len([r for r in rids if r in service.results])
+    print(
+        f"serving_smoke: {n_done}/{len(rids)} requests, "
+        f"{service.stats['steps']} steps, mean occupancy "
+        f"{service.mean_batch_occupancy:.2f}, {warm_compiles} warmup "
+        f"compiles, {service.recompile_events} steady-state recompiles"
+    )
+    for failure in failures:
+        print(f"serving_smoke: FAIL: {failure}", file=sys.stderr)
+    print(f"serving_smoke: {'FAILED' if failures else 'ok'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
