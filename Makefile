@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke bench
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke bench
 
 # graftlint: whole-program trace-safety & collective-correctness static
 # analysis (docs/graftlint.md). Runs before the suite. The on-disk cache
@@ -49,7 +49,15 @@ resilience-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serving_smoke.py
 
-test: lint multichip telemetry-smoke resilience-smoke serve-smoke
+# device-time proof (docs/telemetry.md): tiny GPT, 3 steps with every call
+# profiled (profile_every_n=1) — asserts a nonempty per-device busy/idle +
+# compute/collective split covering >= 80% of each replay's wall clock,
+# a valid Prometheus scrape from the live metrics endpoint, and zero
+# recompiles introduced by the profiling itself
+profile-smoke:
+	JAX_PLATFORMS=cpu python tools/profile_smoke.py
+
+test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke
 	python -m pytest tests/ -q
 
 test_core:
@@ -61,7 +69,8 @@ test_core:
 	  tests/test_fp16_capture.py tests/test_autocast.py \
 	  tests/test_comm_hook.py tests/test_powersgd.py \
 	  tests/test_config_knobs.py \
-	  tests/test_tracking.py tests/test_telemetry.py tests/test_utils_misc.py \
+	  tests/test_tracking.py tests/test_telemetry.py tests/test_device_time.py \
+	  tests/test_utils_misc.py \
 	  tests/test_deepspeed_compat.py tests/test_param_offload.py -q
 
 test_models:

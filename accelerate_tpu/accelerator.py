@@ -1345,6 +1345,13 @@ class Accelerator:
     def end_training(self) -> None:
         self.wait_for_checkpoint()  # an in-flight async save must land
         self.resilience.close()  # restore default signal handling
+        if self.telemetry.enabled and self.num_processes > 1:
+            # fleet merge BEFORE any tracker finishes: the gather is
+            # collective (every rank participates), and the main rank's
+            # JSONL dump below — whether written here or by the bridge's
+            # finish() — must already hold the rank-tagged records plus the
+            # kind="fleet" skew record (docs/telemetry.md §aggregation)
+            self.telemetry.aggregate_fleet()
         for tracker in self.trackers:
             tracker.finish()
         if self.telemetry.enabled and not any(
@@ -1353,6 +1360,7 @@ class Accelerator:
             # no-op unless a JSONL dump path was configured; the tracker
             # bridge, when present, already wrote it in finish()
             self.telemetry.write_jsonl()
+        self.telemetry.close_metrics()  # stop serving /metrics for this run
         self.wait_for_everyone()
 
     # --------------------------------------------------------------- contexts

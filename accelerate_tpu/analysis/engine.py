@@ -33,7 +33,10 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 # unchanged source.  v3: dtype-widen gained the quantized-payload check.
 # v4: recompile-hazard gained the serving bucketing contract (raw request
 # lengths into run_prefill/run_decode).
-ANALYSIS_VERSION = "4"
+# v5: blocking-in-hot-loop gained the profiler-session check
+# (jax.profiler start/stop_trace in a loop without sampled-cadence
+# evidence; a profiling-knob guard alone no longer exempts those calls).
+ANALYSIS_VERSION = "5"
 
 # Names that mark a branch/function as profiling/benchmark plumbing, where a
 # deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the

@@ -12,16 +12,52 @@ whole-program blocking closure (``program.ProgramGraph``): a loop body
 calling ``utils.sync_all(x)`` where ``sync_all`` — in another module —
 unconditionally hits ``block_until_ready`` is the same per-step sync, and
 is flagged with the chain that proves it.
+
+**Profiler-session extension**: ``jax.profiler.start_trace``/``stop_trace``
+inside a step loop is *worse* than a bare sync — each iteration opens a
+global trace session, blocks the pipeline, and writes a dump to disk.  A
+plain profiling-knob guard (``if profiling:``) does NOT exempt it: the knob
+turns every-step tracing on, which is exactly the hazard.  What exempts it
+is **sampled-cadence evidence** in a guarding condition — a modulus test
+(``step % profile_every_n == 0``) or a cadence-named predicate
+(``should_sample``/``every_n``/...) — the pattern the telemetry profiler's
+``profile_every_n`` knob implements (docs/telemetry.md).
 """
 
 from __future__ import annotations
 
 import ast
+import re
 
 from ..callgraph import dotted_name
 from ..engine import Finding, GUARD_NAME_RE, Rule, is_guard_expr
 
 _BLOCKING_LEAVES = {"block_until_ready", "effects_barrier"}
+
+# per-iteration trace sessions: flagged in loops unless a guarding
+# condition carries sampled-cadence evidence (a knob guard alone is not it)
+_PROFILER_SESSION_LEAVES = {"start_trace", "stop_trace"}
+
+_CADENCE_NAME_RE = re.compile(
+    r"every_n|_every\b|every_|sampl|cadence|interval",
+    re.IGNORECASE,
+)
+
+
+def is_cadence_expr(test: ast.AST) -> bool:
+    """True when a guard condition shows sampled-cadence evidence: a
+    modulus test (``i % n == 0``) or a cadence-named knob/predicate."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+            return True
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and _CADENCE_NAME_RE.search(name):
+            return True
+    return False
 
 
 class _LoopVisitor(ast.NodeVisitor):
@@ -32,6 +68,7 @@ class _LoopVisitor(ast.NodeVisitor):
         self.blocking_callables = blocking_callables  # visible name -> chain
         self.loop_depth = 0
         self.guard_depth = 0
+        self.cadence_depth = 0
         self.findings: list[Finding] = []
 
     def visit_For(self, node):
@@ -58,14 +95,42 @@ class _LoopVisitor(ast.NodeVisitor):
     def visit_If(self, node):
         self.visit(node.test)
         guarded = is_guard_expr(node.test)
+        cadenced = is_cadence_expr(node.test)
         self.guard_depth += guarded
+        self.cadence_depth += cadenced
         for stmt in node.body:
             self.visit(stmt)
         self.guard_depth -= guarded
+        self.cadence_depth -= cadenced
         for stmt in node.orelse:
             self.visit(stmt)
 
     def visit_Call(self, node):
+        if self.loop_depth > 0 and self.cadence_depth == 0:
+            # profiler sessions first: a profiling-knob guard (which exempts
+            # plain syncs below) deliberately does NOT exempt these — an
+            # `if profiling:` knob is what turns the every-step session ON
+            fn = node.func
+            leaf_attr = fn.attr if isinstance(fn, ast.Attribute) else None
+            resolved_name = self.module.resolve(fn) or ""
+            resolved_leaf = resolved_name.rsplit(".", 1)[-1]
+            if (
+                leaf_attr in _PROFILER_SESSION_LEAVES
+                or resolved_leaf in _PROFILER_SESSION_LEAVES
+            ):
+                self.findings.append(
+                    Finding(
+                        self.rule.id,
+                        self.module.rel_path,
+                        node.lineno,
+                        node.col_offset,
+                        f"{leaf_attr or resolved_leaf}() inside a loop opens a "
+                        "profiler trace session every iteration — sample it "
+                        "(e.g. `if step % profile_every_n == 0:`) so only the "
+                        "sampled step pays the sync+dump",
+                        symbol=self.fn_qual,
+                    )
+                )
         if self.loop_depth > 0 and self.guard_depth == 0:
             fn = node.func
             resolved = self.module.resolve(fn) or ""
@@ -117,7 +182,9 @@ class BlockingInHotLoop(Rule):
     id = "blocking-in-hot-loop"
     description = (
         "block_until_ready/effects_barrier inside a step loop outside a "
-        "profiling guard (direct, or through a helper in any module)"
+        "profiling guard (direct, or through a helper in any module); "
+        "jax.profiler start/stop_trace inside a loop without sampled-"
+        "cadence evidence"
     )
     kind = "reachability"
 

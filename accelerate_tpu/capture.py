@@ -261,6 +261,14 @@ class CapturedStep:
         t_call = _time.perf_counter()
         tel = self._telemetry
         dl_wait_ms = tel.pop_dataloader_wait_ms() if tel is not None else 0.0
+        # sampled device-time attribution (docs/telemetry.md): every Nth
+        # step the dispatch below runs inside a jax.profiler trace session
+        # and blocks afterwards so this step's device ops land in the
+        # window.  prof_step < 0 on every unsampled call — the hot path
+        # pays one None-check + one modulus; with the knob off (the
+        # default) the profiler is None and nothing below changes.
+        prof = tel.profiler if tel is not None else None
+        prof_step = -1
         acc = self.accelerator
         if self._uses_accumulate:
             # body contains `with accelerator.accumulate(...)`: advance the
@@ -322,31 +330,59 @@ class CapturedStep:
             # counts this dispatch on the fault plan's step axis and delivers
             # any scheduled (injected) SIGTERM — "mid-step" preemption
             res.begin_dispatch()
-        if tel is not None:
-            t_dispatch = _time.perf_counter()
-            if retrier is None:
-                new_state, out, entry, retry_rebuild = self._dispatch_aot(
-                    tel, key, entry, state, args, dev_leaves, host_leaves, flat_args
-                )
-            else:
-                new_state, out, entry, retry_rebuild = retrier.run_dispatch(
+        if prof is not None and prof.should_sample(tel.steps_total):
+            # the session brackets the dispatch (launch + device execution):
+            # builds already happened above, so a trace/compile failure can
+            # never orphan a session.  The measured window is backdated to
+            # call entry — device idle while the host assembled/built is
+            # real idle, and busy+idle must account for the step wall clock
+            if prof.start(tel.steps_total, t0=t_call):
+                prof_step = tel.steps_total
+        try:
+            if tel is not None:
+                t_dispatch = _time.perf_counter()
+                if retrier is None:
+                    new_state, out, entry, retry_rebuild = self._dispatch_aot(
+                        tel, key, entry, state, args, dev_leaves, host_leaves, flat_args
+                    )
+                else:
+                    new_state, out, entry, retry_rebuild = retrier.run_dispatch(
+                        self,
+                        lambda dev, host, e: self._dispatch_aot(
+                            tel, key, e, state, args, dev, host, flat_args
+                        ),
+                        entry, dev_leaves, host_leaves, host_mask,
+                    )
+                if retry_rebuild:
+                    built = True
+                    jitted, ctx, _, host_mask = entry
+            elif retrier is not None:
+                new_state, out, _, _ = retrier.run_dispatch(
                     self,
-                    lambda dev, host, e: self._dispatch_aot(
-                        tel, key, e, state, args, dev, host, flat_args
-                    ),
+                    lambda dev, host, e: (*e[0](dev, host, *flat_args), e, False),
                     entry, dev_leaves, host_leaves, host_mask,
                 )
-            if retry_rebuild:
-                built = True
-                jitted, ctx, _, host_mask = entry
-        elif retrier is not None:
-            new_state, out, _, _ = retrier.run_dispatch(
-                self,
-                lambda dev, host, e: (*e[0](dev, host, *flat_args), e, False),
-                entry, dev_leaves, host_leaves, host_mask,
-            )
-        else:
-            new_state, out = jitted(dev_leaves, host_leaves, *flat_args)
+            else:
+                new_state, out = jitted(dev_leaves, host_leaves, *flat_args)
+            if prof_step >= 0:
+                # close the sampled window before writeback: blocks on this
+                # call's outputs (the documented sampling overhead), parses
+                # the trace into a DeviceStepRecord joined to this
+                # StepRecord by step index; fail-soft — an empty/
+                # unparseable trace records nothing
+                kid = self._key_ids.get(key)
+                if kid is None:
+                    kid = self._key_ids[key] = key_id(key)
+                device_record = prof.stop(prof_step, kid, (new_state, out))
+                if device_record is not None:
+                    tel.record_device_step(device_record)
+        except BaseException:
+            if prof_step >= 0:
+                # a dispatch failure (retry exhaustion, preemption,
+                # rollback) must not leave the global trace session open —
+                # it would silently trace every step until the next sample
+                prof.abort()
+            raise
         self._writeback(new_state)
         if self._uses_accumulate is None:
             # first ever call: the trace just revealed whether the body
@@ -371,6 +407,15 @@ class CapturedStep:
                         # reuse (the sync flag flips back), cross-wiring the
                         # per-program HBM/FLOP stats
                         tel.rekey_last_program(key_id(new_key))
+                        if prof_step >= 0 and device_record is not None:
+                            # a sampled first call recorded its device
+                            # record under the same pre-refile key — follow
+                            # the re-file or the device_step↔program join
+                            # dangles for that sample.  Only when the sample
+                            # actually produced a record: an empty-trace
+                            # sample must not re-key an UNRELATED earlier
+                            # record at device_records[-1]
+                            tel.rekey_last_device_step(key_id(new_key))
         elif ctx.used_accumulate != self._uses_accumulate:
             # a later variant disagrees with the first trace (e.g. the body
             # enters `accumulate()` only when model.training) — the schedule

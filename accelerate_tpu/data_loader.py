@@ -1002,7 +1002,12 @@ class DataLoaderShard(DataLoaderStateMixin):
                     break
                 batch, remainder, produce_ms = pending.pop(0)
                 if telemetry is not None:
-                    telemetry.record_dataloader_wait(produce_ms)
+                    # owner-keyed so the hub can settle at epoch end: wait
+                    # recorded here is only *attributed* to a step if a
+                    # captured call actually pops it before this loader's
+                    # iteration finishes (batch-scoped attribution,
+                    # docs/telemetry.md)
+                    telemetry.record_dataloader_wait(produce_ms, owner=self)
                 if exhausted and not pending:
                     self.end_of_dataloader = True
                     self.remainder = remainder
@@ -1014,6 +1019,14 @@ class DataLoaderShard(DataLoaderStateMixin):
             if self._fetch_pool is not None:
                 self._fetch_pool.shutdown(wait=False)
                 self._fetch_pool = None
+            if telemetry is not None:
+                # batch-scoped settlement: wait this epoch recorded that no
+                # captured step popped was incurred by batches consumed
+                # OUTSIDE the capture path (an eager eval epoch, an
+                # early-broken loop) — discard it into the hub's eager
+                # counter instead of dumping it onto the next captured
+                # step's record
+                telemetry.discard_dataloader_wait(self)
             self.skip_batches = 0
             self.end()
         # epoch completed in full: advance and reset the in-epoch position

@@ -67,6 +67,9 @@ class ServingConfig:
     # request history — streaming consumers take step()'s return value or
     # pop_result() and the bound never bites
     max_retained_results: int = 4096
+    # completions retained for the metrics() sliding window (TTFT/TPOT
+    # p50/p99 on the live endpoint, docs/telemetry.md §metrics endpoint)
+    metrics_window: int = 512
 
 
 @dataclasses.dataclass
@@ -223,6 +226,27 @@ class DecodeService:
             "occupancy_sum": 0.0,
             "queue_peak": 0,
         }
+        # sliding (ttft_ms, tpot_ms) window behind metrics() — the live
+        # endpoint's SLO percentiles must reflect *recent* traffic, not the
+        # whole run
+        self._latency_window: deque = deque(maxlen=max(1, cfg.metrics_window))
+        if self._hub is not None:
+            # the hub's metrics endpoint (telemetry/metrics.py) scrapes any
+            # provider registered here; latest-constructed service wins the
+            # "serving" name (a MetricsServer.add_service call attaches
+            # additional services explicitly).  Registered through a
+            # weakref: the hub is process-lived, and a strong ref from it
+            # would pin this service's params + KV pools after the caller
+            # drops it — a dropped service renders as no gauges, silently
+            import weakref
+
+            service_ref = weakref.ref(self)
+
+            def _serving_metrics():
+                service = service_ref()
+                return service.metrics() if service is not None else {}
+
+            self._hub.register_metrics_provider("serving", _serving_metrics)
 
     # -- request intake ------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int,
@@ -361,6 +385,7 @@ class DecodeService:
         while len(self.results) > self.config.max_retained_results:
             self.results.pop(next(iter(self.results)))
         self.stats["completed"] += 1
+        self._latency_window.append((req.ttft_ms, req.tpot_ms))
         if self._hub is not None:
             self._hub.record_serving({
                 "event": "complete", "rid": req.rid,
@@ -435,6 +460,44 @@ class DecodeService:
         return dict(self.results)
 
     # -- accounting ----------------------------------------------------------
+    def metrics(self) -> dict:
+        """Live scrape snapshot (the metrics endpoint and tests share it):
+        instantaneous occupancy/queue/pool gauges plus TTFT/TPOT p50/p99
+        over the sliding completion window.  Pure host reads — safe to call
+        from the endpoint's thread while the service is stepping."""
+        # the stepping thread appends completions concurrently, and a deque
+        # raises on mutation-during-iteration — retry the snapshot instead
+        # of letting the whole serving section drop out of a scrape
+        window: list = []
+        for _ in range(4):
+            try:
+                window = list(self._latency_window)
+                break
+            except RuntimeError:
+                continue
+        out = {
+            "occupancy": self.active_slots / self.config.max_slots,
+            "slots_active": self.active_slots,
+            "slots_total": self.config.max_slots,
+            "queue_depth": len(self._queue),
+            "queue_peak": self.stats["queue_peak"],
+            "block_pool_free_frac": (
+                self.pool.free_blocks / max(1, self.pool.usable_blocks)
+            ),
+            "steps_total": self.stats["steps"],
+            "admitted_total": self.stats["admitted"],
+            "completed_total": self.stats["completed"],
+            "recompile_events_total": self.recompile_events,
+            "latency_window": len(window),
+        }
+        ttfts = sorted(t for t, _ in window if t is not None)
+        tpots = sorted(p for _, p in window if p is not None)
+        for name, values in (("ttft_ms", ttfts), ("tpot_ms", tpots)):
+            if values:
+                out[f"{name}_p50"] = values[int(0.50 * (len(values) - 1))]
+                out[f"{name}_p99"] = values[int(0.99 * (len(values) - 1))]
+        return out
+
     @property
     def mean_batch_occupancy(self) -> float:
         return self.stats["occupancy_sum"] / max(1, self.stats["steps"])

@@ -19,6 +19,21 @@ Four pillars, all default-OFF and zero-overhead when off:
 4. **Export** (`export.py`) — events flow to the existing ``GeneralTracker``
    fleet through :class:`TelemetryTracker`, or to a schema'd JSONL file that
    ``tools/telemetry_report.py`` renders.
+5. **Device-time attribution** (`profiler.py`) — every Nth step
+   (``TelemetryKwargs(profile_every_n=...)``, default off) the dispatch runs
+   inside a ``jax.profiler`` trace session parsed into a
+   :class:`~.profiler.DeviceStepRecord` (per-device busy/idle,
+   compute/collective/transfer split, top ops, MFU), joined 1:1 to the
+   host-side ``StepRecord`` by step index.
+6. **Fleet aggregation** (`aggregate.py`) — rank-0 ``gather_object`` merge
+   of every hub's records with per-rank skew statistics
+   (``Telemetry.aggregate_fleet``, collective; ``end_training`` calls it on
+   multi-process runs so the JSONL dump is fleet-wide).
+7. **Live metrics endpoint** (`metrics.py`) — a stdlib HTTP thread serving
+   Prometheus text (``TelemetryKwargs(metrics_port=...)`` /
+   ``Telemetry.serve_metrics()``): step-phase timings, recompile/fault
+   counters, collective bytes, device-time gauges, and any registered
+   provider (the decode service self-registers its ``metrics()`` snapshot).
 
 Enable with ``ACCELERATE_TELEMETRY=1`` or
 ``Accelerator(kwargs_handlers=[TelemetryKwargs(enabled=True)])``.  With the
@@ -34,6 +49,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Optional
 
+from .profiler import DeviceStepRecord
 from .recompile import RecompileEvent, diff_keys, key_id
 from .resources import (
     CollectiveRecord,
@@ -90,9 +106,42 @@ class Telemetry:
         # serving subsystem events (per-step occupancy/queue depth, per-
         # request TTFT/TPOT completions) — see serving/scheduler.py
         self.serving_events: deque[dict] = deque(maxlen=handler.max_events)
+        # sampled device-time attribution (profiler.py): a DeviceStepRecord
+        # per sampled step, joined to the host StepRecord by step index;
+        # profiler is None unless the cadence knob armed it — the unsampled
+        # hot path pays one None-check in CapturedStep.__call__
+        self.profile_every_n = int(getattr(handler, "profile_every_n", 0) or 0)
+        self.device_records: deque[DeviceStepRecord] = deque(
+            maxlen=handler.max_events
+        )
+        self.profiler = None
+        if self.enabled and self.profile_every_n > 0:
+            from .profiler import StepProfiler
+
+            profile_dir = getattr(handler, "profile_dir", None)
+            self.profiler = StepProfiler(
+                self.profile_every_n,
+                base_dir=profile_dir,
+                # a user-pinned dir means they want the raw traces on disk;
+                # the default tempdir traces are deleted after parsing
+                keep_traces=profile_dir is not None,
+            )
         self.recompiles_total = 0
         self.steps_total = 0
+        # fleet aggregation (aggregate.py): set by aggregate_fleet() on the
+        # main rank — the JSONL dump then describes every rank, not one
+        self._fleet_records: Optional[list] = None
+        # live metrics endpoint (metrics.py): providers registered here are
+        # rendered by whatever MetricsServer is attached to this hub
+        self._metrics_providers: list = []
+        self.metrics_server = None
         self._dataloader_wait_ms = 0.0
+        # wait that batches consumed OUTSIDE any captured step incurred
+        # (eager eval epochs, early-broken loops) — discarded from step
+        # attribution at loader-epoch end instead of dumped onto the next
+        # captured step's record (docs/telemetry.md)
+        self.eager_dataloader_wait_ms = 0.0
+        self._wait_by_owner: dict = {}
         # export queue: every record lands here once, drained by the
         # TelemetryTracker bridge / flush(); bounded so an undrained run
         # cannot grow without limit.  Only the bridge consumes it, so
@@ -105,7 +154,17 @@ class Telemetry:
         # latest-constructed wins the module slot: a later telemetry-off
         # Accelerator must clear it, or its data loaders keep crediting
         # wait time to the previous run's (possibly defunct) instance
+        displaced = _ACTIVE
         _set_active(self if self.enabled else None)
+        metrics_port = getattr(handler, "metrics_port", None)
+        if self.enabled and metrics_port is not None:
+            if displaced is not None and displaced.metrics_server is not None:
+                # latest-constructed wins the endpoint too: the displaced
+                # hub's server (typically on the same env-pinned port) would
+                # otherwise squat the bind and serve frozen counters for the
+                # rest of the process
+                displaced.close_metrics()
+            self.serve_metrics(port=metrics_port)
 
     # -- spans ---------------------------------------------------------------
     @contextmanager
@@ -121,11 +180,31 @@ class Telemetry:
             yield
 
     # -- producers -----------------------------------------------------------
-    def record_dataloader_wait(self, ms: float) -> None:
+    def record_dataloader_wait(self, ms: float, owner=None) -> None:
+        """Host time a loader spent producing one batch.  ``owner`` (the
+        loader) keys the batch-scoped attribution: wait still pending when
+        that loader's epoch ends was incurred by batches no captured step
+        consumed, and is discarded rather than billed to the next step."""
         self._dataloader_wait_ms += ms
+        if owner is not None:
+            self._wait_by_owner[owner] = self._wait_by_owner.get(owner, 0.0) + ms
 
     def pop_dataloader_wait_ms(self) -> float:
         ms, self._dataloader_wait_ms = self._dataloader_wait_ms, 0.0
+        if self._wait_by_owner:
+            self._wait_by_owner.clear()
+        return ms
+
+    def discard_dataloader_wait(self, owner) -> float:
+        """Epoch-end settlement for one loader: whatever wait it recorded
+        that no captured step popped belongs to batches consumed *outside*
+        the capture path (an eager eval epoch, an early-broken loop) — move
+        it to ``eager_dataloader_wait_ms`` so the next captured step's
+        record shows only its own batch's wait (docs/telemetry.md)."""
+        ms = self._wait_by_owner.pop(owner, 0.0)
+        if ms:
+            self._dataloader_wait_ms = max(0.0, self._dataloader_wait_ms - ms)
+            self.eager_dataloader_wait_ms += ms
         return ms
 
     def next_step_index(self) -> int:
@@ -187,6 +266,44 @@ class Telemetry:
         if self._export_sink:
             self._export_queue.append(dict(record))
 
+    def record_device_step(self, record: DeviceStepRecord) -> DeviceStepRecord:
+        """Sampled device-time record from the profiler: join the program's
+        analytic FLOPs (``cost_analysis`` recorded at build) by variant key
+        and derive MFU where a per-chip peak is known, then retain/export
+        like every other kind."""
+        if record.flops is None:
+            for program in reversed(self.program_records):
+                if program.key == record.key:
+                    flops = program.stats.get("flops")
+                    if isinstance(flops, (int, float)) and flops > 0:
+                        record.flops = float(flops)
+                    break
+        if record.mfu is None and record.flops:
+            from .profiler import derive_mfu
+
+            record.mfu = derive_mfu(
+                record.flops, record.window_ms, n_devices=len(record.devices)
+            )
+        self.device_records.append(record)
+        if self._export_sink:
+            self._export_queue.append(record.to_dict())
+        return record
+
+    def rekey_last_device_step(self, new_key: str) -> None:
+        """Re-key the most recent device-step record (and its pending export
+        dict) — the first-call accumulate re-file moves the program record to
+        the traced sync flag's key, and a sampled first call must follow or
+        its device_step↔program join dangles."""
+        if not self.device_records:
+            return
+        record = self.device_records[-1]
+        old_key = record.key
+        record.key = new_key
+        for pending in reversed(self._export_queue):
+            if pending.get("kind") == "device_step" and pending.get("key") == old_key:
+                pending["key"] = new_key
+                break
+
     def rekey_last_program(self, new_key: str) -> None:
         """Re-key the most recent program record (and its not-yet-drained
         export dict) — the capture path calls this when a first-call
@@ -222,7 +339,7 @@ class Telemetry:
             for record in self.all_records():
                 if record.get("kind") in (
                     "step", "recompile", "program", "collectives",
-                    "resources", "resilience", "serving",
+                    "resources", "resilience", "serving", "device_step",
                 ):
                     self._export_queue.append(record)
 
@@ -237,6 +354,16 @@ class Telemetry:
         out = self.timeline.summary()
         out["recompiles_total"] = self.recompiles_total
         out["schema_version"] = SCHEMA_VERSION
+        out["eager_dataloader_wait_ms"] = round(self.eager_dataloader_wait_ms, 3)
+        if self.device_records:
+            records = list(self.device_records)
+            out["device_samples"] = len(records)
+            out["device_busy_ms_mean"] = round(
+                sum(r.busy_ms for r in records) / len(records), 3
+            )
+            out["device_collective_share_mean"] = round(
+                sum(r.collective_share for r in records) / len(records), 4
+            )
         return out
 
     def all_records(self) -> list[dict]:
@@ -251,6 +378,7 @@ class Telemetry:
             }
         ]
         records += [r.to_dict() for r in self.timeline.records()]
+        records += [d.to_dict() for d in self.device_records]
         records += [e.to_dict() for e in self.recompile_events]
         records += [p.to_dict() for p in self.program_records]
         records += [c.to_dict() for c in self.collective_records]
@@ -259,6 +387,66 @@ class Telemetry:
         records += [dict(e) for e in self.serving_events]
         records.append(self.summary())
         return records
+
+    def export_records(self) -> list[dict]:
+        """What the JSONL dump writes: the fleet-merged view when
+        ``aggregate_fleet`` ran (every record rank-tagged + the skew
+        record), the rank-local history otherwise."""
+        if self._fleet_records is not None:
+            return self._fleet_records
+        return self.all_records()
+
+    def aggregate_fleet(self) -> Optional[list[dict]]:
+        """COLLECTIVE — every process must call (``end_training`` does on
+        multi-process runs; safe and communication-free on one).  Gathers
+        all ranks' retained records to the main process, rank-tags them,
+        and appends the ``kind="fleet"`` skew record; the main process also
+        caches the merge so ``write_jsonl`` dumps the fleet view.  Returns
+        the merged records on main, ``None`` elsewhere."""
+        from .aggregate import gather_fleet, merge_rank_records
+
+        per_rank = gather_fleet(self.all_records())
+        if per_rank is None:
+            return None
+        self._fleet_records = merge_rank_records(per_rank)
+        return self._fleet_records
+
+    # -- metrics endpoint ----------------------------------------------------
+    def register_metrics_provider(self, name: str, fn) -> str:
+        """Attach a live snapshot source (``fn() -> dict``) to whatever
+        MetricsServer serves this hub; same-name re-registration replaces
+        (latest service wins)."""
+        from .metrics import register_provider
+
+        return register_provider(self._metrics_providers, name, fn)
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start (or return) the hub's Prometheus endpoint — idempotent;
+        ``port=0`` binds ephemerally (read ``.port`` back).  A bind failure
+        warns and returns ``None``: observability must not kill the job."""
+        if self.metrics_server is not None:
+            return self.metrics_server
+        from .metrics import MetricsServer
+
+        try:
+            self.metrics_server = MetricsServer(
+                telemetry=self, port=port, host=host
+            ).start()
+        except (OSError, OverflowError, ValueError) as exc:
+            # OSError: port in use / denied; OverflowError/ValueError: an
+            # out-of-range or malformed port — same contract for all three
+            from ..logging import get_logger
+
+            get_logger(__name__).warning(
+                "metrics endpoint failed to bind %s:%s: %s", host, port, exc
+            )
+            return None
+        return self.metrics_server
+
+    def close_metrics(self) -> None:
+        server, self.metrics_server = self.metrics_server, None
+        if server is not None:
+            server.close()
 
     def write_jsonl(self, path: Optional[str] = None) -> Optional[str]:
         from .export import write_jsonl
@@ -300,6 +488,7 @@ def __getattr__(name):
 __all__ = [
     "PHASES",
     "CollectiveRecord",
+    "DeviceStepRecord",
     "ProgramRecord",
     "RecompileEvent",
     "ResourceSample",

@@ -1,0 +1,107 @@
+"""Pillar 6 — multi-host aggregation: one fleet-wide telemetry view.
+
+Every process owns a rank-local :class:`~.Telemetry` hub; on a multi-host
+mesh the JSONL/TensorBoard export therefore used to describe one rank and
+say nothing about the fleet's actual step time — which is gated by the
+*slowest* rank.  ``Telemetry.aggregate_fleet()`` (called automatically by
+``Accelerator.end_training`` on multi-process runs, and on demand anywhere)
+gathers every rank's retained records to rank 0 with ``gather_object``,
+tags each record with its ``rank``, and appends one ``kind: "fleet"``
+record of per-rank skew statistics: per-rank replay step-time means, the
+slowest/fastest ranks, the skew between them, and which phase the
+straggler's extra time sits in.
+
+The gather is COLLECTIVE — every process must call it (the accelerator's
+``end_training`` does); non-main ranks contribute and get ``None`` back.
+All the merge math is plain host code over record dicts, so it tests on a
+single process with synthetic per-rank lists.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# phases eligible for straggler attribution (StepRecord schema)
+_SKEW_PHASES = (
+    "dataloader_wait_ms",
+    "assembly_ms",
+    "dispatch_ms",
+    "retry_wait_ms",
+)
+
+
+def _replay_steps(records: list) -> list:
+    return [
+        r for r in records
+        if r.get("kind") == "step" and not r.get("built")
+        and isinstance(r.get("total_ms"), (int, float))
+    ]
+
+
+def fleet_skew(per_rank: list) -> dict:
+    """Per-rank replay step-time means + slowest/fastest skew + the phase
+    that explains the straggler's delta.  Ranks with no replay steps are
+    reported but excluded from the skew comparison."""
+    rank_stats = []
+    for rank, records in enumerate(per_rank):
+        replays = _replay_steps(records)
+        stat = {"rank": rank, "replay_steps": len(replays)}
+        if replays:
+            stat["replay_total_ms_mean"] = round(
+                sum(r["total_ms"] for r in replays) / len(replays), 3
+            )
+            for phase in _SKEW_PHASES:
+                values = [r.get(phase, 0.0) for r in replays]
+                stat[f"{phase}_mean"] = round(sum(values) / len(values), 3)
+        rank_stats.append(stat)
+    out = {"kind": "fleet", "ranks": len(per_rank), "per_rank": rank_stats}
+    usable = [s for s in rank_stats if s.get("replay_total_ms_mean") is not None]
+    if len(usable) >= 2:
+        slowest = max(usable, key=lambda s: s["replay_total_ms_mean"])
+        fastest = min(usable, key=lambda s: s["replay_total_ms_mean"])
+        skew_ms = slowest["replay_total_ms_mean"] - fastest["replay_total_ms_mean"]
+        out["slowest_rank"] = slowest["rank"]
+        out["fastest_rank"] = fastest["rank"]
+        out["skew_ms"] = round(skew_ms, 3)
+        out["skew_pct"] = round(
+            100.0 * skew_ms / fastest["replay_total_ms_mean"], 1
+        ) if fastest["replay_total_ms_mean"] > 0 else None
+        # straggler attribution: the phase where the slowest rank spends the
+        # most extra time over the fastest
+        deltas = {
+            phase: slowest.get(f"{phase}_mean", 0.0) - fastest.get(f"{phase}_mean", 0.0)
+            for phase in _SKEW_PHASES
+        }
+        phase, delta = max(deltas.items(), key=lambda kv: kv[1])
+        out["straggler_phase"] = phase
+        out["straggler_phase_delta_ms"] = round(delta, 3)
+    return out
+
+
+def merge_rank_records(per_rank: list) -> list:
+    """Rank-tag every record (without mutating the inputs) and append the
+    fleet skew record — the JSONL schema stays per-record valid, each line
+    just carries which rank produced it."""
+    merged = []
+    for rank, records in enumerate(per_rank):
+        for record in records:
+            tagged = dict(record)
+            tagged["rank"] = rank
+            merged.append(tagged)
+    merged.append(fleet_skew(per_rank))
+    return merged
+
+
+def gather_fleet(local_records: list) -> Optional[list]:
+    """COLLECTIVE: gather every rank's record list; returns the per-rank
+    list-of-lists on the main process, ``None`` elsewhere.  On a single
+    process this degenerates to ``[local_records]`` with no communication."""
+    from ..state import PartialState
+    from ..utils.operations import gather_object
+
+    # gather_object flattens one list level across processes, so each rank
+    # contributes [its records] and main receives [rank0_records, rank1_...]
+    gathered = gather_object([local_records])
+    if PartialState._shared_state and not PartialState().is_main_process:
+        return None
+    return gathered

@@ -104,7 +104,10 @@ class TelemetryTracker(GeneralTracker):
 
 
 def write_jsonl(telemetry, path: str) -> str:
+    # export_records(): the fleet-merged view when aggregate_fleet() ran
+    # (rank-tagged records + the kind="fleet" skew record), rank-local
+    # history otherwise
     with open(path, "w", encoding="utf-8") as f:
-        for record in telemetry.all_records():
+        for record in telemetry.export_records():
             f.write(json.dumps(record, default=float) + "\n")
     return path

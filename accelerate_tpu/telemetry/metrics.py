@@ -1,0 +1,245 @@
+"""Pillar 7 — live metrics endpoint: Prometheus text over stdlib HTTP.
+
+A running training job or decode service should be scrapable without
+touching its process: :class:`MetricsServer` runs a daemon
+``http.server`` thread serving ``GET /metrics`` in Prometheus text
+exposition format (version 0.0.4).  Every scrape renders *live* — the
+server holds no state beyond its provider callables, so the numbers are
+whatever the telemetry hub / :class:`~..serving.DecodeService` report at
+that instant.
+
+Metric namespace: ``atpu_<provider>_<field>``; nested dicts flatten with
+``_``; names ending ``_total`` are typed ``counter``, everything else
+``gauge``.  Providers are fail-soft: one raising provider becomes a
+comment line in the scrape, never a 500.
+
+Wiring: ``TelemetryKwargs(metrics_port=...)`` / ``$ACCELERATE_METRICS_PORT``
+starts one automatically (port 0 = ephemeral, read ``server.port``);
+``Telemetry.serve_metrics()`` starts one on demand; a ``DecodeService``
+constructed with a telemetry hub registers its ``metrics()`` snapshot as
+the ``serving`` provider (occupancy, queue depth, block-pool free %, and
+sliding-window TTFT/TPOT percentiles).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def register_provider(providers: list, name: str, fn: Callable[[], dict]) -> str:
+    """Replace-or-append a ``(name, fn)`` snapshot source — the one
+    registry semantics shared by the hub and the server (latest wins on a
+    name collision: the restart-the-service-in-one-process case)."""
+    for i, (existing, _) in enumerate(providers):
+        if existing == name:
+            providers[i] = (name, fn)
+            return name
+    providers.append((name, fn))
+    return name
+
+_NAME_OK_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(*parts: str) -> str:
+    name = "_".join(p for p in parts if p)
+    name = _NAME_OK_RE.sub("_", name)
+    if not name or not (name[0].isalpha() or name[0] == "_"):
+        name = "_" + name
+    return name
+
+
+def _flatten(values: dict, prefix: str = "") -> list:
+    flat = []
+    for key, value in values.items():
+        name = f"{prefix}_{key}" if prefix else str(key)
+        if isinstance(value, dict):
+            flat.extend(_flatten(value, name))
+        elif isinstance(value, bool):
+            flat.append((name, int(value)))
+        elif isinstance(value, (int, float)) and value == value:  # drop NaN
+            flat.append((name, value))
+        # None / strings / lists have no Prometheus sample type: skipped
+    return flat
+
+
+def render_prometheus(sections: list) -> str:
+    """``[(provider, values_dict), ...]`` → text exposition.  Duplicate
+    metric names (two providers under one name) keep the first sample —
+    duplicates are invalid exposition."""
+    lines: list[str] = []
+    seen: set[str] = set()
+    for provider, values in sections:
+        for key, value in _flatten(values):
+            name = _metric_name("atpu", provider, key)
+            if name in seen:
+                continue
+            seen.add(name)
+            kind = "counter" if name.endswith("_total") else "gauge"
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def telemetry_metrics(telemetry) -> dict:
+    """The hub's scrape snapshot: step counters, replay phase timings,
+    recompile/fault counters, collective bytes, and the latest sampled
+    device-time split."""
+    out = {
+        "steps_total": telemetry.steps_total,
+        "recompiles_total": telemetry.recompiles_total,
+        "resilience_events_total": len(telemetry.resilience_events),
+        "eager_dataloader_wait_ms_total": round(
+            telemetry.eager_dataloader_wait_ms, 3
+        ),
+    }
+    for key, value in telemetry.timeline.summary().items():
+        if isinstance(value, (int, float)) and (
+            key.startswith("replay_") or key.startswith("build_")
+        ):
+            out[key] = value
+    if telemetry.collective_records:
+        last = telemetry.collective_records[-1]
+        for key in (
+            "dp_collective_bytes",
+            "dp_collective_bytes_uncompressed",
+            "compression_ratio",
+        ):
+            value = last.stats.get(key)
+            if isinstance(value, (int, float)):
+                out[key] = value
+    if telemetry.device_records:
+        dev = telemetry.device_records[-1]
+        out["device_window_ms"] = dev.window_ms
+        out["device_busy_ms"] = dev.busy_ms
+        out["device_idle_ms"] = dev.idle_ms
+        out["device_compute_ms"] = dev.compute_ms
+        out["device_collective_ms"] = dev.collective_ms
+        out["device_transfer_ms"] = dev.transfer_ms
+        out["device_collective_share"] = dev.collective_share
+        out["device_samples_total"] = len(telemetry.device_records)
+        if dev.mfu is not None:
+            out["device_mfu"] = dev.mfu
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "atpu-metrics/1.0"
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path.split("?", 1)[0] in ("/metrics", "/metrics/"):
+            body = self.server.render_fn().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path in ("", "/"):
+            body = b"accelerate_tpu metrics endpoint; scrape /metrics\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404)
+
+    def log_message(self, *args):  # scrapes must not spam the job's stderr
+        pass
+
+
+class MetricsServer:
+    """One daemon HTTP thread serving live Prometheus text on ``/metrics``.
+
+    ``telemetry`` (optional) contributes the hub snapshot plus every
+    provider registered on the hub (``register_metrics_provider`` — the
+    decode service self-registers there); ``add_provider``/``add_service``
+    attach additional sources directly.  ``port=0`` binds an ephemeral port
+    (read it back from ``.port`` — tests and multi-job hosts)."""
+
+    def __init__(self, telemetry=None, port: int = 0, host: str = "127.0.0.1"):
+        self.telemetry = telemetry
+        self._requested = (host, int(port))
+        self._providers: list = []  # (name, callable) -> dict
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- providers -----------------------------------------------------------
+    def add_provider(self, name: str, fn: Callable[[], dict]) -> str:
+        """Register a snapshot callable (replace-or-append, latest wins)."""
+        return register_provider(self._providers, name, fn)
+
+    def add_service(self, service) -> str:
+        """Scrape a :class:`~..serving.DecodeService` (its ``metrics()``
+        snapshot) under the ``serving`` namespace."""
+        return self.add_provider("serving", service.metrics)
+
+    def _sections(self) -> list:
+        sections: list = []
+        if self.telemetry is not None:
+            hub = self.telemetry
+            sections.append(("telemetry", lambda: telemetry_metrics(hub)))
+            sections.extend(getattr(hub, "_metrics_providers", []))
+        sections.extend(self._providers)
+        return sections
+
+    def render(self) -> str:
+        rendered = []
+        failures = []
+        for name, fn in self._sections():
+            try:
+                values = fn()
+                if isinstance(values, dict):
+                    rendered.append((name, values))
+                else:
+                    failures.append((name, "provider returned non-dict"))
+            except Exception as exc:  # one bad provider must not kill a scrape
+                failures.append((name, f"{type(exc).__name__}: {exc}"))
+        body = render_prometheus(rendered)
+        for name, err in failures:
+            body += f"# provider {name} failed: {err}\n"
+        return body
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer(self._requested, _Handler)
+        httpd.daemon_threads = True
+        httpd.render_fn = self.render
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="atpu-metrics", daemon=True
+        )
+        self._thread.start()
+        logger.info("metrics endpoint serving on %s", self.url)
+        return self
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd is not None else None
+
+    @property
+    def url(self) -> Optional[str]:
+        if self._httpd is None:
+            return None
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}/metrics"
+
+    def close(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

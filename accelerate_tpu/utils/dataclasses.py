@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import enum
 import os
+import warnings
 from dataclasses import dataclass, field
 from datetime import timedelta
 from typing import Any, Callable, Iterable, Optional
@@ -173,6 +174,17 @@ class TelemetryKwargs(KwargsHandler):
     ``jax.profiler.TraceAnnotation`` so xprof traces show named capture
     phases; ``jsonl_path`` (or ``$ACCELERATE_TELEMETRY_JSONL``) auto-dumps
     the full history at ``end_training``/tracker ``finish``.
+
+    ``profile_every_n`` (or ``$ACCELERATE_TELEMETRY_PROFILE_N``; 0 = off)
+    samples device-time attribution: every Nth captured call runs inside a
+    ``jax.profiler`` trace session and blocks until the device drains, so
+    the sampled step's per-device busy/idle + compute/collective/transfer
+    split lands as a ``DeviceStepRecord`` (docs/telemetry.md §device time —
+    the sampled call pays the sync, every other call keeps the async
+    pipeline).  ``profile_dir`` (``$ACCELERATE_TELEMETRY_PROFILE_DIR``)
+    keeps the raw xprof dumps on disk instead of deleting them after
+    parsing.  ``metrics_port`` (``$ACCELERATE_METRICS_PORT``; 0 = ephemeral
+    port) serves live Prometheus text on ``/metrics``.
     """
 
     enabled: Optional[bool] = None  # None → $ACCELERATE_TELEMETRY, default off
@@ -181,6 +193,9 @@ class TelemetryKwargs(KwargsHandler):
     sample_resources: bool = True
     annotate_spans: bool = True
     jsonl_path: Optional[str] = None
+    profile_every_n: Optional[int] = None  # None → env, default 0 (off)
+    profile_dir: Optional[str] = None
+    metrics_port: Optional[int] = None  # None → env, default no endpoint
 
     def __post_init__(self):
         if self.enabled is None:
@@ -188,6 +203,25 @@ class TelemetryKwargs(KwargsHandler):
             self.enabled = bool(str_to_bool(value)) if value is not None else False
         if self.jsonl_path is None:
             self.jsonl_path = os.environ.get("ACCELERATE_TELEMETRY_JSONL")
+        # observability knobs must not kill the job: a malformed env value
+        # warns and leaves the feature off instead of raising mid-__init__
+        if self.profile_every_n is None:
+            self.profile_every_n = self._env_int("ACCELERATE_TELEMETRY_PROFILE_N", 0)
+        if self.profile_dir is None:
+            self.profile_dir = os.environ.get("ACCELERATE_TELEMETRY_PROFILE_DIR")
+        if self.metrics_port is None:
+            self.metrics_port = self._env_int("ACCELERATE_METRICS_PORT", None)
+
+    @staticmethod
+    def _env_int(name, default):
+        value = os.environ.get(name)
+        if value is None or value == "":
+            return default
+        try:
+            return int(value)
+        except ValueError:
+            warnings.warn(f"{name}={value!r} is not an integer; ignoring")
+            return default
 
 
 @dataclass

@@ -853,9 +853,21 @@ def main() -> None:
     # the plain jit path, asserted in tests/test_telemetry.py)
     from accelerate_tpu import TelemetryKwargs
 
+    # sampled device-time attribution (docs/telemetry.md): BENCH_PROFILE_N
+    # (or the library-wide ACCELERATE_TELEMETRY_PROFILE_N) turns on xprof
+    # sampling at that cadence — the sampled steps block, so the timed
+    # window keeps its async pipeline on every other call and the JSON
+    # gains the EQuARX-style device-side split alongside the wire bytes
+    profile_n = int(
+        os.environ.get(
+            "BENCH_PROFILE_N",
+            os.environ.get("ACCELERATE_TELEMETRY_PROFILE_N", "0") or 0,
+        )
+        or 0
+    )
     acc = Accelerator(
         mixed_precision="bf16",
-        kwargs_handlers=[TelemetryKwargs(enabled=True)],
+        kwargs_handlers=[TelemetryKwargs(enabled=True, profile_every_n=profile_n)],
     )
     cfg = GPTConfig.small() if on_accel else GPTConfig.tiny()
     model = GPTLMHeadModel(cfg)
@@ -939,6 +951,32 @@ def main() -> None:
     result["dp_collective_bytes"] = (
         summary["dp_collective_bytes"] if summary else None
     )
+    if profile_n:
+        # device-time attribution of the sampled replay steps (builds are
+        # compile events — their windows measure XLA, not the step).
+        # Fail-soft: a backend whose trace comes back empty (no device op
+        # events) produced no records, and the fields say so with None
+        built_steps = {r.step for r in acc.telemetry.timeline.records() if r.built}
+        samples = [
+            d for d in acc.telemetry.device_records
+            if d.step not in built_steps and d.busy_ms > 0
+        ]
+        result["profile_every_n"] = profile_n
+        result["device_samples"] = len(samples)
+        result["device_step_ms"] = (
+            round(sum(d.busy_ms for d in samples) / len(samples), 3)
+            if samples else None
+        )
+        result["device_collective_ms"] = (
+            round(sum(d.collective_ms for d in samples) / len(samples), 3)
+            if samples else None
+        )
+        result["device_collective_share"] = (
+            round(sum(d.collective_share for d in samples) / len(samples), 4)
+            if samples else None
+        )
+        mfus = [d.mfu for d in samples if d.mfu is not None]
+        result["mfu"] = round(sum(mfus) / len(mfus), 4) if mfus else None
     if os.environ.get("BENCH_COMPRESSION", "1") != "0":
         # per-policy A/B rows (none/int8/fp8 on the same geometry) — the
         # quantized-collective win lands in the JSON the moment a dp>1

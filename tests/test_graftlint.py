@@ -349,6 +349,74 @@ def test_blocking_in_while_test_is_flagged(tmp_path):
     assert len(res.new_findings) == 1
 
 
+def test_profiler_session_in_loop_fires(tmp_path):
+    """jax.profiler start/stop_trace per loop iteration opens a global trace
+    session every step — the blocking-in-hot-loop profiler extension."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        def train(step, batches):
+            for b in batches:
+                jax.profiler.start_trace("/tmp/t")
+                out = step(b)
+                jax.profiler.stop_trace()
+            return out
+        """,
+        rule="blocking-in-hot-loop",
+    )
+    assert len(res.new_findings) == 2, [f.render() for f in res.new_findings]
+    assert all("sample" in f.message for f in res.new_findings)
+
+
+def test_profiler_session_knob_guard_alone_still_fires(tmp_path):
+    """A profiling-knob guard exempts a plain sync, but NOT a trace
+    session: `if profiling:` is what turns the every-step session on —
+    only sampled-cadence evidence exempts start/stop_trace."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        def train(step, batches, profiling=False):
+            for b in batches:
+                if profiling:
+                    jax.profiler.start_trace("/tmp/t")
+                out = step(b)
+                if profiling:
+                    jax.profiler.stop_trace()
+            return out
+        """,
+        rule="blocking-in-hot-loop",
+    )
+    assert len(res.new_findings) == 2, [f.render() for f in res.new_findings]
+
+
+def test_profiler_session_sampled_cadence_is_silent(tmp_path):
+    """The good twin: the session opens only on the sampled iteration —
+    a modulus test (or cadence-named predicate) is the evidence, matching
+    the telemetry profile_every_n pattern."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        def train(step, batches, profile_every_n=0):
+            for i, b in enumerate(batches):
+                sampled = profile_every_n and i % profile_every_n == 0
+                if sampled:
+                    jax.profiler.start_trace("/tmp/t")
+                out = step(b)
+                if sampled:
+                    jax.profiler.stop_trace()
+            return out
+        """,
+        rule="blocking-in-hot-loop",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
 def test_payload_astype_suppressed_inside_compression_layer(tmp_path):
     """Policy-scoped suppression: the compression layer ITSELF is the
     sanctioned quantize/dequantize boundary, so payload casts inside

@@ -145,6 +145,40 @@ def test_prepared_loader_keeps_pinned_hub_after_later_accelerator():
     assert acc.telemetry.timeline.last().dataloader_wait_ms > 0
 
 
+def test_eager_eval_epoch_wait_is_not_dumped_on_next_step():
+    """Batch-scoped wait attribution (ISSUE 8 satellite): an eager eval
+    epoch consumes its batches with no captured step, so its accumulated
+    loader wait must be settled at epoch end into the hub's eager counter —
+    pre-fix it stayed pending and the NEXT captured step's record absorbed
+    the whole eval epoch's wait as its own."""
+    acc, _, step = _make_step()
+
+    data = np.random.default_rng(0).integers(0, 256, (128, 32)).astype(np.int32)
+
+    class Dataset:
+        def __len__(self):
+            return len(data)
+
+        def __getitem__(self, i):
+            return data[i]
+
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    loader = prepare_data_loader(Dataset(), batch_size=8, mesh=acc.mesh)
+    for _ in loader:  # eager eval epoch: no captured step pops any wait
+        pass
+    # the regression pin: nothing pending for the next step, the eval
+    # epoch's wait is accounted where it belongs
+    assert acc.telemetry._dataloader_wait_ms == 0.0
+    assert acc.telemetry.eager_dataloader_wait_ms > 0
+    assert acc.telemetry.summary()["eager_dataloader_wait_ms"] > 0
+    # a captured step after the eval phase still gets its own batch's wait
+    for batch in loader:
+        step(batch)
+        break
+    assert acc.telemetry.timeline.last().dataloader_wait_ms > 0
+
+
 def test_program_labels_stay_unique_across_rebuilds():
     acc, _, step = _make_step()
     step(_batch(acc, seq=32))

@@ -6,10 +6,15 @@
 
 Input: the ``kind``-tagged JSONL that ``Telemetry.write_jsonl`` /
 ``ACCELERATE_TELEMETRY_JSONL`` produces (one JSON object per line; kinds:
-``meta``/``step``/``recompile``/``program``/``resources``/``summary``).
+``meta``/``step``/``device_step``/``recompile``/``program``/``resources``/
+``collectives``/``serving``/``fleet``/``summary``).
 Output: a step-time breakdown table (build steps split out from replays —
-averaging a compile into replay dispatch would hide both), the recompile
-history with attributed causes, and per-program HBM/FLOP accounting.
+averaging a compile into replay dispatch would hide both), the sampled
+device-time attribution joined launch-vs-device per step, the recompile
+history with attributed causes, per-program HBM/FLOP accounting, a serving
+SLO section (TTFT/TPOT percentiles), and fleet skew when the artifact was
+rank-aggregated.  Pre-device-time artifacts simply lack those kinds and
+render without the new sections.
 
 ``validate()`` is the well-formedness check behind ``make telemetry-smoke``:
 it returns a list of schema errors (empty = valid).
@@ -87,11 +92,38 @@ def validate(records: list[dict], min_steps: int = 0) -> list[str]:
     for i, record in enumerate(r for r in records if r.get("kind") == "recompile"):
         if not record.get("cause"):
             errors.append(f"recompile record {i} has no cause")
+    # device_step records (sampled device-time attribution) are OPTIONAL —
+    # pre-device-time artifacts lack them entirely — but when present they
+    # must be well-formed and their busy+idle split must account for the
+    # profiled window
+    for i, record in enumerate(
+        r for r in records if r.get("kind") == "device_step"
+    ):
+        for field in ("step", "window_ms", "busy_ms", "idle_ms"):
+            if not isinstance(record.get(field), (int, float)) or record[field] < 0:
+                errors.append(
+                    f"device_step record {i}: {field}={record.get(field)!r}"
+                )
+                break
+        else:
+            if record["window_ms"] > 0:
+                covered = (record["busy_ms"] + record["idle_ms"]) / record["window_ms"]
+                if not 0.8 <= covered <= 1.2:
+                    errors.append(
+                        f"device_step record {i}: busy+idle cover "
+                        f"{covered:.0%} of the profiled window"
+                    )
     return errors
 
 
 def _mean(values):
     return sum(values) / len(values) if values else 0.0
+
+
+def _pct(values, q):
+    """Nearest-rank percentile of a non-empty list."""
+    ordered = sorted(values)
+    return ordered[int(q / 100.0 * (len(ordered) - 1))]
 
 
 def render(records: list[dict]) -> str:
@@ -128,6 +160,42 @@ def render(records: list[dict]) -> str:
         f"{max([r.get('total_ms', 0.0) for r in replays], default=0.0):>12.3f}"
         f"{_mean([r.get('total_ms', 0.0) for r in builds]):>12.3f}"
     )
+
+    device_steps = [r for r in records if r.get("kind") == "device_step"]
+    if device_steps:
+        # join each sampled device record to its host step by (rank, step):
+        # launch latency (dispatch_ms) next to actual device time is the
+        # async-dispatch gap this section exists to expose
+        by_step = {(r.get("rank"), r.get("step")): r for r in steps}
+        lines.append("")
+        lines.append("device-time attribution (sampled)")
+        header = (
+            f"  {'step':>6}{'launch':>9}{'device':>9}{'busy':>9}{'idle':>9}"
+            f"{'compute':>9}{'coll':>8}{'xfer':>8}{'coll%':>7}{'mfu':>7}"
+        )
+        lines.append(header + "   (ms)")
+        lines.append("  " + "-" * (len(header) - 2))
+        for r in device_steps:
+            host = by_step.get((r.get("rank"), r.get("step")), {})
+            mfu = r.get("mfu")
+            lines.append(
+                f"  {r.get('step', '?'):>6}"
+                f"{host.get('dispatch_ms', 0.0):>9.2f}"
+                f"{r.get('window_ms', 0.0):>9.2f}"
+                f"{r.get('busy_ms', 0.0):>9.2f}"
+                f"{r.get('idle_ms', 0.0):>9.2f}"
+                f"{r.get('compute_ms', 0.0):>9.2f}"
+                f"{r.get('collective_ms', 0.0):>8.2f}"
+                f"{r.get('transfer_ms', 0.0):>8.2f}"
+                f"{100 * r.get('collective_share', 0.0):>6.1f}%"
+                + (f"{100 * mfu:>6.1f}%" if isinstance(mfu, (int, float)) else f"{'-':>7}")
+            )
+        top = (device_steps[-1].get("top_ops") or [])[:5]
+        if top:
+            lines.append(
+                "  top ops (last sample): "
+                + ", ".join(f"{name} {ms:.2f}ms" for name, ms in top)
+            )
 
     lines.append("")
     if recompiles:
@@ -169,6 +237,52 @@ def render(records: list[dict]) -> str:
             lines.append(
                 f"  {r.get('tag', '?'):<12} total {r.get('total_bytes', 0) / 1e6:8.1f} MB"
                 f" over {len(r.get('devices', {}))} device(s)"
+            )
+
+    serving = [r for r in records if r.get("kind") == "serving"]
+    if serving:
+        completions = [r for r in serving if r.get("event") == "complete"]
+        srv_steps = [r for r in serving if r.get("event") == "step"]
+        lines.append("")
+        lines.append(f"serving SLO ({len(completions)} completions, "
+                     f"{len(srv_steps)} engine steps)")
+        ttfts = [r["ttft_ms"] for r in completions
+                 if isinstance(r.get("ttft_ms"), (int, float))]
+        tpots = [r["tpot_ms"] for r in completions
+                 if isinstance(r.get("tpot_ms"), (int, float))]
+        for name, values in (("TTFT", ttfts), ("TPOT", tpots)):
+            if values:
+                lines.append(
+                    f"  {name:<6} p50 {_pct(values, 50):8.2f} ms   "
+                    f"p99 {_pct(values, 99):8.2f} ms   "
+                    f"mean {_mean(values):8.2f} ms"
+                )
+        if srv_steps:
+            lines.append(
+                f"  occupancy mean {_mean([r.get('occupancy', 0.0) for r in srv_steps]):.2f}"
+                f"   queue-depth peak {max(r.get('queue_depth', 0) for r in srv_steps)}"
+            )
+
+    for r in records:
+        if r.get("kind") != "fleet":
+            continue
+        lines.append("")
+        lines.append(f"fleet skew ({r.get('ranks', '?')} rank(s))")
+        for stat in r.get("per_rank", []):
+            mean_ms = stat.get("replay_total_ms_mean")
+            lines.append(
+                f"  rank {stat.get('rank', '?'):>3}: "
+                + (f"replay mean {mean_ms:8.2f} ms over "
+                   f"{stat.get('replay_steps', 0)} steps"
+                   if isinstance(mean_ms, (int, float)) else "no replay steps")
+            )
+        if r.get("slowest_rank") is not None:
+            lines.append(
+                f"  slowest rank {r['slowest_rank']} vs fastest "
+                f"{r['fastest_rank']}: +{r.get('skew_ms', 0.0):.2f} ms"
+                + (f" ({r['skew_pct']}%)" if r.get("skew_pct") is not None else "")
+                + f", mostly {r.get('straggler_phase', '?')}"
+                f" (+{r.get('straggler_phase_delta_ms', 0.0):.2f} ms)"
             )
     return "\n".join(lines)
 
