@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke bench
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke bench
 
 # graftlint: whole-program trace-safety & collective-correctness static
 # analysis (docs/graftlint.md). Runs before the suite. The on-disk cache
@@ -57,7 +57,15 @@ serve-smoke:
 profile-smoke:
 	JAX_PLATFORMS=cpu python tools/profile_smoke.py
 
-test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke
+# zero-cold-start proof (docs/aot_cache.md): tiny GPT trained 2 steps in a
+# fresh subprocess (miss → compile → store), then restarted in a SECOND
+# fresh subprocess against the same cache dir — asserts the first captured
+# call of the restart has zero trace/compile phase time (telemetry-
+# verified), >= 1 cache hit, and bitwise-equal losses to the cold run
+cache-smoke:
+	JAX_PLATFORMS=cpu python tools/cache_smoke.py
+
+test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke
 	python -m pytest tests/ -q
 
 test_core:
@@ -65,7 +73,8 @@ test_core:
 	  tests/test_operations.py tests/test_data_loader.py tests/test_native.py \
 	  tests/test_data_loader_grid.py tests/test_num_workers.py \
 	  tests/test_optimizer.py tests/test_optimizer_offload.py \
-	  tests/test_capture_stability.py tests/test_precision.py \
+	  tests/test_capture_stability.py tests/test_aot_cache.py \
+	  tests/test_precision.py \
 	  tests/test_fp16_capture.py tests/test_autocast.py \
 	  tests/test_comm_hook.py tests/test_powersgd.py \
 	  tests/test_config_knobs.py \

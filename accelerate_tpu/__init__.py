@@ -50,6 +50,7 @@ from .utils.modeling import (
 )
 from .utils.random import set_seed, synchronize_rng_states
 from .utils.dataclasses import (
+    CompilationCacheKwargs,
     CompressionKwargs,
     DataLoaderConfiguration,
     DataParallelPlugin,

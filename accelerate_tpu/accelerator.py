@@ -101,10 +101,12 @@ class Accelerator:
         self.telemetry_handler = None
         self.resilience_handler = None
         self.compression_handler = None
+        self.aot_cache_handler = None
         from .utils.dataclasses import FP8RecipeKwargs
 
         from .utils.dataclasses import (
             AutocastKwargs,
+            CompilationCacheKwargs,
             CompressionKwargs,
             DistributedDataParallelKwargs,
             ResilienceKwargs,
@@ -116,6 +118,8 @@ class Accelerator:
                 self.telemetry_handler = handler
             elif isinstance(handler, CompressionKwargs):
                 self.compression_handler = handler
+            elif isinstance(handler, CompilationCacheKwargs):
+                self.aot_cache_handler = handler
             elif isinstance(handler, ResilienceKwargs):
                 self.resilience_handler = handler
             elif isinstance(handler, AutocastKwargs):
@@ -278,6 +282,26 @@ class Accelerator:
         from .resilience import Resilience
 
         self.resilience = Resilience(self.resilience_handler, telemetry=self.telemetry)
+
+        # persistent AOT executable cache (docs/aot_cache.md): always
+        # constructed, OFF unless CompilationCacheKwargs/$ACCELERATE_AOT_CACHE
+        # names a cache dir — compile_step pins the enabled instance so the
+        # captured build path pays one None-check when off; enabled, builds
+        # deserialize stored executables instead of tracing+compiling, the
+        # hit/miss stream lands as kind="aot_cache" telemetry, and the live
+        # counters serve as atpu_aot_cache_* on the metrics endpoint
+        from .native.aot_cache import AOTCompilationCache, _set_active
+
+        self.aot_cache = AOTCompilationCache(self.aot_cache_handler)
+        # pin the run's topology into the ONE canonical fingerprint now —
+        # a restore-path prefetch() can run before the first captured build,
+        # and both must hash the same mesh/compression or the prefetch pins
+        # a fingerprint no stored entry was keyed under
+        self.aot_cache.set_context(
+            mesh=self.state.mesh, compression=self._compression.name
+        )
+        self.aot_cache.attach_telemetry(self.telemetry)
+        _set_active(self.aot_cache if self.aot_cache.enabled else None)
 
         # seed the nn RNG only when explicitly requested or still unseeded —
         # never clobber a user's earlier manual_seed
@@ -1266,6 +1290,13 @@ class Accelerator:
         models = list(self._models)
         for hook in self._load_state_pre_hooks.values():
             hook(models, input_dir)
+        # zero-cold-start coupling (docs/aot_cache.md): a restore — the
+        # resilience rollback path and the latest_checkpoint preemption
+        # resume both land here — warms the executable cache FIRST, so the
+        # replayed step deserializes the same compiled program from memory
+        # instead of recompiling (or even touching disk on the step path)
+        if self.aot_cache.enabled and self.aot_cache.warm_on_restore:
+            self.aot_cache.prefetch()
         override = load_accelerator_state(
             input_dir,
             models=models,

@@ -36,7 +36,11 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 # v5: blocking-in-hot-loop gained the profiler-session check
 # (jax.profiler start/stop_trace in a loop without sampled-cadence
 # evidence; a profiling-knob guard alone no longer exempts those calls).
-ANALYSIS_VERSION = "5"
+# v6: recompile-hazard gained the AOT executable cache-key contract
+# (deserialize_and_load of a serialized executable without a fingerprint/
+# cache-key check in scope — a stale entry from another topology or jax
+# version must fall through to a compile, never dispatch; docs/aot_cache.md).
+ANALYSIS_VERSION = "6"
 
 # Names that mark a branch/function as profiling/benchmark plumbing, where a
 # deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the

@@ -160,6 +160,19 @@ def _names_in_concretizing_positions(test: ast.AST):
 
 # names whose assignment marks a captured-step callable
 _CAPTURE_LEAVES = {"compile_step", "CapturedStep"}
+# AOT executable deserialization entry points (docs/aot_cache.md): loading a
+# serialized executable bypasses trace+compile, so NOTHING re-validates that
+# the program matches this process — the caller must check the entry's
+# fingerprint/cache key (jax+jaxlib version, platform, device kind+count,
+# mesh) or a stale entry from another topology dispatches a wrong program
+_DESERIALIZE_LEAVES = {"deserialize_and_load"}
+# evidence the caller checks the cache-key contract before loading: a
+# fingerprint/cache-key/topology-named variable, attribute, or dict key
+# anywhere in the enclosing scope (the aot_cache layer's own loaders name
+# their guards exactly this way)
+_FINGERPRINT_EVIDENCE_RE = re.compile(
+    r"fingerprint|cache_key|cachekey|topolog|fp_digest", re.IGNORECASE
+)
 # captured serving/decode entry points (serving/engine.py): their ids/table
 # arguments become program SHAPES, so request-derived lengths must pass
 # through the bucketing helper (kv_blocks.bucket_length / generation.bucket_up)
@@ -318,6 +331,60 @@ class RecompileHazard(Rule):
             findings.extend(self._scan_body(module, info, dynamic))
         findings.extend(self._scan_capture_loops(module))
         findings.extend(self._scan_serving_calls(module))
+        findings.extend(self._scan_aot_deserialize(module))
+        return findings
+
+    # -- AOT cache-key contract ------------------------------------------------
+    def _scan_aot_deserialize(self, module):
+        """A serialized executable deserialized without any fingerprint/
+        cache-key check in scope: deserialize_and_load skips trace AND
+        compile, so no layer below the caller re-validates that the stored
+        program matches this process's topology/compiler — a stale entry
+        (different device count, jax version, compression policy) would
+        dispatch a wrong program instead of recompiling."""
+        findings = []
+        cg = module.callgraph
+        scopes = [module.tree] + [info.node for info in cg.functions.values()]
+        for scope in scopes:
+            calls = []
+            evidence = False
+            # own statements only: a nested function's deserialize call (and
+            # its fingerprint guard) is judged in the nested scope's own row
+            for node in iter_own_nodes(scope):
+                if isinstance(node, ast.Call):
+                    resolved = module.resolve(node.func) or ""
+                    if resolved.rsplit(".", 1)[-1] in _DESERIALIZE_LEAVES:
+                        calls.append(node)
+                name = None
+                if isinstance(node, ast.Name):
+                    name = node.id
+                elif isinstance(node, ast.Attribute):
+                    name = node.attr
+                elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    name = node.value  # meta["fingerprint"]-style dict keys
+                if name and _FINGERPRINT_EVIDENCE_RE.search(name):
+                    evidence = True
+            if not calls or evidence:
+                continue
+            qual = getattr(scope, "name", "")
+            for call in calls:
+                findings.append(
+                    Finding(
+                        self.id,
+                        module.rel_path,
+                        call.lineno,
+                        call.col_offset,
+                        "serialized executable deserialized without a "
+                        "fingerprint/cache-key check in scope — "
+                        "deserialize_and_load skips trace AND compile, so a "
+                        "stale entry (different device count/kind, jax or "
+                        "jaxlib version, mesh, compression policy) dispatches "
+                        "a wrong program; compare the entry's stored "
+                        "fingerprint against the live topology first "
+                        "(docs/aot_cache.md §invalidation)",
+                        symbol=qual,
+                    )
+                )
         return findings
 
     # -- serving bucketing contract -------------------------------------------

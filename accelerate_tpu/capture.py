@@ -17,6 +17,7 @@ compiled program, so LR schedules work across replays without recompiles.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time as _time
 from typing import Any, Callable, Optional
@@ -47,6 +48,11 @@ class CaptureContext:
 
     def __init__(self, owner_advances_accumulate: bool = False):
         self.deferred_scheduler_steps: list[tuple[Any, tuple, dict]] = []
+        # True when this context's entry was deserialized from the AOT
+        # executable cache (docs/aot_cache.md): no trace ran, the side
+        # metadata below was restored from disk, and the entry must not be
+        # re-serialized (a loaded executable may not round-trip)
+        self.aot_loaded = False
         # `with accelerator.accumulate(model):` inside the captured body —
         # legal: the owning CapturedStep advances the schedule host-side once
         # per replay, so the trace-time flag is already the replay-time flag
@@ -186,6 +192,12 @@ class CapturedStep:
         # fault injector's hooks fire
         res = getattr(accelerator, "resilience", None)
         self._resilience = res if (res is not None and res.enabled) else None
+        # persistent AOT executable cache (docs/aot_cache.md): same pinning
+        # discipline — when OFF every build/dispatch line runs exactly as
+        # before this subsystem existed; when ON, builds consult the on-disk
+        # store before tracing and store after compiling
+        cache = getattr(accelerator, "aot_cache", None)
+        self._aot_cache = cache if (cache is not None and cache.enabled) else None
         self._last_key = None  # previous variant key, for recompile forensics
         self._last_build_ms = (0.0, 0.0)  # (trace_ms, compile_ms) of last build
         # monotonic build counter for program-record labels: cache size would
@@ -270,6 +282,15 @@ class CapturedStep:
         prof = tel.profiler if tel is not None else None
         prof_step = -1
         acc = self.accelerator
+        if self._uses_accumulate is None and self._aot_cache is not None:
+            # warm-start profile sidecar (docs/aot_cache.md): on a genuinely
+            # first call the trace would reveal whether the body accumulates
+            # — but a cache hit skips the trace, and an accumulate-using
+            # body must advance its schedule host-side BEFORE the key below
+            # is computed, or the key misses the entry the cold process
+            # stored under.  None (no profile on disk) keeps the legacy
+            # first-trace discovery path.
+            self._uses_accumulate = self._aot_cache.step_profile_uses_accumulate(self)
         if self._uses_accumulate:
             # body contains `with accelerator.accumulate(...)`: advance the
             # micro-step schedule here, host-side, so the sync_gradients flag
@@ -339,7 +360,11 @@ class CapturedStep:
             if prof.start(tel.steps_total, t0=t_call):
                 prof_step = tel.steps_total
         try:
-            if tel is not None:
+            if tel is not None or self._aot_cache is not None:
+                # AOT-compiled entries (telemetry's split builds AND cache-
+                # armed builds) reject drifted input layouts instead of
+                # silently re-tracing — route through the drift-tolerant
+                # dispatch either way; _dispatch_aot is telemetry-optional
                 t_dispatch = _time.perf_counter()
                 if retrier is None:
                     new_state, out, entry, retry_rebuild = self._dispatch_aot(
@@ -427,6 +452,24 @@ class CapturedStep:
                 "such a step. Call accumulate() unconditionally inside the "
                 "body, or move it outside the captured call."
             )
+        if (
+            built
+            and self._aot_cache is not None
+            and not ctx.aot_loaded
+            and not hasattr(entry[0], "lower")
+        ):
+            # persist the freshly compiled executable under the FINAL key
+            # (the accumulate re-file above already settled it) so the next
+            # process starts zero-cold.  Plain-jit fallback entries (.lower
+            # present: repeated layout drift) hold no serializable
+            # executable; cache-loaded entries must not round-trip.
+            # Fail-soft by construction — store_captured records its own
+            # store_failed cause and never raises into the step.
+            build_trace_ms, build_compile_ms = self._last_build_ms
+            self._aot_cache.store_captured(
+                self, key, entry[0], ctx, state, entry[3],
+                build_trace_ms, build_compile_ms,
+            )
         # deferred scheduler steps run for real, python-side, every replay
         for scheduler, s_args, s_kwargs in ctx.deferred_scheduler_steps:
             scheduler.step(*s_args, _from_capture_replay=True, **s_kwargs)
@@ -471,10 +514,16 @@ class CapturedStep:
         rebuild against the live inputs — but make the event loud: this
         rebuild is exactly the hidden multi-minute recompile the forensics
         pillar exists to expose.  Returns (new_state, out, entry,
-        retry_rebuild)."""
+        retry_rebuild).  ``tel`` may be None (cache-armed, telemetry-off
+        runs ride this path too): spans and events are then skipped, the
+        drift handling is identical."""
         executable = entry[0]
+
+        def span(name):
+            return tel.span(name) if tel is not None else contextlib.nullcontext()
+
         try:
-            with tel.span("atpu/dispatch"):
+            with span("atpu/dispatch"):
                 return (*executable(dev_leaves, host_leaves, *flat_args), entry, False)
         except (TypeError, ValueError) as exc:
             # TypeError/ValueError is how the executable's *argument
@@ -507,17 +556,24 @@ class CapturedStep:
                     "plain jit dispatch (per-step trace/compile split "
                     "no longer attributed)"
                 )
-            tel.record_recompile(
-                RecompileEvent(
-                    step=tel.steps_total,
-                    key=key_id(key),
-                    prev_key=key_id(key),
-                    causes=[cause],
-                    kind="layout",
+            if tel is not None:
+                tel.record_recompile(
+                    RecompileEvent(
+                        step=tel.steps_total,
+                        key=key_id(key),
+                        prev_key=key_id(key),
+                        causes=[cause],
+                        kind="layout",
+                    )
                 )
-            )
+            # skip_cache_load: the stored entry matches the layouts this
+            # very rejection just proved stale — loading it back would fail
+            # the retry dispatch identically; the fresh compile below gets
+            # re-stored under the live layouts by __call__
             self._cache.pop(key, None)
-            entry = self._build(key, state, args, force_plain=drifts >= 2)
+            entry = self._build(
+                key, state, args, force_plain=drifts >= 2, skip_cache_load=True
+            )
             # the rebuild recomputed host_mask from the live state — if the
             # drift moved a leaf between memory spaces, the caller's dev/host
             # split is stale, so re-split against the new mask
@@ -528,7 +584,7 @@ class CapturedStep:
             # argument validation fails BEFORE any buffer is donated, so the
             # leaves the failed call touched are intact for the retry; an
             # error from the rebuilt program is real and propagates
-            with tel.span("atpu/dispatch"):
+            with span("atpu/dispatch"):
                 new_state, out = entry[0](dev_leaves, host_leaves, *flat_args)  # graftlint: disable=donation-reuse
             return new_state, out, entry, True
 
@@ -556,7 +612,8 @@ class CapturedStep:
             )
         )
 
-    def _build(self, key, state_template, args_template, force_plain: bool = False):
+    def _build(self, key, state_template, args_template, force_plain: bool = False,
+               skip_cache_load: bool = False):
         acc = self.accelerator
         _, args_treedef = jax.tree_util.tree_flatten(args_template)
         captured_ctx = CaptureContext(
@@ -644,31 +701,67 @@ class CapturedStep:
 
         jitted = jax.jit(traced, donate_argnums=(0,))
         tel = self._telemetry
-        if tel is not None and not force_plain:
+        cache = self._aot_cache
+        if (tel is not None or cache is not None) and not force_plain:
             # AOT capture: lower and compile explicitly so (a) trace vs
             # compile time are separately attributable, (b) the executable's
-            # memory_analysis/cost_analysis are recordable at capture time.
-            # The compiled object is call-compatible with the jitted one and
-            # honors the same donation; the one behavioral difference (it
-            # *rejects* drifted input layouts instead of silently re-tracing)
-            # is handled — and surfaced as a telemetry event — in __call__.
-            flat_state, _ = jax.tree_util.tree_flatten(state_template)
-            dev_leaves = tuple(x for x, h in zip(flat_state, host_mask) if not h)
-            host_leaves = tuple(x for x, h in zip(flat_state, host_mask) if h)
-            flat_args, _ = jax.tree_util.tree_flatten(args_template)
-            t0 = _time.perf_counter()
-            with tel.span("atpu/trace"):
-                lowered = jitted.lower(dev_leaves, host_leaves, *flat_args)
-            t1 = _time.perf_counter()
-            with tel.span("atpu/compile"):
-                compiled = lowered.compile()
-            t2 = _time.perf_counter()
-            self._last_build_ms = ((t1 - t0) * 1e3, (t2 - t1) * 1e3)
-            label = f"capture:{self._builds_total}"
+            # memory_analysis/cost_analysis are recordable at capture time,
+            # (c) the compiled object is serializable into the persistent
+            # executable cache (docs/aot_cache.md).  The compiled object is
+            # call-compatible with the jitted one and honors the same
+            # donation; the one behavioral difference (it *rejects* drifted
+            # input layouts instead of silently re-tracing) is handled — and
+            # surfaced as a telemetry event — in __call__.
+            compiled = side = None
+            if cache is not None and not skip_cache_load:
+                compiled, side = cache.load_captured(
+                    self, key, state_template, host_mask
+                )
+            if compiled is not None:
+                # zero-cold-start hit: the deserialized executable IS the
+                # program the storing process compiled — no trace, no XLA
+                # compile, telemetry's trace/compile phases read 0.  The
+                # trace-time side metadata a skipped trace cannot rediscover
+                # (accumulate use, deferred scheduler replays) is restored
+                # from the entry.
+                self._last_build_ms = (0.0, 0.0)
+                captured_ctx.aot_loaded = True
+                captured_ctx.used_accumulate = bool(side.get("uses_accumulate"))
+                schedulers = acc._schedulers
+                for replay in side.get("scheduler_replays", []):
+                    captured_ctx.deferred_scheduler_steps.append(
+                        (
+                            schedulers[replay["index"]],
+                            tuple(replay.get("args", ())),
+                            dict(replay.get("kwargs", {})),
+                        )
+                    )
+                label = f"capture:{self._builds_total}:aot"
+            else:
+                flat_state, _ = jax.tree_util.tree_flatten(state_template)
+                dev_leaves = tuple(x for x, h in zip(flat_state, host_mask) if not h)
+                host_leaves = tuple(x for x, h in zip(flat_state, host_mask) if h)
+                flat_args, _ = jax.tree_util.tree_flatten(args_template)
+
+                def span(name):
+                    return (
+                        tel.span(name) if tel is not None else contextlib.nullcontext()
+                    )
+
+                t0 = _time.perf_counter()
+                with span("atpu/trace"):
+                    lowered = jitted.lower(dev_leaves, host_leaves, *flat_args)
+                t1 = _time.perf_counter()
+                with span("atpu/compile"):
+                    compiled = lowered.compile()
+                t2 = _time.perf_counter()
+                self._last_build_ms = ((t1 - t0) * 1e3, (t2 - t1) * 1e3)
+                label = f"capture:{self._builds_total}"
             self._builds_total += 1
-            tel.record_program(key, label, compiled)
-            if tel.resource_sampling:
-                tel.sample_resources(label)
+            if tel is not None:
+                tel.record_program(key, label, compiled)
+                if tel.resource_sampling:
+                    tel.sample_resources(label)
             entry = (compiled, captured_ctx, state_treedef, host_mask)
         else:
             if tel is not None:

@@ -189,7 +189,22 @@ class StepRetrier:
                     donated_consumed=consumed,
                     error=error,
                 )
+                # zero-cold-start coupling: load_state warms the AOT
+                # executable cache before restoring, so even a rollback that
+                # somehow lost the in-memory entry (a state-structure change
+                # popped it) replays the serialized executable instead of
+                # recompiling; record how many entries the warm staged
+                cache = getattr(step.accelerator, "aot_cache", None)
                 step.accelerator.load_state(checkpoint)
+                if cache is not None and cache.enabled and cache.warm_on_restore:
+                    # warm_on_restore off means load_state ran NO prefetch —
+                    # reporting a stale count would claim a warm that never
+                    # happened on this restore
+                    hub.record_event(
+                        "aot_cache_warm",
+                        step=call_index,
+                        entries=cache.last_prefetch_count,
+                    )
                 import jax
 
                 flat_state, _ = jax.tree_util.tree_flatten(step._collect_state())

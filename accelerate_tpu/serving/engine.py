@@ -205,55 +205,77 @@ class CompileWatcher:
         self._seen: set = set()
         self._calls = 0
 
+    def note_build(self, label: str, signature, seen: Optional[bool] = None) -> None:
+        """Count one program build.  A build on an already-seen signature is
+        a steady-state recompile (counted + emitted as forensics).  Shared
+        by the jit-cache diff path below and the AOT executable-cache path
+        (native/aot_cache.py), so both dispatch routes keep one contract —
+        including the warmed-from-disk case, where ``seen`` is passed
+        explicitly because the watcher never saw the cold build."""
+        if seen is None:
+            seen = signature in self._seen
+        self.compiles_total += 1
+        if seen:
+            self.recompile_events += 1
+            if self.hub is not None:
+                from ..telemetry import RecompileEvent, key_id
+
+                self.hub.record_recompile(
+                    RecompileEvent(
+                        step=self._calls,
+                        key=key_id(signature),
+                        prev_key=key_id(signature),
+                        causes=[
+                            f"serving {label} compiled a new program for an "
+                            f"already-warm signature {signature!r} — the "
+                            "zero-recompile steady-state contract is broken"
+                        ],
+                        kind="serving",
+                    )
+                )
+        self._seen.add(signature)
+
     def call(self, label: str, signature, jit_fn, *args, **kwargs):
         self._calls += 1
         seen = signature in self._seen
         before = jit_fn._cache_size()
         out = jit_fn(*args, **kwargs)
         if jit_fn._cache_size() > before:
-            self.compiles_total += 1
-            if seen:
-                self.recompile_events += 1
-                if self.hub is not None:
-                    from ..telemetry import RecompileEvent, key_id
-
-                    self.hub.record_recompile(
-                        RecompileEvent(
-                            step=self._calls,
-                            key=key_id(signature),
-                            prev_key=key_id(signature),
-                            causes=[
-                                f"serving {label} compiled a new program for an "
-                                f"already-warm signature {signature!r} — the "
-                                "zero-recompile steady-state contract is broken"
-                            ],
-                            kind="serving",
-                        )
-                    )
-        self._seen.add(signature)
+            self.note_build(label, signature, seen=seen)
+        else:
+            self._seen.add(signature)
         return out
 
 
 def run_prefill(k_pool, v_pool, g, layers, padded_ids, block_row, prompt_len,
-                rng, *, family, cfg, qbits, temperature, watcher: Optional[CompileWatcher] = None):
+                rng, *, family, cfg, qbits, temperature,
+                watcher: Optional[CompileWatcher] = None, aot=None):
     """One request's bucketed prefill; see ``_prefill_jit``.  ``padded_ids``
     must already be bucket-padded (``kv_blocks.bucket_length``) — raw
     request-length shapes here compile one program per distinct length
-    (graftlint: recompile-hazard serving contract)."""
+    (graftlint: recompile-hazard serving contract).  ``aot`` (an
+    :class:`~..native.aot_cache.AOTServingPrograms`) replaces the jit
+    dispatch with the persistent-executable path: signature hits run the
+    deserialized program, misses compile explicitly and store it."""
     args = (k_pool, v_pool, g, layers, padded_ids, block_row, prompt_len, rng)
     statics = dict(family=family, cfg=cfg, qbits=qbits, temperature=temperature)
+    sig = ("prefill", padded_ids.shape[1], qbits, float(temperature))
+    if aot is not None:
+        return aot.call("prefill", sig, _prefill_jit, args, statics, watcher=watcher)
     if watcher is None:
         return _prefill_jit(*args, **statics)
-    sig = ("prefill", padded_ids.shape[1], qbits, float(temperature))
     return watcher.call("prefill", sig, _prefill_jit, *args, **statics)
 
 
 def run_decode(k_pool, v_pool, g, layers, block_tables, positions, tokens,
-               rngs, *, family, cfg, qbits, temperature, watcher: Optional[CompileWatcher] = None):
+               rngs, *, family, cfg, qbits, temperature,
+               watcher: Optional[CompileWatcher] = None, aot=None):
     """One token for the whole slot batch; see ``_decode_jit``."""
     args = (k_pool, v_pool, g, layers, block_tables, positions, tokens, rngs)
     statics = dict(family=family, cfg=cfg, qbits=qbits, temperature=temperature)
+    sig = ("decode", block_tables.shape, qbits, float(temperature))
+    if aot is not None:
+        return aot.call("decode", sig, _decode_jit, args, statics, watcher=watcher)
     if watcher is None:
         return _decode_jit(*args, **statics)
-    sig = ("decode", block_tables.shape, qbits, float(temperature))
     return watcher.call("decode", sig, _decode_jit, *args, **statics)

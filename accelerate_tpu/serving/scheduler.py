@@ -122,7 +122,8 @@ class DecodeService:
     keep their GSPMD layouts — pools and activations inherit them.
     """
 
-    def __init__(self, model, config: Optional[ServingConfig] = None, telemetry=None):
+    def __init__(self, model, config: Optional[ServingConfig] = None, telemetry=None,
+                 aot_cache=None):
         from ..models.generation import stacked_params_for_mode
 
         self.config = cfg = config or ServingConfig()
@@ -219,6 +220,40 @@ class DecodeService:
             telemetry = current_telemetry()
         self._hub = telemetry if (telemetry is not None and telemetry.enabled) else None
         self.watcher = CompileWatcher(hub=self._hub)
+        # persistent AOT executable cache (docs/aot_cache.md): when armed
+        # (explicit handle or the process-active cache), every bucket
+        # program this service compiles is serialized, and a FRESH replica
+        # of the same geometry+topology warms them all from disk right here
+        # — spin-up collapses from per-bucket XLA compiles to disk reads.
+        # Off (the default): both run_* calls below dispatch the plain jit
+        # path byte-identically to the pre-cache service.
+        if aot_cache is None:
+            from ..native.aot_cache import current_aot_cache
+
+            aot_cache = current_aot_cache()
+        self._aot = None
+        if aot_cache is not None and aot_cache.enabled:
+            import jax as _jax
+
+            from ..native.aot_cache import AOTServingPrograms, _leaf_aval
+
+            service_fingerprint = {
+                "family": type(self.spec.family).__name__,
+                "cfg": repr(dcfg),
+                "qbits": self._qbits,
+                "temperature": float(cfg.temperature),
+                "block_size": cfg.block_size,
+                "max_slots": cfg.max_slots,
+                "prompt_bucket": cfg.prompt_bucket,
+                "capacity": self.capacity,
+                "pools": [_leaf_aval(self._k_pool), _leaf_aval(self._v_pool)],
+                "params": [
+                    _leaf_aval(leaf)
+                    for leaf in _jax.tree_util.tree_leaves((self._g, self._layers))
+                ],
+            }
+            self._aot = AOTServingPrograms(aot_cache, service_fingerprint)
+            self._aot.warm()
         self.stats = {
             "steps": 0,
             "admitted": 0,
@@ -340,7 +375,7 @@ class DecodeService:
                 family=self.spec.family, cfg=self.spec.cfg,
                 qbits=self._qbits,
                 temperature=float(self.config.temperature),
-                watcher=self.watcher,
+                watcher=self.watcher, aot=self._aot,
             )
             first = int(tok)
             req.first_token_t = time.perf_counter()
@@ -414,7 +449,7 @@ class DecodeService:
                 family=self.spec.family, cfg=self.spec.cfg,
                 qbits=self._qbits,
                 temperature=float(self.config.temperature),
-                watcher=self.watcher,
+                watcher=self.watcher, aot=self._aot,
             )
             nxt_host = np.asarray(nxt)
             for slot in active:

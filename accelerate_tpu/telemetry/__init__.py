@@ -106,6 +106,9 @@ class Telemetry:
         # serving subsystem events (per-step occupancy/queue depth, per-
         # request TTFT/TPOT completions) — see serving/scheduler.py
         self.serving_events: deque[dict] = deque(maxlen=handler.max_events)
+        # AOT executable cache events (hit/miss/store/warm with cause,
+        # bytes, load vs avoided compile ms) — see native/aot_cache.py
+        self.aot_cache_events: deque[dict] = deque(maxlen=handler.max_events)
         # sampled device-time attribution (profiler.py): a DeviceStepRecord
         # per sampled step, joined to the host StepRecord by step index;
         # profiler is None unless the cadence knob armed it — the unsampled
@@ -266,6 +269,19 @@ class Telemetry:
         if self._export_sink:
             self._export_queue.append(dict(record))
 
+    def record_aot_cache(self, payload: dict) -> None:
+        """AOT executable cache event (hit/miss/store/warm with cause,
+        bytes, load_ms vs avoided compile_ms) — kind-tagged ``"aot_cache"``
+        into the same retained history and export stream as the capture
+        records (docs/aot_cache.md)."""
+        if not self.enabled:
+            return
+        record = dict(payload)
+        record["kind"] = "aot_cache"
+        self.aot_cache_events.append(record)
+        if self._export_sink:
+            self._export_queue.append(dict(record))
+
     def record_device_step(self, record: DeviceStepRecord) -> DeviceStepRecord:
         """Sampled device-time record from the profiler: join the program's
         analytic FLOPs (``cost_analysis`` recorded at build) by variant key
@@ -340,6 +356,7 @@ class Telemetry:
                 if record.get("kind") in (
                     "step", "recompile", "program", "collectives",
                     "resources", "resilience", "serving", "device_step",
+                    "aot_cache",
                 ):
                     self._export_queue.append(record)
 
@@ -355,6 +372,12 @@ class Telemetry:
         out["recompiles_total"] = self.recompiles_total
         out["schema_version"] = SCHEMA_VERSION
         out["eager_dataloader_wait_ms"] = round(self.eager_dataloader_wait_ms, 3)
+        if self.aot_cache_events:
+            events = list(self.aot_cache_events)
+            out["aot_cache_hits"] = sum(1 for e in events if e.get("event") == "hit")
+            out["aot_cache_misses"] = sum(
+                1 for e in events if e.get("event") == "miss"
+            )
         if self.device_records:
             records = list(self.device_records)
             out["device_samples"] = len(records)
@@ -385,6 +408,7 @@ class Telemetry:
         records += [s.to_dict() for s in self.resource_samples]
         records += [dict(e) for e in self.resilience_events]
         records += [dict(e) for e in self.serving_events]
+        records += [dict(e) for e in self.aot_cache_events]
         records.append(self.summary())
         return records
 

@@ -314,6 +314,63 @@ class CompressionKwargs(KwargsHandler):
 
 
 @dataclass
+class CompilationCacheKwargs(KwargsHandler):
+    """Persistent AOT executable cache knobs (``accelerator.aot_cache``,
+    docs/aot_cache.md).
+
+    No reference counterpart — compiled-program persistence is an XLA-native
+    concern.  ``cache_dir`` names the on-disk store; when left ``None`` it
+    resolves from ``$ACCELERATE_AOT_CACHE`` (unset = cache off).  Off means
+    the capture/serving hot paths run their pre-cache code byte-for-byte
+    (one ``None``-check, matching the telemetry/resilience precedent).
+
+    Every compiled captured program (and every serving prefill/decode bucket
+    program) is serialized via ``jax.experimental.serialize_executable`` into
+    a content-addressed entry keyed on the capture cache key extended with a
+    topology/compiler fingerprint (jax/jaxlib version, platform, device
+    kind+count, process count, mesh shape, donation split, compression
+    policy).  A later process with a matching fingerprint deserializes the
+    executable and skips trace+compile entirely; ANY mismatch falls through
+    to a normal compile with a loud ``kind="aot_cache"`` miss record.
+
+    ``max_bytes`` bounds the store (LRU eviction, ``$ACCELERATE_AOT_CACHE_
+    MAX_BYTES``); ``warm_on_restore`` prefetches matching entries into
+    memory during ``load_state`` (the resilience rollback / preemption-resume
+    path) so restore-after-fault replays the serialized executable without a
+    step-path disk read.  ``jax_cache_dir`` additionally arms jax's own
+    persistent XLA compilation cache (``$ACCELERATE_AOT_CACHE_JAX_DIR``) as
+    a second layer for programs outside the capture path.
+    """
+
+    cache_dir: Optional[str] = None  # None → $ACCELERATE_AOT_CACHE, unset = off
+    enabled: Optional[bool] = None  # None → on iff cache_dir resolves
+    max_bytes: int = 2 << 30  # $ACCELERATE_AOT_CACHE_MAX_BYTES
+    warm_on_restore: bool = True
+    jax_cache_dir: Optional[str] = None  # $ACCELERATE_AOT_CACHE_JAX_DIR
+
+    def __post_init__(self):
+        env = os.environ
+        if self.cache_dir is None:
+            value = env.get("ACCELERATE_AOT_CACHE")
+            # "0"/"false" must read as "off", not as a relative cache dir
+            if value and value.lower() not in ("0", "false", "no", "off"):
+                self.cache_dir = value
+        if self.enabled is None:
+            self.enabled = self.cache_dir is not None
+        if "ACCELERATE_AOT_CACHE_MAX_BYTES" in env:
+            try:
+                self.max_bytes = int(env["ACCELERATE_AOT_CACHE_MAX_BYTES"])
+            except ValueError:
+                warnings.warn(
+                    "ACCELERATE_AOT_CACHE_MAX_BYTES="
+                    f"{env['ACCELERATE_AOT_CACHE_MAX_BYTES']!r} is not an "
+                    "integer; keeping the default"
+                )
+        if self.jax_cache_dir is None:
+            self.jax_cache_dir = env.get("ACCELERATE_AOT_CACHE_JAX_DIR")
+
+
+@dataclass
 class DistributedDataParallelKwargs(KwargsHandler):
     """Accepted for API parity with the reference (dataclasses.py:149).
 

@@ -519,6 +519,85 @@ def _compression_ab_block(on_accel: bool) -> dict:
     return out
 
 
+def _aot_cache_block(on_accel: bool) -> dict:
+    """Cold/warm AOT-executable-cache A/B for the primary row
+    (docs/aot_cache.md): the SAME GPT step built twice against one cache
+    dir.  The second build runs in a process-simulated fresh start —
+    ``Accelerator._reset_state()`` plus ``jax.clear_caches()`` drop every
+    in-memory jit/pjit entry, so the only thing that can skip trace+compile
+    is the serialized executable on disk.  Reported: ``first_step_ms_cold``
+    / ``first_step_ms_warm`` (the autoscaling cold-start the ROADMAP names),
+    hit/miss counters, and the speedup ratio (acceptance: >= 5x on the CPU
+    smoke geometry).  ``BENCH_AOT_CACHE=0`` disables the block."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator, CompilationCacheKwargs, TelemetryKwargs
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    cache_dir = tempfile.mkdtemp(prefix="atpu_bench_aot_")
+    n_dev = len(jax.devices())
+    cfg = GPTConfig.small() if on_accel else GPTConfig.tiny()
+    batch, seq = (BATCH * n_dev, SEQ) if on_accel else (2, 128)
+
+    def build_once() -> tuple[float, float, int, int]:
+        Accelerator._reset_state()
+        jax.clear_caches()
+        nn.manual_seed(0)
+        acc = Accelerator(
+            mixed_precision="bf16" if on_accel else "no",
+            kwargs_handlers=[
+                TelemetryKwargs(enabled=True),
+                CompilationCacheKwargs(cache_dir=cache_dir),
+            ],
+        )
+        model = GPTLMHeadModel(cfg)
+        opt = optim.AdamW(model.parameters(), lr=3e-4, weight_decay=0.1)
+        model, opt = acc.prepare(model, opt)
+
+        def step_fn(ids):
+            opt.zero_grad()
+            out = model(ids, labels=ids)
+            acc.backward(out["loss"])
+            opt.step()
+            return out["loss"]
+
+        step = acc.compile_step(step_fn)
+        ids = batch_to_global_array(
+            jnp.asarray(
+                np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)),
+                jnp.int32,
+            ),
+            mesh=acc.mesh,
+        )
+        t0 = _time.perf_counter()
+        loss = float(step(ids))
+        first_ms = (_time.perf_counter() - t0) * 1e3
+        return first_ms, loss, acc.aot_cache.hits, acc.aot_cache.misses
+
+    try:
+        cold_ms, cold_loss, _, cold_misses = build_once()
+        warm_ms, warm_loss, warm_hits, warm_misses = build_once()
+        return {
+            "first_step_ms_cold": round(cold_ms, 1),
+            "first_step_ms_warm": round(warm_ms, 1),
+            "aot_cache_hits": warm_hits,
+            "aot_cache_misses": cold_misses + warm_misses,
+            "aot_cache_speedup": round(cold_ms / warm_ms, 2) if warm_ms else None,
+            "aot_cache_loss_bitwise_equal": cold_loss == warm_loss,
+        }
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def _serving_block(on_accel: bool) -> dict:
     """Serving rows for the primary JSON (docs/serving.md): the continuous-
     batching decode service on the flagship GPT geometry under a synthetic
@@ -985,6 +1064,16 @@ def main() -> None:
             result.update(_compression_ab_block(on_accel))
         except Exception as exc:
             result["compression_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    if os.environ.get("BENCH_AOT_CACHE", "1") != "0":
+        # zero-cold-start A/B (docs/aot_cache.md): cold vs warm first-step
+        # latency against a fresh cache dir — fail-soft like the extras;
+        # with the block disabled the row says so instead of going missing
+        try:
+            result.update(_aot_cache_block(on_accel))
+        except Exception as exc:
+            result["aot_cache_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    else:
+        result["aot_cache_skipped"] = "disabled via BENCH_AOT_CACHE=0"
     if os.environ.get("BENCH_SERVING", "1") != "0":
         # continuous-batching decode service under a Poisson trace
         # (docs/serving.md): TTFT/TPOT percentiles, throughput, occupancy,

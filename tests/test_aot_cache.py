@@ -1,0 +1,409 @@
+"""AOT executable cache (docs/aot_cache.md): warm restarts must dispatch the
+deserialized executable with ZERO trace/compile phase time and bitwise-equal
+losses; any fingerprint/entry problem must fall through to a normal compile
+with a loud miss — never a crash, never a wrong-program dispatch; the
+cache-off path is pinned to the pre-cache code."""
+
+import glob
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import (
+    Accelerator,
+    CompilationCacheKwargs,
+    TelemetryKwargs,
+)
+from accelerate_tpu.native.aot_cache import (
+    AOTCompilationCache,
+    fingerprint_mismatch,
+    topology_fingerprint,
+)
+from accelerate_tpu.nn.tape import Tensor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _reset_active_cache():
+    """A DecodeService constructed without an accelerator resolves the
+    process-active cache (current_aot_cache) — intended for real processes,
+    but between tests it would leak this file's tmp-dir caches into serving
+    tests that never opted in.  Clear the module slot after every test."""
+    yield
+    from accelerate_tpu.native.aot_cache import _set_active
+
+    _set_active(None)
+
+
+def _fresh_accelerator(cache_dir, telemetry=True, **acc_kwargs):
+    """Process-simulated fresh start: reset the library singletons and drop
+    every in-memory jit/pjit cache, so only the on-disk store can skip
+    trace+compile."""
+    Accelerator._reset_state()
+    jax.clear_caches()
+    nn.manual_seed(0)
+    handlers = []
+    if telemetry:
+        handlers.append(TelemetryKwargs(enabled=True))
+    if cache_dir is not None:
+        handlers.append(CompilationCacheKwargs(cache_dir=str(cache_dir)))
+    return Accelerator(kwargs_handlers=handlers, **acc_kwargs)
+
+
+def _linear_step(acc):
+    model = nn.Linear(4, 2)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(xb):
+        opt.zero_grad()
+        loss = model(Tensor(xb)).sum()
+        acc.backward(loss)
+        opt.step()
+        return loss
+
+    return acc.compile_step(step_fn)
+
+
+def _run(cache_dir, n_steps=2, telemetry=True):
+    acc = _fresh_accelerator(cache_dir, telemetry=telemetry)
+    step = _linear_step(acc)
+    xb = jnp.ones((8, 4))
+    losses = [float(step(xb)) for _ in range(n_steps)]
+    return acc, step, losses
+
+
+# ---------------------------------------------------------------------------
+# the zero-cold-start contract
+# ---------------------------------------------------------------------------
+
+def test_warm_reload_skips_trace_and_compile_bitwise_loss(tmp_path):
+    cache_dir = tmp_path / "cache"
+    acc1, step1, losses1 = _run(cache_dir)
+    assert acc1.aot_cache.misses >= 1 and acc1.aot_cache.stores >= 1
+    cold_first = acc1.telemetry.timeline.records()[0]
+    assert cold_first.compile_ms > 0
+
+    acc2, step2, losses2 = _run(cache_dir)
+    warm_first = acc2.telemetry.timeline.records()[0]
+    assert warm_first.built  # a build — just one that came off disk
+    assert warm_first.trace_ms == 0.0 and warm_first.compile_ms == 0.0
+    assert acc2.aot_cache.hits >= 1
+    assert not any(
+        e["event"] == "miss" and e.get("scope") == "train"
+        for e in acc2.telemetry.aot_cache_events
+    )
+    assert losses2 == losses1  # bitwise: same program, same state
+    # the loaded entry is an executable, not the plain-jit fallback
+    entry = next(iter(step2._cache.values()))
+    assert not hasattr(entry[0], "lower")
+
+
+def test_cache_off_is_pinned(tmp_path):
+    """No cache dir → the pre-cache path byte-for-byte: disabled hub handle,
+    a None pin on the CapturedStep, no events, no files; with telemetry
+    also off the entry is the plain jitted callable exactly as before."""
+    acc, step, _ = _run(None)
+    assert not acc.aot_cache.enabled
+    assert step._aot_cache is None
+    assert not list(acc.telemetry.aot_cache_events)
+    entry = next(iter(step._cache.values()))
+    assert not hasattr(entry[0], "lower")  # telemetry AOT build, as before
+
+    acc2, step2, _ = _run(None, telemetry=False)
+    assert step2._aot_cache is None
+    entry2 = next(iter(step2._cache.values()))
+    assert hasattr(entry2[0], "lower")  # plain jit, as before
+
+
+def test_env_surface(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_AOT_CACHE", str(tmp_path / "envcache"))
+    assert CompilationCacheKwargs().enabled
+    monkeypatch.setenv("ACCELERATE_AOT_CACHE", "0")
+    assert not CompilationCacheKwargs().enabled
+    monkeypatch.delenv("ACCELERATE_AOT_CACHE")
+    assert not CompilationCacheKwargs().enabled
+
+
+# ---------------------------------------------------------------------------
+# invalidation: stale fingerprints fall through LOUDLY, broken entries softly
+# ---------------------------------------------------------------------------
+
+def _tamper_fingerprints(cache_dir, **overrides):
+    """Re-file every entry under a fake topology fingerprint (digest suffix
+    AND metadata), simulating entries written by a different fleet shape."""
+    for meta_path in glob.glob(os.path.join(str(cache_dir), "*-*.json")):
+        if os.path.basename(meta_path).startswith("profile-"):
+            continue
+        with open(meta_path, encoding="utf-8") as f:
+            meta = json.load(f)
+        meta["fingerprint"].update(overrides)
+        stem = meta_path[: -len(".json")]
+        variant = os.path.basename(stem).split("-")[0]
+        fake = os.path.join(str(cache_dir), f"{variant}-deadbeefdeadbeef")
+        os.rename(stem + ".pkl", fake + ".pkl")
+        os.remove(meta_path)
+        with open(fake + ".json", "w", encoding="utf-8") as f:
+            json.dump(meta, f)
+
+
+def test_stale_fingerprint_falls_through_with_loud_miss(tmp_path):
+    cache_dir = tmp_path / "cache"
+    _, _, losses1 = _run(cache_dir)
+    _tamper_fingerprints(cache_dir, device_count=999, jax="0.0.1")
+
+    acc2, _, losses2 = _run(cache_dir)
+    misses = [
+        e for e in acc2.telemetry.aot_cache_events if e["event"] == "miss"
+    ]
+    assert misses, "stale entry produced no miss record"
+    assert any(
+        "device_count" in (e.get("cause") or "") and "jax" in (e.get("cause") or "")
+        for e in misses
+    ), misses
+    # fell through to a NORMAL compile: same math, no crash
+    warm_first = acc2.telemetry.timeline.records()[0]
+    assert warm_first.compile_ms > 0
+    assert losses2 == losses1
+
+
+def test_corrupt_entry_is_fail_soft_miss(tmp_path):
+    cache_dir = tmp_path / "cache"
+    _, _, losses1 = _run(cache_dir)
+    for pkl in glob.glob(os.path.join(str(cache_dir), "*-*.pkl")):
+        with open(pkl, "wb") as f:
+            f.write(b"\x00truncated")
+    acc2, _, losses2 = _run(cache_dir)
+    assert losses2 == losses1
+    causes = [
+        e.get("cause") or ""
+        for e in acc2.telemetry.aot_cache_events
+        if e["event"] == "miss"
+    ]
+    assert any("unpicklable" in c or "deserialize" in c for c in causes), causes
+
+
+def test_fingerprint_mismatch_names_moved_fields():
+    live = topology_fingerprint()
+    stale = dict(live, device_count=3, jaxlib="9.9.9")
+    cause = fingerprint_mismatch(stale, live)
+    assert "device_count" in cause and "jaxlib" in cause
+    assert fingerprint_mismatch(None, live) == "entry metadata carries no fingerprint"
+
+
+# ---------------------------------------------------------------------------
+# size bound
+# ---------------------------------------------------------------------------
+
+def test_lru_eviction_bounds_size(tmp_path):
+    from accelerate_tpu.utils.dataclasses import CompilationCacheKwargs as K
+
+    cache = AOTCompilationCache(K(cache_dir=str(tmp_path / "lru"), max_bytes=1))
+    fp = cache.fingerprint()
+
+    def compiled_for(n):
+        return jax.jit(lambda x: x * n).lower(jnp.ones((4,))).compile()
+
+    assert cache.store("variant0", fp, compiled_for(1), None, "train", "k0")
+    assert cache.store("variant1", fp, compiled_for(2), None, "train", "k1")
+    # 1-byte budget: storing entry 1 evicted entry 0 (the just-written entry
+    # itself is exempt, so exactly one survives)
+    assert cache.evictions >= 1
+    pkls = glob.glob(os.path.join(str(tmp_path / "lru"), "*-*.pkl"))
+    assert len(pkls) == 1 and "variant1" in pkls[0]
+    assert cache.lookup("variant0", fp, "train", "k0") is None
+    assert cache.lookup("variant1", fp, "train", "k1") is not None
+
+
+# ---------------------------------------------------------------------------
+# trace-time side effects survive the skipped trace
+# ---------------------------------------------------------------------------
+
+def _scheduler_run(cache_dir, n_steps=3):
+    acc = _fresh_accelerator(cache_dir)
+    model = nn.Linear(2, 1)
+    opt = optim.SGD(model.parameters(), lr=1.0)
+    sched = optim.LambdaLR(opt, lambda s: 1.0 / (s + 1))
+    model, opt, sched = acc.prepare(model, opt, sched)
+
+    def step_fn(xb):
+        opt.zero_grad()
+        loss = model(Tensor(xb)).sum()
+        acc.backward(loss)
+        opt.step()
+        sched.step()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    lrs = []
+    for _ in range(n_steps):
+        step(jnp.ones((2, 2)))
+        lrs.append(float(opt.optimizer.lr))
+    return acc, lrs
+
+
+def test_scheduler_replay_survives_warm_restart(tmp_path):
+    """Deferred scheduler steps are recorded at TRACE time — a warm restart
+    never traces, so they ride the entry's side metadata (scheduler registry
+    index) and must replay identically."""
+    cache_dir = tmp_path / "cache"
+    _, lrs_cold = _scheduler_run(cache_dir)
+    acc2, lrs_warm = _scheduler_run(cache_dir)
+    warm_first = acc2.telemetry.timeline.records()[0]
+    assert warm_first.trace_ms == 0.0 and warm_first.compile_ms == 0.0
+    assert acc2.aot_cache.hits >= 1
+    assert lrs_warm == lrs_cold
+
+
+def _accum_run(cache_dir, n_calls=4):
+    acc = _fresh_accelerator(cache_dir, gradient_accumulation_steps=2)
+    model = nn.Linear(4, 1)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(xb):
+        with acc.accumulate(model):
+            loss = model(Tensor(xb)).sum()
+            acc.backward(loss)
+            opt.step()
+            opt.zero_grad()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    data = np.random.default_rng(0).normal(size=(n_calls, 2, 4)).astype(np.float32)
+    return acc, [float(step(jnp.asarray(data[i]))) for i in range(n_calls)]
+
+
+def test_accumulate_step_warm_restart(tmp_path):
+    """An accumulate-using body bakes sync_gradients into each variant and
+    advances the schedule during its FIRST trace — the warm process (no
+    trace) must advance it host-side via the profile sidecar, land on the
+    stored keys, and reproduce the micro/sync step pattern bitwise."""
+    cache_dir = tmp_path / "cache"
+    acc1, losses_cold = _accum_run(cache_dir)
+    assert acc1.aot_cache.stores >= 2  # one per sync variant
+    acc2, losses_warm = _accum_run(cache_dir)
+    warm_first = acc2.telemetry.timeline.records()[0]
+    assert warm_first.trace_ms == 0.0 and warm_first.compile_ms == 0.0
+    assert acc2.aot_cache.hits >= 2
+    assert not any(
+        e["event"] == "miss" and e.get("scope") == "train"
+        for e in acc2.telemetry.aot_cache_events
+    )
+    assert losses_warm == losses_cold
+
+
+def test_restore_prefetch_then_first_step_hits(tmp_path):
+    """The preemption-resume flow: ``load_state`` runs its cache prefetch
+    BEFORE the process's first captured build, so the prefetch must hash
+    the same (mesh/compression-pinned) fingerprint the cold run stored
+    under — a context-less fingerprint here would stage nothing and every
+    later lookup would miss.  The restored step must then run off the
+    deserialized executable, bitwise-continuing the interrupted run."""
+    cache_dir = tmp_path / "cache"
+    ckpt = tmp_path / "ckpt"
+    acc1 = _fresh_accelerator(cache_dir)
+    step1 = _linear_step(acc1)
+    xb = jnp.ones((8, 4))
+    for _ in range(2):
+        float(step1(xb))
+    acc1.save_state(str(ckpt))
+    loss_ref = float(step1(xb))  # the step a resumed process runs next
+
+    acc2 = _fresh_accelerator(cache_dir)
+    step2 = _linear_step(acc2)
+    acc2.load_state(str(ckpt))  # prefetch fires here, before any build
+    assert acc2.aot_cache.last_prefetch_count >= 1
+    loss2 = float(step2(xb))
+    warm_first = acc2.telemetry.timeline.records()[0]
+    assert warm_first.trace_ms == 0.0 and warm_first.compile_ms == 0.0
+    assert acc2.aot_cache.hits >= 1
+    assert loss2 == loss_ref
+
+
+# ---------------------------------------------------------------------------
+# serving: replica spin-up warms every bucket program from disk
+# ---------------------------------------------------------------------------
+
+def _serving_run(cache_dir):
+    from accelerate_tpu import DecodeService, ServingConfig
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    acc = _fresh_accelerator(cache_dir)
+    cfg = GPTConfig(vocab_size=128, n_positions=96, n_embd=32, n_layer=2, n_head=2)
+    model = acc.prepare(GPTLMHeadModel(cfg))
+    model.eval()
+    service = DecodeService(
+        model,
+        ServingConfig(max_slots=2, block_size=16, prompt_bucket=16),
+        telemetry=acc.telemetry,
+    )
+    rid = service.submit(
+        np.random.default_rng(0).integers(0, 128, (9,), dtype=np.int32),
+        max_new_tokens=4,
+    )
+    service.run()
+    return service, service.results[rid].tokens
+
+
+def test_serving_warm_from_disk(tmp_path):
+    """Replica spin-up: every bucket program the first service STORED comes
+    off disk in the second, and anything XLA:CPU's serializer refused (its
+    executable export can drop function symbols once the process
+    JIT-compiled other programs; verify-on-store catches that and records
+    store_failed) recompiles soundly — warmed + compiles covers both
+    programs, zero steady-state recompile events, identical greedy tokens.
+    The cross-process zero-cold-start proof is `make cache-smoke`."""
+    cache_dir = tmp_path / "cache"
+    svc1, tokens1 = _serving_run(cache_dir)
+    assert svc1.watcher.compiles_total == 2  # prefill bucket + decode
+    assert svc1._aot is not None and svc1._aot.warmed == 0
+    stored = len(
+        [p for p in glob.glob(os.path.join(str(cache_dir), "*-*.pkl"))]
+    )
+
+    svc2, tokens2 = _serving_run(cache_dir)
+    assert svc2._aot.warmed == stored  # everything stored must warm
+    assert svc2._aot.warmed + svc2.watcher.compiles_total == 2
+    assert svc2.recompile_events == 0
+    assert tokens2 == tokens1
+    if stored == 0:
+        # both programs hit the XLA:CPU symbol-dedup store refusal in this
+        # process — the fall-through path above is proven, but the warm
+        # path ran empty; say so instead of silently passing
+        pytest.skip("XLA:CPU refused to serialize both serving programs "
+                    "in this process; warm path exercised with 0 entries")
+
+
+# ---------------------------------------------------------------------------
+# observability: metrics provider, record schema, report section
+# ---------------------------------------------------------------------------
+
+def test_metrics_provider_and_report_section(tmp_path):
+    cache_dir = tmp_path / "cache"
+    _run(cache_dir)
+    acc, _, _ = _run(cache_dir)
+    assert any(
+        name == "aot_cache" for name, _ in acc.telemetry._metrics_providers
+    )
+    metrics = acc.aot_cache.metrics()
+    assert metrics["hits_total"] >= 1 and metrics["entries"] >= 1
+    assert {"misses_total", "stores_total", "bytes"} <= set(metrics)
+
+    jsonl = str(tmp_path / "run.jsonl")
+    acc.telemetry.write_jsonl(jsonl)
+    from telemetry_report import load_records, render, validate
+
+    records = load_records(jsonl)
+    assert validate(records, min_steps=1) == []
+    assert any(r.get("kind") == "aot_cache" for r in records)
+    assert "aot executable cache" in render(records)

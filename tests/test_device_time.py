@@ -95,6 +95,44 @@ def test_parse_trace_events_classifies_and_unions():
     assert parsed["top_ops"][0][0] == "dot.1"
 
 
+def test_parse_trace_events_tpu_device_pids():
+    """Carried ROADMAP item: a synthetic chrome-trace in the TPU layout —
+    ops live under ``/device:TPU:N`` processes and carry NO ``hlo_op`` arg
+    — exercises the same classification path CI otherwise only hits with
+    CPU traces.  The device-pid route alone must classify, split per
+    device, and ignore host processes."""
+    events = [
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 8, "name": "process_name",
+         "args": {"name": "/device:TPU:1"}},
+        {"ph": "M", "pid": 1, "name": "process_name", "args": {"name": "python"}},
+        # TPU op events: bare names, no args.hlo_op — the /device: process
+        # name is the only marker.  Two overlap on TPU:0 (union = 1500µs).
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0, "dur": 1000.0,
+         "name": "fusion.123"},
+        {"ph": "X", "pid": 7, "tid": 2, "ts": 500.0, "dur": 1000.0,
+         "name": "all-reduce.7"},
+        {"ph": "X", "pid": 8, "tid": 1, "ts": 0.0, "dur": 400.0,
+         "name": "copy-done.2"},
+        # host-side python frame on a non-device pid without hlo_op: noise
+        {"ph": "X", "pid": 1, "tid": 3, "ts": 0.0, "dur": 5000.0,
+         "name": "ExecuteOnDevice"},
+    ]
+    parsed = parse_trace_events(events)
+    assert set(parsed["devices"]) == {"/device:TPU:0", "/device:TPU:1"}
+    assert parsed["op_events"] == 3
+    tpu0 = parsed["devices"]["/device:TPU:0"]
+    assert tpu0["busy_ms"] == pytest.approx(1.5)  # union, not 2.0 sum
+    assert tpu0["compute_ms"] == pytest.approx(1.0)
+    assert tpu0["collective_ms"] == pytest.approx(1.0)
+    tpu1 = parsed["devices"]["/device:TPU:1"]
+    assert tpu1["transfer_ms"] == pytest.approx(0.4)
+    assert tpu1["busy_ms"] == pytest.approx(0.4)
+    # the host frame must not appear as a device nor in the top ops
+    assert all(name != "ExecuteOnDevice" for name, _ in parsed["top_ops"])
+
+
 def test_classify_op_names():
     assert classify_op("fused_all-gather.7") == "collective"
     assert classify_op("reduce-scatter.1") == "collective"

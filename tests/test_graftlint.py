@@ -333,6 +333,55 @@ def test_serving_entry_bucketed_is_silent(tmp_path):
     assert res.new_findings == [], [f.render() for f in res.new_findings]
 
 
+def test_aot_deserialize_without_fingerprint_fires(tmp_path):
+    """AOT cache-key contract (docs/aot_cache.md): deserialize_and_load
+    skips trace AND compile, so nothing below the caller re-validates the
+    stored program against this process — loading without a fingerprint
+    check in scope dispatches a wrong program on any topology/jax-version
+    drift.  recompile-hazard fires."""
+    res = lint(
+        tmp_path,
+        """
+        import pickle
+        from jax.experimental import serialize_executable
+
+        def load_program(path):
+            with open(path, "rb") as f:
+                entry = pickle.load(f)
+            return serialize_executable.deserialize_and_load(
+                entry["payload"], entry["in_tree"], entry["out_tree"]
+            )
+        """,
+        rule="recompile-hazard",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert "fingerprint" in res.new_findings[0].message
+
+
+def test_aot_deserialize_with_fingerprint_check_silent(tmp_path):
+    """The good twin: the entry's stored fingerprint is compared against the
+    live topology before the executable loads — stale entries fall through
+    to a normal compile instead of dispatching."""
+    res = lint(
+        tmp_path,
+        """
+        import pickle
+        from jax.experimental import serialize_executable
+
+        def load_program(path, live_topology):
+            with open(path, "rb") as f:
+                entry = pickle.load(f)
+            if entry["fingerprint"] != live_topology:
+                return None  # stale: caller compiles normally
+            return serialize_executable.deserialize_and_load(
+                entry["payload"], entry["in_tree"], entry["out_tree"]
+            )
+        """,
+        rule="recompile-hazard",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
 def test_blocking_in_while_test_is_flagged(tmp_path):
     """A While test re-evaluates every iteration — a blocking call there is
     a per-step sync, same as in the body."""

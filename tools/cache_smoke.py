@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""cache-smoke: the zero-cold-start proof (docs/aot_cache.md, `make cache-smoke`).
+
+Two REAL processes against one cache dir:
+
+1. **cold** — a fresh subprocess trains a tiny GPT for 2 steps with the AOT
+   executable cache armed: the first call misses (no entry), traces,
+   compiles, and stores the serialized executable.
+2. **warm** — a second fresh subprocess (nothing in-memory survives — this
+   is exactly the preempted-and-rescheduled / autoscaled-replica shape)
+   restarts against the same cache dir.
+
+Asserted on the warm run, from its telemetry JSONL (not from trust):
+
+* the FIRST captured call has **zero trace phase time and zero compile
+  phase time** — the program came off disk, not through XLA;
+* **>= 1 cache hit** and zero train-scope misses;
+* every per-step **loss is bitwise-equal** to the cold run's — the
+  deserialized executable dispatches bit-for-bit the same program.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEPS = 2
+
+
+def child(cache_dir: str, out_path: str) -> None:
+    """One training process: tiny GPT, STEPS captured calls, result JSON."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator, CompilationCacheKwargs, TelemetryKwargs
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    nn.manual_seed(0)
+    acc = Accelerator(
+        kwargs_handlers=[
+            TelemetryKwargs(enabled=True),
+            CompilationCacheKwargs(cache_dir=cache_dir),
+        ]
+    )
+    cfg = GPTConfig(vocab_size=256, n_positions=64, n_embd=32, n_layer=2, n_head=2)
+    model = GPTLMHeadModel(cfg)
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    rng = np.random.default_rng(0)
+    ids = batch_to_global_array(
+        jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 32)), jnp.int32),
+        mesh=acc.mesh,
+    )
+    losses = [float(step(ids)) for _ in range(STEPS)]
+    first = acc.telemetry.timeline.records()[0]
+    result = {
+        # repr() keeps the full float; bitwise equality is the contract
+        "losses": [repr(loss) for loss in losses],
+        "first_trace_ms": first.trace_ms,
+        "first_compile_ms": first.compile_ms,
+        "first_built": first.built,
+        "hits": acc.aot_cache.hits,
+        "misses": acc.aot_cache.misses,
+        "stores": acc.aot_cache.stores,
+        "events": [
+            {k: e.get(k) for k in ("event", "scope", "cause")}
+            for e in acc.telemetry.aot_cache_events
+        ],
+    }
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f)
+
+
+def run_child(cache_dir: str, label: str) -> dict:
+    out_path = os.path.join(cache_dir, f"{label}.result.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", cache_dir, out_path],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+    )
+    if proc.returncode != 0:
+        print(f"[cache-smoke] {label} run failed rc={proc.returncode}", file=sys.stderr)
+        print(proc.stdout[-2000:], file=sys.stderr)
+        print(proc.stderr[-4000:], file=sys.stderr)
+        sys.exit(1)
+    with open(out_path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2], sys.argv[3])
+        return 0
+
+    cache_dir = tempfile.mkdtemp(prefix="atpu_cache_smoke_")
+    cold = run_child(cache_dir, "cold")
+    warm = run_child(cache_dir, "warm")
+
+    failures = []
+    if cold["misses"] < 1 or cold["stores"] < 1:
+        failures.append(
+            f"cold run should miss+store (misses={cold['misses']}, "
+            f"stores={cold['stores']})"
+        )
+    if cold["first_compile_ms"] <= 0:
+        failures.append("cold run's first build reports no compile time")
+    if warm["hits"] < 1:
+        failures.append(f"warm run hit nothing (hits={warm['hits']})")
+    train_misses = [
+        e for e in warm["events"] if e["event"] == "miss" and e["scope"] == "train"
+    ]
+    if train_misses:
+        failures.append(f"warm run missed: {train_misses}")
+    if not warm["first_built"]:
+        failures.append("warm first call should still be a build (from disk)")
+    if warm["first_trace_ms"] != 0.0 or warm["first_compile_ms"] != 0.0:
+        failures.append(
+            "warm restart paid trace/compile: "
+            f"trace={warm['first_trace_ms']}ms compile={warm['first_compile_ms']}ms"
+        )
+    if warm["losses"] != cold["losses"]:
+        failures.append(
+            f"losses not bitwise-equal: cold={cold['losses']} warm={warm['losses']}"
+        )
+
+    for failure in failures:
+        print(f"[cache-smoke] FAIL: {failure}", file=sys.stderr)
+    if failures:
+        return 1
+    print(
+        "[cache-smoke] ok: warm restart ran "
+        f"{STEPS} steps from the deserialized executable "
+        f"(cold first build {cold['first_trace_ms']:.0f}ms trace + "
+        f"{cold['first_compile_ms']:.0f}ms compile → warm 0ms + 0ms; "
+        f"{warm['hits']} hit(s), losses bitwise-equal)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

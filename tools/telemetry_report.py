@@ -7,7 +7,7 @@
 Input: the ``kind``-tagged JSONL that ``Telemetry.write_jsonl`` /
 ``ACCELERATE_TELEMETRY_JSONL`` produces (one JSON object per line; kinds:
 ``meta``/``step``/``device_step``/``recompile``/``program``/``resources``/
-``collectives``/``serving``/``fleet``/``summary``).
+``collectives``/``serving``/``aot_cache``/``fleet``/``summary``).
 Output: a step-time breakdown table (build steps split out from replays —
 averaging a compile into replay dispatch would hide both), the sampled
 device-time attribution joined launch-vs-device per step, the recompile
@@ -92,6 +92,16 @@ def validate(records: list[dict], min_steps: int = 0) -> list[str]:
     for i, record in enumerate(r for r in records if r.get("kind") == "recompile"):
         if not record.get("cause"):
             errors.append(f"recompile record {i} has no cause")
+    # aot_cache records (persistent executable cache) are OPTIONAL — pre-
+    # cache artifacts lack them — but a present record must name its event,
+    # and a miss must say why (the loud-miss acceptance contract)
+    for i, record in enumerate(r for r in records if r.get("kind") == "aot_cache"):
+        if record.get("event") not in ("hit", "miss", "store", "store_failed", "warm"):
+            errors.append(
+                f"aot_cache record {i}: unknown event {record.get('event')!r}"
+            )
+        elif record["event"] in ("miss", "store_failed") and not record.get("cause"):
+            errors.append(f"aot_cache record {i} ({record['event']}) has no cause")
     # device_step records (sampled device-time attribution) are OPTIONAL —
     # pre-device-time artifacts lack them entirely — but when present they
     # must be well-formed and their busy+idle split must account for the
@@ -237,6 +247,40 @@ def render(records: list[dict]) -> str:
             lines.append(
                 f"  {r.get('tag', '?'):<12} total {r.get('total_bytes', 0) / 1e6:8.1f} MB"
                 f" over {len(r.get('devices', {}))} device(s)"
+            )
+
+    aot = [r for r in records if r.get("kind") == "aot_cache"]
+    if aot:
+        hits = [r for r in aot if r.get("event") == "hit"]
+        misses = [r for r in aot if r.get("event") == "miss"]
+        stores = [r for r in aot if r.get("event") == "store"]
+        lines.append("")
+        lines.append(
+            f"aot executable cache ({len(hits)} hit(s), {len(misses)} miss(es), "
+            f"{len(stores)} store(s))"
+        )
+        for r in hits:
+            avoided = r.get("avoided_compile_ms")
+            lines.append(
+                f"  hit   [{r.get('scope', '?'):<7}] {str(r.get('key', '?')):<16}"
+                f" {(r.get('bytes') or 0) / 1e6:7.2f} MB"
+                f"  load {r.get('load_ms', 0.0) or 0.0:8.2f} ms"
+                + (
+                    f"  (avoided ~{avoided:.0f} ms compile)"
+                    if isinstance(avoided, (int, float))
+                    else ""
+                )
+            )
+        for r in misses:
+            lines.append(
+                f"  miss  [{r.get('scope', '?'):<7}] {str(r.get('key', '?')):<16}"
+                f" {r.get('cause', '?')}"
+            )
+        warm = [r for r in aot if r.get("event") == "warm"]
+        if warm:
+            lines.append(
+                f"  restore warms: {len(warm)}, entries staged "
+                f"{sum(r.get('entries', 0) or 0 for r in warm)}"
             )
 
     serving = [r for r in records if r.get("kind") == "serving"]
