@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke bench
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke bench
 
 # graftlint: whole-program trace-safety & collective-correctness static
 # analysis (docs/graftlint.md). Runs before the suite. The on-disk cache
@@ -24,10 +24,14 @@ lint-cold:
 # ISSUE acceptance row (int8/fp8/powersgd vs none at dp=4 — loss parity,
 # 1/dp residual sharding, zero recompiles, ≥1.8x byte drop) runs here
 # (docs/compression.md)
+# the elastic-fleet suite rides along at dp=4: drain→vote→rollback
+# rehearsal and the dp=4→dp=2 resize (bitwise state after reshard, zero
+# recompiles after prewarm) exercise the exact multichip extent the
+# acceptance row names (docs/elastic.md)
 multichip:
 	XLA_FLAGS=--xla_force_host_platform_device_count=4 python -m pytest \
 	  tests/test_zero1.py tests/test_zero_sharding.py \
-	  tests/test_compression.py tests/test_serving.py -q
+	  tests/test_compression.py tests/test_serving.py tests/test_fleet.py -q
 
 # telemetry pipeline proof (docs/telemetry.md): tiny model, 3 steps + a
 # forced shape change with telemetry on, JSONL export validated through
@@ -65,7 +69,15 @@ profile-smoke:
 cache-smoke:
 	JAX_PLATFORMS=cpu python tools/cache_smoke.py
 
-test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke
+# survive-and-resize proof (docs/elastic.md): tiny GPT on 4 virtual CPU
+# devices, injected host_lost at step 2 — asserts drain → COMPLETE
+# checkpoint → re-mesh dp=4→2 → reshard → loss-parity resume, run twice
+# against one AOT store so the warm pass's post-resize step deserializes
+# the prewarmed dp=2 program with zero trace/compile
+elastic-smoke:
+	JAX_PLATFORMS=cpu python tools/elastic_smoke.py
+
+test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke
 	python -m pytest tests/ -q
 
 test_core:
@@ -106,7 +118,8 @@ test_big_modeling:
 
 test_checkpoint:
 	python -m pytest tests/test_sharded_checkpoint.py tests/test_fsdp_utils.py \
-	  tests/test_async_checkpoint.py tests/test_resilience.py -q
+	  tests/test_async_checkpoint.py tests/test_resilience.py \
+	  tests/test_fleet.py -q
 
 test_examples:
 	python -m pytest tests/test_examples.py tests/test_external_scripts.py -q

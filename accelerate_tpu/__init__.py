@@ -55,6 +55,7 @@ from .utils.dataclasses import (
     DataLoaderConfiguration,
     DataParallelPlugin,
     DistributedType,
+    FleetKwargs,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     InitProcessGroupKwargs,

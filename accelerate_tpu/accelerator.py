@@ -102,6 +102,7 @@ class Accelerator:
         self.resilience_handler = None
         self.compression_handler = None
         self.aot_cache_handler = None
+        self.fleet_handler = None
         from .utils.dataclasses import FP8RecipeKwargs
 
         from .utils.dataclasses import (
@@ -109,6 +110,7 @@ class Accelerator:
             CompilationCacheKwargs,
             CompressionKwargs,
             DistributedDataParallelKwargs,
+            FleetKwargs,
             ResilienceKwargs,
             TelemetryKwargs,
         )
@@ -120,6 +122,8 @@ class Accelerator:
                 self.compression_handler = handler
             elif isinstance(handler, CompilationCacheKwargs):
                 self.aot_cache_handler = handler
+            elif isinstance(handler, FleetKwargs):
+                self.fleet_handler = handler
             elif isinstance(handler, ResilienceKwargs):
                 self.resilience_handler = handler
             elif isinstance(handler, AutocastKwargs):
@@ -302,6 +306,23 @@ class Accelerator:
         )
         self.aot_cache.attach_telemetry(self.telemetry)
         _set_active(self.aot_cache if self.aot_cache.enabled else None)
+
+        # elastic fleet runtime (docs/elastic.md): always constructed, OFF
+        # unless FleetKwargs/$ACCELERATE_FLEET turns it on — compile_step
+        # pins the enabled instance so the captured path pays one None-check
+        # when off; enabled, it composes the subsystems above into
+        # coordinated multi-host rollback (the resilience retrier consults
+        # it), host-loss-driven dp resize, and the periodic mid-run fleet
+        # aggregation signal
+        from .fleet import Fleet
+
+        self.fleet = Fleet(
+            self.fleet_handler, telemetry=self.telemetry, resilience=self.resilience
+        )
+        self.resilience.fleet = self.fleet if self.fleet.enabled else None
+        # bumped by fleet.resize() when the mesh changes; fleet-armed
+        # CapturedSteps drop their compiled variants when it moves
+        self._mesh_generation = 0
 
         # seed the nn RNG only when explicitly requested or still unseeded —
         # never clobber a user's earlier manual_seed

@@ -131,6 +131,11 @@ class _Collector(ast.NodeVisitor):
     def __init__(self):
         self.stack: list[str] = []
         self.functions: list[FunctionInfo] = []
+        # qualnames of actual ClassDefs: instance-dispatch edges resolve
+        # only through these — a factory FUNCTION with a nested def also
+        # owns `outer.inner` qualnames, and treating it as a class would
+        # wire phantom method edges into the nested function
+        self.classes: set[str] = set()
 
     def _visit_fn(self, node):
         qual = ".".join(self.stack + [node.name])
@@ -139,10 +144,34 @@ class _Collector(ast.NodeVisitor):
         # a data binding passed as an argument is a value, not a reference to
         # a same-named module function — without this, a parameter named like
         # a method creates phantom edges
-        local_data = {a.arg for a in ast.walk(node.args) if isinstance(a, ast.arg)}
+        params = {a.arg for a in ast.walk(node.args) if isinstance(a, ast.arg)}
+        store_counts: dict[str, int] = {}
         for sub in iter_own_nodes(node):
             if isinstance(sub, ast.Name) and isinstance(sub.ctx, (ast.Store, ast.Del)):
-                local_data.add(sub.id)
+                store_counts[sub.id] = store_counts.get(sub.id, 0) + 1
+        local_data = params | set(store_counts)
+        # cheap type inference over single-assignment locals: `obj = Ctor(...)`
+        # pins obj's type to Ctor for the whole function ONLY when obj is
+        # bound exactly once and is not a parameter — then `obj.method(x)`
+        # dispatches to ``Ctor.method`` (resolved by qualname same-module,
+        # through the class's import in program.py).  A reassigned or
+        # parameter-bound receiver stays uninferred: its type is not known,
+        # and a wrong guess would cross-wire reachability.
+        ctor_of: dict[str, str] = {}
+        for sub in iter_own_nodes(node):
+            if (
+                isinstance(sub, ast.Assign)
+                and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Name)
+                and isinstance(sub.value, ast.Call)
+            ):
+                target = sub.targets[0].id
+                if store_counts.get(target) != 1 or target in params:
+                    continue
+                fn = sub.value.func
+                ctor = fn.id if isinstance(fn, ast.Name) else dotted_name(fn)
+                if ctor and ctor.split(".", 1)[0] not in ("self", "cls"):
+                    ctor_of[target] = ctor
         for sub in iter_own_nodes(node):
             if isinstance(sub, ast.Call):
                 # direct calls: f(...), self.f(...) / cls.f(...), and dotted
@@ -157,6 +186,9 @@ class _Collector(ast.NodeVisitor):
                         pass
                     elif isinstance(fn.value, ast.Name) and fn.value.id in ("self", "cls"):
                         info.edges.add(fn.attr)
+                    elif isinstance(fn.value, ast.Name) and fn.value.id in ctor_of:
+                        # inferred instance dispatch: obj = Ctor(); obj.m(x)
+                        info.edges.add(f"{ctor_of[fn.value.id]}.{fn.attr}")
                     elif d.split(".", 1)[0] in ("self", "cls"):
                         # deeper chains (self.state.update()): the receiver's
                         # type is unknown — a bare-leaf edge would collide
@@ -179,6 +211,7 @@ class _Collector(ast.NodeVisitor):
     visit_AsyncFunctionDef = _visit_fn
 
     def visit_ClassDef(self, node):
+        self.classes.add(".".join(self.stack + [node.name]))
         self.stack.append(node.name)
         self.generic_visit(node)
         self.stack.pop()
@@ -192,6 +225,7 @@ class CallGraph:
         self.functions: dict[str, FunctionInfo] = {
             f.qualname: f for f in collector.functions
         }
+        self.classes: set[str] = set(collector.classes)
         self.by_leaf: dict[str, list[FunctionInfo]] = {}
         for f in collector.functions:
             self.by_leaf.setdefault(f.name, []).append(f)
@@ -244,7 +278,17 @@ class CallGraph:
             qual = frontier.pop()
             info = self.functions[qual]
             for name in info.edges:
-                for callee in self.by_leaf.get(name, []):
+                callees = self.by_leaf.get(name, [])
+                if not callees and "." in name:
+                    # instance-dispatch edge (``Cls.method``): same-module
+                    # resolution is an exact qualname lookup, restricted to
+                    # REAL classes — a factory function's nested defs share
+                    # the qualname shape but are not dispatch targets;
+                    # imported-class forms resolve in program.py
+                    target = self.functions.get(name)
+                    if target is not None and name.rsplit(".", 1)[0] in self.classes:
+                        callees = [target]
+                for callee in callees:
                     if callee.barrier:
                         continue  # singleton init: runs once, never in-trace
                     if callee.qualname not in self.reached:

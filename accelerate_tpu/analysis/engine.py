@@ -40,7 +40,11 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 # (deserialize_and_load of a serialized executable without a fingerprint/
 # cache-key check in scope — a stale entry from another topology or jax
 # version must fall through to a compile, never dispatch; docs/aot_cache.md).
-ANALYSIS_VERSION = "6"
+# v7: the call graph resolves instance-method dispatch through cheap type
+# inference over single-assignment locals (`obj = SomeClass(); obj.method(x)`
+# links to SomeClass.method, same-module and through imports), so every
+# reachability rule sees traced code calling into helper-object methods.
+ANALYSIS_VERSION = "7"
 
 # Names that mark a branch/function as profiling/benchmark plumbing, where a
 # deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the

@@ -97,6 +97,7 @@ class ModuleSummary:
     donors: dict = dataclasses.field(default_factory=dict)  # name -> positions
     axes: list = dataclasses.field(default_factory=list)  # [axis, why]
     imports: list = dataclasses.field(default_factory=list)  # raw import records
+    classes: list = dataclasses.field(default_factory=list)  # ClassDef qualnames
     error: Optional[str] = None  # set when the file failed to parse
     error_line: int = 0
 
@@ -108,6 +109,7 @@ class ModuleSummary:
             "donors": self.donors,
             "axes": [list(a) for a in self.axes],
             "imports": self.imports,
+            "classes": list(self.classes),
             "error": self.error,
             "error_line": self.error_line,
         }
@@ -121,6 +123,7 @@ class ModuleSummary:
             donors={k: list(v) for k, v in d.get("donors", {}).items()},
             axes=[tuple(a) for a in d.get("axes", [])],
             imports=d.get("imports", []),
+            classes=list(d.get("classes", [])),
             error=d.get("error"),
             error_line=d.get("error_line", 0),
         )
@@ -294,6 +297,7 @@ def extract_summary(module) -> ModuleSummary:
         donors=donating_callables(module),
         axes=collect_axes(module),
         imports=module.import_records,
+        classes=sorted(cg.classes),
     )
 
 
@@ -356,6 +360,7 @@ class ProgramGraph:
         self.fn_by_qual = [
             {f.qualname: f for f in r.summary.functions} for r in self.records
         ]
+        self.class_sets = [set(r.summary.classes) for r in self.records]
         self.fn_by_leaf: list[dict[str, list[FunctionSummary]]] = []
         for r in self.records:
             leafed: dict[str, list[FunctionSummary]] = {}
@@ -455,6 +460,61 @@ class ProgramGraph:
         mod = ".".join([base] + parts[1:-1])
         return self._resolve_symbol(mod, parts[-1])
 
+    def _is_class(self, i: int, sym: str) -> bool:
+        """``sym`` names an actual ClassDef in module *i*.  Qualname shape
+        is NOT enough: a factory function's nested defs also own
+        ``sym.<member>`` qualnames, and dispatching "methods" into them
+        would wire phantom reachability."""
+        return sym in self.class_sets[i]
+
+    def _resolve_class(self, module_name: str, sym: str, depth: int = 0):
+        """(module index, class qualname) a symbol refers to when it is a
+        class in the analyzed set, chasing ``__init__.py`` re-export chains
+        exactly like :meth:`_resolve_symbol`."""
+        i = self.by_name.get(module_name)
+        if i is None or depth > _MAX_REEXPORT_DEPTH:
+            return None
+        if self._is_class(i, sym):
+            return (i, sym)
+        sa = self.sym_aliases[i]
+        if sym in sa:
+            return self._resolve_class(sa[sym][0], sa[sym][1], depth + 1)
+        return None
+
+    def _resolve_method(self, i: int, dotted: str):
+        """Resolve an instance-dispatch edge — ``Cls.method`` with ``Cls``
+        local or imported, or ``mod.Cls.method`` through a module alias —
+        to the method's summary.  The cross-module half of the single-
+        assignment type inference (callgraph.py): the edge names the
+        receiver's inferred constructor, this walks it to the class."""
+        owner, _, method = dotted.rpartition(".")
+        if not owner or not method:
+            return None
+        cls = None
+        if "." not in owner:
+            if self._is_class(i, owner):
+                cls = (i, owner)
+            else:
+                sa = self.sym_aliases[i]
+                if owner in sa:
+                    cls = self._resolve_class(sa[owner][0], sa[owner][1])
+        else:
+            head, _, rest = owner.partition(".")
+            ma = self.mod_aliases[i]
+            if head in ma:
+                if "." not in rest:
+                    cls = self._resolve_class(ma[head], rest)
+                else:
+                    mod = ".".join([ma[head]] + rest.split(".")[:-1])
+                    cls = self._resolve_class(mod, rest.rsplit(".", 1)[-1])
+        if cls is None:
+            return None
+        j, cls_name = cls
+        target = f"{cls_name}.{method}"
+        if target in self.fn_by_qual[j]:
+            return (j, target)
+        return None
+
     def _resolve_edge(self, i: int, edge: str) -> list[tuple[int, str]]:
         out: list[tuple[int, str]] = []
         if "." not in edge:
@@ -467,8 +527,21 @@ class ProgramGraph:
                     if r is not None:
                         out.append(r)
             return out
+        # same-module instance dispatch (``Cls.method``) resolves even with
+        # cross-module OFF — it is an exact qualname lookup restricted to
+        # REAL classes (a factory function's nested defs share the qualname
+        # shape), the per-module graph's behavior; import-crossing forms
+        # need cross mode below
+        if (
+            edge in self.fn_by_qual[i]
+            and edge.rsplit(".", 1)[0] in self.class_sets[i]
+        ):
+            out.append((i, edge))
+            return out
         if self.cross:
             r = self._resolve_dotted(i, edge)
+            if r is None:
+                r = self._resolve_method(i, edge)
             if r is not None:
                 out.append(r)
         return out

@@ -198,6 +198,13 @@ class CapturedStep:
         # store before tracing and store after compiling
         cache = getattr(accelerator, "aot_cache", None)
         self._aot_cache = cache if (cache is not None and cache.enabled) else None
+        # elastic fleet runtime (docs/elastic.md): same pinning discipline —
+        # when OFF every line below runs exactly as before this subsystem
+        # existed; when ON, each call counts on the host-lost fault axis and
+        # a resize-bumped mesh generation drops the stale compiled variants
+        fleet = getattr(accelerator, "fleet", None)
+        self._fleet = fleet if (fleet is not None and fleet.enabled) else None
+        self._mesh_generation = getattr(accelerator, "_mesh_generation", 0)
         self._last_key = None  # previous variant key, for recompile forensics
         self._last_build_ms = (0.0, 0.0)  # (trace_ms, compile_ms) of last build
         # monotonic build counter for program-record labels: cache size would
@@ -282,6 +289,22 @@ class CapturedStep:
         prof = tel.profiler if tel is not None else None
         prof_step = -1
         acc = self.accelerator
+        fleet = self._fleet
+        if fleet is not None:
+            # counts this call on the fault plan's host_lost axis and runs
+            # the periodic fleet-aggregation cadence (docs/elastic.md)
+            fleet.on_dispatch(self)
+            generation = getattr(acc, "_mesh_generation", 0)
+            if generation != self._mesh_generation:
+                # a resize re-meshed the run: every compiled variant binds
+                # the lost topology — drop them so the lookup below builds
+                # (or AOT-warm-loads) the surviving-topology program instead
+                # of dispatching against a mesh that no longer exists
+                self._cache.clear()
+                self._layout_rebuilds.clear()
+                self._key_ids.clear()
+                self._last_key = None
+                self._mesh_generation = generation
         if self._uses_accumulate is None and self._aot_cache is not None:
             # warm-start profile sidecar (docs/aot_cache.md): on a genuinely
             # first call the trace would reveal whether the body accumulates

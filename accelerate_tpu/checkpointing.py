@@ -546,6 +546,21 @@ def is_complete_checkpoint(path: str) -> bool:
     return os.path.isfile(os.path.join(path, "accelerator_meta.json"))
 
 
+def checkpoint_step(path: str) -> Optional[int]:
+    """The training step a COMPLETE checkpoint was taken at (its meta
+    sentinel's ``step``), or ``None`` for an incomplete/foreign folder.
+    The elastic fleet's restore-point vote orders candidates by this."""
+    meta_path = os.path.join(path, "accelerator_meta.json")
+    try:
+        with open(meta_path, encoding="utf-8") as f:
+            meta = json.load(f)
+        if not isinstance(meta, dict):
+            return None  # foreign/corrupt sentinel: not a candidate, not a crash
+        return int(meta.get("step", 0))
+    except (OSError, ValueError, TypeError):
+        return None
+
+
 def latest_checkpoint(base_dir: str) -> Optional[str]:
     """Newest COMPLETE ``checkpoint_N`` folder under ``base_dir`` (the
     automatic-checkpoint-naming layout), or ``None``.  Skips folders whose

@@ -1,0 +1,237 @@
+"""Elastic fleet runtime (``accelerator.fleet``) — docs/elastic.md.
+
+The "survive and resize" layer over the resilience/checkpoint/AOT-cache
+subsystems, default-OFF (off = byte-identical capture hot path, one
+``None``-check, matching the telemetry/resilience/aot-cache precedent).
+Three pillars:
+
+1. **Coordinated multi-host drain + rollback** (`coordinate.py`) — on retry
+   exhaustion every rank offers its visible complete checkpoints to a
+   gather/vote barrier; all ranks agree on the newest all-ranks-visible
+   restore point BEFORE any rank issues the collective ``load_state``.
+   Replaces the resilience layer's single-process-only rollback refusal.
+2. **Elastic dp resize** (`resize.py`) — a lost host (``host_lost``
+   fault-plan verb on CPU; a reclamation notice in production) trips
+   ``fleet.should_resize``; ``fleet.resize()`` drains a complete
+   checkpoint, re-meshes at the surviving topology, re-lays ZeRO-1
+   masters/moments + compression residuals onto it, restores the
+   spec-carrying checkpoint (reshard, not reinit), and prewarms the
+   new-topology programs from the AOT executable cache.
+3. **Fleet signal** — ``FleetKwargs(aggregate_every_n=N)`` graduates
+   ``telemetry.aggregate_fleet()`` from end-of-training-only to periodic
+   mid-run skew/straggler records (``kind="fleet"``), the
+   autoscaler/resize input read back via :meth:`Fleet.fleet_signal`.
+
+Enable with ``ACCELERATE_FLEET=1`` or
+``Accelerator(kwargs_handlers=[FleetKwargs(enabled=True)])``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..resilience.inject import FaultInjector
+from .coordinate import (
+    agree_restore_point,
+    coordinated_rollback,
+    local_restore_candidates,
+    vote_restore_point,
+)
+from .resize import prewarm_aot_cache, remesh_accelerator, surviving_mesh
+
+
+class Fleet:
+    """Per-Accelerator elastic-fleet hub; inert when disabled."""
+
+    def __init__(self, handler=None, telemetry=None, resilience=None):
+        if handler is None:
+            from ..utils.dataclasses import FleetKwargs
+
+            handler = FleetKwargs()
+        self.handler = handler
+        self.enabled = bool(handler.enabled)
+        # events always land here (tests / diagnostics need them with
+        # telemetry off); they additionally flow into the telemetry export
+        # stream as kind="fleet_event" records when telemetry is on
+        self.telemetry = (
+            telemetry
+            if (telemetry is not None and getattr(telemetry, "enabled", False))
+            else None
+        )
+        self.resilience = resilience
+        self.events: list[dict] = []
+        self.injector: Optional[FaultInjector] = None
+        self.dispatch_calls = 0
+        self.resizes_total = 0
+        self._host_lost = False
+        # collective host-lost poll memo, same discipline as the resilience
+        # preemption poll: at most one gather per dispatch, sticky once set
+        self._poll_cache: Optional[tuple[int, bool]] = None
+        self._poll_resolved = False
+        if not self.enabled:
+            return
+        self.injector = FaultInjector.from_spec(handler.fault_plan)
+
+    # -- events --------------------------------------------------------------
+    def record_event(self, event: str, **fields) -> dict:
+        payload = {"event": event, **fields}
+        self.events.append(payload)
+        if self.telemetry is not None:
+            self.telemetry.record_fleet(dict(payload))
+        return payload
+
+    # -- capture-path hook ---------------------------------------------------
+    def on_dispatch(self, step=None) -> int:
+        """Called by every fleet-armed CapturedStep at the top of its call:
+        counts calls (the ``host_lost`` fault verb's step axis), fires any
+        scheduled host loss, and runs the periodic fleet-aggregation
+        cadence.  One None-check and an integer bump on the armed hot path;
+        fleet-off steps never reach this."""
+        index = self.dispatch_calls
+        self.dispatch_calls += 1
+        if self.injector is not None and not self._host_lost:
+            if self.injector.maybe_host_lost(index):
+                self._host_lost = True
+                self.record_event("host_lost", dispatch_calls=index)
+        every = self.handler.aggregate_every_n
+        if every and self.telemetry is not None and self.dispatch_calls % every == 0:
+            # COLLECTIVE, but cadence-aligned: every rank counts the same
+            # SPMD dispatches, so all ranks enter the gather together
+            self.telemetry.aggregate_fleet(periodic=True)
+        return index
+
+    # -- host-lost flag ------------------------------------------------------
+    def _poll(self) -> bool:
+        if self._poll_resolved:
+            return True  # sticky: a lost host does not come back
+        local = self._host_lost
+        from ..state import PartialState
+
+        if PartialState._shared_state and PartialState().num_processes > 1:
+            if (
+                self._poll_cache is not None
+                and self._poll_cache[0] == self.dispatch_calls
+            ):
+                return self._poll_cache[1]
+            from ..utils import operations as ops
+
+            result = any(bool(flag) for flag in ops.gather_object([local]))
+            self._poll_cache = (self.dispatch_calls, result)
+        else:
+            result = local
+        if result:
+            self._poll_resolved = True
+        return result
+
+    @property
+    def should_resize(self) -> bool:
+        """True once any rank observed a host loss.  Collective on
+        multi-process — call it on every rank (the survivors must agree to
+        drain and re-mesh together, exactly like the preemption flags)."""
+        return self._poll()
+
+    # -- pillar 1: coordinated restore ---------------------------------------
+    def coordinated_rollback(self, accelerator) -> Optional[str]:
+        """Vote on the newest all-ranks-visible complete checkpoint and have
+        every rank restore it collectively (coordinate.py); ``None`` when no
+        agreement exists."""
+        return coordinated_rollback(accelerator, fleet=self)
+
+    # -- pillar 2: elastic resize --------------------------------------------
+    def drain(self, accelerator, output_dir: Optional[str] = None) -> str:
+        """Write a COMPLETE checkpoint now and block until durable — the
+        pre-resize barrier.  Delegates to the resilience drain when that
+        subsystem is armed (same async save machinery + event stream);
+        otherwise drives save_state/wait_for_checkpoint directly."""
+        target = output_dir or self.handler.checkpoint_dir
+        resilience = self.resilience
+        if resilience is not None and resilience.enabled:
+            out = resilience.drain(accelerator, target)
+        else:
+            out = accelerator.save_state(target, async_save=True)
+            accelerator.wait_for_checkpoint()
+        self.record_event("drain", checkpoint=out)
+        return out
+
+    def resize(
+        self,
+        accelerator,
+        target_dp: Optional[int] = None,
+        output_dir: Optional[str] = None,
+        checkpoint: Optional[str] = None,
+        lost_blocks: Optional[list] = None,
+    ) -> dict:
+        """Shrink the dp axis to the surviving topology and resume from a
+        complete checkpoint: drain → re-mesh → relayout → AOT prewarm →
+        spec-carrying reshard restore.  ``checkpoint`` skips the drain (the
+        caller already has a durable restore point — e.g. the host died
+        AFTER a scheduled save).  ``lost_blocks`` names the dead dp-axis
+        block indices (from the reclamation notice) so the survivors —
+        not the dead host's devices — make up the new mesh.  Returns a
+        summary dict (also recorded as a ``resize`` fleet event)."""
+        if not self.enabled:
+            raise RuntimeError("fleet.resize() needs FleetKwargs(enabled=True)")
+        if not self.handler.elastic:
+            raise RuntimeError("elastic resize disabled (FleetKwargs.elastic=False)")
+        mesh = accelerator.state.mesh
+        old_dp = dict(mesh.shape).get("dp", 1)
+        if target_dp is None:
+            # default survivor model: half the fleet gone (one of two hosts)
+            target_dp = max(self.handler.min_dp, old_dp // 2)
+        if target_dp < self.handler.min_dp:
+            raise ValueError(
+                f"resize to dp={target_dp} is below the configured floor "
+                f"(FleetKwargs.min_dp={self.handler.min_dp})"
+            )
+        ckpt = checkpoint or self.drain(accelerator, output_dir)
+        new_mesh = surviving_mesh(mesh, target_dp, lost_blocks=lost_blocks)
+        remesh_accelerator(accelerator, new_mesh)
+        warmed = prewarm_aot_cache(accelerator)
+        # reshard restore: relayout above re-laid masters/moments/residuals
+        # on the survivors, load_state now fills that layout with the
+        # checkpointed values (per-leaf specs recorded at save time make
+        # the N→M move exact) — resharded, never reinitialized
+        accelerator.load_state(ckpt)
+        self.resizes_total += 1
+        # the resize handled the loss: consume the sticky flag so the
+        # documented `if fleet.should_resize: fleet.resize(...)` loop does
+        # not re-drain/re-mesh on every subsequent step (a LATER host loss
+        # re-trips it; all ranks reset together — they all ran this resize)
+        self._host_lost = False
+        self._poll_resolved = False
+        self._poll_cache = None
+        info = {
+            "checkpoint": ckpt,
+            "old_mesh": dict(mesh.shape),
+            "new_mesh": dict(new_mesh.shape),
+            "old_dp": old_dp,
+            "dp": target_dp,
+            "aot_prewarmed": warmed,
+            "resumed_step": accelerator.step,
+        }
+        self.record_event("resize", **info)
+        return info
+
+    # -- pillar 3: fleet signal ----------------------------------------------
+    def fleet_signal(self) -> Optional[dict]:
+        """The latest periodic skew/straggler record (``kind="fleet"``), or
+        ``None`` before the first cadence fires — what an autoscaler polls
+        to decide a resize."""
+        if self.telemetry is None:
+            return None
+        for record in reversed(self.telemetry.fleet_events):
+            if record.get("kind") == "fleet":
+                return record
+        return None
+
+
+__all__ = [
+    "Fleet",
+    "agree_restore_point",
+    "coordinated_rollback",
+    "local_restore_candidates",
+    "prewarm_aot_cache",
+    "remesh_accelerator",
+    "surviving_mesh",
+    "vote_restore_point",
+]

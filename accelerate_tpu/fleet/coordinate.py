@@ -1,0 +1,134 @@
+"""Pillar 1 — coordinated multi-host drain + rollback.
+
+The resilience layer's rollback deliberately refuses multi-process runs: a
+lone rank restoring while its peers proceed to the next step's collectives
+would deadlock the mesh (``resilience/retry.py``).  The fix is the
+torchelastic-style restore protocol this module implements:
+
+1. **Offer** — every rank enumerates the COMPLETE checkpoints it can see
+   (:func:`local_restore_candidates`): the resilience layer's last noted
+   checkpoint plus every sentinel-complete folder under the automatic-naming
+   directory, each tagged with the training step its meta sentinel records.
+2. **Vote** — an allgather barrier (:func:`vote_restore_point`,
+   ``gather_object`` hands every rank the full offer list) after which each
+   rank runs the SAME pure agreement function over the SAME gathered offers:
+   the newest checkpoint present in EVERY rank's offer set wins
+   (:func:`agree_restore_point`).  A checkpoint only some ranks can see — a
+   host-local directory, a drain that landed after a peer died — can never
+   be chosen, because the loser ranks' collective ``load_state`` would hang
+   on its missing shards.
+3. **Restore** — all ranks issue the collective ``load_state`` against the
+   agreed point together (:func:`coordinated_rollback`).
+
+Why every rank reaches the vote: a captured-step dispatch is SPMD — a
+transient fault on the program's collective path surfaces on EVERY rank's
+dispatch of that step, so each rank's retrier exhausts on the same call
+index and enters the protocol together (the same all-ranks-observe-the-
+fault assumption torchelastic's rendezvous makes).  A genuinely one-sided
+failure (a single rank's host dying) is the *elastic resize* case, not a
+rollback (docs/elastic.md).
+
+The agreement math is pure host code over offer dicts, so it tests on a
+single process with synthetic per-rank offer lists — exactly like the
+telemetry fleet-skew merge.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..checkpointing import checkpoint_step, is_complete_checkpoint
+from ..logging import get_logger
+from ..utils.operations import gather_object
+
+logger = get_logger(__name__)
+
+
+def local_restore_candidates(accelerator) -> list[dict]:
+    """This rank's restore-point offers: ``{"path", "step"}`` per COMPLETE
+    checkpoint it can see, newest first.  Sources: the resilience hub's
+    last noted checkpoint and the automatic-naming directory."""
+    paths: list[str] = []
+    resilience = getattr(accelerator, "resilience", None)
+    if resilience is not None and resilience.last_checkpoint:
+        paths.append(resilience.last_checkpoint)
+    project = accelerator.project_configuration
+    if project.automatic_checkpoint_naming and accelerator.project_dir:
+        base = os.path.join(accelerator.project_dir, "checkpoints")
+        if os.path.isdir(base):
+            paths.extend(
+                os.path.join(base, f)
+                for f in os.listdir(base)
+                if f.startswith("checkpoint_") and f.split("_")[-1].isdigit()
+            )
+    offers: list[dict] = []
+    seen: set[str] = set()
+    for path in paths:
+        path = os.path.abspath(path)
+        if path in seen or not is_complete_checkpoint(path):
+            continue
+        seen.add(path)
+        step = checkpoint_step(path)
+        offers.append({"path": path, "step": step if step is not None else -1})
+    offers.sort(key=lambda o: (o["step"], o["path"]), reverse=True)
+    return offers
+
+
+def agree_restore_point(per_rank: list[list[dict]]) -> Optional[dict]:
+    """The restore point every rank can load: the highest-step offer whose
+    path appears in EVERY rank's offer list (ties broken by path so all
+    ranks deterministically pick the same folder).  ``None`` when the
+    intersection is empty — no checkpoint is safe to restore collectively."""
+    if not per_rank:
+        return None
+    common: Optional[dict] = None
+    path_sets = [{o["path"] for o in offers} for offers in per_rank]
+    for offer in per_rank[0]:
+        if all(offer["path"] in paths for paths in path_sets):
+            if common is None or (offer["step"], offer["path"]) > (
+                common["step"], common["path"]
+            ):
+                common = offer
+    return dict(common) if common is not None else None
+
+
+def vote_restore_point(accelerator, fleet=None) -> Optional[dict]:
+    """COLLECTIVE — every rank must call (the coordinated-rollback path
+    does).  Allgathers each rank's offers and returns the agreement; every
+    rank computes it from the same gathered list, so no second broadcast is
+    needed.  Records a ``restore_vote`` fleet event with the full ballot."""
+    local = local_restore_candidates(accelerator)
+    # gather_object flattens one list level: each rank contributes
+    # [its offer list] and everyone receives [rank0_offers, rank1_offers, ...]
+    per_rank = gather_object([local])
+    agreed = agree_restore_point(per_rank)
+    if fleet is not None:
+        fleet.record_event(
+            "restore_vote",
+            ranks=len(per_rank),
+            # the full ballot: what each rank offered — the forensic record
+            # an operator needs when the agreed point looks wrong after an
+            # incident (offers are few per rank; sentinel-complete only)
+            ballot=[[dict(o) for o in offers] for offers in per_rank],
+            agreed=agreed["path"] if agreed is not None else None,
+            agreed_step=agreed["step"] if agreed is not None else None,
+        )
+    return agreed
+
+
+def coordinated_rollback(accelerator, fleet=None) -> Optional[str]:
+    """Vote, then have every rank issue the collective ``load_state``
+    against the agreed restore point.  Returns the restored path, or
+    ``None`` when no all-ranks-visible checkpoint exists (the caller then
+    escalates exactly as the no-checkpoint single-process case does)."""
+    agreed = vote_restore_point(accelerator, fleet=fleet)
+    if agreed is None:
+        return None
+    accelerator.load_state(agreed["path"])
+    if fleet is not None:
+        fleet.record_event(
+            "coordinated_rollback", checkpoint=agreed["path"], step=agreed["step"]
+        )
+    logger.info("coordinated rollback restored %s", agreed["path"])
+    return agreed["path"]

@@ -1,0 +1,173 @@
+"""Pillar 2 — elastic dp resize: re-mesh + reshard at the surviving topology.
+
+A TPU fleet loses whole hosts to preemption; restarting the job at the old
+world size means waiting for a replacement host.  The elastic answer is to
+*resize*: keep the survivors, shrink the ``dp`` axis, and continue from the
+drain checkpoint — every ingredient already exists in-tree and this module
+only composes them:
+
+* checkpoints carry per-leaf PartitionSpecs and both restore paths
+  re-commit onto the CURRENT layout, so an N→M dp reshard is a load
+  (``checkpointing.py`` / ``utils/fsdp_utils.py``);
+* ZeRO-1 masters/moments (and compression error-feedback residuals) are
+  re-laid-out by ``Optimizer.relayout_for_sharded_params`` against the new
+  mesh — the restore then fills the new layout with the checkpointed
+  values, so sharded state is resharded, never reinitialized;
+* the AOT executable cache's fingerprint keys on mesh shape — re-pinning
+  the context and prefetching warms every stored new-topology program, and
+  the cache's miss telemetry enumerates exactly what must recompile.
+
+``surviving_mesh`` shrinks the OUTERMOST (``dp``) axis, which is also the
+cheapest-collective axis — the surviving device block stays physically
+contiguous, so the inner tp/sp ICI neighborhoods are untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def surviving_axis_sizes(mesh: Mesh, target_dp: int) -> dict[str, int]:
+    """The resized axis-size dict: ``dp`` shrunk to ``target_dp``, every
+    other axis preserved.  Validates the shrink is a real sub-topology."""
+    sizes = dict(mesh.shape)
+    dp = sizes.get("dp", 1)
+    if target_dp < 1:
+        raise ValueError(f"target_dp must be >= 1, got {target_dp}")
+    if target_dp > dp:
+        raise ValueError(
+            f"elastic resize only shrinks the dp axis (dp={dp} -> "
+            f"{target_dp}); growing needs new hosts to rendezvous, which is "
+            "a relaunch, not a resize"
+        )
+    sizes["dp"] = target_dp
+    return sizes
+
+
+def surviving_mesh(
+    mesh: Mesh, target_dp: int, lost_blocks: Optional[list] = None
+) -> Mesh:
+    """The mesh over the surviving devices: ``target_dp`` blocks of the
+    ``dp`` axis (dp is outermost, so the survivors keep their inner tp/sp
+    ICI adjacency).  On real hardware the lost host's devices are exactly
+    a dp-axis block — one host serves one slice of the outermost axis;
+    ``lost_blocks`` names which block indices died (a reclamation notice
+    carries this), so a loss of block 0 keeps blocks 1..N rather than
+    binding the dead host's devices.  ``None`` — the rehearsal default —
+    keeps the leading blocks."""
+    sizes = surviving_axis_sizes(mesh, target_dp)
+    if "dp" not in mesh.axis_names:
+        raise ValueError(f"mesh {dict(mesh.shape)} has no dp axis to resize")
+    dp_index = mesh.axis_names.index("dp")
+    dp = mesh.shape["dp"]
+    if lost_blocks is None:
+        keep = list(range(target_dp))
+    else:
+        lost = set(lost_blocks)
+        if not lost <= set(range(dp)):
+            raise ValueError(
+                f"lost_blocks {sorted(lost)} outside the dp axis (dp={dp})"
+            )
+        alive = [b for b in range(dp) if b not in lost]
+        if len(alive) < target_dp:
+            raise ValueError(
+                f"only {len(alive)} dp blocks survive {sorted(lost)}; cannot "
+                f"re-mesh at dp={target_dp}"
+            )
+        keep = alive[:target_dp]
+    device_array = np.take(mesh.devices, keep, axis=dp_index)
+    new = Mesh(device_array, axis_names=mesh.axis_names)
+    assert dict(new.shape) == sizes
+    return new
+
+
+def remesh_accelerator(accelerator, new_mesh: Mesh) -> None:
+    """Swap the run's mesh and re-lay every prepared object onto it.
+
+    Order matters: the mesh swap and model/optimizer relayout run FIRST so
+    the following ``load_state`` (the caller's reshard step) lands the
+    checkpointed values on the new layout — both restore paths re-commit
+    onto whatever the live objects carry.
+    """
+    from ..parallel.sharding import shard_module_params
+
+    state = accelerator.state
+    state.mesh = new_mesh
+    # keep the resolved parallelism layout honest: zero1_enabled, batch
+    # sharding and any later mesh rebuild read dp from here
+    state.parallelism_config.dp_size = dict(new_mesh.shape).get("dp", 1)
+    for model in accelerator._models:
+        shard_module_params(
+            model,
+            new_mesh,
+            fsdp_plugin=state.fsdp_plugin,
+            tp_plugin=state.tp_plugin,
+        )
+    zero1_mesh = new_mesh if state.zero1_enabled else None
+    offload_opt = bool(
+        state.fsdp_plugin is not None
+        and getattr(state.fsdp_plugin, "offload_optimizer", False)
+    )
+    offload_params = bool(
+        state.fsdp_plugin is not None
+        and getattr(state.fsdp_plugin, "cpu_offload", False)
+    )
+    for opt in accelerator._optimizers:
+        opt.optimizer.relayout_for_sharded_params(
+            offload_to_host=offload_opt,
+            offload_params=offload_params,
+            zero1_mesh=zero1_mesh,
+            compression=accelerator._compression,
+            zero2=state.zero2_enabled,
+        )
+    accelerator._refresh_zero2_grads()
+    # gradients from the pre-loss steps are still committed to the lost
+    # topology; the captured step threads them as carried state, so a stale
+    # leaf would trace a program constrained onto devices that no longer
+    # exist.  Re-commit each grad onto its post-resize layout (the ZeRO-2
+    # accumulation sharding when armed — relayout above refreshed it —
+    # else the parameter's own layout), values untouched.
+    for model in accelerator._models:
+        for _, p in model.named_parameters():
+            if p.grad is None:
+                continue
+            sharding = getattr(p, "_grad_sharding", None)
+            if sharding is None:
+                s = getattr(p.data, "sharding", None)
+                sharding = s if isinstance(s, jax.sharding.NamedSharding) else None
+            if sharding is not None:
+                p.grad = jax.device_put(p.grad, sharding)
+    # prepared loaders place each global batch on their pinned mesh — the
+    # next batch must land on the survivors, not the pre-loss layout
+    for loader in accelerator._dataloaders:
+        if getattr(loader, "mesh", None) is not None:
+            loader.mesh = new_mesh
+    # captured programs compiled for the old topology are invalid; bumping
+    # the generation makes every fleet-armed CapturedStep drop its variants
+    # before the next lookup (fleet-off steps never check — the resize API
+    # is only reachable through an enabled fleet)
+    accelerator._mesh_generation = getattr(accelerator, "_mesh_generation", 0) + 1
+
+
+def prewarm_aot_cache(accelerator, compression_name: Optional[str] = None) -> int:
+    """Re-pin the AOT cache's fingerprint to the resized topology and
+    prefetch every stored entry for it — a prior run (or replica) at this
+    topology makes the post-resize first step compile-free; anything not
+    covered surfaces as the cache's loud fingerprint-miss telemetry, which
+    is the recompile worklist."""
+    cache = getattr(accelerator, "aot_cache", None)
+    if cache is None or not cache.enabled:
+        return 0
+    cache.set_context(
+        mesh=accelerator.state.mesh,
+        compression=compression_name or accelerator._compression.name,
+    )
+    return cache.prefetch()

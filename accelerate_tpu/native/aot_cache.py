@@ -34,7 +34,10 @@ with the carried state's structure (treedef, per-leaf shape/dtype/sharding/
 memory-kind), the donation split (host mask) and a digest of the step
 body's source.  The **fingerprint digest** hashes the topology/compiler
 environment: jax+jaxlib versions, platform, device kind+count, process
-count, mesh shape, compression policy and the cache format version.  A
+count, mesh shape, compression policy, the compiler-mode flags
+(``FINGERPRINT_FLAGS`` — ``jax_default_matmul_precision`` et al., whose
+flip would otherwise deserialize a program compiled under the other
+numerics silently) and the cache format version.  A
 lookup globs ``{variant}-*``: an exact fingerprint match is a hit; a
 variant match under a DIFFERENT fingerprint is the stale-entry case — the
 mismatching fields are named in a loud ``kind="aot_cache"`` miss record and
@@ -66,7 +69,22 @@ logger = get_logger(__name__)
 
 # bump when the entry layout / side-metadata schema changes: old entries
 # then report a format mismatch and fall through to a normal compile
-AOT_CACHE_FORMAT = 1
+# (2: compiler flags joined the fingerprint as flat flag:* fields)
+AOT_CACHE_FORMAT = 2
+
+# compiler-mode flags that change the COMPILED PROGRAM without moving any
+# shape/dtype/topology field the fingerprint already hashes: a flip between
+# the storing and loading process would deserialize a program compiled
+# under the other mode and silently dispatch the wrong numerics.  Flat
+# ``flag:<name>`` fields (not one nested dict) so a stale-flag miss names
+# the exact flag that moved.
+FINGERPRINT_FLAGS = (
+    "jax_default_matmul_precision",
+    "jax_enable_x64",
+    "jax_numpy_dtype_promotion",
+    "jax_numpy_rank_promotion",
+    "jax_default_prng_impl",
+)
 
 # the active enabled cache — serving constructs (DecodeService) resolve it
 # here when no explicit cache is passed, mirroring telemetry's module slot
@@ -124,6 +142,10 @@ def topology_fingerprint(mesh=None, compression: Optional[str] = None) -> dict:
         "mesh": dict(mesh.shape) if mesh is not None else None,
         "compression": compression,
     }
+    for flag in FINGERPRINT_FLAGS:
+        # repr, not str: distinguishes unset (None) from the string "None",
+        # and keeps every value JSON-stable
+        fingerprint[f"flag:{flag}"] = repr(getattr(jax.config, flag, None))
     return fingerprint
 
 
@@ -796,6 +818,7 @@ class AOTServingPrograms:
 
 __all__ = [
     "AOT_CACHE_FORMAT",
+    "FINGERPRINT_FLAGS",
     "AOTCompilationCache",
     "AOTServingPrograms",
     "current_aot_cache",

@@ -51,6 +51,11 @@ class Resilience:
             telemetry if (telemetry is not None and getattr(telemetry, "enabled", False)) else None
         )
         self.events: list[dict] = []
+        # the owning Accelerator's enabled Fleet hub, when the elastic fleet
+        # runtime is armed (docs/elastic.md): the retrier consults it to
+        # turn the historical multi-process rollback refusal into the
+        # coordinated all-ranks restore protocol
+        self.fleet = None
         self.injector: Optional[FaultInjector] = None
         self.guard: Optional[PreemptionGuard] = None
         self.retrier: Optional[StepRetrier] = None

@@ -18,6 +18,11 @@ semicolon-separated directives, ``key=int`` options after a colon:
   driven in tests).
 * ``sigterm:step=2`` — deliver a real ``SIGTERM`` to this process right
   before the dispatch of global step ``step`` (mid-step preemption).
+* ``host_lost:step=2`` — mark a whole host as preempted right before the
+  dispatch of global step ``step``.  Consumed by the elastic fleet runtime
+  (``fleet.should_resize``, docs/elastic.md): unlike ``sigterm`` — "this
+  process must drain and exit" — ``host_lost`` means "a peer is gone, the
+  survivors must drain and re-mesh at the smaller topology".
 
 Injection points are reached only when resilience is enabled AND a plan is
 configured — production runs never pay for (or trip over) this module.
@@ -40,7 +45,7 @@ class InjectedTransientError(RuntimeError):
 
 @dataclass
 class _Directive:
-    kind: str  # "init_hang" | "dispatch" | "sigterm"
+    kind: str  # "init_hang" | "dispatch" | "sigterm" | "host_lost"
     step: Optional[int] = None  # dispatch index (dispatch/sigterm)
     times: int = 1  # how many firings remain
     fired: int = 0
@@ -59,10 +64,10 @@ class FaultPlan:
                 continue
             kind, _, opts_raw = raw.partition(":")
             kind = kind.strip()
-            if kind not in ("init_hang", "dispatch", "sigterm"):
+            if kind not in ("init_hang", "dispatch", "sigterm", "host_lost"):
                 raise ValueError(
                     f"unknown fault directive {kind!r} in {spec!r}; use "
-                    "init_hang / dispatch / sigterm"
+                    "init_hang / dispatch / sigterm / host_lost"
                 )
             opts: dict[str, int] = {}
             for pair in opts_raw.split(","):
@@ -79,7 +84,7 @@ class FaultPlan:
             unknown = set(opts) - {"step", "times"}
             if unknown:
                 raise ValueError(f"unknown fault options {sorted(unknown)} in {raw!r}")
-            if kind in ("dispatch", "sigterm") and "step" not in opts:
+            if kind in ("dispatch", "sigterm", "host_lost") and "step" not in opts:
                 raise ValueError(f"{kind!r} directive needs step=N ({raw!r})")
             directives.append(
                 _Directive(
@@ -132,6 +137,16 @@ class FaultInjector:
             return
         directive.fired += 1
         os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_host_lost(self, dispatch_index: int) -> bool:
+        """True when a scheduled host loss fires at this dispatch — the
+        elastic fleet runtime's preemption signal (a real fleet would read
+        the scheduler's reclamation notice here)."""
+        directive = self._pending("host_lost", step=dispatch_index)
+        if directive is None:
+            return False
+        directive.fired += 1
+        return True
 
     def maybe_dispatch_fault(self, dispatch_index: int) -> None:
         """Raise a transient fault for the given dispatch; retries of the same

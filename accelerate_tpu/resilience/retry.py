@@ -27,10 +27,17 @@ Two hard edges, handled explicitly:
   would die on "Array has been deleted".  The loop checks for deleted
   donated leaves before retrying and escalates straight to rollback (the
   restore rebinds fresh buffers) instead of burning retries it cannot win;
-* rollback is single-process only: ``load_state`` is collective, and one
-  rank restoring while its peers proceed to the next step's collectives
-  would deadlock the mesh.  Multi-process exhaustion propagates (the
-  elastic-restart coordination is a ROADMAP item).
+* rollback on a multi-process run needs COORDINATION: ``load_state`` is
+  collective, and one rank restoring while its peers proceed to the next
+  step's collectives would deadlock the mesh.  With the elastic fleet
+  runtime armed (``accelerator.fleet``, docs/elastic.md) exhaustion enters
+  the all-ranks restore protocol instead — every rank offers its visible
+  complete checkpoints to a gather/vote barrier, all ranks agree on the
+  newest all-ranks-visible restore point, and only then does every rank
+  issue the collective ``load_state`` together (a dispatch fault is SPMD —
+  it surfaces on every rank's dispatch of the same call, so all retriers
+  exhaust and vote in lockstep).  Without the fleet, multi-process
+  exhaustion propagates exactly as before.
 """
 
 from __future__ import annotations
@@ -59,6 +66,14 @@ TRANSIENT_MARKERS = (
 
 # errors that are the user's program talking, never the runtime flaking
 _USER_ERROR_TYPES = (TypeError, ValueError, KeyError, AttributeError, AssertionError)
+
+
+def _multi_process() -> bool:
+    """Module-level so tests can pin the world-size read without touching
+    the Borg PartialState."""
+    from ..state import PartialState
+
+    return bool(PartialState._shared_state and PartialState().num_processes > 1)
 
 
 def classify_failure(exc: BaseException) -> str:
@@ -108,15 +123,25 @@ class StepRetrier:
             attempt, self.backoff_s, self.backoff_cap_s, self.jitter, self._rng
         )
 
+    def _coordinator(self):
+        """The enabled Fleet hub when this is a multi-process run that must
+        (and can) coordinate its restore; None on single-process runs —
+        where the local rollback needs no vote."""
+        if not _multi_process():
+            return None
+        fleet = getattr(self.hub, "fleet", None)
+        if fleet is not None and fleet.enabled and fleet.handler.coordinate_rollback:
+            return fleet
+        return None
+
     def _rollback_allowed(self) -> bool:
         if not self.rollback:
             return False
-        from ..state import PartialState
-
-        if PartialState._shared_state and PartialState().num_processes > 1:
+        if _multi_process():
             # load_state is collective; a single rank restoring while its
-            # peers run the next step's collectives would hang the mesh
-            return False
+            # peers run the next step's collectives would hang the mesh —
+            # only the fleet's all-ranks vote protocol makes it safe
+            return self._coordinator() is not None
         return True
 
     def run_dispatch(self, step, dispatch, entry, dev_leaves, host_leaves, host_mask):
@@ -167,7 +192,12 @@ class StepRetrier:
                     self.last_wait_ms += (time.perf_counter() - t_sleep) * 1e3
                     continue
                 checkpoint = hub.last_checkpoint
-                if not self._rollback_allowed() or rolled_back or checkpoint is None:
+                coordinator = self._coordinator()
+                if (
+                    not self._rollback_allowed()
+                    or rolled_back
+                    or (checkpoint is None and coordinator is None)
+                ):
                     hub.record_event(
                         "dispatch_exhausted",
                         step=call_index,
@@ -177,6 +207,28 @@ class StepRetrier:
                         error=error,
                     )
                     raise
+                if coordinator is not None:
+                    # coordinated restore (docs/elastic.md): all ranks reach
+                    # this vote together (the fault is SPMD), agree on the
+                    # newest all-ranks-visible complete checkpoint, and only
+                    # then issue the collective load_state below in lockstep
+                    from ..fleet.coordinate import vote_restore_point
+
+                    agreed = vote_restore_point(
+                        step.accelerator, fleet=coordinator
+                    )
+                    if agreed is None:
+                        hub.record_event(
+                            "dispatch_exhausted",
+                            step=call_index,
+                            attempts=attempt + 1,
+                            rolled_back=False,
+                            donated_consumed=consumed,
+                            error=error,
+                            restore_vote="no all-ranks-visible checkpoint",
+                        )
+                        raise
+                    checkpoint = agreed["path"]
                 # rollback: restore the last good checkpoint and replay this
                 # call against the SAME compiled entry — the cache key is a
                 # function of arg shapes and flags, none of which the restore
@@ -186,6 +238,7 @@ class StepRetrier:
                     "rollback",
                     step=call_index,
                     checkpoint=checkpoint,
+                    coordinated=coordinator is not None,
                     donated_consumed=consumed,
                     error=error,
                 )
@@ -196,6 +249,14 @@ class StepRetrier:
                 # recompiling; record how many entries the warm staged
                 cache = getattr(step.accelerator, "aot_cache", None)
                 step.accelerator.load_state(checkpoint)
+                if coordinator is not None:
+                    # the collective restore landed on every rank — the
+                    # event docs/elastic.md promises operators can grep for
+                    coordinator.record_event(
+                        "coordinated_rollback",
+                        checkpoint=checkpoint,
+                        dispatch_index=call_index,
+                    )
                 if cache is not None and cache.enabled and cache.warm_on_restore:
                     # warm_on_restore off means load_state ran NO prefetch —
                     # reporting a stale count would claim a warm that never

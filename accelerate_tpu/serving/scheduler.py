@@ -265,6 +265,14 @@ class DecodeService:
         # endpoint's SLO percentiles must reflect *recent* traffic, not the
         # whole run
         self._latency_window: deque = deque(maxlen=max(1, cfg.metrics_window))
+        # native Prometheus histograms alongside the window percentiles:
+        # cumulative _bucket series a server-side histogram_quantile() can
+        # rate() over any range and merge across replicas — the window
+        # gauges cannot be aggregated (docs/telemetry.md §endpoint)
+        from ..telemetry.metrics import LatencyHistogram
+
+        self._ttft_hist = LatencyHistogram()
+        self._tpot_hist = LatencyHistogram()
         if self._hub is not None:
             # the hub's metrics endpoint (telemetry/metrics.py) scrapes any
             # provider registered here; latest-constructed service wins the
@@ -421,6 +429,10 @@ class DecodeService:
             self.results.pop(next(iter(self.results)))
         self.stats["completed"] += 1
         self._latency_window.append((req.ttft_ms, req.tpot_ms))
+        if req.ttft_ms is not None:
+            self._ttft_hist.observe(req.ttft_ms)
+        if req.tpot_ms is not None:
+            self._tpot_hist.observe(req.tpot_ms)
         if self._hub is not None:
             self._hub.record_serving({
                 "event": "complete", "rid": req.rid,
@@ -524,6 +536,11 @@ class DecodeService:
             "completed_total": self.stats["completed"],
             "recompile_events_total": self.recompile_events,
             "latency_window": len(window),
+            # native histograms (cumulative over the service lifetime);
+            # the p50/p99 gauges below stay for human eyeballs — dashboards
+            # should quantile() the _bucket series instead
+            "ttft_ms": self._ttft_hist,
+            "tpot_ms": self._tpot_hist,
         }
         ttfts = sorted(t for t, _ in window if t is not None)
         tpots = sorted(p for _, p in window if p is not None)

@@ -109,6 +109,16 @@ class Telemetry:
         # AOT executable cache events (hit/miss/store/warm with cause,
         # bytes, load vs avoided compile ms) — see native/aot_cache.py
         self.aot_cache_events: deque[dict] = deque(maxlen=handler.max_events)
+        # elastic fleet runtime events (host_lost/restore_vote/resize,
+        # kind="fleet_event") plus the periodic mid-run skew records
+        # (kind="fleet") the aggregate cadence appends — see fleet/
+        self.fleet_events: deque[dict] = deque(maxlen=handler.max_events)
+        # native Prometheus histogram of replay step latency (metrics.py):
+        # cumulative _bucket series for the endpoint instead of
+        # point-in-time percentiles; observation is two int bumps per step
+        from .metrics import LatencyHistogram
+
+        self.step_hist = LatencyHistogram()
         # sampled device-time attribution (profiler.py): a DeviceStepRecord
         # per sampled step, joined to the host StepRecord by step index;
         # profiler is None unless the cadence knob armed it — the unsampled
@@ -134,6 +144,10 @@ class Telemetry:
         # fleet aggregation (aggregate.py): set by aggregate_fleet() on the
         # main rank — the JSONL dump then describes every rank, not one
         self._fleet_records: Optional[list] = None
+        # first step index NOT yet covered by a periodic fleet tick: each
+        # tick gathers only newer replay records, so the collective payload
+        # is the delta and the skew record describes the CURRENT window
+        self._fleet_agg_mark = 0
         # live metrics endpoint (metrics.py): providers registered here are
         # rendered by whatever MetricsServer is attached to this hub
         self._metrics_providers: list = []
@@ -218,6 +232,11 @@ class Telemetry:
 
     def record_step(self, record: StepRecord) -> None:
         self.timeline.append(record)
+        if not record.built:
+            # replay latencies only: a build's trace+compile would park the
+            # whole histogram mass in the top bucket and say nothing about
+            # the steady state the SLO cares about
+            self.step_hist.observe(record.total_ms)
         if self._export_sink:
             self._export_queue.append(record.to_dict())
 
@@ -279,6 +298,19 @@ class Telemetry:
         record = dict(payload)
         record["kind"] = "aot_cache"
         self.aot_cache_events.append(record)
+        if self._export_sink:
+            self._export_queue.append(dict(record))
+
+    def record_fleet(self, payload: dict) -> None:
+        """Elastic-fleet record: hub events (host_lost, restore_vote,
+        resize, ...) default to ``kind="fleet_event"``; the periodic
+        aggregation cadence passes ready-made ``kind="fleet"`` skew records
+        through unchanged (docs/elastic.md)."""
+        if not self.enabled:
+            return
+        record = dict(payload)
+        record.setdefault("kind", "fleet_event")
+        self.fleet_events.append(record)
         if self._export_sink:
             self._export_queue.append(dict(record))
 
@@ -356,7 +388,7 @@ class Telemetry:
                 if record.get("kind") in (
                     "step", "recompile", "program", "collectives",
                     "resources", "resilience", "serving", "device_step",
-                    "aot_cache",
+                    "aot_cache", "fleet", "fleet_event",
                 ):
                     self._export_queue.append(record)
 
@@ -409,6 +441,7 @@ class Telemetry:
         records += [dict(e) for e in self.resilience_events]
         records += [dict(e) for e in self.serving_events]
         records += [dict(e) for e in self.aot_cache_events]
+        records += [dict(e) for e in self.fleet_events]
         records.append(self.summary())
         return records
 
@@ -420,15 +453,46 @@ class Telemetry:
             return self._fleet_records
         return self.all_records()
 
-    def aggregate_fleet(self) -> Optional[list[dict]]:
+    def aggregate_fleet(self, periodic: bool = False) -> Optional[list[dict]]:
         """COLLECTIVE — every process must call (``end_training`` does on
-        multi-process runs; safe and communication-free on one).  Gathers
-        all ranks' retained records to the main process, rank-tags them,
-        and appends the ``kind="fleet"`` skew record; the main process also
-        caches the merge so ``write_jsonl`` dumps the fleet view.  Returns
-        the merged records on main, ``None`` elsewhere."""
-        from .aggregate import gather_fleet, merge_rank_records
+        multi-process runs; the fleet hub's cadence does mid-run; safe and
+        communication-free on one).  Gathers all ranks' retained records to
+        the main process, rank-tags them, and appends the ``kind="fleet"``
+        skew record; the main process also caches the merge so
+        ``write_jsonl`` dumps the fleet view.  Returns the merged records
+        on main, ``None`` elsewhere.
 
+        ``periodic=True`` is the mid-run mode (docs/elastic.md): instead of
+        freezing the final fleet dump, the skew/straggler record is
+        computed and RETAINED (``record_fleet``) so a live scrape or the
+        fleet hub's ``fleet_signal()`` can read the current straggler
+        picture while training continues; returns ``[skew_record]`` on the
+        main process."""
+        from .aggregate import fleet_skew, gather_fleet, merge_rank_records
+
+        if periodic:
+            # mid-run payload discipline: only the replay step records the
+            # skew summary consumes ride the collective, and only the DELTA
+            # since the previous tick — re-gathering the whole retained
+            # history every tick would pickle O(window × ranks) per tick
+            # and dilute the "current straggler" signal with steps an
+            # earlier tick already described
+            mark = self._fleet_agg_mark
+            local = [
+                r.to_dict()
+                for r in self.timeline.records()
+                if not r.built and r.step >= mark
+            ]
+            self._fleet_agg_mark = self.steps_total
+            per_rank = gather_fleet(local)
+            if per_rank is None:
+                return None
+            skew = fleet_skew(per_rank)
+            skew["periodic"] = True
+            skew["at_step"] = self.steps_total
+            skew["window_from_step"] = mark
+            self.record_fleet(skew)
+            return [skew]
         per_rank = gather_fleet(self.all_records())
         if per_rank is None:
             return None

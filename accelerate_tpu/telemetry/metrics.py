@@ -10,8 +10,13 @@ that instant.
 
 Metric namespace: ``atpu_<provider>_<field>``; nested dicts flatten with
 ``_``; names ending ``_total`` are typed ``counter``, everything else
-``gauge``.  Providers are fail-soft: one raising provider becomes a
-comment line in the scrape, never a 500.
+``gauge``.  A :class:`LatencyHistogram` value renders as a native
+Prometheus histogram — cumulative ``_bucket{le="..."}`` series plus
+``_sum``/``_count`` — so step/TTFT/TPOT latencies expose full
+distributions a server-side ``histogram_quantile()`` can aggregate across
+the fleet, instead of point-in-time p50/p99 gauges that cannot be merged.
+Providers are fail-soft: one raising provider becomes a comment line in
+the scrape, never a 500.
 
 Wiring: ``TelemetryKwargs(metrics_port=...)`` / ``$ACCELERATE_METRICS_PORT``
 starts one automatically (port 0 = ephemeral, read ``server.port``);
@@ -25,6 +30,7 @@ from __future__ import annotations
 
 import re
 import threading
+from bisect import bisect_left
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
@@ -33,6 +39,69 @@ from ..logging import get_logger
 logger = get_logger(__name__)
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# the grammar of one exposition sample line this module emits: a bare
+# metric name, optionally the one label histograms require
+# (`_bucket{le="..."}`), then the value.  Exported so the smoke tool and
+# the endpoint tests validate the SAME grammar the renderer produces —
+# a format change here updates every validator with it.
+SAMPLE_LINE_RE = re.compile(
+    r"^[a-zA-Z_][a-zA-Z0-9_]*(\{le=\"[^\"]+\"\})? [-+0-9eE.naif]+$"
+)
+
+# default latency bucket bounds (ms): log-ish spacing from sub-ms decode
+# steps to multi-minute cold compiles; +Inf is implicit
+DEFAULT_LATENCY_BUCKETS_MS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0,
+)
+
+
+class LatencyHistogram:
+    """Cumulative Prometheus histogram recorder.
+
+    ``observe()`` is two integer bumps and a float add — cheap enough for
+    the capture hot path and the serving completion path.  Rendering emits
+    the standard ``_bucket{le=...}`` / ``_sum`` / ``_count`` series, which
+    (unlike the sliding-window p50/p99 gauges they replace) are monotonic
+    counters a Prometheus server can rate() and quantile() over any window
+    and aggregate across ranks/replicas.  Writer/scraper races read a
+    bucket count at most one observation stale — monotonicity is preserved
+    because counts only ever grow.
+    """
+
+    def __init__(self, buckets=DEFAULT_LATENCY_BUCKETS_MS):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        # per-bound counts (NON-cumulative internally; cumulated at render)
+        self._counts = [0] * (len(self.buckets) + 1)  # [+Inf] last
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self._counts[bisect_left(self.buckets, float(value))] += 1
+        self.sum += float(value)
+        self.count += 1
+
+    def cumulative_counts(self) -> list[int]:
+        """Per-bound cumulative counts, ``+Inf`` last (== ``count``)."""
+        out, running = [], 0
+        for c in self._counts:
+            running += c
+            out.append(running)
+        return out
+
+    def render_lines(self, name: str) -> list[str]:
+        lines = [f"# TYPE {name} histogram"]
+        cumulative = self.cumulative_counts()
+        for bound, c in zip(self.buckets, cumulative):
+            le = f"{bound:g}"
+            lines.append(f'{name}_bucket{{le="{le}"}} {c}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative[-1]}')
+        lines.append(f"{name}_sum {self.sum}")
+        lines.append(f"{name}_count {cumulative[-1]}")
+        return lines
 
 
 def register_provider(providers: list, name: str, fn: Callable[[], dict]) -> str:
@@ -61,7 +130,9 @@ def _flatten(values: dict, prefix: str = "") -> list:
     flat = []
     for key, value in values.items():
         name = f"{prefix}_{key}" if prefix else str(key)
-        if isinstance(value, dict):
+        if isinstance(value, LatencyHistogram):
+            flat.append((name, value))
+        elif isinstance(value, dict):
             flat.extend(_flatten(value, name))
         elif isinstance(value, bool):
             flat.append((name, int(value)))
@@ -72,9 +143,11 @@ def _flatten(values: dict, prefix: str = "") -> list:
 
 
 def render_prometheus(sections: list) -> str:
-    """``[(provider, values_dict), ...]`` → text exposition.  Duplicate
-    metric names (two providers under one name) keep the first sample —
-    duplicates are invalid exposition."""
+    """``[(provider, values_dict), ...]`` → text exposition.  Scalar values
+    render as counter/gauge samples; :class:`LatencyHistogram` values
+    render as native histogram series.  Duplicate metric names (two
+    providers under one name) keep the first sample — duplicates are
+    invalid exposition."""
     lines: list[str] = []
     seen: set[str] = set()
     for provider, values in sections:
@@ -83,6 +156,9 @@ def render_prometheus(sections: list) -> str:
             if name in seen:
                 continue
             seen.add(name)
+            if isinstance(value, LatencyHistogram):
+                lines.extend(value.render_lines(name))
+                continue
             kind = "counter" if name.endswith("_total") else "gauge"
             lines.append(f"# TYPE {name} {kind}")
             lines.append(f"{name} {value}")
@@ -97,9 +173,12 @@ def telemetry_metrics(telemetry) -> dict:
         "steps_total": telemetry.steps_total,
         "recompiles_total": telemetry.recompiles_total,
         "resilience_events_total": len(telemetry.resilience_events),
+        "fleet_events_total": len(telemetry.fleet_events),
         "eager_dataloader_wait_ms_total": round(
             telemetry.eager_dataloader_wait_ms, 3
         ),
+        # native histogram: replay step latency distribution (_bucket series)
+        "step_latency_ms": telemetry.step_hist,
     }
     for key, value in telemetry.timeline.summary().items():
         if isinstance(value, (int, float)) and (

@@ -19,6 +19,7 @@ from .dataclasses import (
     DistributedDataParallelKwargs,
     DistributedType,
     ExpertParallelPlugin,
+    FleetKwargs,
     FP8RecipeKwargs,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,

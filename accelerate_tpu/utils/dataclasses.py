@@ -276,6 +276,58 @@ class ResilienceKwargs(KwargsHandler):
 
 
 @dataclass
+class FleetKwargs(KwargsHandler):
+    """Elastic-fleet-runtime knobs (``accelerator.fleet``, docs/elastic.md).
+
+    No reference counterpart — this is the torchelastic-style "survive and
+    resize" composition over the resilience/checkpoint/AOT-cache subsystems.
+    When ``enabled`` is left ``None`` it resolves from ``$ACCELERATE_FLEET``
+    (default off); off means the capture hot path runs its pre-fleet code
+    byte-for-byte (one ``None``-check, matching the telemetry/resilience/
+    aot-cache precedent).
+
+    ``coordinate_rollback`` arms the multi-host restore protocol: on retry
+    exhaustion every rank offers its visible complete checkpoints to a
+    gather/vote barrier and all ranks issue the collective ``load_state``
+    against the agreed restore point — replacing the resilience layer's
+    single-process-only rollback refusal.  ``elastic`` arms dp resize: a
+    lost host (``host_lost`` fault-plan verb, or a real reclamation notice)
+    trips ``fleet.should_resize`` and ``fleet.resize()`` drains → re-meshes
+    at the surviving topology → reshards ZeRO-1 masters/moments (and
+    compression residuals) from the spec-carrying checkpoint → prewarms the
+    new-topology programs from the AOT cache.  ``min_dp`` refuses resizes
+    below that dp extent.  ``aggregate_every_n`` (dispatches; 0 = off)
+    graduates ``telemetry.aggregate_fleet()`` to periodic mid-run skew/
+    straggler records — the autoscaler/resize signal.  ``checkpoint_dir``
+    is the default drain target for resize; ``fault_plan`` wires the
+    test-only injector (``$ACCELERATE_FAULT_PLAN``; only the ``host_lost``
+    verb is consumed here — the rest belong to resilience).
+    """
+
+    enabled: Optional[bool] = None  # None → $ACCELERATE_FLEET, default off
+    coordinate_rollback: bool = True
+    elastic: bool = True
+    min_dp: int = 1  # $ACCELERATE_FLEET_MIN_DP
+    aggregate_every_n: int = 0  # $ACCELERATE_FLEET_AGGREGATE_N
+    checkpoint_dir: Optional[str] = None  # $ACCELERATE_FLEET_CHECKPOINT_DIR
+    fault_plan: Optional[str] = None  # $ACCELERATE_FAULT_PLAN (test-only)
+
+    def __post_init__(self):
+        env = os.environ
+        if self.enabled is None:
+            value = env.get("ACCELERATE_FLEET")
+            self.enabled = bool(str_to_bool(value)) if value is not None else False
+        if "ACCELERATE_FLEET_MIN_DP" in env:
+            self.min_dp = int(env["ACCELERATE_FLEET_MIN_DP"])
+        if "ACCELERATE_FLEET_AGGREGATE_N" in env:
+            self.aggregate_every_n = int(env["ACCELERATE_FLEET_AGGREGATE_N"])
+        if self.checkpoint_dir is None:
+            self.checkpoint_dir = env.get("ACCELERATE_FLEET_CHECKPOINT_DIR")
+        if self.fault_plan is None:
+            self.fault_plan = env.get("ACCELERATE_FAULT_PLAN")
+
+
+@dataclass
 class CompressionKwargs(KwargsHandler):
     """dp-axis collective compression knobs (docs/compression.md).
 

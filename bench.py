@@ -598,6 +598,115 @@ def _aot_cache_block(on_accel: bool) -> dict:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def _elastic_block(on_accel: bool) -> dict:
+    """Elastic-resize rehearsal timing for the primary row
+    (docs/elastic.md): tiny GPT at the full dp extent, ``fleet.resize()``
+    to dp/2, then one resumed step.  Reported: the drain/remesh+restore
+    split (``elastic_drain_ms`` / ``elastic_resize_ms``), the AOT entries
+    prewarmed for the surviving topology, the post-resize first-step wall
+    clock (the recovery-time number an autoscaler plans around) and the
+    resumed-step relative loss error vs continuing at full dp.
+    Run TWICE against one AOT store: the cold pass compiles the dp/2
+    program at resize time, the warm pass recovers off the prewarmed
+    serialized executable — the cold/warm post-resize split is the
+    with/without-store recovery story.  ``BENCH_ELASTIC=0`` disables the
+    block."""
+    import tempfile
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import (
+        Accelerator,
+        CompilationCacheKwargs,
+        FleetKwargs,
+        TelemetryKwargs,
+    )
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"elastic_skipped": f"needs >= 2 devices, have {n_dev}"}
+    tmp = tempfile.mkdtemp(prefix="atpu_bench_elastic_")
+    cache_dir = os.path.join(tmp, "aot")
+    cfg = GPTConfig.small() if on_accel else GPTConfig.tiny()
+    batch, seq = (BATCH * n_dev, SEQ) if on_accel else (4, 128)
+
+    def build(fleet: bool):
+        Accelerator._reset_state()
+        jax.clear_caches()
+        nn.manual_seed(0)
+        handlers = [TelemetryKwargs(enabled=True)]
+        if fleet:
+            handlers += [
+                FleetKwargs(enabled=True),
+                CompilationCacheKwargs(cache_dir=cache_dir),
+            ]
+        acc = Accelerator(
+            mixed_precision="bf16" if on_accel else "no",
+            kwargs_handlers=handlers,
+        )
+        model = GPTLMHeadModel(cfg)
+        opt = optim.AdamW(model.parameters(), lr=3e-4, weight_decay=0.1)
+        model, opt = acc.prepare(model, opt)
+
+        def step_fn(ids):
+            opt.zero_grad()
+            out = model(ids, labels=ids)
+            acc.backward(out["loss"])
+            opt.step()
+            return out["loss"]
+
+        rng = np.random.default_rng(0)
+        raw = [
+            rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+            for _ in range(3)
+        ]
+        return acc, acc.compile_step(step_fn), raw
+
+    def rehearse():
+        acc, step, raw = build(fleet=True)
+        dp = dict(acc.mesh.shape)["dp"]
+        for b in raw[:2]:
+            float(step(batch_to_global_array(b, mesh=acc.mesh)))
+        t0 = _time.perf_counter()
+        ckpt = acc.fleet.drain(acc, os.path.join(tmp, "drain"))
+        t1 = _time.perf_counter()
+        info = acc.fleet.resize(acc, target_dp=dp // 2, checkpoint=ckpt)
+        t2 = _time.perf_counter()
+        resumed = float(step(batch_to_global_array(raw[2], mesh=acc.mesh)))
+        t3 = _time.perf_counter()
+        return dp, info, resumed, (t1 - t0, t2 - t1, t3 - t2)
+
+    try:
+        # reference: full-dp run over the same batches
+        acc, step, raw = build(fleet=False)
+        ref = [
+            float(step(batch_to_global_array(b, mesh=acc.mesh))) for b in raw
+        ]
+        dp, _, _, cold = rehearse()
+        _, info, resumed, warm = rehearse()
+        return {
+            "elastic_dp": f"{dp}->{dp // 2}",
+            "elastic_drain_ms": round(warm[0] * 1e3, 1),
+            "elastic_resize_ms": round(warm[1] * 1e3, 1),
+            "elastic_prewarm_entries": info["aot_prewarmed"],
+            "elastic_post_resize_step_ms_cold": round(cold[2] * 1e3, 1),
+            "elastic_post_resize_step_ms_warm": round(warm[2] * 1e3, 1),
+            "elastic_resume_loss_rel_err": (
+                round(abs(resumed - ref[2]) / max(abs(ref[2]), 1e-9), 8)
+            ),
+        }
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _serving_block(on_accel: bool) -> dict:
     """Serving rows for the primary JSON (docs/serving.md): the continuous-
     batching decode service on the flagship GPT geometry under a synthetic
@@ -1082,6 +1191,13 @@ def main() -> None:
             result.update(_serving_block(on_accel))
         except Exception as exc:
             result["serving_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    if os.environ.get("BENCH_ELASTIC", "1") != "0":
+        # survive-and-resize rehearsal (docs/elastic.md): drain/resize
+        # split, prewarm coverage, post-resize recovery step — fail-soft
+        try:
+            result.update(_elastic_block(on_accel))
+        except Exception as exc:
+            result["elastic_error"] = f"{type(exc).__name__}: {exc}"[:300]
     _PRIMARY_RESULT.update(result)
     # secondary BASELINE.md workloads, gated so the default driver run stays
     # inside its time budget (each adds a multi-minute cold compile)

@@ -200,6 +200,44 @@ def test_fingerprint_mismatch_names_moved_fields():
     assert fingerprint_mismatch(None, live) == "entry metadata carries no fingerprint"
 
 
+def test_compiler_flags_in_fingerprint():
+    """ROADMAP carried item: the store is keyed on compiler-mode flags too.
+    The fingerprint carries them as flat ``flag:*`` fields so a stale-flag
+    miss names the exact flag that moved."""
+    from accelerate_tpu.native.aot_cache import FINGERPRINT_FLAGS
+
+    live = topology_fingerprint()
+    for flag in FINGERPRINT_FLAGS:
+        assert f"flag:{flag}" in live, flag
+    assert "flag:jax_default_matmul_precision" in live
+
+
+def test_flag_flip_is_loud_miss_naming_the_flag(tmp_path):
+    """A ``jax_default_matmul_precision`` flip between the storing and the
+    loading process would deserialize a program compiled under the other
+    numerics — it must be a fall-through miss whose cause NAMES the flag,
+    never a silent wrong-precision dispatch."""
+    cache_dir = tmp_path / "cache"
+    prev = jax.config.jax_default_matmul_precision
+    _, _, losses1 = _run(cache_dir)
+    try:
+        jax.config.update("jax_default_matmul_precision", "float32")
+        acc2, _, _ = _run(cache_dir)
+        misses = [
+            e for e in acc2.telemetry.aot_cache_events if e["event"] == "miss"
+        ]
+        assert misses, "flag flip produced no miss record"
+        assert any(
+            "flag:jax_default_matmul_precision" in (e.get("cause") or "")
+            for e in misses
+        ), misses
+        # fell through to a NORMAL compile under the new flag: no crash
+        warm_first = acc2.telemetry.timeline.records()[0]
+        assert warm_first.compile_ms > 0
+    finally:
+        jax.config.update("jax_default_matmul_precision", prev)
+
+
 # ---------------------------------------------------------------------------
 # size bound
 # ---------------------------------------------------------------------------

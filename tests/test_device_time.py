@@ -8,7 +8,6 @@ merge produces per-rank skew stats; the metrics endpoint serves valid
 Prometheus text with live serving gauges — all on the CPU mesh.
 """
 
-import re
 import urllib.request
 
 import numpy as np
@@ -19,7 +18,12 @@ import accelerate_tpu.optim as optim
 from accelerate_tpu import Accelerator, TelemetryKwargs
 from accelerate_tpu.data_loader import batch_to_global_array
 from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
-from accelerate_tpu.telemetry import DeviceStepRecord, Telemetry, _set_active
+from accelerate_tpu.telemetry import (
+    DeviceStepRecord,
+    StepRecord,
+    Telemetry,
+    _set_active,
+)
 from accelerate_tpu.telemetry.aggregate import fleet_skew, merge_rank_records
 from accelerate_tpu.telemetry.profiler import (
     classify_op,
@@ -287,7 +291,9 @@ def _EnabledKwargs():
 # metrics endpoint: valid Prometheus text, live serving gauges
 # ---------------------------------------------------------------------------
 
-_SAMPLE_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]* [-+0-9eE.naif]+$")
+# the renderer's own sample-line grammar (incl. histogram `le` labels) —
+# shared with tools/profile_smoke.py so every validator tracks the format
+from accelerate_tpu.telemetry.metrics import SAMPLE_LINE_RE as _SAMPLE_RE
 
 
 def _scrape(url):
@@ -312,9 +318,48 @@ def test_metrics_endpoint_scrapes_training_hub():
         assert "atpu_telemetry_steps_total 2" in body
         assert "atpu_telemetry_recompiles_total 0" in body
         assert "atpu_telemetry_replay_dispatch_ms_mean" in body
+        # native step-latency histogram: _bucket series, not percentiles
+        assert "# TYPE atpu_telemetry_step_latency_ms histogram" in body
+        assert 'atpu_telemetry_step_latency_ms_bucket{le="+Inf"} 1' in body
+        assert "atpu_telemetry_step_latency_ms_count 1" in body  # replay only
     finally:
         acc.telemetry.close_metrics()
     assert acc.telemetry.metrics_server is None
+
+
+def test_latency_histogram_cumulative_and_replay_scoped():
+    """ROADMAP carried item: native Prometheus `_bucket` series replace the
+    point-in-time percentile gauges — bucket counts are CUMULATIVE (le is
+    inclusive), sum/count track every observation, and the hub's step
+    histogram observes replays only (a build's compile time would park the
+    whole mass in the top bucket)."""
+    from accelerate_tpu.telemetry.metrics import (
+        LatencyHistogram,
+        render_prometheus,
+    )
+
+    hist = LatencyHistogram(buckets=(1.0, 10.0, 100.0))
+    for value in (0.5, 1.0, 5.0, 50.0, 5000.0):
+        hist.observe(value)
+    assert hist.cumulative_counts() == [2, 3, 4, 5]  # le="1" includes 1.0
+    assert hist.count == 5 and hist.sum == 5056.5
+    body = render_prometheus([("t", {"lat_ms": hist})])
+    assert '# TYPE atpu_t_lat_ms histogram' in body
+    assert 'atpu_t_lat_ms_bucket{le="1"} 2' in body
+    assert 'atpu_t_lat_ms_bucket{le="+Inf"} 5' in body
+    assert "atpu_t_lat_ms_count 5" in body
+    # hub scoping: builds excluded from the step histogram
+    def _record(step, built, total_ms):
+        return StepRecord(
+            step=step, key="k", built=built, total_ms=total_ms,
+            assembly_ms=0.0, trace_ms=0.0, compile_ms=0.0,
+            dispatch_ms=total_ms, dataloader_wait_ms=0.0,
+        )
+
+    hub = Telemetry(_EnabledKwargs())
+    hub.record_step(_record(0, built=True, total_ms=5000.0))
+    hub.record_step(_record(1, built=False, total_ms=3.0))
+    assert hub.step_hist.count == 1 and hub.step_hist.sum == 3.0
 
 
 def test_decode_service_metrics_snapshot_and_scrape():
@@ -354,6 +399,12 @@ def test_decode_service_metrics_snapshot_and_scrape():
         assert "atpu_serving_block_pool_free_frac" in body
         assert "atpu_serving_ttft_ms_p50" in body
         assert "atpu_serving_ttft_ms_p99" in body
+        # native TTFT/TPOT histograms alongside the window percentiles:
+        # one observation per completed request, cumulative over lifetime
+        assert "# TYPE atpu_serving_ttft_ms histogram" in body
+        assert 'atpu_serving_ttft_ms_bucket{le="+Inf"} 3' in body
+        assert "atpu_serving_ttft_ms_count 3" in body
+        assert 'atpu_serving_tpot_ms_bucket{le="+Inf"} 3' in body
     finally:
         server.close()
 

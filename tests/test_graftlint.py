@@ -1060,6 +1060,135 @@ def test_reexport_chain_reachability(tmp_path):
     assert res.new_findings[0].path.endswith("helpers.py")
 
 
+INSTANCE_DISPATCH_BAD = {
+    "impl.py": """
+        class Runner:
+            def work(self, x):
+                return x.item()        # host sync, reached via r.work(x)
+        """,
+    "ops.py": """
+        import jax
+        from .impl import Runner
+
+        @jax.jit
+        def step(x):
+            r = Runner()
+            return r.work(x)
+        """,
+}
+
+INSTANCE_DISPATCH_GOOD = {
+    "impl.py": """
+        class Runner:
+            def work(self, x):
+                return x.item()
+        """,
+    "ops.py": """
+        import jax
+        from .impl import Runner
+
+        def other():
+            return object()
+
+        @jax.jit
+        def step(x):
+            r = Runner()
+            r = other()            # reassigned: type no longer inferable
+            return r.work(x)
+        """,
+}
+
+
+def test_instance_method_dispatch_resolves_across_modules(tmp_path):
+    """ANALYSIS_VERSION 7 fixture: `obj = SomeClass(); obj.method(x)` with
+    the class imported from another module — cheap type inference over the
+    single-assignment local links the traced caller to the method."""
+    res = lint_pkg(tmp_path, INSTANCE_DISPATCH_BAD, rule="host-sync-in-trace")
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    f = res.new_findings[0]
+    assert f.path.endswith("impl.py") and f.symbol == "Runner.work"
+    assert "ops.py" in f.message  # the reason names the traced caller
+
+
+def test_instance_method_dispatch_reassigned_receiver_silent(tmp_path):
+    """The good twin: a receiver bound more than once has no inferable type
+    — the edge must NOT be created (a wrong guess would cross-wire
+    reachability into unrelated classes)."""
+    res = lint_pkg(tmp_path, INSTANCE_DISPATCH_GOOD, rule="host-sync-in-trace")
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_instance_method_dispatch_factory_function_not_a_class(tmp_path):
+    """Review-pinned: a factory FUNCTION with a nested def owns
+    `factory.inner` qualnames too — it must NOT be treated as a class, or
+    `obj = make_helper(); obj.compute(x)` would wire a phantom edge into
+    the unrelated nested function (same- and cross-module)."""
+    files = {
+        "impl.py": """
+            def make_helper():
+                def compute(x):
+                    return x.item()     # nested def, NOT a method
+                return object()
+            """,
+        "ops.py": """
+            import jax
+            from .impl import make_helper
+
+            @jax.jit
+            def step(x):
+                obj = make_helper()
+                return obj.compute(x)
+            """,
+    }
+    res = lint_pkg(tmp_path, files, rule="host-sync-in-trace")
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+    # same-module twin
+    res2 = lint(
+        tmp_path,
+        """
+        import jax
+
+        def make_helper():
+            def compute(x):
+                return x.item()
+            return object()
+
+        @jax.jit
+        def step(x):
+            obj = make_helper()
+            return obj.compute(x)
+        """,
+        rule="host-sync-in-trace",
+    )
+    assert res2.new_findings == [], [f.render() for f in res2.new_findings]
+
+
+def test_instance_method_dispatch_same_module(tmp_path):
+    """Same-module form: the `Cls.method` edge resolves by exact qualname
+    (no leaf-name collision with free functions named like the method)."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        class Runner:
+            def work(self, x):
+                return x.item()
+
+        def work(y):               # same-named free function: must NOT fire
+            return y + 1
+
+        @jax.jit
+        def step(x):
+            r = Runner()
+            return r.work(x)
+        """,
+        rule="host-sync-in-trace",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert res.new_findings[0].symbol == "Runner.work"
+
+
 def test_partial_callback_crosses_module_boundary(tmp_path):
     """A partial(...)-wrapped callback handed to lax.scan in another module
     is a trace root there."""

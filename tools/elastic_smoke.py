@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""elastic_smoke — `make elastic-smoke`: prove the survive-and-resize path
+end-to-end on 4 virtual CPU devices in seconds (docs/elastic.md).
+
+Tiny GPT at dp=4 with the fleet armed and a ``host_lost`` fault injected
+right before step 2's dispatch.  The loop finishes that step, reads the
+sticky ``should_resize`` flag, and ``fleet.resize()`` drains a COMPLETE
+checkpoint → re-meshes at dp=2 over the survivors → re-lays ZeRO-1
+masters/moments onto the new topology → restores the spec-carrying
+checkpoint (reshard, not reinit) → prewarms the AOT executable store for
+the new mesh — then training resumes at dp=2 within loss parity of an
+uninterrupted dp=4 run.  The scenario runs TWICE against one cache dir:
+the first pass compiles-and-stores the dp=2 programs, the second pass's
+post-resize first step must deserialize them (zero trace/compile phase
+time, >= 1 cache hit).  Exit 0 = complete drain checkpoint, resized mesh,
+loss parity both passes, and zero recompiles for the prewarmed programs.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEPS = 5
+HOST_LOST_AT = 2
+TARGET_DP = 2
+LOSS_RTOL = 1e-3  # documented resize tolerance: the dp reduce order moves
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import (
+        Accelerator,
+        CompilationCacheKwargs,
+        FleetKwargs,
+        TelemetryKwargs,
+    )
+    from accelerate_tpu.checkpointing import is_complete_checkpoint
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    errors: list[str] = []
+    tmp = tempfile.mkdtemp(prefix="atpu_elastic_")
+    cache_dir = os.path.join(tmp, "aot")
+
+    def build(fleet=False, plan=None):
+        Accelerator._reset_state()
+        jax.clear_caches()
+        nn.manual_seed(0)
+        handlers = [TelemetryKwargs(enabled=True)]
+        if fleet:
+            handlers += [
+                FleetKwargs(enabled=True, fault_plan=plan),
+                CompilationCacheKwargs(cache_dir=cache_dir),
+            ]
+        acc = Accelerator(kwargs_handlers=handlers)
+        model = GPTLMHeadModel(
+            GPTConfig(vocab_size=256, n_positions=64, n_embd=32, n_layer=1, n_head=2)
+        )
+        opt = optim.AdamW(model.parameters(), lr=1e-3)
+        model, opt = acc.prepare(model, opt)
+
+        def step_fn(ids):
+            opt.zero_grad()
+            out = model(ids, labels=ids)
+            acc.backward(out["loss"])
+            opt.step()
+            return out["loss"]
+
+        rng = np.random.default_rng(0)
+        raw = [
+            rng.integers(0, 256, (8, 32), dtype=np.int32) for _ in range(STEPS)
+        ]
+        return acc, acc.compile_step(step_fn), raw
+
+    def run_elastic(tag, drain_dir):
+        acc, step, raw = build(fleet=True, plan=f"host_lost:step={HOST_LOST_AT}")
+        if dict(acc.mesh.shape)["dp"] != 4:
+            errors.append(f"{tag}: expected dp=4 start, got {dict(acc.mesh.shape)}")
+        losses, info, i = [], None, 0
+        while i < len(raw):
+            batch = batch_to_global_array(raw[i], mesh=acc.mesh)
+            losses.append(float(step(batch)))
+            i += 1
+            if info is None and acc.fleet.should_resize:
+                info = acc.fleet.resize(acc, target_dp=TARGET_DP, output_dir=drain_dir)
+        if info is None:
+            errors.append(f"{tag}: host_lost never tripped should_resize")
+            return losses, acc, {}
+        if len(losses) != STEPS:
+            errors.append(f"{tag}: ran {len(losses)} steps, expected {STEPS}")
+        if not is_complete_checkpoint(info["checkpoint"]):
+            errors.append(f"{tag}: drain checkpoint incomplete")
+        if dict(acc.mesh.shape)["dp"] != TARGET_DP:
+            errors.append(f"{tag}: mesh not resized: {dict(acc.mesh.shape)}")
+        events = [e["event"] for e in acc.fleet.events]
+        for expected in ("host_lost", "drain", "resize"):
+            if expected not in events:
+                errors.append(f"{tag}: missing fleet event {expected}: {events}")
+        return losses, acc, info
+
+    # uninterrupted dp=4 reference
+    acc_ref, step, raw = build()
+    reference = [
+        float(step(batch_to_global_array(batch, mesh=acc_ref.mesh)))
+        for batch in raw
+    ]
+
+    # pass 1 (cold store): resize compiles the dp=2 program and stores it
+    losses1, acc1, _ = run_elastic("cold", os.path.join(tmp, "drain1"))
+    if acc1.aot_cache.stores < 1:
+        errors.append(f"cold: no AOT stores recorded ({acc1.aot_cache.stores})")
+
+    # pass 2 (warm store): the post-resize first step must be a prewarm hit
+    losses2, acc2, info2 = run_elastic("warm", os.path.join(tmp, "drain2"))
+    if info2.get("aot_prewarmed", 0) < 1:
+        errors.append(f"warm: prewarm staged no entries ({info2})")
+    built = [r for r in acc2.telemetry.timeline.records() if r.built]
+    if built:
+        post = built[-1]  # the post-resize rebuild
+        if post.trace_ms != 0.0 or post.compile_ms != 0.0:
+            errors.append(
+                "warm: post-resize step recompiled "
+                f"(trace={post.trace_ms}ms compile={post.compile_ms}ms) — "
+                "the prewarmed program was not served"
+            )
+    hits = sum(
+        1 for e in acc2.telemetry.aot_cache_events if e["event"] == "hit"
+    )
+    if hits < 1:
+        errors.append("warm: no aot_cache hits recorded")
+
+    for tag, losses in (("cold", losses1), ("warm", losses2)):
+        if len(losses) == len(reference) and not np.allclose(
+            losses, reference, rtol=LOSS_RTOL
+        ):
+            errors.append(
+                f"{tag}: losses diverged beyond rtol={LOSS_RTOL}: "
+                f"{losses} vs {reference}"
+            )
+
+    for error in errors:
+        print(f"elastic-smoke: FAIL: {error}", file=sys.stderr)
+    if errors:
+        return 1
+    print(
+        f"elastic-smoke: ok — host_lost at step {HOST_LOST_AT}, drain → "
+        f"re-mesh dp=4→{TARGET_DP} → reshard → resume at loss parity "
+        f"(rtol={LOSS_RTOL}); warm pass prewarmed {info2['aot_prewarmed']} "
+        f"entries, post-resize step zero trace/compile, {hits} cache hits"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,7 +15,6 @@ then assert:
 """
 
 import os
-import re
 import sys
 import urllib.request
 
@@ -23,7 +22,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-_SAMPLE_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]* [-+0-9eE.naif]+$")
+# the renderer's own sample-line grammar (incl. histogram `le` labels):
+# one source of truth, so the smoke can never validate a different format
+# than the endpoint emits
+from accelerate_tpu.telemetry.metrics import SAMPLE_LINE_RE as _SAMPLE_RE  # noqa: E402
 
 
 def main() -> int:
@@ -102,6 +104,9 @@ def main() -> int:
             "atpu_telemetry_steps_total 3",
             "atpu_telemetry_recompiles_total 0",
             "atpu_telemetry_device_busy_ms",
+            # native step-latency histogram (docs/telemetry.md §endpoint)
+            "# TYPE atpu_telemetry_step_latency_ms histogram",
+            'atpu_telemetry_step_latency_ms_bucket{le="+Inf"} 2',
         ):
             if needle not in body:
                 errors.append(f"scrape missing {needle!r}")

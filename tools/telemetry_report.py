@@ -311,7 +311,12 @@ def render(records: list[dict]) -> str:
         if r.get("kind") != "fleet":
             continue
         lines.append("")
-        lines.append(f"fleet skew ({r.get('ranks', '?')} rank(s))")
+        header = f"fleet skew ({r.get('ranks', '?')} rank(s))"
+        if r.get("periodic"):
+            # mid-run signal record (docs/elastic.md): one per aggregation
+            # cadence tick, so the report shows the skew trajectory
+            header += f" — mid-run at step {r.get('at_step', '?')}"
+        lines.append(header)
         for stat in r.get("per_rank", []):
             mean_ms = stat.get("replay_total_ms_mean")
             lines.append(
