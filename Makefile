@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke bench
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke kernel-smoke bench bench-gate
 
 # graftlint: whole-program trace-safety & collective-correctness static
 # analysis (docs/graftlint.md). Runs before the suite. The on-disk cache
@@ -28,10 +28,16 @@ lint-cold:
 # rehearsal and the dp=4→dp=2 resize (bitwise state after reshard, zero
 # recompiles after prewarm) exercise the exact multichip extent the
 # acceptance row names (docs/elastic.md)
+# the Pallas-kernel suite rides along at dp=4: interpreter-mode bitwise
+# parity (ZeRO-1 ring gather, fused quantize+RS wire incl. residual
+# evolution, paged decode), IR-inspection assertions, and the
+# kernel-policy AOT fingerprint miss all exercise a real dp ring
+# (docs/kernels.md)
 multichip:
 	XLA_FLAGS=--xla_force_host_platform_device_count=4 python -m pytest \
 	  tests/test_zero1.py tests/test_zero_sharding.py \
-	  tests/test_compression.py tests/test_serving.py tests/test_fleet.py -q
+	  tests/test_compression.py tests/test_serving.py tests/test_fleet.py \
+	  tests/test_kernels.py -q
 
 # telemetry pipeline proof (docs/telemetry.md): tiny model, 3 steps + a
 # forced shape change with telemetry on, JSONL export validated through
@@ -77,7 +83,22 @@ cache-smoke:
 elastic-smoke:
 	JAX_PLATFORMS=cpu python tools/elastic_smoke.py
 
-test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke
+# pallas-kernel proof (docs/kernels.md): tiny GPT on 4 virtual CPU
+# devices, every kernel armed under the interpreter — IR-inspection
+# assertions (no unfused all-gather-then-dot, no full page-span
+# materialization), loss-bitwise parity vs the reference paths, zero
+# recompiles, paged decode token parity
+kernel-smoke:
+	JAX_PLATFORMS=cpu python tools/kernel_smoke.py
+
+# bench regression gate (docs/performance.md): diff the newest
+# BENCH_r*.json primary step_ms against the previous round; exits nonzero
+# past $$BENCH_REGRESSION_PCT (default 10, same-platform rows only) — a
+# hot-path regression finally fails CI instead of riding the trajectory
+bench-gate:
+	python tools/bench_compare.py
+
+test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke kernel-smoke bench-gate
 	python -m pytest tests/ -q
 
 test_core:

@@ -59,6 +59,7 @@ from .utils.dataclasses import (
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     InitProcessGroupKwargs,
+    KernelKwargs,
     ParallelismConfig,
     ProfileKwargs,
     ProjectConfiguration,

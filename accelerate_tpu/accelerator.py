@@ -103,6 +103,7 @@ class Accelerator:
         self.compression_handler = None
         self.aot_cache_handler = None
         self.fleet_handler = None
+        self.kernels_handler = None
         from .utils.dataclasses import FP8RecipeKwargs
 
         from .utils.dataclasses import (
@@ -111,6 +112,7 @@ class Accelerator:
             CompressionKwargs,
             DistributedDataParallelKwargs,
             FleetKwargs,
+            KernelKwargs,
             ResilienceKwargs,
             TelemetryKwargs,
         )
@@ -124,6 +126,8 @@ class Accelerator:
                 self.aot_cache_handler = handler
             elif isinstance(handler, FleetKwargs):
                 self.fleet_handler = handler
+            elif isinstance(handler, KernelKwargs):
+                self.kernels_handler = handler
             elif isinstance(handler, ResilienceKwargs):
                 self.resilience_handler = handler
             elif isinstance(handler, AutocastKwargs):
@@ -178,6 +182,15 @@ class Accelerator:
         self._compression = resolve_policy(
             self.compression_handler, ddp_handler=self.ddp_handler
         )
+        # Pallas hot-path kernels (docs/kernels.md): one default-off policy
+        # for the collective-matmul ZeRO-1 gather, the fused quantize+RS
+        # wire, and serving's paged-attention decode — resolved here so the
+        # optimizer relayout, the serving engine, and the AOT-cache
+        # fingerprint all read ONE armed set
+        from .native.kernels import _set_active_kernels, resolve_kernel_policy
+
+        self.kernels = resolve_kernel_policy(self.kernels_handler)
+        _set_active_kernels(self.kernels if self.kernels.enabled else None)
         # the sync-boundary hook policy: the compression policy itself when
         # it IS a hook (powersgd), else the legacy ddp spelling (which also
         # lets powersgd compose with an int8/fp8 collective policy)
@@ -260,9 +273,11 @@ class Accelerator:
         self.flag_tensor = None
         self._capture_cache: dict = {}
         self._capture_ctx: Optional[dict] = None
-        # (param, sharding) pairs for the ZeRO-2 accumulated-grad layout;
-        # empty (one falsy check in backward) unless prepare() armed it
+        # (param, sharding, dp-axis) triples for the ZeRO-2 accumulated-grad
+        # layout; empty (one falsy check in backward) unless prepare() armed
+        # it.  _zero2_stochastic arms the kernel policy's narrow wire on top
         self._zero2_grads: list = []
+        self._zero2_stochastic = False
 
         # trackers
         from .tracking import filter_trackers
@@ -302,7 +317,11 @@ class Accelerator:
         # and both must hash the same mesh/compression or the prefetch pins
         # a fingerprint no stored entry was keyed under
         self.aot_cache.set_context(
-            mesh=self.state.mesh, compression=self._compression.name
+            mesh=self.state.mesh,
+            compression=self._compression.name,
+            # armed set + lowering mode: a forced interpret flip must be a
+            # loud miss too, not a replay of the other mode's executable
+            kernels=self.kernels.cache_tag(),
         )
         self.aot_cache.attach_telemetry(self.telemetry)
         _set_active(self.aot_cache if self.aot_cache.enabled else None)
@@ -540,6 +559,10 @@ class Accelerator:
                 # (docs/compression.md); both no-ops unless armed
                 compression=self._compression,
                 zero2=self.state.zero2_enabled,
+                # Pallas hot-path kernels (docs/kernels.md): routes the
+                # ZeRO-1 writeback gather through the chunked ring and the
+                # quantized RS through the fused kernel; None-check off-path
+                kernels=self.kernels,
             )
         if offload_params:
             from .hooks import ParamOffloadHook, add_hook_to_module
@@ -551,17 +574,39 @@ class Accelerator:
         self._ensure_powersgd_state()
         self._refresh_zero2_grads()
         self._record_collectives()
+        self._record_kernels()
         return result[0] if len(result) == 1 else tuple(result)
 
     def _refresh_zero2_grads(self) -> None:
         """Collect the (param, accumulation-sharding) pairs ZeRO-2 armed at
         relayout time, so ``backward`` pays one cheap loop (empty when off)."""
+        # (param, sharding, dp-axis, stochastic-wire-eligible): axis and
+        # eligibility come from the optimizer's own relayout bookkeeping —
+        # _dp_state_axis is the dp entry the state spec actually gained,
+        # and _comp_axis is non-None exactly for the tensors the
+        # compression policy's min_size/min_block/dtype gates admit, so the
+        # narrow wire below can never quantize a tensor the reference
+        # reduce-scatter path would deliberately pass through uncompressed
         self._zero2_grads = [
-            (p, p._grad_sharding)
+            (p, p._grad_sharding, opt.optimizer._dp_state_axis[i],
+             opt.optimizer._comp_axis[i] is not None)
             for opt in self._optimizers
-            for p in opt.optimizer.param_list
+            for i, p in enumerate(opt.optimizer.param_list)
             if getattr(p, "_grad_sharding", None) is not None
         ]
+        # stochastic-rounding ZeRO-2 wire (docs/kernels.md §stochastic
+        # wire): the mid-accumulation scatter crosses dp narrow only when
+        # the kernel policy AND an int8 collective policy AND ZeRO-2 are
+        # all armed — the unbiased floor(y+u) round is what PR 6's
+        # deterministic rounding could not offer
+        import jax.numpy as jnp
+
+        self._zero2_stochastic = bool(
+            self._zero2_grads
+            and self.kernels.quantized_rs
+            and getattr(self._compression, "wire_dtype", None) is not None
+            and jnp.dtype(self._compression.wire_dtype) == jnp.int8
+        )
 
     def _record_collectives(self) -> None:
         """dp-axis collective-bytes attribution (telemetry
@@ -574,6 +619,28 @@ class Accelerator:
             summary = opt.optimizer.compression_summary()
             if summary is not None:
                 self.telemetry.record_collectives(summary)
+
+    def _record_kernels(self) -> None:
+        """One ``kind="kernel"`` record per armed Pallas kernel
+        (docs/kernels.md): which hot path it replaces and how it lowers —
+        the attribution bench.py's kernel A/B and the per-phase device
+        split join against."""
+        if not self.telemetry.enabled or not self.kernels.enabled:
+            return
+        targets = {
+            "collective_matmul": "zero1 all-gather → chunked ring + partial matmuls",
+            "quantized_rs": "compress reduce-scatter → fused scale+round region",
+            "paged_attention": "serving decode gather → VMEM block-table walk",
+        }
+        for name in self.kernels.armed():
+            self.telemetry.record_kernel(
+                {
+                    "kernel": name,
+                    "target": targets[name],
+                    "interpret": self.kernels.interpret,
+                    "policy": self.kernels.describe(),
+                }
+            )
 
     def _prepare_one(self, obj):
         from .utils.torch_bridge import (
@@ -724,19 +791,48 @@ class Accelerator:
             loss = loss / self.gradient_state.num_steps
         if self.scaler is not None:
             loss = loss * self.scaler.scale
-        loss.backward(**kwargs)
+        import jax
+
+        with jax.named_scope("atpu_backward"):
+            # the scope is HLO metadata only (numerics untouched): the
+            # sampled device timeline splits per phase on it
+            # (docs/telemetry.md §per-phase attribution)
+            loss.backward(**kwargs)
         if self._zero2_grads:
             # ZeRO-2 (docs/compression.md): keep the accumulated grads
             # reduce-scattered between micro-steps so the accumulation
             # buffer is ~1/dp per replica.  Layout-only — the value is the
-            # same global array, and compressing a running fp32 sum every
-            # micro-step would round it num_steps times (same reason the
-            # comm hook below runs only at the sync boundary).
+            # same global array, and deterministically compressing a running
+            # fp32 sum every micro-step would round it num_steps times (same
+            # reason the comm hook below runs only at the sync boundary).
+            # With the kernel policy's stochastic wire armed the scatter
+            # crosses dp narrow anyway: floor(y+u) is unbiased per re-round
+            # (docs/kernels.md §stochastic wire).
             from .parallel.compress import shard_accumulation
 
-            for p, s in self._zero2_grads:
-                if p.grad is not None:
-                    p.grad = shard_accumulation(p.grad, s)
+            if self._zero2_stochastic and not self.gradient_state.sync_gradients:
+                from .native.kernels.quantize_rs import zero2_stochastic_wire
+
+                for p, s, axis, sr_ok in self._zero2_grads:
+                    if p.grad is None:
+                        continue
+                    if sr_ok and axis is not None:
+                        p.grad = zero2_stochastic_wire(
+                            p.grad, s, axis, nn_random.next_key(),
+                            interpret=self.kernels.interpret,
+                        )
+                    else:
+                        # the policy's eligibility gates exempt this tensor
+                        # (too small to amortize the scale granularity):
+                        # layout-only, exactly like the reference RS path
+                        p.grad = shard_accumulation(p.grad, s)
+            else:
+                # the sync-boundary micro-step feeds the update directly —
+                # its trip is the (exactly-quantized, error-fed) ZeRO-1
+                # reduce-scatter, so it stays layout-only here
+                for p, s, _axis, _sr_ok in self._zero2_grads:
+                    if p.grad is not None:
+                        p.grad = shard_accumulation(p.grad, s)
         if self.gradient_state.sync_gradients:
             # only at the sync boundary: re-quantizing the running fp32
             # accumulation every micro-step would pass the sum through

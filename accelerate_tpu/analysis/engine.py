@@ -44,7 +44,10 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 # inference over single-assignment locals (`obj = SomeClass(); obj.method(x)`
 # links to SomeClass.method, same-module and through imports), so every
 # reachability rule sees traced code calling into helper-object methods.
-ANALYSIS_VERSION = "7"
+# v8: new pallas-hazard rule — host callbacks / python-side branches on ref
+# parameters inside pl.pallas_call kernel bodies, and pallas_call sites
+# without an interpret=/policy-gated fallback in scope (docs/kernels.md).
+ANALYSIS_VERSION = "8"
 
 # Names that mark a branch/function as profiling/benchmark plumbing, where a
 # deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the

@@ -5,6 +5,7 @@ from .blocking import BlockingInHotLoop
 from .donation import DonationReuse
 from .dtype_widen import DtypeWiden
 from .host_sync import HostSyncInTrace
+from .pallas_hazard import PallasHazard
 from .recompile import RecompileHazard
 from .spec_drift import ShardingSpecDrift
 from .transitive_donation import TransitiveDonation
@@ -18,6 +19,7 @@ ALL_RULES = [
     DtypeWiden,
     BlockingInHotLoop,
     ShardingSpecDrift,
+    PallasHazard,
 ]
 
 

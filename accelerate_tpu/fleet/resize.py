@@ -127,6 +127,10 @@ def remesh_accelerator(accelerator, new_mesh: Mesh) -> None:
             zero1_mesh=zero1_mesh,
             compression=accelerator._compression,
             zero2=state.zero2_enabled,
+            # a resize must not silently disarm the Pallas kernel policy
+            # (docs/kernels.md): the re-laid-out update keeps the same
+            # ring/fused-RS routing the pre-loss steps compiled with
+            kernels=accelerator.kernels,
         )
     accelerator._refresh_zero2_grads()
     # gradients from the pre-loss steps are still committed to the lost
@@ -169,5 +173,9 @@ def prewarm_aot_cache(accelerator, compression_name: Optional[str] = None) -> in
     cache.set_context(
         mesh=accelerator.state.mesh,
         compression=compression_name or accelerator._compression.name,
+        # the fingerprint keys on the kernel policy too (docs/kernels.md):
+        # the re-pin must hash the same armed set the new-topology
+        # programs will compile with, or every prewarm lookup misses
+        kernels=accelerator.kernels.cache_tag(),
     )
     return cache.prefetch()

@@ -124,9 +124,14 @@ def _leaf_aval(x) -> list:
             getattr(s, "memory_kind", None)]
 
 
-def topology_fingerprint(mesh=None, compression: Optional[str] = None) -> dict:
+def topology_fingerprint(mesh=None, compression: Optional[str] = None,
+                         kernels: Optional[str] = None) -> dict:
     """The invalidation matrix (docs/aot_cache.md): any field moving between
-    the storing and the loading process makes the entry stale."""
+    the storing and the loading process makes the entry stale.  ``kernels``
+    is the armed Pallas-kernel set (``KernelPolicy.describe()``,
+    docs/kernels.md): a kernel-armed program computes through different IR
+    than the reference path, so flipping a kernel must be a loud miss
+    NAMING the ``kernels`` field — never a silently-stale executable."""
     import jax
     import jaxlib
 
@@ -141,6 +146,7 @@ def topology_fingerprint(mesh=None, compression: Optional[str] = None) -> dict:
         "process_count": jax.process_count(),
         "mesh": dict(mesh.shape) if mesh is not None else None,
         "compression": compression,
+        "kernels": kernels or "none",
     }
     for flag in FINGERPRINT_FLAGS:
         # repr, not str: distinguishes unset (None) from the string "None",
@@ -267,16 +273,18 @@ class AOTCompilationCache:
             self._telemetry.record_aot_cache({"event": event, **fields})
 
     # -- fingerprint ---------------------------------------------------------
-    def set_context(self, mesh=None, compression: Optional[str] = None) -> None:
-        """Pin the owning run's mesh/compression into the cache's ONE
-        canonical fingerprint (the Accelerator calls this at construction).
-        Every consumer — captured-step digests, serving warm, restore
-        prefetch — must hash the same fingerprint, or a prefetch that runs
-        before the first step (the preemption-resume flow) would pin a
-        mesh-less fingerprint and every later lookup would miss."""
+    def set_context(self, mesh=None, compression: Optional[str] = None,
+                    kernels: Optional[str] = None) -> None:
+        """Pin the owning run's mesh/compression/kernel-policy into the
+        cache's ONE canonical fingerprint (the Accelerator calls this at
+        construction).  Every consumer — captured-step digests, serving
+        warm, restore prefetch — must hash the same fingerprint, or a
+        prefetch that runs before the first step (the preemption-resume
+        flow) would pin a mesh-less fingerprint and every later lookup
+        would miss."""
         if self.enabled:
             self._fingerprint = topology_fingerprint(
-                mesh=mesh, compression=compression
+                mesh=mesh, compression=compression, kernels=kernels
             )
 
     def fingerprint(self) -> dict:

@@ -1,0 +1,130 @@
+"""Fused quantize+reduce-scatter (docs/kernels.md §quantize-rs).
+
+``parallel/compress.py``'s reference wire is four separate XLA ops with an
+HBM round-trip between each: per-block ``amax`` → scale divide →
+round/clip/narrow → widen-by-scales.  This module collapses scale compute,
+rounding and widening into ONE Pallas kernel region, so on TPU the whole
+quantize→dequantize ride happens in VMEM next to the shard boundary the
+payload crosses ("scale+round ride the RDMA hops" — the EQuARX move,
+PAPERS.md #3), and the StableHLO the captured program commits to keeps the
+narrow (int8 / f8E4M3FN) payload at the boundary instead of a widened fp32
+intermediate (asserted by ``inspect.check_quantize_rs``).
+
+Numerics contract: the kernel body runs the reference's EXACT op sequence
+(``compress.quantize`` then ``compress.dequantize``), so under jit the wire
+is **bitwise-identical** to the reference path — which makes the
+error-feedback residual evolution bitwise too (the residual math stays
+outside the kernel, shared with the reference).  Verified on CPU through
+interpreter mode in tests/test_kernels.py.
+
+The stochastic-rounding wire (``stochastic_quantize_dequantize`` /
+``zero2_stochastic_wire``) reopens the ZeRO-2 first scatter: PR 6 kept that
+scatter layout-only because deterministically re-rounding a running fp32
+accumulation every micro-step compounds bias ``num_steps`` times.
+Stochastic rounding (``floor(y + u)``, ``u ~ U[0,1)``) is unbiased —
+``E[wire] == sum`` at every micro-step — so the accumulated gradient can
+cross the dp boundary narrow during accumulation without systematic drift
+(int8 wire only; fp8 stays deterministic).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ...parallel.compress import _qmax, _to_layout, dequantize, quantize
+
+__all__ = [
+    "fused_quantize_dequantize",
+    "fused_reduce_scatter",
+    "stochastic_quantize_dequantize",
+    "zero2_stochastic_wire",
+]
+
+
+def _qdq_kernel(x_ref, o_ref, *, axis: int, wire_dtype):
+    """One region: per-block amax → scale → round/clip → narrow → widen —
+    by calling the reference's own ``compress.quantize``/``dequantize`` on
+    the loaded value (they are pure jnp, so they trace into the kernel
+    body unchanged), which is what makes the fused wire bitwise-identical
+    BY CONSTRUCTION: a future edit to the reference math cannot silently
+    diverge the kernel."""
+    payload, scales = quantize(x_ref[:], axis, wire_dtype)
+    o_ref[:] = dequantize(payload, scales)
+
+
+def fused_quantize_dequantize(x, axis: int, wire_dtype, *, interpret: bool = True):
+    """``x`` (fp32) → the wire value (fp32, same shape): what the far side
+    of the quantized reduce-scatter reconstructs, computed in one kernel."""
+    kernel = functools.partial(_qdq_kernel, axis=axis, wire_dtype=wire_dtype)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+        interpret=interpret,
+    )(x)
+
+
+def fused_reduce_scatter(x32, sharding, axis: int, err, policy, *,
+                         interpret: bool = True):
+    """Drop-in for :meth:`CompressionPolicy.reduce_scatter` with the wire
+    computed by the fused kernel.  Returns ``(g_used, err_new)`` with the
+    identical contract — and identical bits: the residual update
+    (``used = wire + err``, ``err_new = truth - wire``) is the reference's
+    own math on a bitwise-equal wire."""
+    wire = fused_quantize_dequantize(
+        x32, axis, policy.wire_dtype, interpret=interpret
+    )
+    wire = _to_layout(wire, sharding)
+    if err is None:
+        return wire, None
+    used = wire + err
+    truth = _to_layout(x32, sharding)
+    return used, truth - wire
+
+
+# ---------------------------------------------------------------------------
+# stochastic-rounding wire (the ZeRO-2 first scatter)
+# ---------------------------------------------------------------------------
+def _sr_kernel(x_ref, u_ref, o_ref, *, axis: int, qmax: float):
+    """Same fused region with ``floor(y + u)`` in place of ``round(y)`` —
+    unbiased over ``u ~ U[0,1)``: int8 wire for the mid-accumulation
+    scatter."""
+    x = x_ref[:]
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    amax = jnp.max(jnp.abs(x), axis=reduce_axes, keepdims=True)
+    scales = amax / qmax
+    safe = jnp.where(scales > 0, scales, 1.0)
+    y = x / safe
+    payload = jnp.clip(jnp.floor(y + u_ref[:]), -qmax, qmax).astype(jnp.int8)
+    o_ref[:] = payload.astype(jnp.float32) * scales
+
+
+def stochastic_quantize_dequantize(x, axis: int, key, *, interpret: bool = True):
+    """Stochastically-rounded int8 wire value of ``x``: deterministic for a
+    fixed ``key`` (replay-stable under capture — the key threads through
+    the captured RNG state), unbiased across keys."""
+    u = jax.random.uniform(key, x.shape, jnp.float32)
+    kernel = functools.partial(_sr_kernel, axis=axis, qmax=_qmax(jnp.int8))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+        interpret=interpret,
+    )(x, u)
+
+
+def zero2_stochastic_wire(grad, sharding, axis: int, key, *,
+                          interpret: bool = True):
+    """The ZeRO-2 mid-accumulation scatter, narrow: stochastic int8 wire +
+    the same layout constraint ``compress.shard_accumulation`` applies.
+
+    PR 6's layout-only scatter refused to quantize here because
+    deterministic rounding would bias the running sum ``num_steps`` times;
+    the stochastic wire's per-micro-step re-round is unbiased
+    (``E[wire] == sum``), which is what reopens the narrow first scatter
+    (docs/kernels.md §stochastic wire; armed only when the kernel policy
+    AND an int8 collective policy AND ZeRO-2 are all on)."""
+    wire = stochastic_quantize_dequantize(grad, axis, key, interpret=interpret)
+    return _to_layout(wire, sharding)

@@ -76,6 +76,13 @@ class Optimizer:
         """
         if closure is not None:
             closure()
+        # HLO-metadata-only scope (numerics untouched): the sampled device
+        # timeline attributes the update's compute/collective time to its
+        # own phase (docs/telemetry.md §per-phase attribution)
+        with jax.named_scope("atpu_update"):
+            self._apply_update(grad_scale)
+
+    def _apply_update(self, grad_scale) -> None:
         self._ensure_master()
         self.stage_state_on_device()
         # ZeRO-Infinity-style param offload: the no-master path reads p.data
@@ -114,8 +121,14 @@ class Optimizer:
                     # under ZeRO-1 `new` is the dp-sharded master; the param
                     # must come back on ITS layout (replicated under pure DP)
                     # — this constraint is the all-gather of the sharded
-                    # update
-                    p.data = self._on_param_layout(new.astype(p.dtype), i)
+                    # update.  With the collective-matmul kernel armed the
+                    # gather is an explicit chunked ring instead (bitwise:
+                    # movement only), whose per-hop schedule the compiler
+                    # can overlap with the step's first matmuls
+                    # (docs/kernels.md §collective-matmul).
+                    p.data = self._on_param_layout(
+                        self._kernel_gather(new.astype(p.dtype), i), i
+                    )
             else:
                 # no fp32 master (fp32 params): the replica's param is the
                 # ONLY copy, so the quantized-delta transport's implicit
@@ -123,7 +136,9 @@ class Optimizer:
                 # rounding would accumulate as an uncorrected random walk.
                 # Gather exactly instead (the grad side stays quantized);
                 # _comp_ag_ok keeps the bytes accounting honest about it.
-                p.data = self._on_param_layout(new, i)
+                # The ring gather is exact movement too, so the kernel
+                # routing composes with the fp32 path unchanged.
+                p.data = self._on_param_layout(self._kernel_gather(new, i), i)
         self._step_count += 1
 
     # -- quantized dp collectives (docs/compression.md) ----------------------
@@ -142,10 +157,41 @@ class Optimizer:
             s = self._state_shardings[i]
             if axis is None or not isinstance(s, jax.sharding.NamedSharding):
                 continue
-            out[i], self._comp_rs_err[i] = comp.reduce_scatter(
-                g, s, axis, self._comp_rs_err[i]
-            )
+            kernels = getattr(self, "_kernels", None)
+            if kernels is not None and kernels.quantized_rs:
+                # fused quantize+RS (docs/kernels.md): one kernel region
+                # computes scale+round+widen at the shard boundary; wire
+                # (and therefore residual evolution) bitwise vs the policy
+                from .native.kernels.quantize_rs import fused_reduce_scatter
+
+                out[i], self._comp_rs_err[i] = fused_reduce_scatter(
+                    g, s, axis, self._comp_rs_err[i], comp,
+                    interpret=kernels.interpret,
+                )
+            else:
+                out[i], self._comp_rs_err[i] = comp.reduce_scatter(
+                    g, s, axis, self._comp_rs_err[i]
+                )
         return out
+
+    def _kernel_gather(self, arr, i: int):
+        """Route one param's ZeRO-1 writeback through the chunked ring
+        gather when the kernel policy arms ``collective_matmul`` and the
+        state layout is ring-eligible; the identity otherwise (the layout
+        constraint in ``_on_param_layout`` then IS the gather)."""
+        kernels = getattr(self, "_kernels", None)
+        if kernels is None or not kernels.collective_matmul:
+            return arr
+        from .native.kernels.collective_matmul import (
+            zero1_all_gather,
+            zero1_gather_eligible,
+        )
+
+        axis = self._dp_state_axis[i]
+        sharding = self._state_shardings[i]
+        if not zero1_gather_eligible(sharding, axis):
+            return arr
+        return zero1_all_gather(arr, sharding, axis, interpret=kernels.interpret)
 
     def _compress_all_gather(self, new32, i: int):
         """Updated dp-sharded fp32 value → replica-layout param through the
@@ -288,6 +334,7 @@ class Optimizer:
         zero1_mesh=None,
         compression=None,
         zero2: bool = False,
+        kernels=None,
     ) -> None:
         """Move optimizer state + fp32 masters onto the params' shardings.
 
@@ -341,6 +388,16 @@ class Optimizer:
                             break
         self._state_shardings = state_shardings
         self._init_compression(compression, zero2)
+        # Pallas hot-path kernels (docs/kernels.md): pinned here like the
+        # compression policy so the update's collective pair can route
+        # through the ring gather / fused quantize kernel; None when off or
+        # without a ZeRO-1 dp pair to fuse (one None-check per step)
+        self._kernels = (
+            kernels
+            if (kernels is not None and getattr(kernels, "enabled", False)
+                and self._zero1)
+            else None
+        )
 
         def to_param_layout(leaf, i):
             s = state_shardings[i]

@@ -110,7 +110,8 @@ def _prefill_jit(
 
 @partial(
     jax.jit,
-    static_argnames=("family", "cfg", "qbits", "temperature"),
+    static_argnames=("family", "cfg", "qbits", "temperature", "paged",
+                     "kernel_interpret"),
     donate_argnums=(0, 1),
 )
 def _decode_jit(
@@ -127,6 +128,8 @@ def _decode_jit(
     cfg,
     qbits: int,
     temperature: float,
+    paged: bool = False,  # paged-attention kernel (docs/kernels.md)
+    kernel_interpret: bool = True,
 ):
     block_size = k_pool.shape[3]
     plain_layers, q_layers, s_layers = layers
@@ -154,15 +157,26 @@ def _decode_jit(
         kp_l = kp_l.at[blk, :, off].set(k[:, :, 0, :].astype(kp_l.dtype))
         vp_l = vp_l.at[blk, :, off].set(v[:, :, 0, :].astype(vp_l.dtype))
 
-        def attend_one(q_s, row, p_s):
-            # gather this slot's pages: table order IS logical order, so the
-            # flattened view is a virtually contiguous cache and the plain
-            # causal mask applies unchanged
-            kc = kp_l[row].transpose(1, 0, 2, 3).reshape(kp_l.shape[1], -1, kp_l.shape[3])
-            vc = vp_l[row].transpose(1, 0, 2, 3).reshape(vp_l.shape[1], -1, vp_l.shape[3])
-            return cached_attention(q_s[None], kc[None], vc[None], p_s[None], cfg)[0]
+        if paged:
+            # paged-attention kernel (docs/kernels.md): walk the block table
+            # in VMEM instead of materializing each slot's full page span —
+            # per-slot logits bitwise-identical to the gather path below
+            from ..native.kernels.paged_attention import paged_attention
 
-        att = jax.vmap(attend_one)(q, block_tables, positions)  # (slots, H, 1, d)
+            att = paged_attention(
+                q, kp_l, vp_l, block_tables, positions, cfg=cfg,
+                interpret=kernel_interpret,
+            )
+        else:
+            def attend_one(q_s, row, p_s):
+                # gather this slot's pages: table order IS logical order, so
+                # the flattened view is a virtually contiguous cache and the
+                # plain causal mask applies unchanged
+                kc = kp_l[row].transpose(1, 0, 2, 3).reshape(kp_l.shape[1], -1, kp_l.shape[3])
+                vc = vp_l[row].transpose(1, 0, 2, 3).reshape(vp_l.shape[1], -1, vp_l.shape[3])
+                return cached_attention(q_s[None], kc[None], vc[None], p_s[None], cfg)[0]
+
+            att = jax.vmap(attend_one)(q, block_tables, positions)  # (slots, H, 1, d)
         x = jax.vmap(lambda x_s, a_s: family.attn_out(l, x_s[None], a_s[None], cfg)[0])(
             x, att
         )
@@ -269,11 +283,24 @@ def run_prefill(k_pool, v_pool, g, layers, padded_ids, block_row, prompt_len,
 
 def run_decode(k_pool, v_pool, g, layers, block_tables, positions, tokens,
                rngs, *, family, cfg, qbits, temperature,
-               watcher: Optional[CompileWatcher] = None, aot=None):
-    """One token for the whole slot batch; see ``_decode_jit``."""
+               watcher: Optional[CompileWatcher] = None, aot=None,
+               kernels=None):
+    """One token for the whole slot batch; see ``_decode_jit``.
+
+    ``kernels`` (a :class:`~..native.kernels.KernelPolicy`) arms the
+    paged-attention decode kernel — a STATIC compile-mode choice, so it
+    rides the watcher/AOT signature: flipping it is a new program, never a
+    silent steady-state recompile."""
     args = (k_pool, v_pool, g, layers, block_tables, positions, tokens, rngs)
     statics = dict(family=family, cfg=cfg, qbits=qbits, temperature=temperature)
-    sig = ("decode", block_tables.shape, qbits, float(temperature))
+    paged = bool(kernels is not None and kernels.paged_attention)
+    if paged:
+        statics.update(paged=True, kernel_interpret=kernels.interpret)
+    # the lowering mode rides the signature too: interpret is normally
+    # backend-derived, but KernelKwargs(interpret=...) can force it, and
+    # two services with opposite modes must not share one program
+    sig = ("decode", block_tables.shape, qbits, float(temperature),
+           paged and ("interpret" if kernels.interpret else "mosaic"))
     if aot is not None:
         return aot.call("decode", sig, _decode_jit, args, statics, watcher=watcher)
     if watcher is None:

@@ -123,8 +123,21 @@ class DecodeService:
     """
 
     def __init__(self, model, config: Optional[ServingConfig] = None, telemetry=None,
-                 aot_cache=None):
+                 aot_cache=None, kernels=None):
         from ..models.generation import stacked_params_for_mode
+
+        # Pallas paged-attention decode (docs/kernels.md): explicit handle
+        # or the process-active policy; None (the default) keeps run_decode
+        # on the gather-then-attend path byte-identically
+        if kernels is None:
+            from ..native.kernels import current_kernel_policy
+
+            kernels = current_kernel_policy()
+        self._kernels = (
+            kernels
+            if (kernels is not None and getattr(kernels, "paged_attention", False))
+            else None
+        )
 
         self.config = cfg = config or ServingConfig()
         if cfg.block_size < 1 or cfg.max_slots < 1:
@@ -241,6 +254,19 @@ class DecodeService:
                 "family": type(self.spec.family).__name__,
                 "cfg": repr(dcfg),
                 "qbits": self._qbits,
+                # a kernel-armed decode is a different program: flipping the
+                # kernel — or forcing the lowering mode — must be a loud
+                # serving-cache miss (docs/kernels.md).  Only the kernel the
+                # decode path actually consumes rides the key: arming a
+                # TRAINING kernel (collective_matmul/quantized_rs) changes
+                # nothing about these programs and must not cold-compile a
+                # warm replica.
+                "kernels": (
+                    "paged_attention:"
+                    + ("interpret" if self._kernels.interpret else "mosaic")
+                    if self._kernels is not None
+                    else "none"
+                ),
                 "temperature": float(cfg.temperature),
                 "block_size": cfg.block_size,
                 "max_slots": cfg.max_slots,
@@ -462,6 +488,7 @@ class DecodeService:
                 qbits=self._qbits,
                 temperature=float(self.config.temperature),
                 watcher=self.watcher, aot=self._aot,
+                kernels=self._kernels,
             )
             nxt_host = np.asarray(nxt)
             for slot in active:

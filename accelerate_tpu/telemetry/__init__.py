@@ -53,6 +53,7 @@ from .profiler import DeviceStepRecord
 from .recompile import RecompileEvent, diff_keys, key_id
 from .resources import (
     CollectiveRecord,
+    KernelRecord,
     ProgramRecord,
     ResourceSample,
     program_stats,
@@ -113,6 +114,13 @@ class Telemetry:
         # kind="fleet_event") plus the periodic mid-run skew records
         # (kind="fleet") the aggregate cadence appends — see fleet/
         self.fleet_events: deque[dict] = deque(maxlen=handler.max_events)
+        # armed Pallas hot-path kernels (docs/kernels.md), recorded at
+        # prepare() like the collective-bytes attribution
+        self.kernel_records: deque[KernelRecord] = deque(maxlen=handler.max_events)
+        # compiled-variant key id -> {hlo op name -> atpu phase}: parsed
+        # from the program's HLO metadata at build when sampling is armed,
+        # joined by record_device_step into the per-phase device split
+        self._scope_maps: dict = {}
         # native Prometheus histogram of replay step latency (metrics.py):
         # cumulative _bucket series for the endpoint instead of
         # point-in-time percentiles; observation is two int bumps per step
@@ -249,9 +257,37 @@ class Telemetry:
     def record_program(self, key, label: str, compiled) -> ProgramRecord:
         record = ProgramRecord(key=key_id(key), label=label, stats=program_stats(compiled))
         self.program_records.append(record)
+        if self.profiler is not None:
+            # per-phase device attribution (docs/telemetry.md): the HLO
+            # text is the only place the atpu named scopes survive to —
+            # CPU/TPU trace events carry bare op names — so snapshot the
+            # op->scope map per variant while the compiled handle is here
+            from .profiler import scope_map_from_compiled
+
+            self._scope_maps[record.key] = scope_map_from_compiled(compiled)
+            if len(self._scope_maps) > len(self.program_records) + 8:
+                # the deque rolls old program records off at max_events;
+                # maps for rolled-off variants must roll too (each holds
+                # thousands of op names — a churning long-lived process
+                # would otherwise leak them for its lifetime)
+                live = {p.key for p in self.program_records}
+                for stale in [k for k in self._scope_maps if k not in live]:
+                    del self._scope_maps[stale]
         if self._export_sink:
             self._export_queue.append(record.to_dict())
         return record
+
+    def record_kernel(self, payload: dict) -> None:
+        """Armed Pallas-kernel attribution (docs/kernels.md), kind-tagged
+        ``"kernel"`` into the retained history and export stream — one
+        record per armed kernel, written at ``prepare()``."""
+        if not self.enabled:
+            return
+        stats = dict(payload)
+        record = KernelRecord(kernel=stats.pop("kernel", "?"), stats=stats)
+        self.kernel_records.append(record)
+        if self._export_sink:
+            self._export_queue.append(record.to_dict())
 
     def record_collectives(self, summary: dict) -> CollectiveRecord:
         """dp-axis collective-bytes attribution for one optimizer's update
@@ -332,6 +368,17 @@ class Telemetry:
             record.mfu = derive_mfu(
                 record.flops, record.window_ms, n_devices=len(record.devices)
             )
+        if not record.phases:
+            # per-phase split (docs/telemetry.md): join the sampled op
+            # durations to the variant's op->scope map so the
+            # compute/collective split reads per atpu phase, not one
+            # whole-step window.  Fail-soft: no map (pre-build sample,
+            # metadata-less backend) leaves phases empty.
+            scope_map = self._scope_maps.get(record.key)
+            if scope_map and record.op_detail:
+                from .profiler import split_phases
+
+                record.phases = split_phases(record.op_detail, scope_map)
         self.device_records.append(record)
         if self._export_sink:
             self._export_queue.append(record.to_dict())
@@ -362,6 +409,10 @@ class Telemetry:
         record = self.program_records[-1]
         old_key = record.key
         record.key = new_key
+        if old_key in self._scope_maps:
+            # the per-phase join keys on the same variant id — follow the
+            # re-file or the next sample of this variant loses its split
+            self._scope_maps[new_key] = self._scope_maps.pop(old_key)
         for pending in reversed(self._export_queue):
             if pending.get("kind") == "program" and pending.get("key") == old_key:
                 pending["key"] = new_key
@@ -388,7 +439,7 @@ class Telemetry:
                 if record.get("kind") in (
                     "step", "recompile", "program", "collectives",
                     "resources", "resilience", "serving", "device_step",
-                    "aot_cache", "fleet", "fleet_event",
+                    "aot_cache", "fleet", "fleet_event", "kernel",
                 ):
                     self._export_queue.append(record)
 
@@ -437,6 +488,7 @@ class Telemetry:
         records += [e.to_dict() for e in self.recompile_events]
         records += [p.to_dict() for p in self.program_records]
         records += [c.to_dict() for c in self.collective_records]
+        records += [k.to_dict() for k in self.kernel_records]
         records += [s.to_dict() for s in self.resource_samples]
         records += [dict(e) for e in self.resilience_events]
         records += [dict(e) for e in self.serving_events]

@@ -124,6 +124,12 @@ class DeviceStepRecord:
     overhead_ms: float = 0.0  # stop_trace + parse cost (outside window_ms)
     flops: Optional[float] = None  # from the program's cost_analysis
     mfu: Optional[float] = None  # None without a known per-chip peak
+    # per-atpu-phase compute/collective/transfer split (docs/telemetry.md):
+    # op durations joined to the program's HLO op->scope map — empty when
+    # no scope map exists for the variant (fail-soft)
+    phases: dict = field(default_factory=dict)
+    # raw {op name: [class, ms]} the phase join consumes; not exported
+    op_detail: dict = field(default_factory=dict)
 
     @property
     def collective_share(self) -> float:
@@ -149,6 +155,11 @@ class DeviceStepRecord:
             "overhead_ms": round(self.overhead_ms, 3),
             "flops": self.flops,
             "mfu": self.mfu,
+            "phases": {
+                name: {k: (round(v, 3) if isinstance(v, float) else v)
+                       for k, v in split.items()}
+                for name, split in self.phases.items()
+            },
         }
 
 
@@ -195,6 +206,7 @@ def parse_trace_events(events: list, top_k: int = 10) -> dict:
     per_device: dict[str, dict] = {}
     intervals: dict[str, list] = {}
     op_ms: dict[str, float] = {}
+    op_detail: dict[str, list] = {}  # name -> [class, summed ms]
     n_ops = 0
     for ev in events:
         if ev.get("ph") != "X":
@@ -217,15 +229,71 @@ def parse_trace_events(events: list, top_k: int = 10) -> dict:
             {"busy_ms": 0.0, "compute_ms": 0.0, "collective_ms": 0.0,
              "transfer_ms": 0.0, "idle_ms": 0.0, "ops": 0},
         )
-        dev[f"{classify_op(name)}_ms"] += dur / 1e3
+        op_class = classify_op(name)
+        dev[f"{op_class}_ms"] += dur / 1e3
         dev["ops"] += 1
         intervals.setdefault(device, []).append((ts, ts + dur))
         op_ms[name] = op_ms.get(name, 0.0) + dur / 1e3
+        entry = op_detail.setdefault(name, [op_class, 0.0])
+        entry[1] += dur / 1e3
         n_ops += 1
     for device, dev in per_device.items():
         dev["busy_ms"] = _union_ms(intervals[device])
     top_ops = sorted(op_ms.items(), key=lambda kv: kv[1], reverse=True)[:top_k]
-    return {"devices": per_device, "top_ops": top_ops, "op_events": n_ops}
+    return {
+        "devices": per_device,
+        "top_ops": top_ops,
+        "op_events": n_ops,
+        "op_detail": op_detail,
+    }
+
+
+# HLO-text instruction metadata: `%name = ... metadata={... op_name="path"}`
+# — the only place the atpu named scopes survive to (trace events carry
+# bare instruction names on every backend we parse)
+_HLO_OP_NAME_RE = re.compile(r"%?([\w.\-]+) = [^\n]*op_name=\"([^\"]+)\"")
+
+
+def scope_map_from_compiled(compiled) -> dict:
+    """``{hlo instruction name: atpu phase}`` from a compiled program's HLO
+    text.  The phase is the DEEPEST ``atpu``-prefixed segment of the op's
+    scope path (``jit(f)/atpu_captured_body/atpu_update/add`` →
+    ``atpu_update``); unscoped instructions are omitted.  Fail-soft: any
+    error returns an empty map and the sample simply carries no phase
+    split."""
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return {}
+    scope_map: dict = {}
+    for match in _HLO_OP_NAME_RE.finditer(text):
+        name, path = match.group(1), match.group(2)
+        phase = None
+        for segment in path.split("/"):
+            if segment.startswith("atpu"):
+                phase = segment  # keep walking: deepest wins
+        if phase is not None:
+            scope_map[name] = phase
+    return scope_map
+
+
+def split_phases(op_detail: dict, scope_map: dict) -> dict:
+    """Join sampled per-op durations (``{name: [class, ms]}``) to the
+    program's op->scope map: the whole-step compute/collective/transfer
+    split re-read per atpu phase.  Ops outside every atpu scope (input
+    copies, infeed, runtime bookkeeping) land in ``"unscoped"``."""
+    phases: dict = {}
+    for name, (op_class, ms) in op_detail.items():
+        phase = scope_map.get(name, "unscoped")
+        split = phases.setdefault(
+            phase,
+            {"total_ms": 0.0, "compute_ms": 0.0, "collective_ms": 0.0,
+             "transfer_ms": 0.0, "ops": 0},
+        )
+        split[f"{op_class}_ms"] += ms
+        split["total_ms"] += ms
+        split["ops"] += 1
+    return phases
 
 
 def find_trace_json(trace_dir: str) -> Optional[str]:
@@ -394,4 +462,5 @@ class StepProfiler:
             top_ops=[list(kv) for kv in parsed["top_ops"]],
             op_events=parsed["op_events"],
             overhead_ms=overhead_ms,
+            op_detail=parsed.get("op_detail", {}),
         )

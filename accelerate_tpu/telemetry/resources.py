@@ -126,6 +126,21 @@ class CollectiveRecord:
 
 
 @dataclass
+class KernelRecord:
+    """One armed Pallas hot-path kernel (docs/kernels.md), recorded at
+    ``prepare()`` like :class:`CollectiveRecord`: which reference path the
+    kernel replaces and how it lowers (compiled Mosaic vs interpreter) —
+    the join key for bench.py's kernel A/B and the per-phase device-time
+    split."""
+
+    kernel: str
+    stats: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"kind": "kernel", "kernel": self.kernel, **self.stats}
+
+
+@dataclass
 class ResourceSample:
     tag: str
     time: float = field(default_factory=time.time)

@@ -26,6 +26,7 @@ from .dataclasses import (
     GradScalerKwargs,
     InitProcessGroupKwargs,
     KwargsHandler,
+    KernelKwargs,
     LoggerType,
     ParallelismConfig,
     PipelineParallelPlugin,

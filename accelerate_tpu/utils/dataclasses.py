@@ -423,6 +423,44 @@ class CompilationCacheKwargs(KwargsHandler):
 
 
 @dataclass
+class KernelKwargs(KwargsHandler):
+    """Pallas hot-path kernel knobs (``accelerator.kernels``,
+    docs/kernels.md).
+
+    No reference counterpart — custom-kernel fusion is an XLA/Mosaic-native
+    concern.  ``kernels`` names the armed set: a comma/plus-separated
+    subset of ``collective_matmul`` (the ZeRO-1 all-gather as a chunked
+    ring feeding partial matmuls), ``quantized_rs`` (compress.py's
+    per-block scale+round fused into one kernel region at the shard
+    boundary, plus the stochastic-rounding ZeRO-2 wire) and
+    ``paged_attention`` (serving decode walks the block table in VMEM
+    instead of materializing each slot's full page span); ``all`` arms all
+    three.  When left ``None`` it resolves from ``$ACCELERATE_KERNELS``
+    (default off) — off means every hot path runs its pre-kernel code
+    byte-for-byte, matching the telemetry/resilience/aot-cache/fleet
+    precedent.
+
+    ``interpret`` forces the Pallas lowering mode; ``None`` (default)
+    resolves to interpreter mode off-TPU (bitwise-testable StableHLO, the
+    tier-1 surface) and compiled Mosaic on TPU.  The AOT cache fingerprint
+    keys on the armed set, so flipping a kernel is a loud miss, never a
+    stale executable.
+    """
+
+    kernels: Optional[str] = None  # None → $ACCELERATE_KERNELS, default off
+    interpret: Optional[bool] = None  # None → auto (off-TPU: interpreter)
+
+    def __post_init__(self):
+        if self.kernels is None:
+            self.kernels = os.environ.get("ACCELERATE_KERNELS", "")
+        self.kernels = str(self.kernels).lower()
+        if self.interpret is None and "ACCELERATE_KERNELS_INTERPRET" in os.environ:
+            self.interpret = bool(
+                str_to_bool(os.environ["ACCELERATE_KERNELS_INTERPRET"])
+            )
+
+
+@dataclass
 class DistributedDataParallelKwargs(KwargsHandler):
     """Accepted for API parity with the reference (dataclasses.py:149).
 

@@ -806,6 +806,149 @@ def _serving_block(on_accel: bool) -> dict:
     }
 
 
+def _kernels_ab_block(on_accel: bool) -> dict:
+    """Per-kernel on/off A/B rows for the primary JSON (docs/kernels.md):
+    the SAME GPT geometry trained with each training kernel armed vs off
+    (``kernel_<name>_step_ms_{off,on}`` + ``kernel_<name>_speedup`` + dp
+    bytes), and the decode service driven with paged attention armed vs off
+    (tokens/s).  On the CPU interpreter the kernels exist for correctness,
+    not speed — the A/B is the harness the first on-TPU window fills with
+    the real fusion win.  ``BENCH_KERNELS=0`` disables the block; rows are
+    fail-soft per kernel like the compression A/B."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import (
+        Accelerator,
+        CompressionKwargs,
+        KernelKwargs,
+        TelemetryKwargs,
+    )
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    n_dev = len(jax.devices())
+    out: dict = {"kernels_interpret": not on_accel}
+    cfg = GPTConfig.small() if on_accel else GPTConfig.tiny()
+    batch, seq, steps = (BATCH * n_dev, SEQ, 20) if on_accel else (2 * n_dev, 128, 3)
+
+    def train_ms(kernels: str, policy: str):
+        Accelerator._reset_state()
+        nn.manual_seed(0)
+        acc = Accelerator(
+            mixed_precision="bf16",
+            kwargs_handlers=[
+                TelemetryKwargs(enabled=True),
+                CompressionKwargs(policy=policy),
+                KernelKwargs(kernels=kernels),
+            ],
+        )
+        model = GPTLMHeadModel(cfg)
+        opt = optim.AdamW(model.parameters(), lr=3e-4, weight_decay=0.1)
+        model, opt = acc.prepare(model, opt)
+
+        def step_fn(ids):
+            opt.zero_grad()
+            loss_out = model(ids, labels=ids)
+            acc.backward(loss_out["loss"])
+            opt.step()
+            return loss_out["loss"]
+
+        step = acc.compile_step(step_fn)
+        rng = np.random.default_rng(0)
+        batches = [
+            batch_to_global_array(
+                jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32),
+                mesh=acc.mesh,
+            )
+            for _ in range(4)
+        ]
+        _, dt, final_loss, recompile, _ = _timed_steps(
+            step, batches, steps, WARMUP if on_accel else 1
+        )
+        records = list(acc.telemetry.collective_records)
+        bytes_total = records[-1].stats.get("dp_collective_bytes") if records else None
+        return dt / steps * 1e3, final_loss, recompile["count"], bytes_total
+
+    if n_dev > 1:
+        for name, policy in (("collective_matmul", "none"), ("quantized_rs", "int8")):
+            try:
+                off_ms, off_loss, _, off_bytes = train_ms("none", policy)
+                on_ms, on_loss, on_rec, on_bytes = train_ms(name, policy)
+                out[f"kernel_{name}_step_ms_off"] = round(off_ms, 2)
+                out[f"kernel_{name}_step_ms_on"] = round(on_ms, 2)
+                out[f"kernel_{name}_speedup"] = round(off_ms / on_ms, 3)
+                # the armed run's own figure, even if None — substituting
+                # the off-arm's bytes would mislabel the A/B row
+                out[f"kernel_{name}_dp_bytes"] = on_bytes
+                out[f"kernel_{name}_recompile_events"] = on_rec
+                out[f"kernel_{name}_loss_delta"] = round(abs(on_loss - off_loss), 6)
+            except Exception as exc:  # fail-soft: keep the other kernels' rows
+                out[f"kernel_{name}_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    else:
+        out["kernel_training_skipped"] = "dp=1: no dp collective pair to fuse"
+
+    try:
+        from accelerate_tpu.native.kernels import KernelPolicy
+        from accelerate_tpu.serving import DecodeService, ServingConfig
+
+        Accelerator._reset_state()
+        nn.manual_seed(0)
+        model = GPTLMHeadModel(cfg)
+        scfg = ServingConfig(
+            max_slots=8, block_size=16, prompt_bucket=32,
+            max_request_len=min(256, cfg.n_positions),
+        )
+        rng = np.random.default_rng(0)
+        prompts = [
+            rng.integers(1, cfg.vocab_size, (int(n),)).astype(np.int32)
+            for n in rng.integers(4, 28, 8)
+        ]
+
+        def decode_tok_s(kernels):
+            svc = DecodeService(model, scfg, kernels=kernels)
+            rids = [svc.submit(p, max_new_tokens=16) for p in prompts]
+
+            def tokens_total():
+                # finished AND in-flight: warmup-produced tokens must not be
+                # credited to the timed window
+                done = sum(
+                    len(svc.results[r].tokens) for r in rids if r in svc.results
+                )
+                live = sum(
+                    len(req.tokens) for req in svc._slot_req if req is not None
+                )
+                return done + live
+
+            for _ in range(4):
+                svc.step()  # warmup: admit + compile both programs
+            warm_tokens = tokens_total()
+            t0 = _t.perf_counter()
+            for _ in range(200):
+                svc.step()
+                if all(r in svc.results for r in rids):
+                    break
+            dt = _t.perf_counter() - t0
+            decoded = tokens_total() - warm_tokens
+            return (decoded / dt if dt > 0 else 0.0), svc.watcher.recompile_events
+
+        off_tok, _ = decode_tok_s(None)
+        on_tok, on_rec = decode_tok_s(KernelPolicy(paged_attention=True))
+        out["kernel_paged_attention_tok_s_off"] = round(off_tok, 1)
+        out["kernel_paged_attention_tok_s_on"] = round(on_tok, 1)
+        if off_tok > 0:
+            out["kernel_paged_attention_speedup"] = round(on_tok / off_tok, 3)
+        out["kernel_paged_attention_recompile_events"] = on_rec
+    except Exception as exc:
+        out["kernel_paged_attention_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    return out
+
+
 def _opt_inference_workload(on_accel: bool) -> dict:
     """BASELINE.json config 5: OPT device_map='auto'-style sharded inference
     (reference benchmarks/big_model_inference/README.md:31-37 form: load
@@ -1198,6 +1341,14 @@ def main() -> None:
             result.update(_elastic_block(on_accel))
         except Exception as exc:
             result["elastic_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    if os.environ.get("BENCH_KERNELS", "1") != "0":
+        # per-kernel on/off A/B (docs/kernels.md): step_ms + dp bytes for
+        # the two training kernels, decode tokens/s for paged attention —
+        # so the first on-TPU window captures the fusion win; fail-soft
+        try:
+            result.update(_kernels_ab_block(on_accel))
+        except Exception as exc:
+            result["kernels_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
     _PRIMARY_RESULT.update(result)
     # secondary BASELINE.md workloads, gated so the default driver run stays
     # inside its time budget (each adds a multi-minute cold compile)

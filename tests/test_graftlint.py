@@ -242,6 +242,43 @@ FIXTURES = {
             return out
         """,
     ),
+    "pallas-hazard": (
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            if x_ref[0, 0] > 0:          # python branch on a ref param
+                o_ref[:] = x_ref[:] * 2.0
+            print("traced!")             # host print in a kernel body
+
+        def call(x):
+            return pl.pallas_call(       # no interpret= / gated fallback
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+        """,
+        3,
+        """
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref, *, scale):
+            if scale > 1:                 # static (kw-only) config: fine
+                pl.debug_print("x00 = {}", x_ref[0, 0])
+            o_ref[:] = jnp.where(x_ref[:] > 0, x_ref[:] * scale, 0.0)
+
+        def call(x, policy_interpret):
+            return pl.pallas_call(
+                functools.partial(kernel, scale=2.0),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=policy_interpret,   # policy-threaded lowering
+            )(x)
+        """,
+    ),
 }
 
 

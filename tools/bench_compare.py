@@ -1,0 +1,121 @@
+#!/usr/bin/env python3
+"""bench_compare: the bench-regression gate (ROADMAP autotune carried item).
+
+The repo keeps a ``BENCH_r*.json`` trajectory, but until now nothing FAILED
+when ``step_ms`` regressed — a slow hot path could ride a green CI forever.
+This tool diffs the newest artifact's primary ``step_ms`` against the
+previous round and exits non-zero past a threshold:
+
+* only **CPU-geometry rows are comparable to each other** (the default
+  gate): a TPU row against a CPU row is a platform change, not a
+  regression, so mixed-platform pairs are reported and skipped unless both
+  artifacts ran on the same platform;
+* the threshold is ``$BENCH_REGRESSION_PCT`` (default 10): CI noise on the
+  CPU geometry sits well under that (r02→r05 moved within ±7%), so a trip
+  means a real hot-path change;
+* artifacts wrap the parsed row under ``{"parsed": {...}}`` (the driver
+  format) or carry the fields at top level (a direct ``bench.py`` dump) —
+  both are read.
+
+Usage::
+
+    python tools/bench_compare.py                  # newest two BENCH_r*.json
+    python tools/bench_compare.py --files A B      # explicit pair (A=older)
+    python tools/bench_compare.py --pct 5          # tighter threshold
+
+``make bench-gate`` chains this into CI (Makefile); tests/test_kernels.py
+pins the injected-regression trip and the current-trajectory pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def parsed_row(path: str) -> dict:
+    """The primary-result dict of one artifact (driver-wrapped or direct)."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        return {}
+    inner = data.get("parsed")
+    return inner if isinstance(inner, dict) else data
+
+
+def trajectory(bench_dir: str) -> list[str]:
+    """``BENCH_r*.json`` paths in round order (live/partial variants are
+    not rounds and do not gate)."""
+    rounds = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        match = _ROUND_RE.search(os.path.basename(path))
+        if match:
+            rounds.append((int(match.group(1)), path))
+    return [path for _, path in sorted(rounds)]
+
+
+def compare(prev_path: str, new_path: str, pct: float) -> tuple[int, str]:
+    """(exit code, human verdict) for one artifact pair."""
+    prev, new = parsed_row(prev_path), parsed_row(new_path)
+    prev_ms, new_ms = prev.get("step_ms"), new.get("step_ms")
+    if not isinstance(prev_ms, (int, float)) or not isinstance(new_ms, (int, float)):
+        return 0, (
+            f"skip: no comparable step_ms ({os.path.basename(prev_path)}="
+            f"{prev_ms!r}, {os.path.basename(new_path)}={new_ms!r})"
+        )
+    prev_plat, new_plat = prev.get("platform"), new.get("platform")
+    if prev_plat != new_plat:
+        return 0, (
+            f"skip: platform moved {prev_plat!r} -> {new_plat!r} — rows are "
+            "not comparable (the gate compares same-platform, CPU-geometry "
+            "trajectories)"
+        )
+    delta_pct = (new_ms - prev_ms) / prev_ms * 100.0
+    line = (
+        f"{os.path.basename(prev_path)} step_ms={prev_ms} -> "
+        f"{os.path.basename(new_path)} step_ms={new_ms} "
+        f"({delta_pct:+.1f}%, threshold +{pct:.0f}%)"
+    )
+    if delta_pct > pct:
+        return 1, f"REGRESSION: {line}"
+    return 0, f"ok: {line}"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--files", nargs=2, metavar=("PREV", "NEW"),
+        help="explicit artifact pair (default: newest two BENCH_r*.json)",
+    )
+    parser.add_argument(
+        "--bench-dir", default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding the BENCH_r*.json trajectory",
+    )
+    parser.add_argument(
+        "--pct", type=float,
+        default=float(os.environ.get("BENCH_REGRESSION_PCT", 10)),
+        help="fail when step_ms grows more than this percent (default "
+        "$BENCH_REGRESSION_PCT or 10)",
+    )
+    args = parser.parse_args(argv)
+    if args.files:
+        prev_path, new_path = args.files
+    else:
+        rounds = trajectory(args.bench_dir)
+        if len(rounds) < 2:
+            print(f"bench-gate: skip — fewer than two rounds in {args.bench_dir}")
+            return 0
+        prev_path, new_path = rounds[-2], rounds[-1]
+    code, verdict = compare(prev_path, new_path, args.pct)
+    print(f"bench-gate: {verdict}")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -206,6 +206,19 @@ def render(records: list[dict]) -> str:
                 "  top ops (last sample): "
                 + ", ".join(f"{name} {ms:.2f}ms" for name, ms in top)
             )
+        phases = device_steps[-1].get("phases") or {}
+        if phases:
+            lines.append("  per-phase split (last sample, ms):")
+            for name in sorted(phases):
+                split = phases[name]
+                lines.append(
+                    f"    {name:<22}"
+                    f" total {split.get('total_ms', 0.0):>8.2f}"
+                    f"  compute {split.get('compute_ms', 0.0):>8.2f}"
+                    f"  coll {split.get('collective_ms', 0.0):>8.2f}"
+                    f"  xfer {split.get('transfer_ms', 0.0):>7.2f}"
+                    f"  ({split.get('ops', 0)} ops)"
+                )
 
     lines.append("")
     if recompiles:
@@ -239,6 +252,15 @@ def render(records: list[dict]) -> str:
                 f" ratio {r.get('compression_ratio', 1.0):.2f}x,"
                 f" {r.get('tensors_compressed', 0)}/{r.get('tensors_total', 0)}"
                 " tensors)"
+            )
+    kernels = [r for r in records if r.get("kind") == "kernel"]
+    if kernels:
+        lines.append("")
+        lines.append("armed pallas kernels (docs/kernels.md)")
+        for r in kernels:
+            mode = "interpreter" if r.get("interpret") else "mosaic"
+            lines.append(
+                f"  {r.get('kernel', '?'):<20} [{mode}]  {r.get('target', '')}"
             )
     if resources:
         lines.append("")
