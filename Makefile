@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke kernel-smoke bench bench-gate
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke bench bench-gate
 
 # graftlint: whole-program trace-safety & collective-correctness static
 # analysis (docs/graftlint.md). Runs before the suite. The on-disk cache
@@ -83,6 +83,15 @@ cache-smoke:
 elastic-smoke:
 	JAX_PLATFORMS=cpu python tools/elastic_smoke.py
 
+# closed-loop proof (docs/elastic.md §autopilot): tiny GPT on 4 virtual CPU
+# devices, NO caller polling — injected host_lost → the autopilot shrinks
+# dp 4→2 → injected host_gained → it grows back 2→4, losses within parity
+# of an uninterrupted run, warm pass serves every post-resize build from
+# the AOT store (zero trace/compile), and an injected signal_storm is
+# suppressed by the debounce/hysteresis window (records, zero resizes)
+autopilot-smoke:
+	JAX_PLATFORMS=cpu python tools/autopilot_smoke.py
+
 # pallas-kernel proof (docs/kernels.md): tiny GPT on 4 virtual CPU
 # devices, every kernel armed under the interpreter — IR-inspection
 # assertions (no unfused all-gather-then-dot, no full page-span
@@ -98,7 +107,7 @@ kernel-smoke:
 bench-gate:
 	python tools/bench_compare.py
 
-test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke kernel-smoke bench-gate
+test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke bench-gate
 	python -m pytest tests/ -q
 
 test_core:
@@ -140,7 +149,7 @@ test_big_modeling:
 test_checkpoint:
 	python -m pytest tests/test_sharded_checkpoint.py tests/test_fsdp_utils.py \
 	  tests/test_async_checkpoint.py tests/test_resilience.py \
-	  tests/test_fleet.py -q
+	  tests/test_fleet.py tests/test_fleet_distributed.py -q
 
 test_examples:
 	python -m pytest tests/test_examples.py tests/test_external_scripts.py -q

@@ -150,14 +150,18 @@ class _Collector(ast.NodeVisitor):
             if isinstance(sub, ast.Name) and isinstance(sub.ctx, (ast.Store, ast.Del)):
                 store_counts[sub.id] = store_counts.get(sub.id, 0) + 1
         local_data = params | set(store_counts)
-        # cheap type inference over single-assignment locals: `obj = Ctor(...)`
-        # pins obj's type to Ctor for the whole function ONLY when obj is
-        # bound exactly once and is not a parameter — then `obj.method(x)`
-        # dispatches to ``Ctor.method`` (resolved by qualname same-module,
-        # through the class's import in program.py).  A reassigned or
-        # parameter-bound receiver stays uninferred: its type is not known,
+        # cheap type inference over locals bound to constructor calls:
+        # `obj = Ctor(...)` pins obj's type to Ctor for the whole function —
+        # then `obj.method(x)` dispatches to ``Ctor.method`` (resolved by
+        # qualname same-module, through the class's import in program.py).
+        # Join-over-branches: a receiver rebound across branches counts too,
+        # as long as EVERY binding of the name is a call of the SAME
+        # constructor (`obj = Cls() if fast else Cls(opts)`) — the join of
+        # identical types is that type.  Any other binding shape (a
+        # parameter, a different ctor, a non-call assignment, a loop/del
+        # rebind) leaves the receiver uninferred: its type is not knowable,
         # and a wrong guess would cross-wire reachability.
-        ctor_of: dict[str, str] = {}
+        ctor_assigns: dict[str, list[str]] = {}
         for sub in iter_own_nodes(node):
             if (
                 isinstance(sub, ast.Assign)
@@ -166,12 +170,19 @@ class _Collector(ast.NodeVisitor):
                 and isinstance(sub.value, ast.Call)
             ):
                 target = sub.targets[0].id
-                if store_counts.get(target) != 1 or target in params:
-                    continue
                 fn = sub.value.func
                 ctor = fn.id if isinstance(fn, ast.Name) else dotted_name(fn)
                 if ctor and ctor.split(".", 1)[0] not in ("self", "cls"):
-                    ctor_of[target] = ctor
+                    ctor_assigns.setdefault(target, []).append(ctor)
+        ctor_of: dict[str, str] = {}
+        for target, ctors in ctor_assigns.items():
+            if target in params:
+                continue
+            # every Store/Del of the name must be one of these ctor calls
+            # (a non-call rebind wouldn't appear in ctor_assigns and makes
+            # the counts disagree), and they must all name the same class
+            if store_counts.get(target) == len(ctors) and len(set(ctors)) == 1:
+                ctor_of[target] = ctors[0]
         for sub in iter_own_nodes(node):
             if isinstance(sub, ast.Call):
                 # direct calls: f(...), self.f(...) / cls.f(...), and dotted

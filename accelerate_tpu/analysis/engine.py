@@ -47,7 +47,11 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 # v8: new pallas-hazard rule — host callbacks / python-side branches on ref
 # parameters inside pl.pallas_call kernel bodies, and pallas_call sites
 # without an interpret=/policy-gated fallback in scope (docs/kernels.md).
-ANALYSIS_VERSION = "8"
+# v9: instance-dispatch inference joins over branches — a receiver rebound
+# across branches to the SAME class (`obj = Cls() if fast else Cls(opts)`)
+# now links `obj.method` to Cls.method; receivers rebound to different
+# classes (or to non-constructor values) stay uninferred.
+ANALYSIS_VERSION = "9"
 
 # Names that mark a branch/function as profiling/benchmark plumbing, where a
 # deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the

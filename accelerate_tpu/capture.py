@@ -527,6 +527,15 @@ class CapturedStep:
                     retry_wait_ms=retry_wait_ms,
                 )
             )
+        if fleet is not None and fleet.autopilot is not None:
+            # autopilot hook (docs/elastic.md): the closed signal→decision→
+            # action loop evaluates at the step boundary — after writeback
+            # and the step record, so a fired resize/grow never lands
+            # mid-step and never pollutes this step's timing.  Guarded on
+            # the autopilot handle: plain fleet-armed runs (the manual
+            # should_resize loop) pay one extra None-check, fleet-off runs
+            # none at all.
+            fleet.on_dispatch_end(self)
         return out
 
     def _dispatch_aot(self, tel, key, entry, state, args, dev_leaves, host_leaves, flat_args):
@@ -736,6 +745,7 @@ class CapturedStep:
             # input layouts instead of silently re-tracing) is handled — and
             # surfaced as a telemetry event — in __call__.
             compiled = side = None
+            aot_scope_map = None
             if cache is not None and not skip_cache_load:
                 compiled, side = cache.load_captured(
                     self, key, state_template, host_mask
@@ -760,6 +770,10 @@ class CapturedStep:
                         )
                     )
                 label = f"capture:{self._builds_total}:aot"
+                # deserialized executables carry no HLO metadata — adopt the
+                # op→scope map the STORING process parsed, so warm samples
+                # keep their per-phase device split (docs/aot_cache.md)
+                aot_scope_map = side.get("scope_map")
             else:
                 flat_state, _ = jax.tree_util.tree_flatten(state_template)
                 dev_leaves = tuple(x for x, h in zip(flat_state, host_mask) if not h)
@@ -783,6 +797,10 @@ class CapturedStep:
             self._builds_total += 1
             if tel is not None:
                 tel.record_program(key, label, compiled)
+                if aot_scope_map:
+                    # after record_program: its live parse of the metadata-
+                    # less deserialized executable filed an empty map
+                    tel.restore_scope_map(key_id(key), aot_scope_map)
                 if tel.resource_sampling:
                     tel.sample_resources(label)
             entry = (compiled, captured_ctx, state_treedef, host_mask)

@@ -21,6 +21,17 @@ Three pillars:
    ``telemetry.aggregate_fleet()`` from end-of-training-only to periodic
    mid-run skew/straggler records (``kind="fleet"``), the
    autoscaler/resize input read back via :meth:`Fleet.fleet_signal`.
+4. **Grow-side resize** (`grow.py`) — a returned host (``host_gained``
+   fault-plan verb; a rejoin beacon in production) trips
+   ``fleet.should_grow``; ``fleet.grow()`` drains, runs the grow
+   rendezvous barrier (all ranks agree on the widened topology), re-meshes
+   dp *up*, re-lays/reshards state onto the wider mesh, and prewarms the
+   AOT store — the torchelastic new-member half PR 11 deferred.
+5. **Autopilot** (`autopilot.py`) — ``FleetKwargs(autopilot=...)`` /
+   ``$ACCELERATE_FLEET_AUTOPILOT`` closes signal→decision→action: a pure,
+   rank-deterministic policy over the fleet/serving signal window
+   (debounce + hysteresis + cooldown) drives ``resize``/``grow`` from the
+   captured-step dispatch path itself — no caller polling loop.
 
 Enable with ``ACCELERATE_FLEET=1`` or
 ``Accelerator(kwargs_handlers=[FleetKwargs(enabled=True)])``.
@@ -28,15 +39,18 @@ Enable with ``ACCELERATE_FLEET=1`` or
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from ..resilience.inject import FaultInjector
+from .autopilot import Autopilot, AutopilotPolicy, evaluate_window
 from .coordinate import (
     agree_restore_point,
     coordinated_rollback,
     local_restore_candidates,
     vote_restore_point,
 )
+from .grow import agree_grow, grow_rendezvous, grown_mesh, max_growable_dp
 from .resize import prewarm_aot_cache, remesh_accelerator, surviving_mesh
 
 
@@ -61,16 +75,24 @@ class Fleet:
         self.resilience = resilience
         self.events: list[dict] = []
         self.injector: Optional[FaultInjector] = None
+        self.autopilot: Optional[Autopilot] = None
         self.dispatch_calls = 0
         self.resizes_total = 0
+        self.grows_total = 0
         self._host_lost = False
-        # collective host-lost poll memo, same discipline as the resilience
-        # preemption poll: at most one gather per dispatch, sticky once set
-        self._poll_cache: Optional[tuple[int, bool]] = None
-        self._poll_resolved = False
+        self._host_gained = False
+        # collective host-lost/-gained poll memo, same discipline as the
+        # resilience preemption poll: at most ONE gather per dispatch (both
+        # flags ride the same collective), sticky once set
+        self._poll_cache: Optional[tuple[int, bool, bool]] = None
+        self._lost_resolved = False
+        self._gained_resolved = False
         if not self.enabled:
             return
         self.injector = FaultInjector.from_spec(handler.fault_plan)
+        policy = getattr(handler, "autopilot_policy", None)
+        if policy is not None:
+            self.autopilot = Autopilot(self, policy)
 
     # -- events --------------------------------------------------------------
     def record_event(self, event: str, **fields) -> dict:
@@ -89,10 +111,13 @@ class Fleet:
         fleet-off steps never reach this."""
         index = self.dispatch_calls
         self.dispatch_calls += 1
-        if self.injector is not None and not self._host_lost:
-            if self.injector.maybe_host_lost(index):
+        if self.injector is not None:
+            if not self._host_lost and self.injector.maybe_host_lost(index):
                 self._host_lost = True
                 self.record_event("host_lost", dispatch_calls=index)
+            if not self._host_gained and self.injector.maybe_host_gained(index):
+                self._host_gained = True
+                self.record_event("host_gained", dispatch_calls=index)
         every = self.handler.aggregate_every_n
         if every and self.telemetry is not None and self.dispatch_calls % every == 0:
             # COLLECTIVE, but cadence-aligned: every rank counts the same
@@ -100,11 +125,21 @@ class Fleet:
             self.telemetry.aggregate_fleet(periodic=True)
         return index
 
-    # -- host-lost flag ------------------------------------------------------
-    def _poll(self) -> bool:
-        if self._poll_resolved:
-            return True  # sticky: a lost host does not come back
-        local = self._host_lost
+    def on_dispatch_end(self, step) -> None:
+        """Called by autopilot-armed CapturedSteps after writeback — the
+        step boundary, so a fired resize/grow never lands mid-step.  The
+        capture path guards on ``fleet.autopilot``: plain fleet-armed runs
+        (manual ``should_resize`` loop) never reach this."""
+        if self.autopilot is not None:
+            self.autopilot.on_dispatch_end(step)
+
+    # -- host-lost / host-gained flags ---------------------------------------
+    def _poll(self) -> tuple[bool, bool]:
+        """(host_lost, host_gained), each sticky once any rank observed it.
+        Both flags ride ONE gather per dispatch on multi-process runs."""
+        if self._lost_resolved and self._gained_resolved:
+            return True, True
+        local = (self._host_lost, self._host_gained)
         from ..state import PartialState
 
         if PartialState._shared_state and PartialState().num_processes > 1:
@@ -112,23 +147,52 @@ class Fleet:
                 self._poll_cache is not None
                 and self._poll_cache[0] == self.dispatch_calls
             ):
-                return self._poll_cache[1]
-            from ..utils import operations as ops
+                lost, gained = self._poll_cache[1], self._poll_cache[2]
+            else:
+                from ..utils import operations as ops
 
-            result = any(bool(flag) for flag in ops.gather_object([local]))
-            self._poll_cache = (self.dispatch_calls, result)
+                flags = ops.gather_object([local])
+                lost = any(bool(pair[0]) for pair in flags)
+                gained = any(bool(pair[1]) for pair in flags)
+                self._poll_cache = (self.dispatch_calls, lost, gained)
         else:
-            result = local
-        if result:
-            self._poll_resolved = True
-        return result
+            lost, gained = local
+        lost = lost or self._lost_resolved
+        gained = gained or self._gained_resolved
+        if lost:
+            self._lost_resolved = True
+        if gained:
+            self._gained_resolved = True
+        return lost, gained
+
+    def consume_host_lost(self) -> None:
+        """Reset the sticky host-lost flag after it was handled (a resize,
+        or an at-the-floor suppression) — a LATER loss re-trips it; all
+        ranks reset together, they all handled the same event."""
+        self._host_lost = False
+        self._lost_resolved = False
+        self._poll_cache = None
+
+    def consume_host_gained(self) -> None:
+        """Reset the sticky host-gained flag after it was handled (a grow,
+        or an at-the-ceiling suppression)."""
+        self._host_gained = False
+        self._gained_resolved = False
+        self._poll_cache = None
 
     @property
     def should_resize(self) -> bool:
         """True once any rank observed a host loss.  Collective on
         multi-process — call it on every rank (the survivors must agree to
         drain and re-mesh together, exactly like the preemption flags)."""
-        return self._poll()
+        return self._poll()[0]
+
+    @property
+    def should_grow(self) -> bool:
+        """True once any rank observed a host RETURN (``host_gained``) —
+        the grow-side twin of ``should_resize``; same collective/sticky
+        contract."""
+        return self._poll()[1]
 
     # -- pillar 1: coordinated restore ---------------------------------------
     def coordinated_rollback(self, accelerator) -> Optional[str]:
@@ -144,6 +208,44 @@ class Fleet:
         subsystem is armed (same async save machinery + event stream);
         otherwise drives save_state/wait_for_checkpoint directly."""
         target = output_dir or self.handler.checkpoint_dir
+        if target is None and not (
+            accelerator.project_configuration.automatic_checkpoint_naming
+            and accelerator.project_dir
+        ):
+            # autopilot-driven drains have no caller to pass output_dir:
+            # derive a path every rank computes identically from the shared
+            # counters (dispatch count and resize tally are SPMD-aligned).
+            # Production fleets should pin FleetKwargs.checkpoint_dir — a
+            # durable shared filesystem — this fallback is the rehearsal/
+            # single-host default.  Single-process runs add their pid so
+            # two unrelated jobs on one machine cannot write the same
+            # folder; multi-process runs have no communication-free shared
+            # unique token, so the counters stand and the warning below
+            # tells the operator to pin a real dir.
+            import tempfile
+
+            from ..state import PartialState
+
+            multi = (
+                bool(PartialState._shared_state)
+                and PartialState().num_processes > 1
+            )
+            token = "" if multi else f"_{os.getpid()}"
+            if multi:
+                from ..logging import get_logger
+
+                get_logger(__name__).warning(
+                    "fleet drain falling back to a counter-derived tmp path; "
+                    "set FleetKwargs.checkpoint_dir (shared, durable) — "
+                    "concurrent jobs on one filesystem could collide"
+                )
+            base = accelerator.project_dir or tempfile.gettempdir()
+            target = os.path.join(
+                base,
+                "atpu_fleet_drain"
+                f"{token}_"
+                f"{self.resizes_total + self.grows_total}_{self.dispatch_calls}",
+            )
         resilience = self.resilience
         if resilience is not None and resilience.enabled:
             out = resilience.drain(accelerator, target)
@@ -178,6 +280,14 @@ class Fleet:
         if target_dp is None:
             # default survivor model: half the fleet gone (one of two hosts)
             target_dp = max(self.handler.min_dp, old_dp // 2)
+        if target_dp > old_dp:
+            # one resize verb either direction: a wider target routes to
+            # the grow path (rendezvous + widened mesh) — what used to be a
+            # "growing is a relaunch" refusal before grow.py existed
+            return self.grow(
+                accelerator, target_dp=target_dp, output_dir=output_dir,
+                checkpoint=checkpoint,
+            )
         if target_dp < self.handler.min_dp:
             raise ValueError(
                 f"resize to dp={target_dp} is below the configured floor "
@@ -197,15 +307,69 @@ class Fleet:
         # documented `if fleet.should_resize: fleet.resize(...)` loop does
         # not re-drain/re-mesh on every subsequent step (a LATER host loss
         # re-trips it; all ranks reset together — they all ran this resize)
-        self._host_lost = False
-        self._poll_resolved = False
-        self._poll_cache = None
+        self.consume_host_lost()
         info = {
             "checkpoint": ckpt,
             "old_mesh": dict(mesh.shape),
             "new_mesh": dict(new_mesh.shape),
             "old_dp": old_dp,
             "dp": target_dp,
+            "direction": "shrink",
+            "aot_prewarmed": warmed,
+            "resumed_step": accelerator.step,
+        }
+        self.record_event("resize", **info)
+        return info
+
+    def grow(
+        self,
+        accelerator,
+        target_dp: Optional[int] = None,
+        output_dir: Optional[str] = None,
+        checkpoint: Optional[str] = None,
+        devices: Optional[list] = None,
+    ) -> dict:
+        """Widen the dp axis over rejoined device blocks and resume from a
+        complete checkpoint: drain → grow rendezvous (all ranks agree on
+        the widened topology) → re-mesh up → relayout → AOT prewarm →
+        spec-carrying reshard restore.  The grow-side twin of
+        :meth:`resize` (docs/elastic.md §grow); ``devices`` overrides the
+        rejoined-device pool (default: every process-visible device)."""
+        if not self.enabled:
+            raise RuntimeError("fleet.grow() needs FleetKwargs(enabled=True)")
+        if not self.handler.elastic:
+            raise RuntimeError("elastic resize disabled (FleetKwargs.elastic=False)")
+        mesh = accelerator.state.mesh
+        old_dp = dict(mesh.shape).get("dp", 1)
+        if target_dp is None:
+            # default rejoin model: the lost half came back
+            target_dp = min(old_dp * 2, max_growable_dp(mesh, devices=devices))
+        ckpt = checkpoint or self.drain(accelerator, output_dir)
+        plan = grow_rendezvous(accelerator, target_dp, fleet=self, devices=devices)
+        if plan is None:
+            raise RuntimeError(
+                "grow rendezvous found no agreement: some rank proposed a "
+                "different topology (rejoined host not yet visible there?) "
+                "— growing onto divergent meshes would deadlock the first "
+                "collective"
+            )
+        new_mesh = grown_mesh(mesh, plan["target_dp"], devices=devices)
+        remesh_accelerator(accelerator, new_mesh)
+        warmed = prewarm_aot_cache(accelerator)
+        # same reshard-restore contract as the shrink: relayout laid the
+        # wider-mesh layouts first, the spec-carrying load fills them with
+        # the checkpointed values — masters/moments bitwise vs a
+        # from-checkpoint cold start at the wide topology (test-pinned)
+        accelerator.load_state(ckpt)
+        self.grows_total += 1
+        self.consume_host_gained()
+        info = {
+            "checkpoint": ckpt,
+            "old_mesh": dict(mesh.shape),
+            "new_mesh": dict(new_mesh.shape),
+            "old_dp": old_dp,
+            "dp": plan["target_dp"],
+            "direction": "grow",
             "aot_prewarmed": warmed,
             "resumed_step": accelerator.step,
         }
@@ -224,12 +388,31 @@ class Fleet:
                 return record
         return None
 
+    def serving_signal(self) -> Optional[dict]:
+        """The latest decode-service step record (``kind="serving"``,
+        ``event="step"``) — queue depth / occupancy / pool back-pressure,
+        the serving half of the autopilot's input (docs/serving.md §fleet
+        signal); ``None`` when no service reported yet."""
+        if self.telemetry is None:
+            return None
+        for record in reversed(self.telemetry.serving_events):
+            if record.get("event") == "step":
+                return record
+        return None
+
 
 __all__ = [
+    "Autopilot",
+    "AutopilotPolicy",
     "Fleet",
+    "agree_grow",
     "agree_restore_point",
     "coordinated_rollback",
+    "evaluate_window",
+    "grow_rendezvous",
+    "grown_mesh",
     "local_restore_candidates",
+    "max_growable_dp",
     "prewarm_aot_cache",
     "remesh_accelerator",
     "surviving_mesh",

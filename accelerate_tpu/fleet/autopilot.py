@@ -1,0 +1,494 @@
+"""Fleet autopilot — the signal→decision→action loop (docs/elastic.md).
+
+PR 11 built the elastic *mechanism*: ``host_lost`` trips a sticky collective
+flag, ``fleet.resize()`` drains/re-meshes/reshards, and the periodic
+``kind="fleet"`` skew records measure the stragglers — but the caller still
+had to poll ``fleet.should_resize`` in their own training loop, and the skew
+signal was retained, never acted on.  This module closes the loop: a
+deterministic, rank-coordinated autoscaler policy consumes the fleet signal
+(straggler skew for training, queue depth/occupancy from the decode
+service's step records for serving), debounces it over a configurable
+window with hysteresis, and drives ``fleet.resize()``/``fleet.grow()``
+itself from the captured-step dispatch path — no caller loop.
+
+Two layers, deliberately split:
+
+* :class:`AutopilotPolicy` + :func:`evaluate_window` — the *decision*: pure
+  host code over a list of signal samples.  Every decision is reproducible
+  from its record (the record carries the window values, thresholds and
+  policy knobs) and unit-testable with synthetic samples, no mesh needed.
+* :class:`Autopilot` — the *driver*: owns the sample ring, the cooldown
+  counter, and the action plumbing (``resize``/``grow`` with dp-floor and
+  device-availability bounds).  Called once per armed captured dispatch, at
+  the step boundary (after writeback), so an action never lands mid-step.
+
+Determinism across ranks: every rank evaluates the same pure policy over
+the same inputs — the periodic skew record is computed from the allgather
+on EVERY rank (telemetry/__init__.py periodic mode), the host-lost/-gained
+flags are collective sticky polls, and the dispatch counter is SPMD-aligned
+— so all ranks reach the same decision at the same dispatch and enter the
+collective resize together, exactly like the manual loop did.
+
+Debounce + hysteresis semantics (the ``signal_storm`` proof): a soft signal
+fires only when the trailing ``window`` samples ALL sit at or above the
+sustain floor (``threshold * (1 - hysteresis)`` — dead band: dipping just
+below the threshold does not reset the streak) AND at least one crossed the
+threshold itself.  A flap below the floor resets the streak and emits a
+*suppressed* decision record; a flapping storm therefore produces telemetry
+and exactly zero resizes.  Hard host signals (``host_lost``/``host_gained``
+— a reclamation notice is authoritative, not noisy) bypass the window and
+the cooldown.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+# spellings that arm the default policy / leave the autopilot off when the
+# knob comes in through $ACCELERATE_FLEET_AUTOPILOT
+_ON_WORDS = ("1", "on", "true", "yes", "default")
+_OFF_WORDS = ("", "0", "off", "false", "no", "none")
+
+
+def _multi_process() -> bool:
+    from ..state import PartialState
+
+    return bool(PartialState._shared_state) and PartialState().num_processes > 1
+
+
+@dataclass
+class AutopilotPolicy:
+    """The pure decision policy: thresholds + debounce knobs.
+
+    ``skew_pct`` — shrink when the periodic fleet record's straggler skew
+    (slowest vs fastest rank, percent) sustains at/above this: a straggling
+    host degrades every step, and dropping its block beats riding it.
+    ``queue_high`` — grow when the decode service's queue depth sustains
+    at/above this (capacity shortage is user-facing latency).
+    ``occupancy_low`` — shrink when serving occupancy sustains at/below
+    this with an empty queue (capacity sits idle).
+    ``window`` — consecutive samples a condition must hold (the debounce).
+    ``hysteresis`` — dead-band fraction: once armed, the streak survives
+    dips down to ``threshold * (1 - hysteresis)`` (inverted conditions:
+    up to ``threshold * (1 + hysteresis)``).
+    ``cooldown`` — dispatches after a fired action before another soft
+    decision may fire (hard host signals ignore it).
+
+    Bad values raise ``ValueError`` here — at ``FleetKwargs`` construction,
+    not at the first fire (test-pinned).
+    """
+
+    skew_pct: float = 100.0
+    queue_high: float = 8.0
+    occupancy_low: float = 0.25
+    window: int = 3
+    hysteresis: float = 0.25
+    cooldown: int = 8
+
+    def __post_init__(self):
+        if self.skew_pct <= 0:
+            raise ValueError(f"autopilot skew_pct must be > 0, got {self.skew_pct}")
+        if self.queue_high <= 0:
+            raise ValueError(
+                f"autopilot queue_high must be > 0, got {self.queue_high}"
+            )
+        if not 0 <= self.occupancy_low < 1:
+            raise ValueError(
+                f"autopilot occupancy_low must be in [0, 1), got {self.occupancy_low}"
+            )
+        if self.window < 1:
+            raise ValueError(f"autopilot window must be >= 1, got {self.window}")
+        if not 0 <= self.hysteresis < 1:
+            raise ValueError(
+                f"autopilot hysteresis must be in [0, 1), got {self.hysteresis}"
+            )
+        if self.cooldown < 0:
+            raise ValueError(f"autopilot cooldown must be >= 0, got {self.cooldown}")
+
+    _FIELDS = ("skew_pct", "queue_high", "occupancy_low", "window", "hysteresis",
+               "cooldown")
+
+    @classmethod
+    def parse(cls, spec: str) -> "AutopilotPolicy":
+        """``key=value`` pairs, comma-separated — the
+        ``$ACCELERATE_FLEET_AUTOPILOT`` grammar
+        (``"skew_pct=150,window=4,hysteresis=0.2"``); bare on-words arm the
+        defaults."""
+        spec = spec.strip()
+        if spec.lower() in _ON_WORDS:
+            return cls()
+        kwargs: dict = {}
+        for pair in spec.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            key, sep, value = pair.partition("=")
+            key = key.strip()
+            if not sep or key not in cls._FIELDS:
+                raise ValueError(
+                    f"autopilot option {pair!r} in {spec!r}: use "
+                    f"key=value with key in {cls._FIELDS}"
+                )
+            try:
+                kwargs[key] = int(value) if key in ("window", "cooldown") else float(value)
+            except ValueError:
+                raise ValueError(
+                    f"autopilot option {pair!r} in {spec!r} is not numeric"
+                ) from None
+        return cls(**kwargs)
+
+    @classmethod
+    def resolve(cls, value) -> Optional["AutopilotPolicy"]:
+        """``FleetKwargs(autopilot=...)`` / env → a policy or ``None`` (off).
+        Accepts ``None``/bool/on-off words (default policy or off), a spec
+        string, a dict of knobs, or a ready policy."""
+        if value is None:
+            return None
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, (bool, int)):
+            # bools AND plain 0/1 — the rest of the knob surface treats
+            # them interchangeably, so must this one
+            return cls() if value else None
+        if isinstance(value, dict):
+            unknown = set(value) - set(cls._FIELDS)
+            if unknown:
+                raise ValueError(f"unknown autopilot options {sorted(unknown)}")
+            return cls(**value)
+        if isinstance(value, str):
+            if value.strip().lower() in _OFF_WORDS:
+                return None
+            return cls.parse(value)
+        raise ValueError(f"autopilot must be None/bool/str/dict/policy, got {value!r}")
+
+    def describe(self) -> dict:
+        return {name: getattr(self, name) for name in self._FIELDS}
+
+
+# ---------------------------------------------------------------------------
+# pure evaluation — every decision is a function of (policy, samples)
+# ---------------------------------------------------------------------------
+
+# (sample key, action, policy threshold field, inverted?) in priority order:
+# a capacity shortage (queue) outranks the shrink signals — user-facing
+# latency beats reclaiming idle capacity
+_SOFT_SIGNALS = (
+    ("queue_depth", "grow", "queue_high", False),
+    ("skew_pct", "shrink", "skew_pct", False),
+    ("occupancy", "shrink", "occupancy_low", True),
+)
+
+
+def _sustains(value: Optional[float], threshold: float, hysteresis: float,
+              inverted: bool) -> bool:
+    """Inside the hysteresis band: the streak survives at this value."""
+    if value is None:
+        return False
+    floor = threshold * (1 + hysteresis) if inverted else threshold * (1 - hysteresis)
+    return value <= floor if inverted else value >= floor
+
+
+def _arms(value: Optional[float], threshold: float, inverted: bool) -> bool:
+    """At/past the threshold itself: the condition is armed."""
+    if value is None:
+        return False
+    return value <= threshold if inverted else value >= threshold
+
+
+def evaluate_window(policy: AutopilotPolicy, samples: list) -> dict:
+    """One decision from the trailing signal window — pure host code.
+
+    ``samples`` is oldest-first; each is a dict of optional floats
+    (``skew_pct``, ``queue_depth``, ``occupancy``).  Each signal is
+    evaluated over the last ``window`` samples that CARRY it — signals
+    arrive on different cadences (the skew record every
+    ``aggregate_every_n`` dispatches, serving per service step), so the
+    debounce counts consecutive *measurements* of the signal, not
+    dispatches.  Returns a decision dict carrying everything needed to
+    reproduce it: the signal, its window values, both thresholds (arm +
+    sustain floor), the held count, and whether it fired or was suppressed
+    (armed now, but the debounce window is not satisfied — a flap or a
+    too-young streak)."""
+    suppressed: Optional[dict] = None
+    for key, action, threshold_field, inverted in _SOFT_SIGNALS:
+        threshold = getattr(policy, threshold_field)
+        bearing = [s for s in samples if s.get(key) is not None]
+        recent = bearing[-policy.window:]
+        values = [s[key] for s in recent]
+        newest = values[-1] if values else None
+        if newest is None:
+            continue
+        if key == "occupancy":
+            # idle capacity only counts when nothing is waiting for it —
+            # judged from the same sample the newest occupancy came from
+            queue_now = recent[-1].get("queue_depth")
+            if queue_now is None or queue_now > 0:
+                continue
+        held = 0
+        for value in reversed(values):
+            if not _sustains(value, threshold, policy.hysteresis, inverted):
+                break
+            held += 1
+        armed_in_streak = any(
+            _arms(v, threshold, inverted) for v in values[len(values) - held:]
+        )
+        decision = {
+            "signal": key,
+            "action": action,
+            "value": newest,
+            "threshold": threshold,
+            "sustain_floor": round(
+                threshold * (1 + policy.hysteresis if inverted else
+                             1 - policy.hysteresis), 6
+            ),
+            "inverted": inverted,
+            "window_values": list(values),
+            "held": held,
+            "window": policy.window,
+        }
+        if len(values) >= policy.window and held >= policy.window and armed_in_streak:
+            if suppressed is not None:
+                # a HIGHER-priority signal (the loop is priority-ordered)
+                # is armed but still debouncing — e.g. queue depth spiking
+                # while skew also holds.  Firing this lower-priority action
+                # would shrink capacity exactly as demand arrives (and its
+                # cooldown would then block the grow); hold the fire and
+                # let the higher-priority signal finish its window.
+                suppressed["reason"] += (
+                    f" (deferring a held {key} {action} behind it)"
+                )
+                return suppressed
+            decision["fired"] = True
+            decision["suppressed"] = False
+            return decision
+        if _arms(newest, threshold, inverted) and suppressed is None:
+            decision["fired"] = False
+            decision["suppressed"] = True
+            decision["reason"] = (
+                f"debounce: held {held}/{policy.window} samples"
+                + (" (streak reset by a flap below the sustain floor)"
+                   if held < len(values) else "")
+            )
+            suppressed = decision
+    if suppressed is not None:
+        return suppressed
+    return {"action": "none", "fired": False, "suppressed": False}
+
+
+class Autopilot:
+    """The driver: samples signals each armed dispatch, evaluates the pure
+    policy, and executes fired decisions through the fleet's resize/grow
+    verbs.  Constructed by :class:`~..Fleet` when
+    ``FleetKwargs(autopilot=...)`` / ``$ACCELERATE_FLEET_AUTOPILOT`` arms
+    it; fleet-off and autopilot-off paths never construct one."""
+
+    def __init__(self, fleet, policy: AutopilotPolicy):
+        self.fleet = fleet
+        self.policy = policy
+        # keep more than the window so a decision record can show the flap
+        # that reset the streak, not just the post-reset tail
+        self.samples: deque = deque(maxlen=max(policy.window * 4, 16))
+        self.cooldown_remaining = 0
+        self.decisions_total = 0
+        self.fired_total = 0
+        self.suppressed_total = 0
+        # last-consumed identity per retained-record source: the latest
+        # record is re-READABLE every dispatch, but one measurement must
+        # count ONCE toward the debounce window — re-sampling a stale
+        # record until it "held for window ticks" would fire on a single
+        # noisy measurement, exactly what the debounce exists to suppress
+        self._skew_mark = None
+        self._serving_mark = None
+        # dispatches to wait before retrying a grow whose rendezvous
+        # failed (the rejoined host not visible on every rank yet)
+        self._grow_backoff = 0
+
+    # -- signal sampling -----------------------------------------------------
+    def _sample(self) -> dict:
+        """One evaluation tick's view of every signal source: optional
+        floats ``skew_pct``/``queue_depth``/``occupancy`` plus the
+        ``storm``/``at_dispatch`` forensics fields.  A retained record
+        contributes only when it is FRESH (its step mark advanced since
+        the last consumed one; markless records — hand-rolled signals —
+        fail open)."""
+        fleet = self.fleet
+        sample: dict = {"at_dispatch": fleet.dispatch_calls, "storm": False}
+        spike = None
+        if fleet.injector is not None:
+            spike = fleet.injector.maybe_signal_storm(fleet.dispatch_calls)
+        if spike is not None:
+            # injected storm (resilience/inject.py): a synthetic skew that
+            # flaps across the threshold — the hysteresis/debounce proof
+            sample["storm"] = True
+            sample["skew_pct"] = self.policy.skew_pct * 2.0 if spike else 0.0
+        else:
+            signal = fleet.fleet_signal()
+            if signal is not None and isinstance(signal.get("skew_pct"), (int, float)):
+                mark = signal.get("at_step")
+                if mark is None or mark != self._skew_mark:
+                    self._skew_mark = mark
+                    sample["skew_pct"] = float(signal["skew_pct"])
+        serving = fleet.serving_signal()
+        if serving is not None and not _multi_process():
+            # rank-local gate: serving records live on ONE rank's hub, and
+            # a signal only that rank sees would fire a collective resize
+            # its peers never enter — deadlock.  Until multi-host serving
+            # exports a rank-symmetric signal, the serving half is
+            # single-process only (docs/elastic.md §autopilot).
+            mark = serving.get("step")
+            if mark is None or mark != self._serving_mark:
+                self._serving_mark = mark
+                for key in ("queue_depth", "occupancy"):
+                    value = serving.get(key)
+                    if isinstance(value, (int, float)):
+                        sample[key] = float(value)
+        return sample
+
+    # -- decision records ----------------------------------------------------
+    def _record(self, decision: dict, info: Optional[dict] = None) -> dict:
+        self.decisions_total += 1
+        if decision.get("fired"):
+            self.fired_total += 1
+        if decision.get("suppressed"):
+            self.suppressed_total += 1
+        payload = dict(decision)
+        payload["policy"] = self.policy.describe()
+        payload["ts"] = time.time()  # the outage-forensics join key
+        if info is not None:
+            payload["resize"] = {
+                k: info.get(k) for k in ("old_dp", "dp", "direction", "checkpoint")
+            }
+        return self.fleet.record_event(
+            "autopilot_decision", kind="autopilot", **payload
+        )
+
+    # -- the hook ------------------------------------------------------------
+    def on_dispatch_end(self, step) -> Optional[dict]:
+        """Called by every autopilot-armed CapturedStep after writeback —
+        the step boundary, so a fired action never lands mid-step.  Returns
+        the decision record when one was written (fired or suppressed),
+        ``None`` on a quiet tick."""
+        accelerator = step.accelerator
+        fleet = self.fleet
+        if self._grow_backoff > 0:
+            self._grow_backoff -= 1
+        if fleet.handler.elastic:
+            # hard host signals first: a reclamation notice / rejoin beacon
+            # is authoritative, so it bypasses the soft window AND the
+            # cooldown — a lost host cannot wait out a debounce.  The one
+            # exception: a grow whose RENDEZVOUS just failed (rejoined
+            # host not visible everywhere yet) backs off before retrying,
+            # or it would re-drain every single dispatch.
+            if fleet.should_resize:
+                return self._act(
+                    accelerator,
+                    {"signal": "host_lost", "action": "shrink", "value": 1.0,
+                     "threshold": 1.0, "fired": True, "suppressed": False,
+                     "hard": True},
+                )
+            if fleet.should_grow and self._grow_backoff == 0:
+                return self._act(
+                    accelerator,
+                    {"signal": "host_gained", "action": "grow", "value": 1.0,
+                     "threshold": 1.0, "fired": True, "suppressed": False,
+                     "hard": True},
+                )
+        sample = self._sample()
+        fresh = any(
+            sample.get(key) is not None
+            for key in ("skew_pct", "queue_depth", "occupancy")
+        )
+        in_cooldown = self.cooldown_remaining > 0
+        if in_cooldown:
+            self.cooldown_remaining -= 1
+        if not fresh:
+            # no new measurement: the window is unchanged, and re-deciding
+            # on it would spam an identical record every dispatch
+            return None
+        self.samples.append(sample)
+        decision = evaluate_window(self.policy, list(self.samples))
+        if decision["action"] == "none" and not decision.get("suppressed"):
+            return None
+        if decision.get("fired") and in_cooldown:
+            decision = dict(
+                decision, fired=False, suppressed=True,
+                reason=f"cooldown: {self.cooldown_remaining} dispatches remaining",
+            )
+        if not decision.get("fired"):
+            return self._record(decision)
+        if not fleet.handler.elastic:
+            # same anti-spam discipline as the bounds refusals in _act: a
+            # sustained signal would re-record this identical downgrade on
+            # every fresh measurement without the cooldown
+            self.cooldown_remaining = self.policy.cooldown
+            return self._record(dict(
+                decision, fired=False, suppressed=True,
+                reason="elastic resize disabled (FleetKwargs.elastic=False)",
+            ))
+        return self._act(accelerator, decision)
+
+    def _act(self, accelerator, decision: dict) -> dict:
+        """Execute a fired decision through the fleet, bounds-checked: a
+        shrink refuses the dp floor, a grow refuses when no devices exist to
+        grow into — both downgrade to a suppressed record, never a raise
+        (the loop must keep training)."""
+        fleet = self.fleet
+        mesh = accelerator.state.mesh
+        dp = dict(mesh.shape).get("dp", 1)
+        if decision["action"] == "shrink":
+            target = max(fleet.handler.min_dp, dp // 2)
+            if target >= dp:
+                if decision.get("hard"):
+                    # consume the sticky flag: at the floor the loss is
+                    # survivable only by the rollback path, and re-deciding
+                    # every dispatch would spam identical records
+                    fleet.consume_host_lost()
+                else:
+                    # a soft signal that stays high would otherwise re-fire
+                    # (and re-record) this same refusal every dispatch
+                    self.cooldown_remaining = self.policy.cooldown
+                return self._record(dict(
+                    decision, fired=False, suppressed=True,
+                    reason=f"at the dp floor (dp={dp}, min_dp={fleet.handler.min_dp})",
+                ))
+            info = fleet.resize(accelerator, target_dp=target)
+        else:
+            from .grow import max_growable_dp
+
+            ceiling = max_growable_dp(mesh)
+            target = min(dp * 2, ceiling)
+            if target <= dp:
+                if decision.get("hard"):
+                    fleet.consume_host_gained()
+                else:
+                    self.cooldown_remaining = self.policy.cooldown
+                return self._record(dict(
+                    decision, fired=False, suppressed=True,
+                    reason=f"no devices to grow into (dp={dp}, ceiling={ceiling})",
+                ))
+            try:
+                info = fleet.grow(accelerator, target_dp=target)
+            except RuntimeError as exc:
+                # an aborted rendezvous (some rank cannot see the rejoined
+                # host yet) is an expected coordination outcome, not a
+                # crash: the loop must keep training.  The sticky flag
+                # stays set and the backoff bounds the retry cadence — the
+                # next attempt drains again once every rank caught up.
+                self._grow_backoff = max(1, self.policy.cooldown)
+                return self._record(dict(
+                    decision, fired=False, suppressed=True,
+                    reason=f"grow aborted: {exc}"[:300],
+                ))
+        self.cooldown_remaining = self.policy.cooldown
+        self.samples.clear()  # the fleet changed shape: old window is moot
+        return self._record(decision, info=info)
+
+
+__all__ = ["Autopilot", "AutopilotPolicy", "evaluate_window"]

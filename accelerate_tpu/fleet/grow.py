@@ -1,0 +1,179 @@
+"""Grow-side resize — re-mesh dp *up* when a host comes back.
+
+PR 11's resize only shrank: growing was refused with "new hosts need a
+rendezvous, which is a relaunch".  This module implements that rendezvous
+half (the torchelastic new-member flow we deferred): a returned host — the
+``host_gained`` fault-plan verb on CPU, a scheduler's rejoin beacon in
+production — trips ``fleet.should_grow``; ``fleet.grow()`` then drains a
+COMPLETE checkpoint, runs the **grow rendezvous barrier** (every rank
+gathers its proposed target and visible device set; a pure agreement
+function accepts the plan only when every rank proposes the identical
+topology), widens the ``dp`` axis over the rejoined device blocks, re-lays
+ZeRO-1 masters/moments and compression residuals onto the wider mesh
+(``remesh_accelerator`` — the exact relayout the shrink path uses), AOT-
+prewarms the wider topology so recovery is deserialize-not-compile, and
+reshards the spec-carrying checkpoint onto it — masters/moments bitwise
+versus a from-checkpoint cold start, same 1e-3 loss-parity bound as the
+shrink (dp reduce order moves; docs/elastic.md).
+
+Device accounting: the dp axis is outermost, so a host's devices are whole
+dp-axis blocks.  ``grown_mesh`` appends the rejoined blocks AFTER the
+survivors' blocks, drawn from the process-visible device pool in stable id
+order — every rank computes the identical mesh, which the rendezvous
+ballot then double-checks before anything re-lays out.
+
+On a real multi-host fleet the NEW process must additionally join the
+``jax.distributed`` world before its devices appear in the pool; the
+rendezvous barrier here is exercised under a real 2-process gloo/CPU
+world in ``tests/test_fleet_distributed.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+from jax.sharding import Mesh
+
+from ..logging import get_logger
+from ..utils.operations import gather_object
+
+logger = get_logger(__name__)
+
+
+def _device_pool(devices=None) -> list:
+    if devices is not None:
+        return list(devices)
+    import jax
+
+    return list(jax.devices())
+
+
+def max_growable_dp(mesh: Mesh, devices=None) -> int:
+    """The dp ceiling the visible device pool supports at this mesh's inner
+    extents — what a grow decision bounds its target by."""
+    inner = 1
+    for axis, size in dict(mesh.shape).items():
+        if axis != "dp":
+            inner *= size
+    pool = _device_pool(devices)
+    return len(pool) // max(1, inner)
+
+
+def grown_axis_sizes(mesh: Mesh, target_dp: int) -> dict[str, int]:
+    """The widened axis-size dict: ``dp`` grown to ``target_dp``, every
+    other axis preserved.  Validates the grow is a real widening."""
+    sizes = dict(mesh.shape)
+    dp = sizes.get("dp", 1)
+    if target_dp <= dp:
+        raise ValueError(
+            f"grow needs target_dp > current dp ({target_dp} <= {dp}); "
+            "shrinking is fleet.resize()'s job"
+        )
+    sizes["dp"] = target_dp
+    return sizes
+
+
+def grown_mesh(mesh: Mesh, target_dp: int, devices=None) -> Mesh:
+    """The mesh widened to ``target_dp`` dp blocks: the current blocks stay
+    in place (live state never moves under a grow — only the NEW blocks
+    receive resharded state) and the rejoined blocks are appended from the
+    device pool in stable id order, so every rank builds the identical
+    mesh.  ``devices`` overrides the pool (tests, explicit rejoin notices);
+    default is every process-visible device."""
+    sizes = grown_axis_sizes(mesh, target_dp)
+    if "dp" not in mesh.axis_names:
+        raise ValueError(f"mesh {dict(mesh.shape)} has no dp axis to grow")
+    dp_index = mesh.axis_names.index("dp")
+    dp = mesh.shape["dp"]
+    current = {d.id for d in mesh.devices.flat}
+    pool = _device_pool(devices)
+    candidates = sorted(
+        (d for d in pool if d.id not in current), key=lambda d: d.id
+    )
+    inner_shape = list(mesh.devices.shape)
+    inner = int(np.prod([s for i, s in enumerate(inner_shape) if i != dp_index]))
+    needed = (target_dp - dp) * inner
+    if len(candidates) < needed:
+        raise ValueError(
+            f"grow to dp={target_dp} needs {needed} rejoined devices; only "
+            f"{len(candidates)} are visible outside the current mesh"
+        )
+    block_shape = list(inner_shape)
+    block_shape[dp_index] = target_dp - dp
+    new_blocks = np.asarray(candidates[:needed], dtype=object).reshape(block_shape)
+    device_array = np.concatenate([mesh.devices, new_blocks], axis=dp_index)
+    new = Mesh(device_array, axis_names=mesh.axis_names)
+    assert dict(new.shape) == sizes
+    return new
+
+
+# ---------------------------------------------------------------------------
+# rendezvous barrier — pure agreement over gathered proposals
+# ---------------------------------------------------------------------------
+
+def grow_proposal(mesh: Mesh, target_dp: int, devices=None) -> dict:
+    """This rank's rendezvous ballot: the target extent and the exact
+    device ids the widened mesh would bind, in mesh order.  A rank that
+    CANNOT build the target (the rejoined host is not visible to it yet)
+    ballots its error instead of crashing the barrier — the rendezvous must
+    abort cleanly, with the straggler named in the recorded ballot."""
+    try:
+        ids = [
+            int(d.id)
+            for d in grown_mesh(mesh, target_dp, devices=devices).devices.flat
+        ]
+    except ValueError as exc:
+        return {"target_dp": int(target_dp), "error": str(exc)[:200]}
+    return {"target_dp": int(target_dp), "device_ids": ids}
+
+
+def agree_grow(per_rank: list[dict]) -> Optional[dict]:
+    """The grow plan every rank can execute: all ranks must propose the
+    IDENTICAL target and device list — any disagreement (a rank that
+    cannot see the rejoined host yet, a straggling notice) aborts the grow
+    rather than letting ranks re-mesh onto different topologies and
+    deadlock the first collective.  ``None`` = no agreement."""
+    if not per_rank:
+        return None
+    first = per_rank[0]
+    if "device_ids" not in first:
+        return None  # an error ballot — even unanimously, there is no plan
+    for proposal in per_rank[1:]:
+        if proposal != first:
+            return None
+    return dict(first)
+
+
+def grow_rendezvous(accelerator, target_dp: int, fleet=None,
+                    devices=None) -> Optional[dict]:
+    """COLLECTIVE — every rank must call (``fleet.grow`` does).  Gathers
+    each rank's proposal and returns the agreement; every rank computes it
+    from the same gathered ballot, so no second broadcast is needed.
+    Records a ``grow_rendezvous`` fleet event with the full ballot."""
+    local = grow_proposal(accelerator.state.mesh, target_dp, devices=devices)
+    per_rank = gather_object([local])
+    agreed = agree_grow(per_rank)
+    if fleet is not None:
+        fleet.record_event(
+            "grow_rendezvous",
+            ranks=len(per_rank),
+            ballot=[dict(p) for p in per_rank],
+            agreed=agreed is not None,
+            target_dp=agreed["target_dp"] if agreed is not None else None,
+        )
+    if agreed is None:
+        logger.warning(
+            "grow rendezvous found no agreement across %d ranks", len(per_rank)
+        )
+    return agreed
+
+
+__all__ = [
+    "agree_grow",
+    "grow_proposal",
+    "grow_rendezvous",
+    "grown_axis_sizes",
+    "grown_mesh",
+    "max_growable_dp",
+]

@@ -44,9 +44,9 @@ def surviving_axis_sizes(mesh: Mesh, target_dp: int) -> dict[str, int]:
         raise ValueError(f"target_dp must be >= 1, got {target_dp}")
     if target_dp > dp:
         raise ValueError(
-            f"elastic resize only shrinks the dp axis (dp={dp} -> "
-            f"{target_dp}); growing needs new hosts to rendezvous, which is "
-            "a relaunch, not a resize"
+            f"surviving_mesh only shrinks the dp axis (dp={dp} -> "
+            f"{target_dp}); growing runs the rendezvous path — "
+            "fleet.grow() / grow.grown_mesh (docs/elastic.md §grow)"
         )
     sizes["dp"] = target_dp
     return sizes

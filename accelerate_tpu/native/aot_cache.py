@@ -460,7 +460,12 @@ class AOTCompilationCache:
                     "created_at": time.time(),
                     "used_at": time.time(),
                     "side": {
-                        k: v for k, v in (side or {}).items() if k != "scheduler_replays"
+                        k: v
+                        for k, v in (side or {}).items()
+                        # bulky payloads stay in the pickle only: the JSON
+                        # metadata is the listing/diagnosis surface and must
+                        # stay cheap to read per entry
+                        if k not in ("scheduler_replays", "scope_map")
                     },
                     "sig": (side or {}).get("sig"),
                     "service": (side or {}).get("service"),
@@ -540,6 +545,13 @@ class AOTCompilationCache:
             return 0
         live = self.fingerprint()
         fp_digest = _digest(live)
+        # entries staged for a PREVIOUS fingerprint are dead weight now: an
+        # elastic fleet that resizes repeatedly (shrink → grow → shrink…)
+        # re-pins the context each time, and without this sweep every past
+        # topology's executables would stay resident for the process's life
+        suffix = f"-{fp_digest}.pkl"
+        for stale in [p for p in self._prefetched if not p.endswith(suffix)]:
+            del self._prefetched[stale]
         count = 0
         for pkl_path in glob.glob(
             os.path.join(self.cache_dir, f"*-{fp_digest}.pkl")
@@ -666,6 +678,23 @@ class AOTCompilationCache:
             "uses_accumulate": bool(ctx.used_accumulate),
             "scheduler_replays": replays,
         }
+        # per-phase device attribution survives the warm start (ROADMAP
+        # carried item, docs/telemetry.md §phases): a deserialized
+        # executable carries NO HLO metadata, so the op→scope map must be
+        # parsed NOW — while the freshly compiled object still has it — and
+        # persisted beside the executable; the loading process restores it
+        # into its telemetry hub (capture.py) so warm samples keep the
+        # split instead of reading empty phases.  Gated on the storing
+        # step's telemetry: as_text() stringifies the whole HLO module
+        # (can be tens of MB on big programs), and a telemetry-off run has
+        # no atpu scopes in its trace to map anyway (the named_scope spans
+        # only exist when telemetry instrumented the capture).
+        if step._telemetry is not None:
+            from ..telemetry.profiler import scope_map_from_compiled
+
+            scope_map = scope_map_from_compiled(compiled)
+            if scope_map:
+                side["scope_map"] = scope_map
         from ..telemetry.recompile import key_id
 
         ok = self.store(

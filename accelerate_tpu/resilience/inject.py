@@ -23,6 +23,16 @@ semicolon-separated directives, ``key=int`` options after a colon:
   (``fleet.should_resize``, docs/elastic.md): unlike ``sigterm`` — "this
   process must drain and exit" — ``host_lost`` means "a peer is gone, the
   survivors must drain and re-mesh at the smaller topology".
+* ``host_gained:step=4`` — mark a host as RETURNED right before dispatch
+  ``step`` (the rejoin beacon a scheduler sends when a reclaimed host comes
+  back).  Consumed by the elastic fleet runtime (``fleet.should_grow``):
+  the survivors drain and re-mesh dp *up* over the rejoined blocks.
+* ``signal_storm:step=2,times=6`` — for the next ``times`` autopilot
+  evaluation ticks starting at dispatch ``step``, flap the observed
+  straggler-skew signal alternately above and below the autopilot's
+  threshold.  Consumed by the fleet autopilot (docs/elastic.md): the
+  hysteresis/debounce proof — a storm must produce suppressed-decision
+  telemetry and exactly zero resizes.
 
 Injection points are reached only when resilience is enabled AND a plan is
 configured — production runs never pay for (or trip over) this module.
@@ -45,7 +55,7 @@ class InjectedTransientError(RuntimeError):
 
 @dataclass
 class _Directive:
-    kind: str  # "init_hang" | "dispatch" | "sigterm" | "host_lost"
+    kind: str  # init_hang | dispatch | sigterm | host_lost | host_gained | signal_storm
     step: Optional[int] = None  # dispatch index (dispatch/sigterm)
     times: int = 1  # how many firings remain
     fired: int = 0
@@ -64,10 +74,14 @@ class FaultPlan:
                 continue
             kind, _, opts_raw = raw.partition(":")
             kind = kind.strip()
-            if kind not in ("init_hang", "dispatch", "sigterm", "host_lost"):
+            if kind not in (
+                "init_hang", "dispatch", "sigterm", "host_lost",
+                "host_gained", "signal_storm",
+            ):
                 raise ValueError(
                     f"unknown fault directive {kind!r} in {spec!r}; use "
-                    "init_hang / dispatch / sigterm / host_lost"
+                    "init_hang / dispatch / sigterm / host_lost / "
+                    "host_gained / signal_storm"
                 )
             opts: dict[str, int] = {}
             for pair in opts_raw.split(","):
@@ -84,7 +98,11 @@ class FaultPlan:
             unknown = set(opts) - {"step", "times"}
             if unknown:
                 raise ValueError(f"unknown fault options {sorted(unknown)} in {raw!r}")
-            if kind in ("dispatch", "sigterm", "host_lost") and "step" not in opts:
+            if (
+                kind in ("dispatch", "sigterm", "host_lost", "host_gained",
+                         "signal_storm")
+                and "step" not in opts
+            ):
                 raise ValueError(f"{kind!r} directive needs step=N ({raw!r})")
             directives.append(
                 _Directive(
@@ -147,6 +165,33 @@ class FaultInjector:
             return False
         directive.fired += 1
         return True
+
+    def maybe_host_gained(self, dispatch_index: int) -> bool:
+        """True when a scheduled host RETURN fires at this dispatch — the
+        grow-side signal (a real fleet would read the scheduler's rejoin
+        beacon here; docs/elastic.md)."""
+        directive = self._pending("host_gained", step=dispatch_index)
+        if directive is None:
+            return False
+        directive.fired += 1
+        return True
+
+    def maybe_signal_storm(self, dispatch_index: int) -> Optional[bool]:
+        """Storm override for the autopilot's skew sample: ``True`` = spike
+        above the threshold, ``False`` = drop below it, ``None`` = no storm
+        active.  Unlike the step-pinned verbs, a storm runs from its start
+        dispatch for ``times`` consecutive ticks, alternating spike/drop —
+        the flap the hysteresis window must suppress."""
+        for d in self.plan.directives:
+            if (
+                d.kind == "signal_storm"
+                and d.fired < d.times
+                and d.step is not None
+                and dispatch_index >= d.step
+            ):
+                d.fired += 1
+                return d.fired % 2 == 1  # spike first, then drop, then spike...
+        return None
 
     def maybe_dispatch_fault(self, dispatch_index: int) -> None:
         """Raise a transient fault for the given dispatch; retries of the same

@@ -371,6 +371,13 @@ class DecodeService:
         return sum(r is not None for r in self._slot_req)
 
     @property
+    def pool_free_frac(self) -> float:
+        """Free fraction of the usable KV block pool — the back-pressure
+        gauge the step records, the fleet signal and the metrics endpoint
+        all report (one definition, three consumers)."""
+        return self.pool.free_blocks / max(1, self.pool.usable_blocks)
+
+    @property
     def has_work(self) -> bool:
         return bool(self._queue) or self.active_slots > 0
 
@@ -512,6 +519,11 @@ class DecodeService:
                 "event": "step", "step": self.stats["steps"],
                 "occupancy": occupancy, "active": len(active),
                 "queue_depth": len(self._queue),
+                # pool back-pressure rides the step record too: the fleet
+                # autopilot's serving signal (docs/elastic.md §autopilot)
+                # reads queue depth/occupancy from here, and a full pool is
+                # the "queue deep because blocks, not slots" disambiguator
+                "pool_free_frac": self.pool_free_frac,
                 "admitted": len(admitted),
                 # true slot evictions only — a one-token request completing
                 # inside _admit never held a decode slot and is visible in
@@ -534,6 +546,19 @@ class DecodeService:
         return dict(self.results)
 
     # -- accounting ----------------------------------------------------------
+    def fleet_signal(self) -> dict:
+        """The serving half of the fleet autopilot's input (docs/elastic.md
+        §autopilot): instantaneous queue depth, occupancy and pool
+        back-pressure — pure host reads, safe from any thread.  The same
+        numbers ride every ``kind="serving"`` step record, which is where a
+        training-colocated autopilot actually samples them (the records are
+        rank-retained; this accessor is the direct/standalone form)."""
+        return {
+            "queue_depth": len(self._queue),
+            "occupancy": self.active_slots / self.config.max_slots,
+            "pool_free_frac": self.pool_free_frac,
+        }
+
     def metrics(self) -> dict:
         """Live scrape snapshot (the metrics endpoint and tests share it):
         instantaneous occupancy/queue/pool gauges plus TTFT/TPOT p50/p99
@@ -555,9 +580,7 @@ class DecodeService:
             "slots_total": self.config.max_slots,
             "queue_depth": len(self._queue),
             "queue_peak": self.stats["queue_peak"],
-            "block_pool_free_frac": (
-                self.pool.free_blocks / max(1, self.pool.usable_blocks)
-            ),
+            "block_pool_free_frac": self.pool_free_frac,
             "steps_total": self.stats["steps"],
             "admitted_total": self.stats["admitted"],
             "completed_total": self.stats["completed"],

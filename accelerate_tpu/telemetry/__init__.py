@@ -384,6 +384,19 @@ class Telemetry:
             self._export_queue.append(record.to_dict())
         return record
 
+    def restore_scope_map(self, key: str, scope_map: dict) -> None:
+        """Adopt a PERSISTED HLO op→scope map for a compiled variant
+        (docs/aot_cache.md): executables deserialized from the AOT store
+        carry no HLO metadata, so ``record_program``'s live parse yields an
+        empty map and every sample of that variant would read empty
+        ``phases`` — the store's side payload carries the map the compiling
+        process parsed, and the capture path restores it here on a warm
+        load.  No-op unless the sampler is armed (the maps only feed the
+        per-phase device split) or the map is empty."""
+        if self.profiler is None or not scope_map:
+            return
+        self._scope_maps[key] = dict(scope_map)
+
     def rekey_last_device_step(self, new_key: str) -> None:
         """Re-key the most recent device-step record (and its pending export
         dict) — the first-call accumulate re-file moves the program record to
@@ -440,6 +453,7 @@ class Telemetry:
                     "step", "recompile", "program", "collectives",
                     "resources", "resilience", "serving", "device_step",
                     "aot_cache", "fleet", "fleet_event", "kernel",
+                    "autopilot",
                 ):
                     self._export_queue.append(record)
 
@@ -516,10 +530,13 @@ class Telemetry:
 
         ``periodic=True`` is the mid-run mode (docs/elastic.md): instead of
         freezing the final fleet dump, the skew/straggler record is
-        computed and RETAINED (``record_fleet``) so a live scrape or the
-        fleet hub's ``fleet_signal()`` can read the current straggler
-        picture while training continues; returns ``[skew_record]`` on the
-        main process."""
+        computed and RETAINED (``record_fleet``) on EVERY rank — the
+        allgather hands each rank the identical ballot, so each computes
+        the identical record deterministically.  That symmetry is what
+        makes the record usable as an *autoscaler input*: every rank's
+        autopilot evaluates the same signal window and reaches the same
+        resize decision at the same dispatch (rank-divergent signals would
+        deadlock the collective resize).  Returns ``[skew_record]``."""
         from .aggregate import fleet_skew, gather_fleet, merge_rank_records
 
         if periodic:
@@ -529,6 +546,8 @@ class Telemetry:
             # history every tick would pickle O(window × ranks) per tick
             # and dilute the "current straggler" signal with steps an
             # earlier tick already described
+            from ..utils.operations import gather_object
+
             mark = self._fleet_agg_mark
             local = [
                 r.to_dict()
@@ -536,9 +555,9 @@ class Telemetry:
                 if not r.built and r.step >= mark
             ]
             self._fleet_agg_mark = self.steps_total
-            per_rank = gather_fleet(local)
-            if per_rank is None:
-                return None
+            # NOT gather_fleet (which nulls non-main ranks): every rank
+            # keeps the full gather and derives the same pure skew record
+            per_rank = gather_object([local])
             skew = fleet_skew(per_rank)
             skew["periodic"] = True
             skew["at_step"] = self.steps_total

@@ -81,10 +81,22 @@ def fleet_skew(per_rank: list) -> dict:
 def merge_rank_records(per_rank: list) -> list:
     """Rank-tag every record (without mutating the inputs) and append the
     fleet skew record — the JSONL schema stays per-record valid, each line
-    just carries which rank produced it."""
+    just carries which rank produced it.
+
+    Periodic ``kind="fleet"`` records are kept from rank 0 only: the
+    mid-run cadence retains the IDENTICAL record on every rank (the
+    autopilot needs rank-symmetric inputs, telemetry/__init__.py), so the
+    merged dump would otherwise carry world-size duplicates per tick and
+    any post-mortem counting them would over-count by that factor."""
     merged = []
     for rank, records in enumerate(per_rank):
         for record in records:
+            if (
+                rank != 0
+                and record.get("kind") == "fleet"
+                and record.get("periodic")
+            ):
+                continue
             tagged = dict(record)
             tagged["rank"] = rank
             merged.append(tagged)

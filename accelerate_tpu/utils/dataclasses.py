@@ -295,13 +295,23 @@ class FleetKwargs(KwargsHandler):
     trips ``fleet.should_resize`` and ``fleet.resize()`` drains → re-meshes
     at the surviving topology → reshards ZeRO-1 masters/moments (and
     compression residuals) from the spec-carrying checkpoint → prewarms the
-    new-topology programs from the AOT cache.  ``min_dp`` refuses resizes
-    below that dp extent.  ``aggregate_every_n`` (dispatches; 0 = off)
-    graduates ``telemetry.aggregate_fleet()`` to periodic mid-run skew/
-    straggler records — the autoscaler/resize signal.  ``checkpoint_dir``
-    is the default drain target for resize; ``fault_plan`` wires the
-    test-only injector (``$ACCELERATE_FAULT_PLAN``; only the ``host_lost``
-    verb is consumed here — the rest belong to resilience).
+    new-topology programs from the AOT cache; a returned host
+    (``host_gained``) trips ``fleet.should_grow`` and ``fleet.grow()``
+    re-meshes dp *up* through the grow rendezvous.  ``min_dp`` refuses
+    resizes below that dp extent.  ``aggregate_every_n`` (dispatches;
+    0 = off) graduates ``telemetry.aggregate_fleet()`` to periodic mid-run
+    skew/straggler records — the autoscaler/resize signal.  ``autopilot``
+    arms the signal-driven autoscaler (docs/elastic.md §autopilot):
+    ``True``/``"on"`` for the default policy, a ``"key=value,..."`` spec
+    string (``"skew_pct=150,window=4,hysteresis=0.2,cooldown=8"``), a dict
+    of the same knobs, or a ready ``fleet.AutopilotPolicy``; resolves from
+    ``$ACCELERATE_FLEET_AUTOPILOT`` when left ``None`` (default off) —
+    explicit kwargs beat the env, and BAD VALUES RAISE HERE, at
+    construction, never at the first fire.  ``checkpoint_dir`` is the
+    default drain target for resize; ``fault_plan`` wires the test-only
+    injector (``$ACCELERATE_FAULT_PLAN``; the ``host_lost`` /
+    ``host_gained`` / ``signal_storm`` verbs are consumed here — the rest
+    belong to resilience).
     """
 
     enabled: Optional[bool] = None  # None → $ACCELERATE_FLEET, default off
@@ -309,6 +319,7 @@ class FleetKwargs(KwargsHandler):
     elastic: bool = True
     min_dp: int = 1  # $ACCELERATE_FLEET_MIN_DP
     aggregate_every_n: int = 0  # $ACCELERATE_FLEET_AGGREGATE_N
+    autopilot: Optional[object] = None  # None → $ACCELERATE_FLEET_AUTOPILOT, off
     checkpoint_dir: Optional[str] = None  # $ACCELERATE_FLEET_CHECKPOINT_DIR
     fault_plan: Optional[str] = None  # $ACCELERATE_FAULT_PLAN (test-only)
 
@@ -321,6 +332,13 @@ class FleetKwargs(KwargsHandler):
             self.min_dp = int(env["ACCELERATE_FLEET_MIN_DP"])
         if "ACCELERATE_FLEET_AGGREGATE_N" in env:
             self.aggregate_every_n = int(env["ACCELERATE_FLEET_AGGREGATE_N"])
+        if self.autopilot is None:
+            self.autopilot = env.get("ACCELERATE_FLEET_AUTOPILOT")
+        # resolve (and VALIDATE) the policy now: a bad threshold must raise
+        # at Accelerator construction, not at the autopilot's first fire
+        from ..fleet.autopilot import AutopilotPolicy
+
+        self.autopilot_policy = AutopilotPolicy.resolve(self.autopilot)
         if self.checkpoint_dir is None:
             self.checkpoint_dir = env.get("ACCELERATE_FLEET_CHECKPOINT_DIR")
         if self.fault_plan is None:

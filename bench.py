@@ -606,11 +606,19 @@ def _elastic_block(on_accel: bool) -> dict:
     prewarmed for the surviving topology, the post-resize first-step wall
     clock (the recovery-time number an autoscaler plans around) and the
     resumed-step relative loss error vs continuing at full dp.
+    After the resumed step the lost half "returns" and ``fleet.grow()``
+    re-meshes back to full dp (docs/elastic.md §grow) — the grow-side
+    recovery row: ``elastic_grow_ms`` (drain + rendezvous + remesh +
+    reshard restore) and ``elastic_post_grow_step_ms``.  No cold/warm
+    split for the grow direction: a grow-back is warm BY CONSTRUCTION —
+    the run compiled and stored its own full-dp program before the loss,
+    so the prewarm always serves it (the split would compare the store
+    against itself).
     Run TWICE against one AOT store: the cold pass compiles the dp/2
     program at resize time, the warm pass recovers off the prewarmed
-    serialized executable — the cold/warm post-resize split is the
-    with/without-store recovery story.  ``BENCH_ELASTIC=0`` disables the
-    block."""
+    serialized executable — the cold/warm post-SHRINK split is the
+    with/without-store recovery story.
+    ``BENCH_ELASTIC=0`` disables the block."""
     import tempfile
     import time as _time
 
@@ -664,7 +672,7 @@ def _elastic_block(on_accel: bool) -> dict:
         rng = np.random.default_rng(0)
         raw = [
             rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
-            for _ in range(3)
+            for _ in range(4)
         ]
         return acc, acc.compile_step(step_fn), raw
 
@@ -680,7 +688,18 @@ def _elastic_block(on_accel: bool) -> dict:
         t2 = _time.perf_counter()
         resumed = float(step(batch_to_global_array(raw[2], mesh=acc.mesh)))
         t3 = _time.perf_counter()
-        return dp, info, resumed, (t1 - t0, t2 - t1, t3 - t2)
+        # grow-side recovery: the lost half returns, the fleet re-meshes
+        # back to full dp (drain + rendezvous + remesh + reshard restore)
+        ginfo = acc.fleet.grow(
+            acc, target_dp=dp, output_dir=os.path.join(tmp, "drain_grow")
+        )
+        t4 = _time.perf_counter()
+        regrown = float(step(batch_to_global_array(raw[3], mesh=acc.mesh)))
+        t5 = _time.perf_counter()
+        return (
+            dp, info, resumed, (t1 - t0, t2 - t1, t3 - t2),
+            ginfo, regrown, (t4 - t3, t5 - t4),
+        )
 
     try:
         # reference: full-dp run over the same batches
@@ -688,8 +707,8 @@ def _elastic_block(on_accel: bool) -> dict:
         ref = [
             float(step(batch_to_global_array(b, mesh=acc.mesh))) for b in raw
         ]
-        dp, _, _, cold = rehearse()
-        _, info, resumed, warm = rehearse()
+        dp, _, _, cold, _, _, _ = rehearse()
+        _, info, resumed, warm, ginfo, regrown, warm_grow = rehearse()
         return {
             "elastic_dp": f"{dp}->{dp // 2}",
             "elastic_drain_ms": round(warm[0] * 1e3, 1),
@@ -699,6 +718,15 @@ def _elastic_block(on_accel: bool) -> dict:
             "elastic_post_resize_step_ms_warm": round(warm[2] * 1e3, 1),
             "elastic_resume_loss_rel_err": (
                 round(abs(resumed - ref[2]) / max(abs(ref[2]), 1e-9), 8)
+            ),
+            "elastic_grow_dp": f"{dp // 2}->{dp}",
+            "elastic_grow_ms": round(warm_grow[0] * 1e3, 1),
+            "elastic_grow_prewarm_entries": ginfo["aot_prewarmed"],
+            # warm-by-construction: the run stored its own full-dp program
+            # before the loss, so there is no honest "cold" grow-back arm
+            "elastic_post_grow_step_ms": round(warm_grow[1] * 1e3, 1),
+            "elastic_regrow_loss_rel_err": (
+                round(abs(regrown - ref[3]) / max(abs(ref[3]), 1e-9), 8)
             ),
         }
     finally:

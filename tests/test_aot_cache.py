@@ -445,3 +445,123 @@ def test_metrics_provider_and_report_section(tmp_path):
     assert validate(records, min_steps=1) == []
     assert any(r.get("kind") == "aot_cache" for r in records)
     assert "aot executable cache" in render(records)
+
+
+# ---------------------------------------------------------------------------
+# scope-map persistence: warm processes keep the per-phase device split
+# ---------------------------------------------------------------------------
+
+_SCOPE_MAP_CHILD = '''
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the suite's persistent XLA compilation cache (tests/conftest.py) strips
+# HLO metadata from deserialized programs — the very failure mode this
+# feature exists to survive, but here it would ALSO blank the cold child's
+# store-side parse, so the children run without it
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+sys.path.insert(0, "@REPO@")
+cache_dir, out_path = sys.argv[1], sys.argv[2]
+
+import numpy as np
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator, CompilationCacheKwargs, TelemetryKwargs
+from accelerate_tpu.data_loader import batch_to_global_array
+from accelerate_tpu.nn import Tensor
+
+nn.manual_seed(0)
+acc = Accelerator(
+    kwargs_handlers=[
+        TelemetryKwargs(enabled=True, profile_every_n=1),
+        CompilationCacheKwargs(cache_dir=cache_dir),
+    ]
+)
+model = nn.Linear(16, 8)
+opt = optim.AdamW(model.parameters(), lr=1e-2)
+model, opt = acc.prepare(model, opt)
+
+
+def step_fn(x):
+    opt.zero_grad()
+    loss = model(Tensor(x)).sum()
+    acc.backward(loss)
+    opt.step()
+    return loss
+
+
+step = acc.compile_step(step_fn)
+rng = np.random.default_rng(0)
+x = batch_to_global_array(
+    np.asarray(rng.normal(size=(8, 16)), np.float32), mesh=acc.mesh
+)
+for _ in range(2):
+    float(step(x))
+first = acc.telemetry.timeline.records()[0]
+result = {
+    "first_trace_ms": first.trace_ms,
+    "first_compile_ms": first.compile_ms,
+    "hits": acc.aot_cache.hits,
+    "stores": acc.aot_cache.stores,
+    "phases_per_sample": [
+        sorted(r.phases) for r in acc.telemetry.device_records
+    ],
+}
+with open(out_path, "w") as f:
+    json.dump(result, f)
+'''
+
+
+@pytest.mark.slow
+def test_scope_map_persists_across_processes(tmp_path):
+    """ROADMAP carried item: programs deserialized from the AOT store carry
+    no HLO metadata, so a warm process used to sample EMPTY ``phases`` —
+    the op→scope map is now persisted beside the executable and restored on
+    load.  Two real subprocesses (like ``make cache-smoke``): the cold one
+    compiles/stores with every step profiled, the warm one deserializes
+    (zero trace/compile) and its samples must STILL split by atpu phase."""
+    import subprocess
+
+    child = tmp_path / "child.py"
+    child.write_text(_SCOPE_MAP_CHILD.replace("@REPO@", REPO))
+    cache_dir = str(tmp_path / "aot")
+
+    def run(label):
+        out = str(tmp_path / f"{label}.json")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, str(child), cache_dir, out],
+            env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+        )
+        assert proc.returncode == 0, (
+            f"{label} child failed\n{proc.stdout[-2000:]}\n{proc.stderr[-4000:]}"
+        )
+        with open(out, encoding="utf-8") as f:
+            return json.load(f)
+
+    cold = run("cold")
+    assert cold["stores"] >= 1 and cold["first_compile_ms"] > 0
+    # the cold process compiled in-process: its samples carry phases from
+    # the live HLO parse — the baseline the warm process must match
+    assert cold["phases_per_sample"], "cold run sampled nothing"
+    assert any(
+        any(p.startswith("atpu") for p in phases)
+        for phases in cold["phases_per_sample"]
+    ), cold["phases_per_sample"]
+
+    warm = run("warm")
+    assert warm["hits"] >= 1
+    assert warm["first_trace_ms"] == 0.0 and warm["first_compile_ms"] == 0.0, (
+        "warm child recompiled — the store did not serve the program"
+    )
+    # THE pin: a metadata-less deserialized program still splits by phase,
+    # because the stored scope map was restored into the telemetry hub
+    assert warm["phases_per_sample"], "warm run sampled nothing"
+    assert any(
+        any(p.startswith("atpu") for p in phases)
+        for phases in warm["phases_per_sample"]
+    ), f"warm samples lost the per-phase split: {warm['phases_per_sample']}"

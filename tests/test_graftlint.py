@@ -1226,6 +1226,77 @@ def test_instance_method_dispatch_same_module(tmp_path):
     assert res.new_findings[0].symbol == "Runner.work"
 
 
+INSTANCE_DISPATCH_REBOUND_SAME_BAD = {
+    "impl.py": """
+        class Runner:
+            def __init__(self, opts=None):
+                self.opts = opts
+
+            def work(self, x):
+                return x.item()        # host sync, reached via r.work(x)
+        """,
+    "ops.py": """
+        import jax
+        from .impl import Runner
+
+        @jax.jit
+        def step(x, fast):
+            if fast:
+                r = Runner()
+            else:
+                r = Runner({"slow": True})   # rebound — SAME class
+            return r.work(x)
+        """,
+}
+
+INSTANCE_DISPATCH_REBOUND_MIXED_GOOD = {
+    "impl.py": """
+        class Runner:
+            def work(self, x):
+                return x.item()
+        """,
+    "ops.py": """
+        import jax
+        from .impl import Runner
+
+        class Other:
+            def work(self, x):
+                return x + 1
+
+        @jax.jit
+        def step(x, fast):
+            if fast:
+                r = Runner()
+            else:
+                r = Other()            # rebound to a DIFFERENT class
+            return r.work(x)
+        """,
+}
+
+
+def test_instance_dispatch_joins_over_branches_same_class(tmp_path):
+    """ANALYSIS_VERSION 9 fixture (ROADMAP carried item): a receiver
+    rebound across branches to the SAME class is still that class — the
+    join of identical types — so `r.work(x)` links to Runner.work and the
+    traced host sync fires."""
+    res = lint_pkg(
+        tmp_path, INSTANCE_DISPATCH_REBOUND_SAME_BAD, rule="host-sync-in-trace"
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    f = res.new_findings[0]
+    assert f.path.endswith("impl.py") and f.symbol == "Runner.work"
+
+
+def test_instance_dispatch_rebound_different_classes_silent(tmp_path):
+    """The good twin: branches binding DIFFERENT classes have no single
+    join type — the edge must NOT be created (a wrong guess would
+    cross-wire reachability into whichever class happened to list first)."""
+    res = lint_pkg(
+        tmp_path, INSTANCE_DISPATCH_REBOUND_MIXED_GOOD, rule="host-sync-in-trace"
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
 def test_partial_callback_crosses_module_boundary(tmp_path):
     """A partial(...)-wrapped callback handed to lax.scan in another module
     is a trace root there."""

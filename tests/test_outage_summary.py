@@ -161,3 +161,90 @@ def test_cli_bench_json_join(tmp_path):
     (joined,) = payload["bench_join"]
     assert joined["in_down_window"] is True
     assert joined["down_window"] == {"start": 2800, "end": 3400, "seconds": 600}
+
+
+# -------------------------------------------------- --telemetry-jsonl join
+from outage_summary import join_autopilot, load_autopilot_records  # noqa: E402
+
+
+def _write_telemetry(tmp_path, records, name="run.jsonl"):
+    path = tmp_path / name
+    lines = [json.dumps(r) for r in records]
+    lines.insert(1, "not json at all")  # the dump interleaves; must be skipped
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+_DECISIONS = [
+    {"kind": "meta", "schema_version": 1},
+    {"kind": "step", "step": 0, "total_ms": 5.0},
+    # inside DOWN window 1 (1600-2500): a fired shrink
+    {"kind": "autopilot", "ts": 2000, "signal": "host_lost", "action": "shrink",
+     "fired": True, "suppressed": False,
+     "resize": {"old_dp": 4, "dp": 2, "direction": "shrink"}},
+    # inside DOWN window 2 (2800-3400): a suppressed flap
+    {"kind": "autopilot", "ts": 3000, "signal": "skew_pct", "action": "shrink",
+     "fired": False, "suppressed": True,
+     "reason": "debounce: held 1/3 samples"},
+    # outside every window
+    {"kind": "autopilot", "ts": 1100, "signal": "host_gained", "action": "grow",
+     "fired": True, "suppressed": False,
+     "resize": {"old_dp": 2, "dp": 4, "direction": "grow"}},
+    # no timestamp: counted but unjoinable
+    {"kind": "autopilot", "signal": "queue_depth", "action": "grow",
+     "fired": False, "suppressed": True},
+]
+
+
+def test_load_autopilot_records_filters_kind_and_bad_lines(tmp_path):
+    path = _write_telemetry(tmp_path, _DECISIONS)
+    records = load_autopilot_records(path)
+    assert len(records) == 4
+    assert all(r["kind"] == "autopilot" for r in records)
+
+
+def test_join_autopilot_attributes_decisions_to_down_windows(tmp_path):
+    """ISSUE satellite: the post-mortem join — what the autopilot did
+    during each outage window, with fired/suppressed tallies and the dp
+    move, plus honest counts for unjoinable records."""
+    windows = down_windows(parse_log(_write(tmp_path)))
+    records = load_autopilot_records(_write_telemetry(tmp_path, _DECISIONS))
+    joined = join_autopilot("run.jsonl", records, windows)
+    assert joined["decisions_total"] == 4
+    assert joined["decisions_no_ts"] == 1
+    assert joined["decisions_outside_windows"] == 1
+    w1, w2 = joined["windows"]
+    assert w1["window"]["start"] == 1600
+    assert w1["fired"] == 1 and w1["suppressed"] == 0
+    (d1,) = w1["decisions"]
+    assert d1["action"] == "shrink" and d1["signal"] == "host_lost"
+    assert d1["resize"] == {"old_dp": 4, "dp": 2, "direction": "shrink"}
+    assert w2["fired"] == 0 and w2["suppressed"] == 1
+    (d2,) = w2["decisions"]
+    assert d2["reason"].startswith("debounce")
+
+
+def test_cli_telemetry_jsonl_join(tmp_path):
+    log = _write(tmp_path)
+    jsonl = _write_telemetry(tmp_path, _DECISIONS)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "outage_summary.py"),
+         "--json", log, "--telemetry-jsonl", jsonl],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    (joined,) = payload["autopilot_join"]
+    assert joined["decisions_total"] == 4
+    assert [w["fired"] for w in joined["windows"]] == [1, 0]
+    # human rendering names the dp move and the suppression reason
+    human = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "outage_summary.py"),
+         log, "--telemetry-jsonl", jsonl],
+        capture_output=True,
+        text=True,
+    )
+    assert human.returncode == 0, human.stderr
+    assert "shrink(host_lost) fired dp 4->2" in human.stdout
+    assert "suppressed" in human.stdout and "debounce" in human.stdout

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""autopilot_smoke — `make autopilot-smoke`: prove the CLOSED elastic loop
+end-to-end on 4 virtual CPU devices in seconds (docs/elastic.md §autopilot).
+
+Tiny GPT at dp=4 with the fleet armed AND the autopilot driving — the
+training loop below does NO polling: no ``should_resize`` read, no
+``resize()`` call, it just steps batches.  The fault plan injects a
+``host_lost`` before step 2's dispatch and a ``host_gained`` before step
+4's; the autopilot alone drives dp 4→2→4 from the captured-step dispatch
+path (drain → re-mesh → reshard → AOT prewarm each way), with every
+decision landing as a ``kind="autopilot"`` record.  The scenario runs
+TWICE against one AOT store: the warm pass's post-resize first step in
+EACH direction must deserialize a stored program (zero trace/compile phase
+time on every build).  A third leg injects a ``signal_storm`` flapping the
+skew signal across the threshold: the debounce/hysteresis window must
+suppress it — decision records present, exactly zero resizes.
+
+Exit 0 = autopilot shrank and grew back unattended, losses within the
+documented rtol of an uninterrupted dp=4 run both passes, zero
+trace/compile on the warm pass's builds, and the storm suppressed.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STEPS = 6
+HOST_LOST_AT = 2
+HOST_GAINED_AT = 4
+LOSS_RTOL = 1e-3  # documented resize tolerance: the dp reduce order moves
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import (
+        Accelerator,
+        CompilationCacheKwargs,
+        FleetKwargs,
+        TelemetryKwargs,
+    )
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    errors: list[str] = []
+    tmp = tempfile.mkdtemp(prefix="atpu_autopilot_")
+    cache_dir = os.path.join(tmp, "aot")
+
+    def build(plan=None, autopilot=None):
+        Accelerator._reset_state()
+        jax.clear_caches()
+        nn.manual_seed(0)
+        handlers = [TelemetryKwargs(enabled=True)]
+        if plan is not None or autopilot is not None:
+            handlers += [
+                FleetKwargs(
+                    enabled=True,
+                    autopilot=autopilot,
+                    fault_plan=plan,
+                    checkpoint_dir=os.path.join(tmp, "drain"),
+                ),
+                CompilationCacheKwargs(cache_dir=cache_dir),
+            ]
+        acc = Accelerator(kwargs_handlers=handlers)
+        model = GPTLMHeadModel(
+            GPTConfig(vocab_size=256, n_positions=64, n_embd=32, n_layer=1, n_head=2)
+        )
+        opt = optim.AdamW(model.parameters(), lr=1e-3)
+        model, opt = acc.prepare(model, opt)
+
+        def step_fn(ids):
+            opt.zero_grad()
+            out = model(ids, labels=ids)
+            acc.backward(out["loss"])
+            opt.step()
+            return out["loss"]
+
+        rng = np.random.default_rng(0)
+        raw = [rng.integers(0, 256, (8, 32), dtype=np.int32) for _ in range(STEPS)]
+        return acc, acc.compile_step(step_fn), raw
+
+    def run_autopilot(tag):
+        acc, step, raw = build(
+            plan=f"host_lost:step={HOST_LOST_AT};host_gained:step={HOST_GAINED_AT}",
+            autopilot=True,
+        )
+        dp0 = dict(acc.mesh.shape)["dp"]
+        if dp0 != 4:
+            errors.append(f"{tag}: expected dp=4 start, got {dict(acc.mesh.shape)}")
+        # THE loop under test: no fleet polling, no resize call — the batch
+        # is placed on the LIVE mesh each iteration and that is all the
+        # caller contributes to elasticity
+        losses = [
+            float(step(batch_to_global_array(b, mesh=acc.mesh))) for b in raw
+        ]
+        if acc.fleet.resizes_total != 1 or acc.fleet.grows_total != 1:
+            errors.append(
+                f"{tag}: expected exactly 1 shrink + 1 grow, got "
+                f"{acc.fleet.resizes_total} resizes / {acc.fleet.grows_total} grows"
+            )
+        if dict(acc.mesh.shape)["dp"] != dp0:
+            errors.append(
+                f"{tag}: fleet did not grow back to dp={dp0}: "
+                f"{dict(acc.mesh.shape)}"
+            )
+        decisions = [e for e in acc.fleet.events if e.get("kind") == "autopilot"]
+        fired = [(d["signal"], d["action"]) for d in decisions if d.get("fired")]
+        if fired != [("host_lost", "shrink"), ("host_gained", "grow")]:
+            errors.append(f"{tag}: unexpected fired decisions: {fired}")
+        events = [e["event"] for e in acc.fleet.events]
+        for expected in ("host_lost", "host_gained", "grow_rendezvous"):
+            if expected not in events:
+                errors.append(f"{tag}: missing fleet event {expected}: {events}")
+        return losses, acc
+
+    # uninterrupted dp=4 reference over the same batches
+    acc_ref, step, raw = build()
+    reference = [
+        float(step(batch_to_global_array(b, mesh=acc_ref.mesh))) for b in raw
+    ]
+
+    # pass 1 (cold store): the shrink compiles+stores the dp=2 program; the
+    # initial steps store the dp=4 one
+    losses1, acc1 = run_autopilot("cold")
+    if acc1.aot_cache.stores < 1:
+        errors.append(f"cold: no AOT stores recorded ({acc1.aot_cache.stores})")
+
+    # pass 2 (warm store): EVERY build — the first step, the post-shrink
+    # step, the post-grow step — must deserialize (zero trace/compile)
+    losses2, acc2 = run_autopilot("warm")
+    built = [r for r in acc2.telemetry.timeline.records() if r.built]
+    if len(built) < 3:
+        errors.append(f"warm: expected >= 3 builds (start/shrink/grow), got {len(built)}")
+    for record in built:
+        if record.trace_ms != 0.0 or record.compile_ms != 0.0:
+            errors.append(
+                f"warm: build at step {record.step} recompiled "
+                f"(trace={record.trace_ms}ms compile={record.compile_ms}ms) — "
+                "a post-resize program was not served from the store"
+            )
+    hits = sum(1 for e in acc2.telemetry.aot_cache_events if e["event"] == "hit")
+    if hits < 3:
+        errors.append(f"warm: expected >= 3 aot_cache hits, got {hits}")
+
+    for tag, losses in (("cold", losses1), ("warm", losses2)):
+        if len(losses) == len(reference) and not np.allclose(
+            losses, reference, rtol=LOSS_RTOL
+        ):
+            errors.append(
+                f"{tag}: losses diverged beyond rtol={LOSS_RTOL}: "
+                f"{losses} vs {reference}"
+            )
+
+    # storm leg: a flapping skew signal must be SUPPRESSED by the
+    # debounce/hysteresis window — records written, zero resizes
+    acc3, step, raw = build(plan="signal_storm:step=1,times=8", autopilot=True)
+    for b in raw:
+        float(step(batch_to_global_array(b, mesh=acc3.mesh)))
+    if acc3.fleet.resizes_total != 0 or acc3.fleet.grows_total != 0:
+        errors.append(
+            f"storm: the flapping signal resized the fleet "
+            f"({acc3.fleet.resizes_total} resizes / {acc3.fleet.grows_total} grows)"
+        )
+    suppressed = [
+        e
+        for e in acc3.fleet.events
+        if e.get("kind") == "autopilot" and e.get("suppressed")
+    ]
+    if len(suppressed) < 2:
+        errors.append(
+            f"storm: expected suppressed decision records, got {len(suppressed)}"
+        )
+
+    for error in errors:
+        print(f"autopilot-smoke: FAIL: {error}", file=sys.stderr)
+    if errors:
+        return 1
+    print(
+        "autopilot-smoke: ok — autopilot alone drove dp 4→2 (host_lost at "
+        f"step {HOST_LOST_AT}) and 2→4 (host_gained at step {HOST_GAINED_AT}), "
+        f"losses within rtol={LOSS_RTOL} of the uninterrupted run both "
+        f"passes; warm pass served every build from the AOT store ({hits} "
+        f"hits, zero trace/compile); signal storm suppressed "
+        f"({len(suppressed)} records, zero resizes)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,14 @@ failure inside a DOWN window the watcher independently observed?  Accepts
 both raw bench output and the driver-wrapped ``{"parsed": {...}}`` form;
 the time join needs the ``init_ts`` key (emitted since the library init
 path landed) — older artifacts without it report the overlap as unknown.
+
+``--telemetry-jsonl`` joins the logs' DOWN windows against a telemetry
+JSONL dump's ``kind="autopilot"`` decision records (docs/elastic.md
+§autopilot): a post-mortem then shows what the autopilot DID during each
+outage — which signal fired, whether it resized or suppressed, and the
+dp move — instead of reconstructing it from scattered logs.  Decisions
+carry a wall-clock ``ts``; records without one are counted but cannot be
+joined.
 """
 
 from __future__ import annotations
@@ -140,6 +148,110 @@ def join_bench(path: str, diag: dict, windows: list[dict]) -> dict:
     return out
 
 
+def load_autopilot_records(path: str) -> list[dict]:
+    """``kind="autopilot"`` decision records out of a telemetry JSONL dump;
+    unparseable lines are skipped (the dump interleaves every record
+    kind)."""
+    records: list[dict] = []
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(record, dict) and record.get("kind") == "autopilot":
+                records.append(record)
+    return records
+
+
+def _decision_summary(record: dict) -> dict:
+    out = {
+        "ts": record.get("ts"),
+        "signal": record.get("signal"),
+        "action": record.get("action"),
+        "fired": bool(record.get("fired")),
+        "suppressed": bool(record.get("suppressed")),
+    }
+    if record.get("reason"):
+        out["reason"] = record["reason"]
+    resize = record.get("resize")
+    if isinstance(resize, dict):
+        out["resize"] = {
+            k: resize.get(k) for k in ("old_dp", "dp", "direction")
+        }
+    return out
+
+
+def join_autopilot(path: str, records: list[dict], windows: list[dict]) -> dict:
+    """What the autopilot did during each observed DOWN window — decisions
+    whose ``ts`` falls inside the window, plus totals for decisions outside
+    every window and records carrying no timestamp."""
+    timed = [r for r in records if isinstance(r.get("ts"), (int, float))]
+    per_window = []
+    joined_ids = set()
+    for window in windows:
+        inside = [
+            r for r in timed if window["start"] <= r["ts"] <= window["end"]
+        ]
+        joined_ids.update(id(r) for r in inside)
+        per_window.append(
+            {
+                "window": window,
+                "decisions": [_decision_summary(r) for r in inside],
+                "fired": sum(1 for r in inside if r.get("fired")),
+                "suppressed": sum(1 for r in inside if r.get("suppressed")),
+            }
+        )
+    return {
+        "telemetry": path,
+        "decisions_total": len(records),
+        "decisions_no_ts": len(records) - len(timed),
+        "decisions_outside_windows": sum(
+            1 for r in timed if id(r) not in joined_ids
+        ),
+        "windows": per_window,
+    }
+
+
+def render_autopilot_join(joined: dict) -> str:
+    lines = [
+        f"{joined['telemetry']}: {joined['decisions_total']} autopilot "
+        f"decision(s) ({joined['decisions_outside_windows']} outside DOWN "
+        "windows"
+        + (
+            f", {joined['decisions_no_ts']} without ts"
+            if joined["decisions_no_ts"]
+            else ""
+        )
+        + ")"
+    ]
+    for entry in joined["windows"]:
+        w = entry["window"]
+        lines.append(
+            f"  DOWN {_utc(w['start'])} → {_utc(w['end'])} "
+            f"({_hms(w['seconds'])}): {len(entry['decisions'])} decision(s), "
+            f"{entry['fired']} fired, {entry['suppressed']} suppressed"
+        )
+        for d in entry["decisions"]:
+            offset = (
+                f"+{int(d['ts'] - w['start'])}s" if d.get("ts") is not None else "?"
+            )
+            verdict = "fired" if d["fired"] else (
+                "suppressed" if d["suppressed"] else "quiet"
+            )
+            detail = f"    {offset} {d.get('action')}({d.get('signal')}) {verdict}"
+            resize = d.get("resize")
+            if resize and resize.get("old_dp") is not None:
+                detail += f" dp {resize['old_dp']}->{resize['dp']}"
+            if d.get("reason"):
+                detail += f" ({d['reason']})"
+            lines.append(detail)
+    return "\n".join(lines)
+
+
 def render_bench_join(joined: dict) -> str:
     label = "init failed" if joined["init_failed"] else "init ok"
     detail = (
@@ -203,6 +315,14 @@ def main(argv=None) -> int:
         metavar="BENCH",
         help="BENCH_r*.json artifacts to join against the logs' DOWN windows",
     )
+    parser.add_argument(
+        "--telemetry-jsonl",
+        nargs="+",
+        default=[],
+        metavar="JSONL",
+        help="telemetry JSONL dumps whose kind=\"autopilot\" decision "
+        "records are joined against the logs' DOWN windows",
+    )
     args = parser.parse_args(argv)
 
     summaries = {}
@@ -231,16 +351,32 @@ def main(argv=None) -> int:
             continue
         bench_joins.append(join_bench(path, diag, all_windows))
 
+    autopilot_joins: list[dict] = []
+    for path in args.telemetry_jsonl:
+        try:
+            records = load_autopilot_records(path)
+        except OSError as e:
+            print(
+                f"outage_summary: cannot read telemetry {path}: {e}",
+                file=sys.stderr,
+            )
+            continue
+        autopilot_joins.append(join_autopilot(path, records, all_windows))
+
     if args.json:
         payload: dict = dict(summaries)
         if bench_joins:
             payload["bench_join"] = bench_joins
+        if autopilot_joins:
+            payload["autopilot_join"] = autopilot_joins
         print(json.dumps(payload, indent=2))
     else:
         for path, s in summaries.items():
             print(render(path, s))
         for joined in bench_joins:
             print(render_bench_join(joined))
+        for joined in autopilot_joins:
+            print(render_autopilot_join(joined))
     return 0
 
 
