@@ -113,6 +113,92 @@ class FunctionInfo:
     barrier: bool = False
 
 
+def factory_returned_classes(tree: ast.AST) -> dict[str, str]:
+    """``{factory function name: constructed class name}`` for every
+    MODULE-LEVEL function whose returns are ALL ``SomeClass(...)`` calls of
+    the SAME constructor — the receiver-type source behind factory-return
+    dispatch inference (``obj = make_runner(); obj.work(x)`` →
+    ``Runner.work``).
+
+    Deliberately strict, mirroring the join-over-branches rule for direct
+    constructor rebinds: one ``return`` of anything else (a bare value, a
+    different constructor, ``self``/``cls``/parameter-rooted calls), or no
+    return at all, leaves the function out — and two same-named functions
+    that disagree on the class knock the name out entirely (the caller
+    resolves factories by bare name, and a wrong guess would cross-wire
+    reachability).  Only top-level defs qualify: a METHOD's bare name is
+    never callable as ``name()``, and a nested def's name is only live
+    inside its enclosing function — mapping either through a module-global
+    table would wire edges for unrelated same-named callables (e.g. an
+    injected callback parameter).  Async defs are excluded too: a bare
+    call of an async factory binds a COROUTINE, not the constructed class
+    (and the awaited form is an ``ast.Await``, which never consults the
+    map anyway).  Decorated defs are excluded: the wrapper decides what a
+    call returns (a future, a memo proxy), not the body's ``return``.
+    And a name REBOUND at module level — a later same-named def that does
+    not itself qualify with the same class, or any plain assignment — is
+    knocked out entirely: the live binding is whatever ran last, and a
+    stale mapping would be wrong, not conservative.  Single-level only: a
+    factory delegating to another factory records the inner factory's
+    NAME, which then fails class resolution downstream — silent, never
+    wrong."""
+    factories: dict[str, str] = {}
+    knocked_out: set[str] = set()
+    for node in getattr(tree, "body", []):
+        name = None
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            name = node.name
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            # module-level rebind of the name shadows any earlier def
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    knocked_out.add(t.id)
+            continue
+        if name is None:
+            continue
+        qualifies = False
+        ctor = None
+        if isinstance(node, ast.FunctionDef) and not node.decorator_list:
+            params = {
+                a.arg for a in ast.walk(node.args) if isinstance(a, ast.arg)
+            }
+            returns = [
+                sub for sub in iter_own_nodes(node)
+                if isinstance(sub, ast.Return)
+            ]
+            ctors: set[str] = set()
+            for ret in returns:
+                c = None
+                if isinstance(ret.value, ast.Call):
+                    fn = ret.value.func
+                    c = fn.id if isinstance(fn, ast.Name) else dotted_name(fn)
+                if (
+                    c is None
+                    or c.split(".", 1)[0] in ("self", "cls")
+                    or c.split(".", 1)[0] in params
+                ):
+                    ctors.clear()
+                    break
+                ctors.add(c)
+            if len(ctors) == 1:
+                qualifies = True
+                ctor = ctors.pop()
+        if not qualifies:
+            # a non-factory def AFTER a qualifying one is the live binding
+            # — the stale mapping must go.  (A non-factory def BEFORE a
+            # qualifying one is simply shadowed by it: keep the later.)
+            if name in factories:
+                knocked_out.add(name)
+            continue
+        if factories.setdefault(name, ctor) != ctor:
+            knocked_out.add(name)
+    for name in knocked_out:
+        factories.pop(name, None)
+    return factories
+
+
 def _is_singleton_init(fn_node: ast.AST) -> bool:
     for sub in iter_own_nodes(fn_node):
         if isinstance(sub, ast.Assign):
@@ -128,9 +214,13 @@ def _is_singleton_init(fn_node: ast.AST) -> bool:
 
 
 class _Collector(ast.NodeVisitor):
-    def __init__(self):
+    def __init__(self, factories: Optional[dict[str, str]] = None):
         self.stack: list[str] = []
         self.functions: list[FunctionInfo] = []
+        # same-module factory functions (factory_returned_classes): a
+        # receiver bound from `make_runner()` dispatches as the class every
+        # return of make_runner constructs
+        self.factories: dict[str, str] = factories or {}
         # qualnames of actual ClassDefs: instance-dispatch edges resolve
         # only through these — a factory FUNCTION with a nested def also
         # owns `outer.inner` qualnames, and treating it as a class would
@@ -173,6 +263,21 @@ class _Collector(ast.NodeVisitor):
                 fn = sub.value.func
                 ctor = fn.id if isinstance(fn, ast.Name) else dotted_name(fn)
                 if ctor and ctor.split(".", 1)[0] not in ("self", "cls"):
+                    # factory-return inference (v10): a bare-name call of a
+                    # same-module factory binds the CLASS the factory
+                    # constructs, so it joins over branches with direct
+                    # constructor binds (`r = Runner() if fast else
+                    # make_runner()` is still Runner).  A locally-bound
+                    # name (parameter, assignment) is DATA shadowing the
+                    # module function — any callable could be injected, so
+                    # the factory map must not apply (same guard the plain
+                    # call edges use)
+                    if (
+                        isinstance(fn, ast.Name)
+                        and ctor in self.factories
+                        and ctor not in local_data
+                    ):
+                        ctor = self.factories[ctor]
                     ctor_assigns.setdefault(target, []).append(ctor)
         ctor_of: dict[str, str] = {}
         for target, ctors in ctor_assigns.items():
@@ -231,7 +336,7 @@ class _Collector(ast.NodeVisitor):
 class CallGraph:
     def __init__(self, module):
         self.module = module
-        collector = _Collector()
+        collector = _Collector(factories=factory_returned_classes(module.tree))
         collector.visit(module.tree)
         self.functions: dict[str, FunctionInfo] = {
             f.qualname: f for f in collector.functions

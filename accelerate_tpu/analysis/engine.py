@@ -51,7 +51,16 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 # across branches to the SAME class (`obj = Cls() if fast else Cls(opts)`)
 # now links `obj.method` to Cls.method; receivers rebound to different
 # classes (or to non-constructor values) stay uninferred.
-ANALYSIS_VERSION = "9"
+# v10: instance-dispatch inference through factory returns — a receiver
+# bound from a same-module TOP-LEVEL function whose returns are ALL
+# `SomeClass(...)` constructors of one class (`obj = make_runner();
+# obj.work(x)`) resolves to SomeClass.work, joining over branches with
+# direct constructor binds.  Mixed-class or non-constructor returns,
+# same-named factories that disagree, methods/nested defs (bare name not
+# module-callable), and locally-shadowed names (an injected callable
+# parameter is DATA, not the module factory) all leave the receiver
+# uninferred.
+ANALYSIS_VERSION = "10"
 
 # Names that mark a branch/function as profiling/benchmark plumbing, where a
 # deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the

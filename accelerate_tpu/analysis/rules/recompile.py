@@ -17,7 +17,7 @@ the loader shows no ``PaddingCollate`` / ``TPU_PAD_MULTIPLE`` / bucketing
 evidence (a ``collate_fn=`` or a pad/bucket-named helper counts).
 
 The serving variant (docs/serving.md): the captured serving/decode entries
-(``serving/engine.py``'s ``run_prefill``/``run_decode``) pin one program
+(``serving/engine.py``'s ``run_prefill``/``run_decode_n``) pin one program
 per bucketed geometry — an argument built straight from ``len(prompt)`` /
 ``.shape`` with no bucket/pad evidence in the call compiles one program
 per distinct request length, the per-request analog of the unbucketed
@@ -176,7 +176,10 @@ _FINGERPRINT_EVIDENCE_RE = re.compile(
 # captured serving/decode entry points (serving/engine.py): their ids/table
 # arguments become program SHAPES, so request-derived lengths must pass
 # through the bucketing helper (kv_blocks.bucket_length / generation.bucket_up)
-_SERVING_ENTRY_LEAVES = {"run_prefill", "run_decode", "_prefill_jit", "_decode_jit"}
+_SERVING_ENTRY_LEAVES = {
+    "run_prefill", "run_decode", "run_decode_n",
+    "_prefill_jit", "_decode_jit", "_decode_n_jit",
+}
 # evidence the author already buckets shapes (PaddingCollate pads to
 # TPU_PAD_MULTIPLE; any custom collate_fn is assumed to know its shapes)
 _PAD_EVIDENCE_RE = re.compile(r"pad|bucket|PaddingCollate|TPU_PAD_MULTIPLE", re.IGNORECASE)

@@ -67,6 +67,23 @@ from ..logging import get_logger
 
 logger = get_logger(__name__)
 
+# Process-wide latch for the jax-compilation-cache second layer: once a
+# scope-dependent (profiler-armed) run disarms it, NO later-constructed
+# cache may silently re-arm it — jax's config is global, the sampler stays
+# live for the process, and cache-served executables carry no HLO scope
+# metadata (docs/telemetry.md §phases).  A latch, not an instance field:
+# the hazard is exactly that a DIFFERENT instance re-arms the layer.
+_JAX_CACHE_LAYER_DISARMED = False
+
+
+def _jax_cache_layer_disarmed() -> bool:
+    return _JAX_CACHE_LAYER_DISARMED
+
+
+def _set_jax_cache_layer_disarmed(value: bool) -> None:
+    global _JAX_CACHE_LAYER_DISARMED
+    _JAX_CACHE_LAYER_DISARMED = value
+
 # bump when the entry layout / side-metadata schema changes: old entries
 # then report a format mismatch and fall through to a normal compile
 # (2: compiler flags joined the fingerprint as flat flag:* fields)
@@ -230,25 +247,90 @@ class AOTCompilationCache:
             self.enabled = False
             return
         if handler.jax_cache_dir:
-            # second layer (SNIPPETS.md [2]): jax's own persistent XLA
-            # compilation cache catches programs outside the capture path
-            try:
-                import jax
+            if _jax_cache_layer_disarmed():
+                # a profiler-armed hub already disarmed the layer for this
+                # PROCESS (attach_telemetry below): the config is global,
+                # and a later-constructed cache silently re-arming it would
+                # reintroduce metadata-less cache-served executables while
+                # the sampler is still live
+                logger.info(
+                    "jax compilation cache layer (%s) NOT armed: disarmed "
+                    "process-wide for a scope-dependent run",
+                    handler.jax_cache_dir,
+                )
+            else:
+                # second layer (SNIPPETS.md [2]): jax's own persistent XLA
+                # compilation cache catches programs outside the capture path
+                try:
+                    import jax
 
-                jax.config.update("jax_compilation_cache_dir", handler.jax_cache_dir)
-            except Exception as exc:
-                logger.warning("jax compilation cache dir not set: %s", exc)
+                    jax.config.update(
+                        "jax_compilation_cache_dir", handler.jax_cache_dir
+                    )
+                except Exception as exc:
+                    logger.warning("jax compilation cache dir not set: %s", exc)
 
     # -- telemetry -----------------------------------------------------------
     def attach_telemetry(self, hub) -> None:
         """Pin the enabled telemetry hub so every hit/miss/store lands as a
         ``kind="aot_cache"`` record, and expose the live counters on the
         hub's Prometheus endpoint (``atpu_aot_cache_hits_total`` /
-        ``_misses_total``)."""
+        ``_misses_total``).
+
+        Scope-fidelity guard (ROADMAP carried item, docs/telemetry.md
+        §phases): when the hub samples device time (``profile_every_n``),
+        the per-phase split joins trace events to the op→scope map parsed
+        from the compiled program's HLO metadata — but an executable served
+        by jax's own XLA compilation cache (the ``jax_cache_dir`` second
+        layer) carries NO metadata, and unlike the first-layer AOT store it
+        has no side payload to persist the storing process's map in.  A
+        pre-compile parse can't substitute either: the lowered module's
+        scope paths hang off UNOPTIMIZED instruction names, which never
+        match the post-fusion names trace events carry.  So a
+        scope-dependent run disarms that layer — every program it compiles
+        is a real compile whose metadata is parseable, and the per-phase
+        device split stays populated regardless of warm/cold.  The
+        first-layer AOT store keeps serving (its entries carry the
+        persisted map)."""
         if hub is None or not getattr(hub, "enabled", False) or not self.enabled:
             return
         self._telemetry = hub
         hub.register_metrics_provider("aot_cache", self.metrics)
+        if getattr(hub, "profiler", None) is not None:
+            # the hazard is the PROCESS-GLOBAL config, not this instance's
+            # own knob: another cache may have armed the layer already (or
+            # may try later), so a dir-less cache attaching the sampler
+            # must still disarm whatever is set and latch the process
+            armed_dir = None
+            try:
+                import jax
+
+                armed_dir = jax.config.jax_compilation_cache_dir
+                if armed_dir:
+                    jax.config.update("jax_compilation_cache_dir", None)
+            except Exception as exc:
+                logger.warning(
+                    "could not disarm the jax compilation cache for the "
+                    "scope-dependent run: %s", exc,
+                )
+                return
+            # latch it process-wide: any cache constructed AFTER this point
+            # must not re-arm the layer (the __init__ arm checks the latch)
+            _set_jax_cache_layer_disarmed(True)
+            if armed_dir or self.handler.jax_cache_dir:
+                logger.info(
+                    "jax compilation cache layer (%s) disarmed: device-time "
+                    "sampling is on, and cache-served executables carry no "
+                    "HLO scope metadata (phases would sample empty)",
+                    armed_dir or self.handler.jax_cache_dir,
+                )
+                self._record(
+                    "jax_cache_layer_disarmed", scope="train",
+                    key="jax_cache_dir",
+                    cause="device-time sampling armed: executables served "
+                    "from the XLA compilation cache carry no HLO metadata "
+                    "and would sample empty phases",
+                )
 
     _METRICS_TTL_S = 15.0  # dir-stat memo: scrapes must not stat a shared
     # NFS/GCS cache dir per entry every 15 s — counters below are live ints

@@ -13,17 +13,18 @@ package turns it into a serving path:
   fixed-size blocks + an int32 block table per slot, so wildly different
   sequence lengths share ONE pinned program.
 * :mod:`~.engine` — the two captured programs (bucketed prefill, whole-
-  batch single-token decode) layered on the same ``DecoderFamily`` /
-  ``cached_attention`` / ``stacked_params_for_mode`` contracts the
-  one-shot engine uses — quantized int8/int4 weight modes and
-  ``shard_for_inference`` layouts compose unchanged.
+  batch ``decode_steps``-token decode with in-program token feedback)
+  layered on the same ``DecoderFamily`` / ``cached_attention`` /
+  ``stacked_params_for_mode`` contracts the one-shot engine uses —
+  quantized int8/int4 weight modes and ``shard_for_inference`` layouts
+  compose unchanged.
 
 Steady state is **zero recompiles** — asserted through the telemetry
 recompile forensics (``CompileWatcher``), benched by bench.py's serving
 block, and smoke-tested by ``make serve-smoke``.
 """
 
-from .kv_blocks import BlockPool, bucket_length, make_pools
+from .kv_blocks import BlockPool, blocks_for_request, bucket_length, make_pools
 from .scheduler import DecodeService, Request, ServingConfig
 
 __all__ = [
@@ -31,6 +32,7 @@ __all__ = [
     "DecodeService",
     "Request",
     "ServingConfig",
+    "blocks_for_request",
     "bucket_length",
     "make_pools",
 ]

@@ -8,11 +8,19 @@ stalls token streaming for in-flight sequences:
   samples the request's first token.  One compiled variant per
   ``(bucket_len, mode)`` — prompt lengths are bucketed by the scheduler
   (``kv_blocks.bucket_length``), the TRUE length rides as a traced scalar.
-* ``run_decode`` — the WHOLE slot batch one token forward: per-slot embed
-  at the slot's own position, scatter the new k/v into the pool
-  (``block_tables[slot][pos // bs]`` at offset ``pos % bs``), gather each
-  slot's pages back as a virtually contiguous cache and reuse
-  ``cached_attention`` unchanged.  Every shape is fixed at service
+* ``run_decode_n`` — the WHOLE slot batch ``decode_steps`` tokens forward
+  inside ONE captured program: each micro-step embeds the slot's current
+  token at its own position, scatters the new k/v into the pool
+  (``block_tables[slot][pos // bs]`` at offset ``pos % bs``), gathers each
+  slot's pages back as a virtually contiguous cache, reuses
+  ``cached_attention`` unchanged, samples — and feeds the sampled token
+  back into the next micro-step's embed IN-PROGRAM, advancing positions
+  in-program too.  The host sees one ``(slots, n)`` token block per
+  dispatch instead of one scalar per token: dispatch overhead and the
+  per-token host sync amortize ``n``-fold (the device-resident hot loop,
+  docs/serving.md §device-resident decode).  ``decode_steps=1`` is the
+  degenerate loop — the body inlined once, no ``scan`` wrapper, exactly
+  the classic one-token program.  Every shape is fixed at service
   construction, so the steady state is exactly one program, replayed.
 
 Both reuse the single-request engine's contracts wholesale: the
@@ -25,7 +33,22 @@ math, same true positions, same mask formula; only the (masked, zero-prob)
 padding width differs.
 
 Pools are DONATED through both programs — the update is in-place at the XLA
-level, never a pool-sized copy per token.
+level, never a pool-sized copy per token.  The multi-token program's
+positions/tokens/rng streams are returned (the scheduler owns them as
+committed device arrays and feeds each call's outputs into the next, so a
+steady-state ``decode_steps > 1`` step uploads NOTHING host→device —
+regression-pinned with a ``jax.transfer_guard`` in tests/test_serving.py)
+but deliberately NOT donated: they are scan carries whose final values
+alias slices of the stacked token-block output, and donating them tripped
+an allocation-dependent XLA:CPU buffer-aliasing corruption — the donated
+input buffer was reused for one output while another output still read it,
+silently freezing degenerate sequences mid-stream in SOME processes (the
+per-process coin flip came from allocator layout).  They are three tiny
+int arrays; the copy costs nothing.  The single-token program keeps the
+legacy per-step mirror uploads — its inputs' avals (and therefore its
+compiled binary) must stay byte-identical to the pre-multi-token service,
+or cross-program bitwise parity with ``generate()`` is at the mercy of an
+independent XLA compile (see ``_decode_jit``).
 
 Zero-recompile forensics: the scheduler routes every call through
 :class:`CompileWatcher`, which diffs the jit cache size around the call.
@@ -108,13 +131,7 @@ def _prefill_jit(
     return k_pool, v_pool, tok[0], rng_out
 
 
-@partial(
-    jax.jit,
-    static_argnames=("family", "cfg", "qbits", "temperature", "paged",
-                     "kernel_interpret"),
-    donate_argnums=(0, 1),
-)
-def _decode_jit(
+def _decode_body(
     k_pool,
     v_pool,
     g,
@@ -131,6 +148,9 @@ def _decode_jit(
     paged: bool = False,  # paged-attention kernel (docs/kernels.md)
     kernel_interpret: bool = True,
 ):
+    """ONE token for the whole slot batch — the micro-step body shared by
+    every ``decode_steps`` variant, so an n-token block is bitwise the same
+    math as n single-token dispatches (the parity contract)."""
     block_size = k_pool.shape[3]
     plain_layers, q_layers, s_layers = layers
 
@@ -198,6 +218,113 @@ def _decode_jit(
 
         rngs_out, nxt = jax.vmap(sample_one)(rngs, logits)
     return k_pool, v_pool, nxt, rngs_out
+
+
+@partial(
+    jax.jit,
+    static_argnames=("family", "cfg", "qbits", "temperature", "paged",
+                     "kernel_interpret"),
+    donate_argnums=(0, 1),
+)
+def _decode_jit(
+    k_pool,
+    v_pool,
+    g,
+    layers,
+    block_tables,
+    positions,
+    tokens,
+    rngs,
+    *,
+    family: DecoderFamily,
+    cfg,
+    qbits: int,
+    temperature: float,
+    paged: bool = False,
+    kernel_interpret: bool = True,
+):
+    """The classic single-token program — ``_decode_body`` jitted with the
+    SAME signature, donation split and outputs the service has always
+    pinned.  ``decode_steps=1`` dispatches THIS program, not a length-1
+    loop: a degenerate ``_decode_n_jit`` returns extra outputs that alias
+    each other (``positions + 1``, the token block AND the trailing token
+    both being ``nxt``), a pattern that intermittently corrupted token
+    streams on XLA:CPU (see the module docstring's aliasing note) and at
+    best compiles to a DIFFERENT binary than the seed program — and
+    cross-program bitwise parity with ``generate()`` is only ever as
+    stable as the exact binary it was proven on.  The legacy shape
+    sidesteps the whole class: byte-identical programs, byte-identical
+    cache entries, byte-identical tokens."""
+    return _decode_body(
+        k_pool, v_pool, g, layers, block_tables, positions, tokens, rngs,
+        family=family, cfg=cfg, qbits=qbits, temperature=temperature,
+        paged=paged, kernel_interpret=kernel_interpret,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("family", "cfg", "qbits", "temperature", "decode_steps",
+                     "paged", "kernel_interpret"),
+    donate_argnums=(0, 1),
+)
+def _decode_n_jit(
+    k_pool,
+    v_pool,
+    g,
+    layers,
+    block_tables,  # (slots, blocks_per_slot) int32 — NOT donated (reused)
+    positions,  # (slots,) int32 — advanced in-program, returned
+    tokens,  # (slots,) int32 — each sampled token fed back in-program
+    rngs,  # (slots, 2) uint32 — per-slot streams, split in-program
+    *,
+    family: DecoderFamily,
+    cfg,
+    qbits: int,
+    temperature: float,
+    decode_steps: int = 1,
+    paged: bool = False,
+    kernel_interpret: bool = True,
+):
+    """``decode_steps`` micro-steps of ``_decode_body`` in one captured
+    program: the sampled token feeds the next embed and positions advance
+    WITHOUT leaving the device.  Returns ``(k_pool, v_pool, tok_block,
+    positions, tokens, rngs)`` where ``tok_block`` is ``(slots,
+    decode_steps)`` int32 — one dispatch and one host sync per *n* tokens.
+
+    ``decode_steps`` is static and >= 2 here (callers route 1 to the
+    legacy ``_decode_jit`` — see its docstring for why the degenerate loop
+    must not exist as a program): each distinct n is its own pinned
+    program, riding the CompileWatcher signature and the serving AOT
+    fingerprint — flipping it is a loud new program, never a silent
+    steady-state recompile.
+
+    Only the POOLS are donated.  positions/tokens/rngs are scan carries
+    whose final values alias slices of the stacked ``tok_block`` output
+    (``tokens`` out == ``tok_block[:, -1]``), and donating them tripped an
+    allocation-dependent XLA:CPU aliasing corruption (module docstring) —
+    they stay undonated, three tiny int arrays."""
+    statics = dict(
+        family=family, cfg=cfg, qbits=qbits, temperature=temperature,
+        paged=paged, kernel_interpret=kernel_interpret,
+    )
+
+    def micro(carry, _):
+        kp, vp, pos, tok, rg = carry
+        kp, vp, nxt, rg = _decode_body(
+            kp, vp, g, layers, block_tables, pos, tok, rg, **statics
+        )
+        # the sampled token IS the next micro-step's input; its k/v will be
+        # scattered at pos+1 — the host loop's feedback, now in-program
+        return (kp, vp, pos + 1, nxt, rg), nxt
+
+    (k_pool, v_pool, positions, tokens, rngs), toks = jax.lax.scan(
+        micro, (k_pool, v_pool, positions, tokens, rngs), None,
+        length=decode_steps,
+    )
+    # scan stacks along the leading (micro-step) axis; the scheduler wants
+    # per-slot rows
+    return k_pool, v_pool, jnp.moveaxis(toks, 0, 1), positions, tokens, rngs
 
 
 class CompileWatcher:
@@ -285,7 +412,9 @@ def run_decode(k_pool, v_pool, g, layers, block_tables, positions, tokens,
                rngs, *, family, cfg, qbits, temperature,
                watcher: Optional[CompileWatcher] = None, aot=None,
                kernels=None):
-    """One token for the whole slot batch; see ``_decode_jit``.
+    """One token for the whole slot batch; see ``_decode_jit``.  The
+    ``decode_steps=1`` (default) dispatch path — signature, program and
+    AOT entries byte-identical to the pre-multi-token service.
 
     ``kernels`` (a :class:`~..native.kernels.KernelPolicy`) arms the
     paged-attention decode kernel — a STATIC compile-mode choice, so it
@@ -306,3 +435,47 @@ def run_decode(k_pool, v_pool, g, layers, block_tables, positions, tokens,
     if watcher is None:
         return _decode_jit(*args, **statics)
     return watcher.call("decode", sig, _decode_jit, *args, **statics)
+
+
+def run_decode_n(k_pool, v_pool, g, layers, block_tables, positions, tokens,
+                 rngs, *, family, cfg, qbits, temperature, decode_steps=1,
+                 watcher: Optional[CompileWatcher] = None, aot=None,
+                 kernels=None):
+    """``decode_steps`` tokens for the whole slot batch in one dispatch;
+    see ``_decode_n_jit``.  Returns ``(k_pool, v_pool, tok_block,
+    positions, tokens, rngs)`` with ``tok_block`` of shape ``(slots,
+    decode_steps)``.
+
+    ``decode_steps=1`` delegates to :func:`run_decode` (the legacy
+    single-token program — see ``_decode_jit`` for why a length-1 loop
+    variant must not exist) and adapts its outputs to the uniform 6-tuple
+    with two tiny eager device ops; the scheduler calls ``run_decode``
+    directly on that path instead, skipping the adaptation.
+
+    ``kernels`` (a :class:`~..native.kernels.KernelPolicy`) arms the
+    paged-attention decode kernel — a STATIC compile-mode choice, so it
+    rides the watcher/AOT signature: flipping it is a new program, never a
+    silent steady-state recompile.  ``decode_steps`` rides the signature
+    for the same reason."""
+    decode_steps = int(decode_steps)
+    if decode_steps == 1:
+        k_pool, v_pool, nxt, rngs = run_decode(
+            k_pool, v_pool, g, layers, block_tables, positions, tokens, rngs,
+            family=family, cfg=cfg, qbits=qbits, temperature=temperature,
+            watcher=watcher, aot=aot, kernels=kernels,
+        )
+        return k_pool, v_pool, nxt[:, None], positions + 1, nxt, rngs
+    args = (k_pool, v_pool, g, layers, block_tables, positions, tokens, rngs)
+    statics = dict(family=family, cfg=cfg, qbits=qbits,
+                   temperature=temperature, decode_steps=decode_steps)
+    paged = bool(kernels is not None and kernels.paged_attention)
+    if paged:
+        statics.update(paged=True, kernel_interpret=kernels.interpret)
+    sig = ("decode", block_tables.shape, qbits, float(temperature),
+           paged and ("interpret" if kernels.interpret else "mosaic"),
+           decode_steps)
+    if aot is not None:
+        return aot.call("decode", sig, _decode_n_jit, args, statics, watcher=watcher)
+    if watcher is None:
+        return _decode_n_jit(*args, **statics)
+    return watcher.call("decode", sig, _decode_n_jit, *args, **statics)

@@ -23,9 +23,14 @@ inactive slots instead of corrupting a neighbour's cache.  Allocation is
 host-side and O(blocks) — the pool itself never moves; only tables do.
 
 Blocks for a request are reserved up front at admission
-(``ceil(max(bucket_len, prompt_len + max_new) / block_size)``) and freed
-the step the request finishes, so a full pool back-pressures admission
-(requests wait in the queue) rather than failing mid-decode.
+(:func:`blocks_for_request`) and freed the step the request finishes, so a
+full pool back-pressures admission (requests wait in the queue) rather than
+failing mid-decode.  With a multi-token decode block (``decode_steps=n``,
+docs/serving.md §device-resident decode) the reservation additionally
+covers the ≤ ``n-1`` micro-step OVERRUN past a request's budget/eos — the
+device cannot know a sequence finished until the host reads the token
+block, so the discarded trailing micro-steps still scatter k/v, and those
+writes must land inside the slot's own reservation, never a neighbour's.
 """
 
 from __future__ import annotations
@@ -47,6 +52,37 @@ def bucket_length(n: int, multiple: int, cap: Optional[int] = None) -> int:
     from ..models.generation import bucket_up
 
     return bucket_up(n, multiple, cap)
+
+
+def blocks_for_request(prompt_len: int, max_new: int, bucket_len: int,
+                       block_size: int, decode_steps: int = 1,
+                       blocks_per_slot: Optional[int] = None) -> int:
+    """Up-front block reservation for one request — the ONE place the
+    admission math lives (submit validation and the pool gate both read it).
+
+    The decode span is rounded up to whole ``decode_steps`` blocks: an
+    n-token captured decode executes up to ``n-1`` micro-steps past the
+    request's budget/eos before the host sees the token block, and every
+    overrun micro-step scatters one (discarded) k/v row at the next
+    position.  Covering the bucketed horizon keeps those writes inside the
+    slot's own reservation — at most one extra block per request.
+    ``decode_steps=1`` reduces to the classic
+    ``ceil(max(bucket_len, prompt_len + max_new) / block_size)`` exactly.
+
+    ``blocks_per_slot`` clamps the result to the slot's table length: a
+    near-capacity request's overrun horizon may round past the table, and
+    those tail writes are already safe without blocks behind them (table
+    entries past the row are the trash block; a position past the whole
+    table clamps into the slot's own last block — both masked stale data
+    for any future owner)."""
+    # prefill emits token 1; the decode loop emits the remaining max_new-1
+    # in ceil((max_new-1)/n) blocks of n micro-steps
+    steps = max(1, decode_steps)
+    horizon = 1 + -(-(max_new - 1) // steps) * steps
+    needed = -(-max(bucket_len, prompt_len + horizon) // block_size)
+    if blocks_per_slot is not None:
+        needed = min(needed, blocks_per_slot)
+    return needed
 
 
 @dataclasses.dataclass

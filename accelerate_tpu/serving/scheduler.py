@@ -10,15 +10,31 @@ arbitrary prompt lengths and token budgets, and drive ``step()`` (or
    the request's blocks up front, run the captured prefill (which writes
    the prompt's k/v into the reserved blocks and samples the first token —
    that token's latency is the request's TTFT).
-2. **Decode** — one captured call steps EVERY occupied slot one token.
-   Admission happens only at these step boundaries, so a joining prompt
-   never stalls streaming for in-flight sequences beyond one token.
-3. **Evict** — sequences that hit their token budget or per-request stop
-   token free their slot and blocks IMMEDIATELY (the freed slot is
-   re-admissible next step), instead of riding out the batch.
+2. **Decode** — one captured call steps EVERY occupied slot
+   ``decode_steps`` tokens (default 1): the sampled token feeds the next
+   embed and positions advance IN-PROGRAM, so the host pays one dispatch
+   and one blocking sync per *n* tokens instead of per token.  Admission
+   happens only at these block boundaries, so a joining prompt never
+   stalls streaming for in-flight sequences beyond one block.
+3. **Evict** — finish detection is host-side post-processing of the
+   returned ``(slots, n)`` token block: tokens past a slot's budget/eos
+   are discarded (the ≤ n-1 micro-step overrun wrote only into the slot's
+   own reservation — ``kv_blocks.blocks_for_request``), and finished
+   sequences free their slot and blocks at the block boundary (the freed
+   slot is re-admissible next step), instead of riding out the batch.
 
-The host side owns small int mirrors (block tables, positions, last
-tokens); the pools live on device and are donated through every call.
+The host keeps small int mirrors (block tables, positions, last tokens)
+for admission math.  On the multi-token path (``decode_steps > 1``) the
+arrays the decode program consumes are COMMITTED DEVICE STATE owned by
+the service: each call's outputs feed the next call's inputs, and the
+mirrors are re-uploaded only when admission or eviction actually changed
+them — a steady-state step performs ZERO host→device transfers.  The
+default ``decode_steps=1`` path keeps the classic per-step mirror
+uploads on purpose: the program must see the exact (uncommitted) avals
+it always has, or it lowers to a different HLO module whose
+independently-compiled binary can drift a near-tie argmax off
+``generate()``'s — see ``step()``.  The pools live on device and are
+donated through every call.
 Telemetry: when a hub is attached, every step emits a ``kind="serving"``
 occupancy record and every completion a per-request TTFT/TPOT record
 (docs/telemetry.md).
@@ -34,7 +50,7 @@ from typing import Optional
 import numpy as np
 
 from ..logging import get_logger
-from .kv_blocks import BlockPool, bucket_length, make_pools
+from .kv_blocks import BlockPool, blocks_for_request, bucket_length, make_pools
 
 logger = get_logger(__name__)
 
@@ -50,13 +66,25 @@ class ServingConfig:
     request (defaults to the model's positional capacity); ``num_blocks``
     sizes the shared pool (default: full reservation — every slot can hold
     a max-length request; set it lower to oversubscribe and exercise
-    queue back-pressure)."""
+    queue back-pressure).
+
+    ``decode_steps`` is the device-resident hot-loop knob
+    (docs/serving.md §device-resident decode): each engine iteration runs
+    *n* decode micro-steps inside ONE captured program, feeding sampled
+    tokens back on-device, and the host syncs once per n-token block.
+    Default 1 (``$ACCELERATE_SERVING_DECODE_STEPS``) is the classic
+    one-token-per-step path, byte-identical to the pre-knob service.
+    Greedy per-sequence outputs are identical at every n; latency trades
+    granularity for dispatch overhead — a request's tokens arrive in
+    blocks of n, so small-batch TPOT drops ~n× while per-token streaming
+    granularity coarsens to the block."""
 
     max_slots: int = 8
     block_size: int = 16
     prompt_bucket: int = 32
     num_blocks: Optional[int] = None
     max_request_len: Optional[int] = None
+    decode_steps: Optional[int] = None  # None → $ACCELERATE_SERVING_DECODE_STEPS, default 1
     temperature: float = 0.0
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
@@ -70,6 +98,14 @@ class ServingConfig:
     # completions retained for the metrics() sliding window (TTFT/TPOT
     # p50/p99 on the live endpoint, docs/telemetry.md §metrics endpoint)
     metrics_window: int = 512
+
+    def __post_init__(self):
+        if self.decode_steps is None:
+            from ..utils.dataclasses import env_int
+
+            # malformed values warn and keep the single-token default —
+            # the one shared env-int parser (utils/dataclasses.env_int)
+            self.decode_steps = env_int("ACCELERATE_SERVING_DECODE_STEPS", 1)
 
 
 @dataclasses.dataclass
@@ -142,6 +178,10 @@ class DecodeService:
         self.config = cfg = config or ServingConfig()
         if cfg.block_size < 1 or cfg.max_slots < 1:
             raise ValueError("block_size and max_slots must be >= 1")
+        if cfg.decode_steps < 1:
+            raise ValueError(
+                f"decode_steps must be >= 1, got {cfg.decode_steps}"
+            )
         if cfg.prompt_bucket % cfg.block_size:
             raise ValueError(
                 f"prompt_bucket ({cfg.prompt_bucket}) must be a multiple of "
@@ -215,6 +255,18 @@ class DecodeService:
         self._tables = np.zeros((slots, blocks_per_slot), np.int32)
         self._positions = np.zeros(slots, np.int32)
         self._tokens = np.full(slots, cfg.pad_token_id, np.int32)
+        # device-resident decode state (docs/serving.md §device-resident
+        # decode): the arrays the multi-token (decode_steps > 1) captured
+        # decode consumes.  The numpy mirrors above stay the source of
+        # truth for admission math; _flush_device_state re-commits them
+        # ONLY when the dirty flag says admission/eviction changed a slot —
+        # a steady-state step feeds the previous call's outputs straight
+        # back, uploading nothing.  (The n=1 path deliberately keeps the
+        # legacy per-step uploads — see step().)
+        self._dev_tables = None
+        self._dev_positions = None
+        self._dev_tokens = None
+        self._state_dirty = True
         self._slot_req: list[Optional[Request]] = [None] * slots
         self._base_rng = jax.random.PRNGKey(cfg.rng_seed)
         self._rngs = jnp.stack(
@@ -278,6 +330,15 @@ class DecodeService:
                     for leaf in _jax.tree_util.tree_leaves((self._g, self._layers))
                 ],
             }
+            if cfg.decode_steps != 1:
+                # the n-token decode block is a different program with a
+                # different OUTPUT ARITY (token block + advanced state):
+                # entries stored by a service of another n must miss
+                # loudly, never deserialize into a shape the caller can't
+                # unpack.  Keyed CONDITIONALLY so default (n=1) services
+                # keep the fingerprint — and the warm entries — they have
+                # always had.
+                service_fingerprint["decode_steps"] = int(cfg.decode_steps)
             self._aot = AOTServingPrograms(aot_cache, service_fingerprint)
             self._aot.warm()
         self.stats = {
@@ -286,6 +347,18 @@ class DecodeService:
             "completed": 0,
             "occupancy_sum": 0.0,
             "queue_peak": 0,
+            # dispatch-overhead accounting (docs/telemetry.md §serving):
+            # host_syncs counts EVERY blocking device→host read (prefill
+            # first tokens + decode blocks); decode_syncs counts PER-SLOT
+            # sync exposures (each decode sync, once per active slot) so
+            # host_syncs_per_token = decode_syncs/decode_tokens reads 1.0
+            # on the classic path and ~1/n on n-token blocks independent
+            # of batch size; h2d_uploads counts host→device state
+            # re-commits (0 in steady state)
+            "host_syncs": 0,
+            "decode_syncs": 0,
+            "decode_tokens": 0,
+            "h2d_uploads": 0,
         }
         # sliding (ttft_ms, tpot_ms) window behind metrics() — the live
         # endpoint's SLO percentiles must reflect *recent* traffic, not the
@@ -344,7 +417,11 @@ class DecodeService:
                 f"the service's per-request capacity ({self.capacity})"
             )
         blen = bucket_length(p_len, self.config.prompt_bucket, cap=self.capacity)
-        needed = -(-max(blen, p_len + max_new_tokens) // self.config.block_size)
+        needed = blocks_for_request(
+            p_len, max_new_tokens, blen, self.config.block_size,
+            decode_steps=self.config.decode_steps,
+            blocks_per_slot=self.pool.blocks_per_slot,
+        )
         if needed > self.pool.usable_blocks:
             raise ValueError(
                 f"request needs {needed} blocks but the pool only has "
@@ -418,6 +495,7 @@ class DecodeService:
                 temperature=float(self.config.temperature),
                 watcher=self.watcher, aot=self._aot,
             )
+            self.stats["host_syncs"] += 1
             first = int(tok)
             req.first_token_t = time.perf_counter()
             req.tokens.append(first)
@@ -436,6 +514,7 @@ class DecodeService:
             self._tables[slot] = table_row
             self._positions[slot] = req.prompt_len
             self._tokens[slot] = first
+            self._state_dirty = True  # new slot row: re-commit before decode
             self._rngs = self._rngs.at[slot].set(rng_out)
         return admitted
 
@@ -448,6 +527,9 @@ class DecodeService:
         self._tables[slot] = 0
         self._positions[slot] = 0
         self._tokens[slot] = self.config.pad_token_id
+        # the device copy of this slot now points at freed blocks (and, at
+        # decode_steps>1, overran positions) — re-commit before next decode
+        self._state_dirty = True
 
     def pop_result(self, rid: int) -> Optional[Request]:
         """Take (and drop) one finished request — the streaming-consumer
@@ -475,42 +557,117 @@ class DecodeService:
                 "tpot_ms": req.tpot_ms,
             })
 
-    def step(self) -> list[Request]:
-        """One engine iteration (admit → decode one token → evict); returns
-        the requests that completed during it."""
+    def _flush_device_state(self) -> None:
+        """Re-commit the host mirrors to the device (the ``decode_steps >
+        1`` path) — ONLY when admission or eviction changed a slot since
+        the last decode.  Steady state (every slot mid-sequence) feeds the
+        previous call's outputs straight back: zero host→device transfers
+        per step, pinned by the ``jax.transfer_guard`` regression test in
+        tests/test_serving.py."""
+        if not self._state_dirty and self._dev_tables is not None:
+            return
+        import jax
         import jax.numpy as jnp
 
-        from .engine import run_decode
+        arrays = (
+            jnp.asarray(self._tables),
+            jnp.asarray(self._positions),
+            jnp.asarray(self._tokens),
+        )
+        if self._pool_sharding is not None:
+            # same stability argument as the pools/rng streams: the decode
+            # program returns this state re-committed on the params' mesh,
+            # and an uncommitted re-upload would flip the input sharding
+            arrays = tuple(
+                jax.device_put(a, self._pool_sharding) for a in arrays
+            )
+        self._dev_tables, self._dev_positions, self._dev_tokens = arrays
+        self._state_dirty = False
+        self.stats["h2d_uploads"] += 1
 
+    def step(self) -> list[Request]:
+        """One engine iteration (admit → decode a ``decode_steps`` token
+        block → evict); returns the requests that completed during it."""
+        from .engine import run_decode, run_decode_n
+
+        n = self.config.decode_steps
         admitted = self._admit()
         completed = [r for r in admitted if r.state == "done"]
         slot_evictions = 0
+        emitted = 0
         active = [i for i, r in enumerate(self._slot_req) if r is not None]
+        uploads_before = self.stats["h2d_uploads"]
         if active:
-            (self._k_pool, self._v_pool, nxt, self._rngs) = run_decode(
-                self._k_pool, self._v_pool, self._g, self._layers,
-                jnp.asarray(self._tables), jnp.asarray(self._positions),
-                jnp.asarray(self._tokens), self._rngs,
+            if n > 1:
+                self._flush_device_state()
+            common = dict(
                 family=self.spec.family, cfg=self.spec.cfg,
                 qbits=self._qbits,
                 temperature=float(self.config.temperature),
                 watcher=self.watcher, aot=self._aot,
                 kernels=self._kernels,
             )
-            nxt_host = np.asarray(nxt)
+            if n == 1:
+                # legacy single-token dispatch, byte-identical to the
+                # pre-multi-token service INCLUDING the per-step mirror
+                # uploads: the program must see the exact avals it always
+                # has (fresh uncommitted int arrays), because inputs
+                # committed with a NamedSharding lower to a DIFFERENT HLO
+                # module — an independently compiled binary whose near-tie
+                # argmaxes can drift 1 ulp from generate()'s programs and
+                # break the bitwise parity contract (caught live on a
+                # prepared single-device run; see engine._decode_jit for
+                # the same argument against a length-1 loop variant).  The
+                # uploads are three tiny int arrays; the per-token cost
+                # that matters — the blocking host sync — is unchanged
+                # here and amortized n-fold on the n>1 path below.
+                import jax.numpy as jnp
+
+                (self._k_pool, self._v_pool, nxt, self._rngs) = run_decode(
+                    self._k_pool, self._v_pool, self._g, self._layers,
+                    jnp.asarray(self._tables), jnp.asarray(self._positions),
+                    jnp.asarray(self._tokens), self._rngs, **common,
+                )
+                self.stats["h2d_uploads"] += 1
+                self._state_dirty = True  # mirrors stay the source of truth
+                tok_block = nxt  # reshaped host-side below
+            else:
+                (self._k_pool, self._v_pool, tok_block, self._dev_positions,
+                 self._dev_tokens, self._rngs) = run_decode_n(
+                    self._k_pool, self._v_pool, self._g, self._layers,
+                    self._dev_tables, self._dev_positions, self._dev_tokens,
+                    self._rngs, decode_steps=n, **common,
+                )
+            # THE host sync of the hot loop: one blocking read per n-token
+            # block, weighted per active slot for the per-token ratio
+            self.stats["host_syncs"] += 1
+            self.stats["decode_syncs"] += len(active)
+            block_host = np.asarray(tok_block).reshape(
+                self.config.max_slots, n
+            )
             for slot in active:
                 req = self._slot_req[slot]
-                tok = int(nxt_host[slot])
-                req.tokens.append(tok)
-                self._positions[slot] += 1
-                self._tokens[slot] = tok
-                if len(req.tokens) >= req.max_new_tokens or (
-                    req.eos_token_id is not None and tok == req.eos_token_id
-                ):
-                    self._evict(slot)
-                    self._finish(req)
-                    completed.append(req)
-                    slot_evictions += 1
+                for j in range(n):
+                    tok = int(block_host[slot, j])
+                    req.tokens.append(tok)
+                    self._positions[slot] += 1
+                    self._tokens[slot] = tok
+                    emitted += 1
+                    if len(req.tokens) >= req.max_new_tokens or (
+                        req.eos_token_id is not None
+                        and tok == req.eos_token_id
+                    ):
+                        # tokens past the stop are DISCARDED (never appended
+                        # — the block's tail is pad as far as any consumer
+                        # can see), and eviction lands at the block
+                        # boundary; greedy output stays identical to
+                        # generate() at every n
+                        self._evict(slot)
+                        self._finish(req)
+                        completed.append(req)
+                        slot_evictions += 1
+                        break
+        self.stats["decode_tokens"] += emitted
         self.stats["steps"] += 1
         occupancy = len(active) / self.config.max_slots
         self.stats["occupancy_sum"] += occupancy
@@ -531,6 +688,13 @@ class DecodeService:
                 # evicted against occupancy)
                 "evicted": slot_evictions,
                 "completed": len(completed),
+                # device-resident hot-loop accounting (docs/telemetry.md):
+                # block size, tokens actually emitted to requests this step
+                # (overrun tokens past a stop are discarded, not emitted),
+                # and whether this step re-committed host state
+                "decode_steps": n,
+                "emitted": emitted,
+                "h2d_upload": self.stats["h2d_uploads"] > uploads_before,
             })
         return completed
 
@@ -585,6 +749,15 @@ class DecodeService:
             "admitted_total": self.stats["admitted"],
             "completed_total": self.stats["completed"],
             "recompile_events_total": self.recompile_events,
+            # device-resident decode counters (docs/telemetry.md §serving):
+            # syncs/token is the dispatch-overhead gauge — 1.0 on the
+            # classic path, ~1/n with an n-token block; h2d uploads stay
+            # flat while the batch is steady
+            "decode_steps": self.config.decode_steps,
+            "decode_tokens_total": self.stats["decode_tokens"],
+            "host_syncs_total": self.stats["host_syncs"],
+            "h2d_uploads_total": self.stats["h2d_uploads"],
+            "host_syncs_per_token": round(self.host_syncs_per_token, 4),
             "latency_window": len(window),
             # native histograms (cumulative over the service lifetime);
             # the p50/p99 gauges below stay for human eyeballs — dashboards
@@ -603,6 +776,18 @@ class DecodeService:
     @property
     def mean_batch_occupancy(self) -> float:
         return self.stats["occupancy_sum"] / max(1, self.stats["steps"])
+
+    @property
+    def host_syncs_per_token(self) -> float:
+        """Blocking device→host syncs a sequence experiences per emitted
+        DECODE token — the dispatch-overhead gauge the bench A/B and
+        serve-smoke assert on: exactly 1.0 on the classic per-token path,
+        ~1/n with an n-token device-resident block (slightly above 1/n
+        when stops discard overrun tokens).  Each decode sync counts once
+        per active slot, so the ratio is batch-size independent; prefill's
+        per-request first-token sync is per-request, not per-token, so it
+        rides ``stats["host_syncs"]`` but not this ratio."""
+        return self.stats["decode_syncs"] / max(1, self.stats["decode_tokens"])
 
     @property
     def recompile_events(self) -> int:

@@ -86,6 +86,22 @@ class ComputeBackend(BaseEnum):
     CPU = "cpu"
 
 
+def env_int(name, default):
+    """Integer env knob with the observability-grade failure mode: unset or
+    empty reads as the default, and a malformed value WARNS and falls back
+    instead of raising mid-``__init__`` — one parser shared by every
+    integer env knob (telemetry cadence/ports, serving decode_steps, bench
+    A/B legs) so empty-string and typo semantics can never drift apart."""
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    try:
+        return int(value)
+    except ValueError:
+        warnings.warn(f"{name}={value!r} is not an integer; ignoring")
+        return default
+
+
 # ---------------------------------------------------------------------------
 # Kwargs handlers (typed pass-throughs; reference dataclasses.py:62-551)
 # ---------------------------------------------------------------------------
@@ -214,14 +230,7 @@ class TelemetryKwargs(KwargsHandler):
 
     @staticmethod
     def _env_int(name, default):
-        value = os.environ.get(name)
-        if value is None or value == "":
-            return default
-        try:
-            return int(value)
-        except ValueError:
-            warnings.warn(f"{name}={value!r} is not an integer; ignoring")
-            return default
+        return env_int(name, default)
 
 
 @dataclass

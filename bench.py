@@ -739,10 +739,16 @@ def _serving_block(on_accel: bool) -> dict:
     """Serving rows for the primary JSON (docs/serving.md): the continuous-
     batching decode service on the flagship GPT geometry under a synthetic
     Poisson request trace — p50/p99 TTFT, p50/p99 per-token latency,
-    aggregate generated tokens/s, mean batch occupancy, and
+    aggregate generated tokens/s, mean batch occupancy,
     ``serving_recompile_events`` (the zero-recompile steady-state contract,
     counted by the engine's CompileWatcher forensics; must be 0 after
-    warmup).  ``BENCH_SERVING=0`` disables the block."""
+    warmup) and ``serving_host_syncs_per_token`` (dispatch-overhead gauge).
+
+    Plus the device-resident multi-token A/B (ISSUE 14): the SAME trace
+    re-run with ``decode_steps=$BENCH_DECODE_STEPS`` (default 8 — 0/1
+    disables the leg), reported as ``serving_multistep_*`` rows with a
+    tokens/s speedup against the per-token leg.  ``BENCH_SERVING=0``
+    disables the whole block."""
     import time as _time
 
     import numpy as np
@@ -750,6 +756,7 @@ def _serving_block(on_accel: bool) -> dict:
     import accelerate_tpu.nn as nn
     from accelerate_tpu import Accelerator, DecodeService, ServingConfig
     from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+    from accelerate_tpu.serving import bucket_length
 
     Accelerator._reset_state()
     nn.manual_seed(0)
@@ -761,13 +768,12 @@ def _serving_block(on_accel: bool) -> dict:
 
     if on_accel:
         n_requests, max_new, rate_per_s = 32, 64, 8.0
-        scfg = ServingConfig(max_slots=8, block_size=32, prompt_bucket=64)
+        geometry = dict(max_slots=8, block_size=32, prompt_bucket=64)
         prompt_lens = (24, 57, 128, 200, 96, 33, 160, 80)
     else:
         n_requests, max_new, rate_per_s = 8, 8, 200.0
-        scfg = ServingConfig(max_slots=4, block_size=16, prompt_bucket=16)
+        geometry = dict(max_slots=4, block_size=16, prompt_bucket=16)
         prompt_lens = (3, 9, 17, 30)
-    service = DecodeService(model, scfg, telemetry=acc.telemetry)
 
     rng = np.random.default_rng(0)
     arrivals = np.cumsum(rng.exponential(1.0 / rate_per_s, size=n_requests))
@@ -775,63 +781,105 @@ def _serving_block(on_accel: bool) -> dict:
         rng.integers(0, cfg.vocab_size, (prompt_lens[i % len(prompt_lens)],), dtype=np.int32)
         for i in range(n_requests)
     ]
-    # warmup: compile the decode program + every prefill bucket the trace
-    # uses BEFORE the clock starts, so the latency percentiles measure the
-    # steady state and the recompile counter's warmup set is primed
-    from accelerate_tpu.serving import bucket_length
 
-    buckets = sorted({bucket_length(len(p), scfg.prompt_bucket) for p in prompts})
-    warm_rids = {
-        service.submit(np.ones(blen, np.int32), max_new_tokens=2)
-        for blen in buckets
-    }
-    service.run()
-    warm_compiles = service.watcher.compiles_total
-    # occupancy statistics restart at the measured trace (the warmup
-    # requests ran near-solo and would dilute the mean)
-    service.stats.update(steps=0, occupancy_sum=0.0)
+    def run_trace(decode_steps: int, trace_max_new: int) -> dict:
+        service = DecodeService(
+            model, ServingConfig(decode_steps=decode_steps, **geometry),
+            telemetry=acc.telemetry,
+        )
+        # warmup: compile the decode program + every prefill bucket the
+        # trace uses BEFORE the clock starts, so the latency percentiles
+        # measure the steady state and the recompile counter's warmup set
+        # is primed
+        buckets = sorted(
+            {bucket_length(len(p), geometry["prompt_bucket"]) for p in prompts}
+        )
+        warm_rids = {
+            service.submit(np.ones(blen, np.int32),
+                           max_new_tokens=decode_steps + 1)
+            for blen in buckets
+        }
+        service.run()
+        warm_compiles = service.watcher.compiles_total
+        # occupancy/sync statistics restart at the measured trace (the
+        # warmup requests ran near-solo and would dilute the means)
+        service.stats.update(
+            steps=0, occupancy_sum=0.0, decode_syncs=0, decode_tokens=0
+        )
 
-    t0 = _time.perf_counter()
-    submitted = 0
-    while submitted < n_requests or service.has_work:
-        now = _time.perf_counter() - t0
-        while submitted < n_requests and arrivals[submitted] <= now:
-            # backdate the TTFT clock to the Poisson ARRIVAL: several
-            # arrivals can come due during one decode step, and starting
-            # their clocks at submit would exclude exactly the queueing
-            # tail the p99 row exists to expose (coordinated omission)
-            service.submit(
-                prompts[submitted], max_new_tokens=max_new,
-                arrival_t=t0 + arrivals[submitted],
+        t0 = _time.perf_counter()
+        submitted = 0
+        while submitted < n_requests or service.has_work:
+            now = _time.perf_counter() - t0
+            while submitted < n_requests and arrivals[submitted] <= now:
+                # backdate the TTFT clock to the Poisson ARRIVAL: several
+                # arrivals can come due during one decode step, and
+                # starting their clocks at submit would exclude exactly
+                # the queueing tail the p99 row exposes (coordinated
+                # omission)
+                service.submit(
+                    prompts[submitted], max_new_tokens=trace_max_new,
+                    arrival_t=t0 + arrivals[submitted],
+                )
+                submitted += 1
+            if service.has_work:
+                service.step()
+            elif submitted < n_requests:
+                _time.sleep(min(0.001, arrivals[submitted] - now))
+        dt = _time.perf_counter() - t0
+
+        reqs = [r for r in service.results.values() if r.rid not in warm_rids]
+        ttft = sorted(r.ttft_ms for r in reqs)
+        tpot = sorted(r.tpot_ms for r in reqs if r.tpot_ms is not None)
+
+        def pct(vals, q):
+            return round(vals[min(len(vals) - 1, int(q * len(vals)))], 2) if vals else None
+
+        total_tokens = sum(len(r.tokens) for r in reqs)
+        return {
+            "requests": len(reqs),
+            "ttft_p50_ms": pct(ttft, 0.50),
+            "ttft_p99_ms": pct(ttft, 0.99),
+            "tpot_p50_ms": pct(tpot, 0.50),
+            "tpot_p99_ms": pct(tpot, 0.99),
+            "tokens_per_sec": round(total_tokens / dt, 1),
+            "mean_occupancy": round(service.mean_batch_occupancy, 3),
+            "recompile_events": service.recompile_events,
+            "warmup_compiles": warm_compiles,
+            "host_syncs_per_token": round(service.host_syncs_per_token, 4),
+        }
+
+    base = run_trace(1, max_new)
+    out = {f"serving_{k}": v for k, v in base.items()}
+    out["serving_max_slots"] = geometry["max_slots"]
+    out["serving_block_size"] = geometry["block_size"]
+
+    # the device-resident A/B leg: same trace, n-token captured blocks.
+    # Budgets stretch to cover whole blocks (n*3+1) so the syncs-per-token
+    # ratio measures the loop, not truncation by tiny budgets — the n=1
+    # denominator for the speedup is re-run at the SAME budgets
+    from accelerate_tpu.utils.dataclasses import env_int
+
+    n = env_int("BENCH_DECODE_STEPS", 8)
+    if n > 1:
+        ab_max_new = max(max_new, 3 * n + 1)
+        ab_base = base if ab_max_new == max_new else run_trace(1, ab_max_new)
+        multi = run_trace(n, ab_max_new)
+        out["serving_multistep_decode_steps"] = n
+        for key in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                    "tpot_p99_ms", "tokens_per_sec", "mean_occupancy",
+                    "recompile_events", "host_syncs_per_token"):
+            out[f"serving_multistep_{key}"] = multi[key]
+        if ab_base is not base:
+            out["serving_multistep_base_tokens_per_sec"] = ab_base["tokens_per_sec"]
+            out["serving_multistep_base_host_syncs_per_token"] = (
+                ab_base["host_syncs_per_token"]
             )
-            submitted += 1
-        if service.has_work:
-            service.step()
-        elif submitted < n_requests:
-            _time.sleep(min(0.001, arrivals[submitted] - now))
-    dt = _time.perf_counter() - t0
-
-    reqs = [r for r in service.results.values() if r.rid not in warm_rids]
-    ttft = sorted(r.ttft_ms for r in reqs)
-    tpot = sorted(r.tpot_ms for r in reqs if r.tpot_ms is not None)
-
-    def pct(vals, q):
-        return round(vals[min(len(vals) - 1, int(q * len(vals)))], 2) if vals else None
-
-    total_tokens = sum(len(r.tokens) for r in reqs)
-    return {
-        "serving_requests": len(reqs),
-        "serving_ttft_p50_ms": pct(ttft, 0.50),
-        "serving_ttft_p99_ms": pct(ttft, 0.99),
-        "serving_tpot_p50_ms": pct(tpot, 0.50),
-        "serving_tpot_p99_ms": pct(tpot, 0.99),
-        "serving_tokens_per_sec": round(total_tokens / dt, 1),
-        "serving_mean_occupancy": round(service.mean_batch_occupancy, 3),
-        "serving_recompile_events": service.recompile_events,
-        "serving_warmup_compiles": warm_compiles,
-        "serving_max_slots": scfg.max_slots,
-        "serving_block_size": scfg.block_size,
-    }
+        if ab_base["tokens_per_sec"]:
+            out["serving_multistep_speedup"] = round(
+                multi["tokens_per_sec"] / ab_base["tokens_per_sec"], 2
+            )
+    return out
 
 
 def _kernels_ab_block(on_accel: bool) -> dict:

@@ -565,3 +565,57 @@ def test_scope_map_persists_across_processes(tmp_path):
         any(p.startswith("atpu") for p in phases)
         for phases in warm["phases_per_sample"]
     ), f"warm samples lost the per-phase split: {warm['phases_per_sample']}"
+
+
+def test_jax_cache_layer_disarmed_for_scope_dependent_runs(tmp_path):
+    """ROADMAP carried item, second layer: executables served by jax's OWN
+    XLA compilation cache (``jax_cache_dir``) carry no HLO metadata and no
+    side payload to persist a scope map in — a device-time-sampling run
+    would read empty ``phases`` from every cache-served program.  Attaching
+    a profiler-armed telemetry hub must therefore DISARM that layer (with a
+    kind="aot_cache" record saying why); a hub without device-time sampling
+    keeps it, because nothing scope-dependent ever reads the maps.  The
+    disarm is a PROCESS-WIDE latch: jax's config is global, so a cache
+    constructed after the disarm must not silently re-arm the layer while
+    the sampler is still live (review-pinned)."""
+    from accelerate_tpu.native import aot_cache as aot_mod
+    from accelerate_tpu.telemetry import Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryKwargs
+
+    saved = jax.config.jax_compilation_cache_dir
+    jax_dir = str(tmp_path / "jaxcache")
+    try:
+        # a hub WITHOUT device-time sampling: the layer stays armed
+        cache = AOTCompilationCache(CompilationCacheKwargs(
+            cache_dir=str(tmp_path / "aot1"), jax_cache_dir=jax_dir,
+        ))
+        hub_plain = Telemetry(TelemetryKwargs(enabled=True))
+        assert hub_plain.profiler is None
+        cache.attach_telemetry(hub_plain)
+        assert jax.config.jax_compilation_cache_dir == jax_dir
+
+        # a scope-dependent hub (profile_every_n): the layer is disarmed
+        cache2 = AOTCompilationCache(CompilationCacheKwargs(
+            cache_dir=str(tmp_path / "aot2"), jax_cache_dir=jax_dir,
+        ))
+        hub = Telemetry(TelemetryKwargs(enabled=True, profile_every_n=1))
+        assert hub.profiler is not None
+        cache2.attach_telemetry(hub)
+        assert jax.config.jax_compilation_cache_dir is None
+        events = [
+            r for r in hub.all_records()
+            if r.get("kind") == "aot_cache"
+            and r.get("event") == "jax_cache_layer_disarmed"
+        ]
+        assert events and "metadata" in events[0]["cause"]
+
+        # THE latch pin: a cache constructed AFTER the disarm (a second
+        # Accelerator, a serving replica) must NOT re-arm the global layer
+        # while the profiler-armed hub is still sampling
+        AOTCompilationCache(CompilationCacheKwargs(
+            cache_dir=str(tmp_path / "aot3"), jax_cache_dir=jax_dir,
+        ))
+        assert jax.config.jax_compilation_cache_dir is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", saved)
+        aot_mod._set_jax_cache_layer_disarmed(False)

@@ -1297,6 +1297,196 @@ def test_instance_dispatch_rebound_different_classes_silent(tmp_path):
     assert res.new_findings == [], [f.render() for f in res.new_findings]
 
 
+FACTORY_RETURN_DISPATCH_BAD = {
+    "impl.py": """
+        class Runner:
+            def __init__(self, opts=None):
+                self.opts = opts
+
+            def work(self, x):
+                return x.item()        # host sync, reached via the factory
+        """,
+    "ops.py": """
+        import jax
+        from .impl import Runner
+
+        def make_runner(fast=True):
+            if fast:
+                return Runner()
+            return Runner({"slow": True})   # every return: SAME class
+
+        @jax.jit
+        def step(x):
+            r = make_runner()
+            return r.work(x)
+        """,
+}
+
+FACTORY_RETURN_DISPATCH_MIXED_GOOD = {
+    "impl.py": """
+        class Runner:
+            def work(self, x):
+                return x.item()
+        """,
+    "ops.py": """
+        import jax
+        from .impl import Runner
+
+        class Other:
+            def work(self, x):
+                return x + 1
+
+        def make_runner(fast=True):
+            if fast:
+                return Runner()
+            return Other()             # mixed classes: no single return type
+
+        def make_opaque(cfg):
+            if cfg:
+                return Runner()
+            return cfg                 # non-constructor return
+
+        @jax.jit
+        def step(x, cfg):
+            r = make_runner()
+            s = make_opaque(cfg)
+            return r.work(x) + s.work(x)
+        """,
+}
+
+
+def test_instance_dispatch_through_factory_returns(tmp_path):
+    """ANALYSIS_VERSION 10 fixture (ROADMAP carried item): a receiver bound
+    from a function whose returns are ALL `SomeClass(...)` constructors of
+    one class resolves to SomeClass.method — `r = make_runner(); r.work(x)`
+    reaches Runner.work and the traced host sync fires."""
+    res = lint_pkg(
+        tmp_path, FACTORY_RETURN_DISPATCH_BAD, rule="host-sync-in-trace"
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    f = res.new_findings[0]
+    assert f.path.endswith("impl.py") and f.symbol == "Runner.work"
+
+
+def test_instance_dispatch_factory_mixed_returns_silent(tmp_path):
+    """The good twin: a factory whose branches construct DIFFERENT classes
+    — or return a non-constructor value — has no single return type, so
+    the receiver stays uninferred and nothing fires."""
+    res = lint_pkg(
+        tmp_path, FACTORY_RETURN_DISPATCH_MIXED_GOOD, rule="host-sync-in-trace"
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_instance_dispatch_factory_shadowed_or_method_silent(tmp_path):
+    """Review-pinned guards on the v10 factory map: (1) a PARAMETER named
+    like a module factory is data — any callable could be injected, so the
+    receiver must stay uninferred; (2) a METHOD (or nested def) sharing a
+    factory-shaped body must not enter the bare-name map — `build` is
+    never callable as a module-level name."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        class Runner:
+            def work(self, x):
+                return x.item()
+
+        def make_runner():
+            return Runner()
+
+        @jax.jit
+        def step(x, make_runner):
+            r = make_runner()        # the PARAMETER, not the factory
+            return r.work(x)
+        """,
+        rule="host-sync-in-trace",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+    res2 = lint(
+        tmp_path,
+        """
+        import jax
+
+        class Runner:
+            def work(self, x):
+                return x.item()
+
+        class Pool:
+            def build(self):
+                return Runner()      # a METHOD, not a bare-name factory
+
+        @jax.jit
+        def step(x, build):
+            r = build()              # unrelated injected callable
+            return r.work(x)
+        """,
+        rule="host-sync-in-trace",
+        name="snippet2.py",
+    )
+    assert res2.new_findings == [], [f.render() for f in res2.new_findings]
+
+
+def test_instance_dispatch_factory_rebound_or_decorated_silent(tmp_path):
+    """Review-pinned guards on the v10 factory map, round 2: (1) a module
+    name REBOUND after a qualifying factory def (a later non-factory def
+    wins the live binding) must drop the mapping; (2) a DECORATED factory's
+    wrapper decides what a call returns (a future, a memo proxy) — the
+    body's returns say nothing, so no mapping."""
+    res = lint(
+        tmp_path,
+        """
+        import jax
+
+        class Runner:
+            def work(self, x):
+                return x.item()
+
+        def make():
+            return Runner()
+
+        def make():                  # live binding: NOT a factory
+            return _singleton
+
+        @jax.jit
+        def step(x):
+            r = make()
+            return r.work(x)
+        """,
+        rule="host-sync-in-trace",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+    res2 = lint(
+        tmp_path,
+        """
+        import jax
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Runner:
+            def work(self, x):
+                return x.item()
+
+        def deferred(fn):
+            def wrap(*a):
+                return ThreadPoolExecutor().submit(fn, *a)
+            return wrap
+
+        @deferred
+        def make():                  # calling make() returns a Future
+            return Runner()
+
+        @jax.jit
+        def step(x):
+            r = make()
+            return r.work(x)
+        """,
+        rule="host-sync-in-trace",
+        name="snippet2.py",
+    )
+    assert res2.new_findings == [], [f.render() for f in res2.new_findings]
+
+
 def test_partial_callback_crosses_module_boundary(tmp_path):
     """A partial(...)-wrapped callback handed to lax.scan in another module
     is a trace root there."""

@@ -15,6 +15,7 @@ from accelerate_tpu.serving import (
     BlockPool,
     DecodeService,
     ServingConfig,
+    blocks_for_request,
     bucket_length,
 )
 
@@ -361,3 +362,234 @@ def test_sampled_serving_is_slot_independent(tiny_model):
     solo = run([9], [5], 0)
     crowded = run([9, 4, 17, 30], [5, 6, 4, 3], 0)
     np.testing.assert_array_equal(solo, crowded)
+
+
+# ---------------------------------------------------------------------------
+# device-resident multi-token decode (ISSUE 14): n-token captured blocks,
+# on-device token feedback, one host sync per block
+# ---------------------------------------------------------------------------
+
+def _serve_all(service, prompts, budgets, per_step=2):
+    """Staggered submission driver shared by the multi-token cases."""
+    rids, pending = [], list(zip(prompts, budgets))
+    while pending or service.has_work:
+        for _ in range(per_step):
+            if pending:
+                p, b = pending.pop(0)
+                rids.append(service.submit(p, max_new_tokens=b))
+        service.step()
+    return rids
+
+
+def test_blocks_for_request_covers_overrun_horizon():
+    """Reservation math: decode_steps=1 is the classic formula exactly;
+    n>1 rounds the decode span up to whole n-blocks (the ≤ n-1 overrun
+    writes stay inside the slot's own reservation) and clamps to the
+    slot's table length for near-capacity requests."""
+    # classic: ceil(max(bucket, p+new)/bs)
+    assert blocks_for_request(3, 6, 16, 16) == 1
+    assert blocks_for_request(3, 20, 16, 16) == 2
+    assert blocks_for_request(30, 3, 32, 16) == 3
+    # n=8: a 6-token budget runs 1 + ceil(5/8)*8 = 9 positions past p_len
+    assert blocks_for_request(3, 6, 16, 16, decode_steps=8) == 1
+    assert blocks_for_request(14, 6, 16, 16, decode_steps=8) == 2  # 14+9=23
+    # max_new=1 never holds a decode slot: horizon is 1 at every n
+    assert blocks_for_request(3, 1, 16, 16, decode_steps=8) == 1
+    # clamp: the overrun horizon may round past the table — tail writes are
+    # trash-block/clamped-in-slot safe, so never reserve past the table
+    assert blocks_for_request(50, 14, 64, 16, decode_steps=8,
+                              blocks_per_slot=4) == 4
+
+
+def test_multi_token_matches_generate_and_n1(tiny_model):
+    """The tentpole acceptance: n=8 greedy tokens are per-sequence
+    BITWISE identical to single-request generate() AND to the n=1 path,
+    under staggered admission landing at block boundaries mid-flight."""
+    lengths = [3, 9, 17, 30, 5, 24, 12, 40]
+    budgets = [6, 4, 8, 3, 7, 5, 6, 4]
+    prompts = _prompts(lengths)
+    outs = {}
+    for n in (1, 8):
+        service = DecodeService(
+            tiny_model,
+            ServingConfig(max_slots=4, block_size=16, prompt_bucket=16,
+                          decode_steps=n),
+        )
+        rids = _serve_all(service, prompts, budgets)
+        outs[n] = [service.results[rid].output_ids for rid in rids]
+        service.pool.check_no_leaks()
+        assert service.pool.free_blocks == service.pool.usable_blocks
+        assert service.recompile_events == 0
+    for p, b, got1, got8 in zip(prompts, budgets, outs[1], outs[8]):
+        want = np.asarray(tiny_model.generate(p[None], max_new_tokens=b))[0]
+        np.testing.assert_array_equal(got1, want)
+        np.testing.assert_array_equal(got8, want)
+
+
+def test_multi_token_mid_block_eos_masking(tiny_model):
+    """A stop token landing MID-block finishes the request at that token:
+    the block's overrun tail is discarded (never reaches the output), the
+    eos itself is emitted, and the output equals the generate() prefix —
+    while a slot-mate without eos runs to budget unperturbed."""
+    prompts = _prompts([6, 8], seed=4)
+    p_len = len(prompts[0])
+    ref = np.asarray(tiny_model.generate(prompts[0][None], max_new_tokens=8))[0]
+    eos = int(ref[p_len + 2])  # 3rd generated token plays the eos
+    first_hit = int(np.argmax(ref[p_len:] == eos))
+    service = DecodeService(
+        tiny_model,
+        ServingConfig(max_slots=2, block_size=16, prompt_bucket=16,
+                      decode_steps=8),
+    )
+    r0 = service.submit(prompts[0], max_new_tokens=8, eos_token_id=eos)
+    r1 = service.submit(prompts[1], max_new_tokens=8)
+    service.run()
+    got = service.results[r0].output_ids
+    assert got.shape[0] == p_len + first_hit + 1 and got[-1] == eos
+    np.testing.assert_array_equal(got, ref[: len(got)])
+    want1 = np.asarray(tiny_model.generate(prompts[1][None], max_new_tokens=8))[0]
+    np.testing.assert_array_equal(service.results[r1].output_ids, want1)
+    service.pool.check_no_leaks()
+
+
+def test_multi_token_overrun_keeps_pool_leak_free(tiny_model):
+    """Budgets that are NOT multiples of n overrun the captured block by up
+    to n-1 micro-steps on an UNDERSIZED pool: every overrun write lands in
+    the finishing slot's own reservation (or the trash block), the pool
+    drains leak-free, and outputs stay exact."""
+    service = DecodeService(
+        tiny_model,
+        ServingConfig(max_slots=4, block_size=16, prompt_bucket=16,
+                      num_blocks=7, decode_steps=8),
+    )
+    prompts = _prompts([17, 20, 25], seed=3)
+    budgets = [4, 11, 6]  # none a multiple of 8
+    rids = [
+        service.submit(p, max_new_tokens=b) for p, b in zip(prompts, budgets)
+    ]
+    service.run()
+    for rid, p, b in zip(rids, prompts, budgets):
+        want = np.asarray(tiny_model.generate(p[None], max_new_tokens=b))[0]
+        np.testing.assert_array_equal(service.results[rid].output_ids, want)
+    service.pool.check_no_leaks()
+    assert service.pool.free_blocks == service.pool.usable_blocks
+
+
+def test_zero_recompiles_steady_state_multi_token(tiny_model):
+    """The zero-recompile contract holds at n>1: one decode-block program +
+    one prefill program per bucket at warmup, then pure replays — and the
+    decode_steps flip itself is a NEW signature, never a steady-state
+    recompile event."""
+    from accelerate_tpu.serving import engine
+
+    engine._prefill_jit.clear_cache()
+    engine._decode_n_jit.clear_cache()
+    service = DecodeService(
+        tiny_model,
+        ServingConfig(max_slots=4, block_size=16, prompt_bucket=16,
+                      decode_steps=8),
+    )
+    for n in (4, 20):
+        service.submit(np.ones(n, np.int32), max_new_tokens=3)
+    service.run()
+    warm = service.watcher.compiles_total
+    assert warm >= 3  # 2 prefill buckets + 1 decode-block program
+    for p, b in zip(_prompts([5, 9, 17, 31, 2, 26], seed=1), [4, 2, 5, 3, 6, 2]):
+        service.submit(p, max_new_tokens=b)
+    service.run()
+    assert service.watcher.compiles_total == warm
+    assert service.recompile_events == 0
+    assert service.host_syncs_per_token < 0.5  # blocks, not per-token syncs
+
+
+def test_decode_steps_default_off_and_env_wiring(tiny_model, monkeypatch):
+    """decode_steps defaults to 1 (today's per-token path, byte-identical)
+    and resolves from $ACCELERATE_SERVING_DECODE_STEPS; a malformed value
+    warns and keeps the default; <1 is rejected at construction."""
+    assert ServingConfig().decode_steps == 1
+    monkeypatch.setenv("ACCELERATE_SERVING_DECODE_STEPS", "8")
+    assert ServingConfig().decode_steps == 8
+    monkeypatch.setenv("ACCELERATE_SERVING_DECODE_STEPS", "fast")
+    assert ServingConfig().decode_steps == 1
+    monkeypatch.delenv("ACCELERATE_SERVING_DECODE_STEPS")
+    with pytest.raises(ValueError, match="decode_steps"):
+        DecodeService(tiny_model, ServingConfig(decode_steps=0))
+    # explicit config wins over env
+    monkeypatch.setenv("ACCELERATE_SERVING_DECODE_STEPS", "4")
+    service = DecodeService(
+        tiny_model,
+        ServingConfig(max_slots=2, block_size=16, prompt_bucket=16,
+                      decode_steps=1),
+    )
+    p = _prompts([7], seed=11)[0]
+    rid = service.submit(p, max_new_tokens=5)
+    service.run()
+    want = np.asarray(tiny_model.generate(p[None], max_new_tokens=5))[0]
+    np.testing.assert_array_equal(service.results[rid].output_ids, want)
+    # the per-token path syncs once per token
+    assert service.host_syncs_per_token == 1.0
+
+
+@pytest.mark.parametrize("decode_steps", [4, 8])
+def test_steady_state_step_uploads_nothing(tiny_model, decode_steps):
+    """Regression (ISSUE 14 satellite): DecodeService.step() used to
+    re-upload tables/positions/tokens every step even with no admission.
+    On the multi-token path the decode state is device-resident — a
+    steady-state step performs ZERO host→device transfers, enforced with a
+    hard jax transfer guard (any upload raises), and the service's own h2d
+    counter agrees.  (decode_steps=1 deliberately keeps the legacy
+    per-step uploads: identical input avals → identical compiled binary →
+    the bitwise generate() parity contract stays anchored to the exact
+    program the seed service always ran.)"""
+    import jax
+
+    service = DecodeService(
+        tiny_model,
+        ServingConfig(max_slots=2, block_size=16, prompt_bucket=16,
+                      decode_steps=decode_steps),
+    )
+    prompts = _prompts([5, 9], seed=12)
+    rids = [service.submit(p, max_new_tokens=30) for p in prompts]
+    service.step()  # admission step: uploads happen here, by design
+    uploads_admit = service.stats["h2d_uploads"]
+    assert uploads_admit >= 1
+    with jax.transfer_guard_host_to_device("disallow"):
+        for _ in range(3):
+            service.step()
+    assert service.stats["h2d_uploads"] == uploads_admit
+    service.run()
+    for rid, p in zip(rids, prompts):
+        want = np.asarray(tiny_model.generate(p[None], max_new_tokens=30))[0]
+        np.testing.assert_array_equal(service.results[rid].output_ids, want)
+
+
+def test_multi_token_telemetry_and_metrics_counters(tiny_model):
+    """The new serving counters (docs/telemetry.md): step records carry
+    decode_steps/emitted, metrics() exposes host_syncs_per_token and the
+    h2d upload counter, and at n=8 the sync ratio lands near 1/8."""
+    from accelerate_tpu.telemetry import Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryKwargs
+
+    hub = Telemetry(TelemetryKwargs(enabled=True))
+    service = DecodeService(
+        tiny_model,
+        ServingConfig(max_slots=4, block_size=16, prompt_bucket=16,
+                      decode_steps=8),
+        telemetry=hub,
+    )
+    prompts = _prompts([4, 7, 9], seed=6)
+    _serve_all(service, prompts, [9, 8, 9])
+    steps = [
+        r for r in hub.all_records()
+        if r.get("kind") == "serving" and r.get("event") == "step"
+    ]
+    decoded = [r for r in steps if r["active"]]
+    assert decoded and all(r["decode_steps"] == 8 for r in steps)
+    assert all(r["emitted"] >= r["active"] for r in decoded)
+    metrics = service.metrics()
+    assert metrics["decode_steps"] == 8
+    assert metrics["decode_tokens_total"] == sum(r["emitted"] for r in steps)
+    assert metrics["h2d_uploads_total"] == service.stats["h2d_uploads"]
+    # one sync per 8-token block; stops discard some overrun tokens, so the
+    # ratio sits between 1/8 and the all-discarded worst case
+    assert 1 / 8 <= metrics["host_syncs_per_token"] <= 1 / 8 + 0.05

@@ -1,9 +1,11 @@
 #!/usr/bin/env python
 """serving_smoke — `make serve-smoke`: prove the decode service end-to-end
-on CPU in seconds (docs/serving.md, ISSUE 7 acceptance).
+on CPU in seconds (docs/serving.md, ISSUE 7 + ISSUE 14 acceptance).
 
 Tiny GPT, 8 concurrent requests with mixed prompt lengths and staggered
-arrivals through the continuous-batching service.  Exit 0 requires:
+arrivals through the continuous-batching service — TWICE: once on the
+classic per-token path (decode_steps=1) and once on the device-resident
+multi-token loop (decode_steps=8).  Exit 0 requires, for BOTH legs:
 
 * every request completes, and its greedy tokens are IDENTICAL to a
   single-request ``generate()`` of the same prompt (the parity contract —
@@ -12,7 +14,12 @@ arrivals through the continuous-batching service.  Exit 0 requires:
   program + one prefill program per prompt bucket, then pure replays);
 * the block pool drains with no leaked blocks;
 * telemetry (on for the run) retained ``kind="serving"`` step records with
-  occupancy and per-request completion records with TTFT/TPOT.
+  occupancy and per-request completion records with TTFT/TPOT;
+
+and additionally for the decode_steps=8 leg:
+
+* ``host_syncs_per_token`` ≤ 1/8 + ε — the hot loop really does sync the
+  host once per 8-token block, not per token.
 """
 
 import os
@@ -23,39 +30,36 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def main() -> int:
+def run_leg(model, hub, decode_steps: int) -> tuple[list[str], str]:
+    """One full staggered-trace run; returns (failures, summary line)."""
     import numpy as np
 
-    import accelerate_tpu.nn as nn
     from accelerate_tpu import DecodeService, ServingConfig
-    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
-    from accelerate_tpu.telemetry import Telemetry
-    from accelerate_tpu.utils.dataclasses import TelemetryKwargs
+    from accelerate_tpu.serving import bucket_length
 
-    nn.manual_seed(0)
-    model = GPTLMHeadModel(GPTConfig.tiny())
-    model.eval()
-    hub = Telemetry(TelemetryKwargs(enabled=True))
     service = DecodeService(
         model,
-        ServingConfig(max_slots=4, block_size=16, prompt_bucket=16),
+        ServingConfig(max_slots=4, block_size=16, prompt_bucket=16,
+                      decode_steps=decode_steps),
         telemetry=hub,
     )
 
     rng = np.random.default_rng(0)
     lengths = [3, 9, 17, 30, 5, 24, 12, 40]
-    budgets = [6, 4, 8, 3, 7, 5, 6, 4]
+    # budgets deep enough that the n=8 leg amortizes whole blocks — a
+    # request finishing inside its first block would read ~1/(budget-1)
+    # syncs/token no matter how good the loop is
+    budgets = [25, 17, 33, 9, 28, 19, 24, 14]
     prompts = [
         rng.integers(0, model.config.vocab_size, (n,), dtype=np.int32)
         for n in lengths
     ]
 
-    # warmup: one request per prefill bucket + the decode program
-    from accelerate_tpu.serving import bucket_length
-
+    # warmup: one request per prefill bucket + the decode program (budget
+    # = one full decode block, so warmup traffic amortizes like the trace)
     buckets = sorted({bucket_length(n, 16) for n in lengths})
     for b in buckets:
-        service.submit(np.ones(b, np.int32), max_new_tokens=2)
+        service.submit(np.ones(b, np.int32), max_new_tokens=decode_steps + 1)
     service.run()
     warm_compiles = service.watcher.compiles_total
 
@@ -70,40 +74,73 @@ def main() -> int:
                 rids.append(service.submit(p, max_new_tokens=b))
         service.step()
 
+    leg = f"decode_steps={decode_steps}"
     failures = []
     if service.recompile_events != 0:
         failures.append(
-            f"{service.recompile_events} recompile event(s) after warmup "
-            f"(warmup compiled {warm_compiles})"
+            f"[{leg}] {service.recompile_events} recompile event(s) after "
+            f"warmup (warmup compiled {warm_compiles})"
         )
     for rid, p, b in zip(rids, prompts, budgets):
         want = np.asarray(model.generate(p[None], max_new_tokens=b))[0]
         got = service.results[rid].output_ids
         if not np.array_equal(got, want):
-            failures.append(f"request {rid}: tokens diverge from generate()")
+            failures.append(
+                f"[{leg}] request {rid}: tokens diverge from generate()"
+            )
     try:
         service.pool.check_no_leaks()
         if service.pool.free_blocks != service.pool.usable_blocks:
-            failures.append("pool did not drain: blocks still reserved")
+            failures.append(f"[{leg}] pool did not drain: blocks reserved")
     except AssertionError as exc:
-        failures.append(str(exc))
+        failures.append(f"[{leg}] {exc}")
     records = [r for r in hub.all_records() if r.get("kind") == "serving"]
     steps = [r for r in records if r.get("event") == "step"]
     completes = [r for r in records if r.get("event") == "complete"]
     if not steps or any("occupancy" not in r for r in steps):
-        failures.append("no kind='serving' step records with occupancy")
+        failures.append(f"[{leg}] no kind='serving' step records with occupancy")
     if len(completes) < len(rids) or any(
         r.get("ttft_ms") is None for r in completes
     ):
-        failures.append("missing kind='serving' completion records with TTFT")
-
+        failures.append(f"[{leg}] missing completion records with TTFT")
+    # the device-resident loop's whole point: one host sync per n tokens
+    # (ε absorbs overrun tokens discarded at stops)
+    syncs = service.host_syncs_per_token
+    if syncs > 1.0 / decode_steps + 0.05:
+        failures.append(
+            f"[{leg}] host_syncs_per_token {syncs:.3f} > "
+            f"{1.0 / decode_steps:.3f} + 0.05 — the hot loop is syncing "
+            "the host more than once per block"
+        )
     n_done = len([r for r in rids if r in service.results])
-    print(
-        f"serving_smoke: {n_done}/{len(rids)} requests, "
+    summary = (
+        f"serving_smoke[{leg}]: {n_done}/{len(rids)} requests, "
         f"{service.stats['steps']} steps, mean occupancy "
         f"{service.mean_batch_occupancy:.2f}, {warm_compiles} warmup "
-        f"compiles, {service.recompile_events} steady-state recompiles"
+        f"compiles, {service.recompile_events} steady-state recompiles, "
+        f"{syncs:.3f} host syncs/token, "
+        f"{service.stats['h2d_uploads']} h2d uploads"
     )
+    return failures, summary
+
+
+def main() -> int:
+    import accelerate_tpu.nn as nn
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+    from accelerate_tpu.telemetry import Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryKwargs
+
+    nn.manual_seed(0)
+    model = GPTLMHeadModel(GPTConfig.tiny())
+    model.eval()
+
+    failures = []
+    for decode_steps in (1, 8):
+        hub = Telemetry(TelemetryKwargs(enabled=True))
+        leg_failures, summary = run_leg(model, hub, decode_steps)
+        failures.extend(leg_failures)
+        print(summary)
+
     for failure in failures:
         print(f"serving_smoke: FAIL: {failure}", file=sys.stderr)
     print(f"serving_smoke: {'FAILED' if failures else 'ok'}")
