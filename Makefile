@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke bench bench-gate
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke pipeline-smoke bench bench-gate
 
 # graftlint: whole-program trace-safety & collective-correctness static
 # analysis (docs/graftlint.md). Runs before the suite. The on-disk cache
@@ -33,11 +33,16 @@ lint-cold:
 # evolution, paged decode), IR-inspection assertions, and the
 # kernel-policy AOT fingerprint miss all exercise a real dp ring
 # (docs/kernels.md)
+# the ParallelPlan suite rides along at the ISSUE-15 acceptance geometry:
+# 2-stage × dp=2 interleaved 1F1B with ZeRO-1 + int8 compression + grad
+# accumulation in one captured step, ≤1e-3 loss parity vs the dp-only
+# run, zero steady-state recompiles, warm AOT restart of the stage
+# program with zero trace/compile (docs/parallel_plan.md)
 multichip:
 	XLA_FLAGS=--xla_force_host_platform_device_count=4 python -m pytest \
 	  tests/test_zero1.py tests/test_zero_sharding.py \
 	  tests/test_compression.py tests/test_serving.py tests/test_fleet.py \
-	  tests/test_kernels.py -q
+	  tests/test_kernels.py tests/test_parallel_plan.py -q
 
 # telemetry pipeline proof (docs/telemetry.md): tiny model, 3 steps + a
 # forced shape change with telemetry on, JSONL export validated through
@@ -100,6 +105,15 @@ autopilot-smoke:
 kernel-smoke:
 	JAX_PLATFORMS=cpu python tools/kernel_smoke.py
 
+# parallel-plan proof (docs/parallel_plan.md): 2-stage × dp=2 interleaved
+# 1F1B (V=2) with ZeRO-1 + int8 compression + grad accumulation in ONE
+# captured step on 4 virtual CPU devices — asserts the resolved plan IS
+# the acceptance geometry, ≤1e-3 loss parity vs the dp-only run, zero
+# steady-state recompiles, interleaved-vs-fused trajectory parity, and
+# the strictly-smaller analytic bubble at V=2
+pipeline-smoke:
+	JAX_PLATFORMS=cpu python tools/pipeline_smoke.py
+
 # bench regression gate (docs/performance.md): diff the newest
 # BENCH_r*.json primary step_ms against the previous round; exits nonzero
 # past $$BENCH_REGRESSION_PCT (default 10, same-platform rows only) — a
@@ -107,7 +121,7 @@ kernel-smoke:
 bench-gate:
 	python tools/bench_compare.py
 
-test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke bench-gate
+test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke pipeline-smoke bench-gate
 	python -m pytest tests/ -q
 
 test_core:
@@ -135,7 +149,8 @@ test_models:
 test_parallel:
 	python -m pytest tests/test_sharding_plan.py tests/test_zero_sharding.py \
 	  tests/test_zero1.py tests/test_compression.py \
-	  tests/test_pipeline.py tests/test_1f1b.py tests/test_ring_attention.py \
+	  tests/test_pipeline.py tests/test_1f1b.py tests/test_parallel_plan.py \
+	  tests/test_ring_attention.py \
 	  tests/test_flash_attention.py tests/test_sliding_window.py -q
 
 test_cli:

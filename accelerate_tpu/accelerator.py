@@ -302,6 +302,15 @@ class Accelerator:
 
         self.resilience = Resilience(self.resilience_handler, telemetry=self.telemetry)
 
+        # ONE resolved ParallelPlan (docs/parallel_plan.md): mesh axes, ZeRO
+        # modes, compression, pipeline stage layout — resolved here, once,
+        # from ParallelismConfig/plugins/env, published on the Borg state
+        # (parallel.plan.current_plan) and re-resolved only by a fleet
+        # resize.  Every consumer below (optimizer relayout, compression,
+        # capture, AOT fingerprint, fleet, the pipelined models) reads THIS
+        # object instead of rediscovering its own axis.
+        self._resolve_plan()
+
         # persistent AOT executable cache (docs/aot_cache.md): always
         # constructed, OFF unless CompilationCacheKwargs/$ACCELERATE_AOT_CACHE
         # names a cache dir — compile_step pins the enabled instance so the
@@ -322,6 +331,10 @@ class Accelerator:
             # armed set + lowering mode: a forced interpret flip must be a
             # loud miss too, not a replay of the other mode's executable
             kernels=self.kernels.cache_tag(),
+            # the resolved plan digest: a schedule/virtual-stage/ZeRO flip
+            # compiles a different program, so it must be a loud miss
+            # NAMING the plan field (docs/parallel_plan.md §AOT coupling)
+            plan=self.plan.describe(),
         )
         self.aot_cache.attach_telemetry(self.telemetry)
         _set_active(self.aot_cache if self.aot_cache.enabled else None)
@@ -349,6 +362,38 @@ class Accelerator:
             nn_random.manual_seed(int(os.environ["ACCELERATE_SEED"]))
         elif nn_random.default_rng._base_key is None:
             nn_random.manual_seed(nn_random.default_rng._seed)
+
+    # ----------------------------------------------------------------- plan
+    def _resolve_plan(self, bump: bool = False):
+        """Resolve (or, after a fleet resize, RE-resolve) the run's ONE
+        :class:`~accelerate_tpu.parallel.plan.ParallelPlan` from the live
+        state and publish it on the Borg state for :func:`current_plan`.
+
+        ``bump=True`` (the resize path) advances the plan generation and
+        the mesh generation together, so fleet-armed CapturedSteps drop
+        every compiled variant bound to the old layout before their next
+        lookup, and syncs the parallelism config's dp entry from the live
+        mesh — the single place it may move — so later mesh rebuilds and
+        ``zero1_enabled`` reads agree with what the plan says.  At
+        construction the config is left untouched: it just BUILT the mesh,
+        and pinning the auto-resolved dp onto it would make a second
+        Accelerator with an equivalent auto config a conflicting re-init
+        on the Borg state.
+        """
+        from .parallel.plan import DP_AXIS, ParallelPlan
+
+        if bump:
+            self.state.parallelism_config.dp_size = dict(
+                self.state.mesh.shape
+            ).get(DP_AXIS, 1)
+        generation = (self.plan.generation + 1) if (bump and hasattr(self, "plan")) else 0
+        self.plan = ParallelPlan.resolve(
+            self.state, compression=self._compression.name, generation=generation
+        )
+        self.state.plan = self.plan
+        if bump:
+            self._mesh_generation = getattr(self, "_mesh_generation", 0) + 1
+        return self.plan
 
     # ------------------------------------------------------------------ props
     @property
@@ -548,8 +593,10 @@ class Accelerator:
         # ZeRO-1 (arXiv:2004.13336): with a dp axis and no fsdp owner the
         # state relayout below additionally shards masters + moments over dp,
         # turning the captured update into reduce-scatter → 1/dp-shard-local
-        # AdamW → all-gather with no eager-mode change for users
-        zero1_mesh = self.state.mesh if self.state.zero1_enabled else None
+        # AdamW → all-gather with no eager-mode change for users.  The armed
+        # modes come off the resolved ParallelPlan (docs/parallel_plan.md) —
+        # the optimizer never re-derives its own dp axis.
+        zero1_mesh = self.state.mesh if self.plan.zero1 else None
         for opt in self._optimizers:
             opt.optimizer.relayout_for_sharded_params(
                 offload_to_host=offload_opt,
@@ -558,11 +605,14 @@ class Accelerator:
                 # quantized dp collectives + ZeRO-2 grad-accumulation layout
                 # (docs/compression.md); both no-ops unless armed
                 compression=self._compression,
-                zero2=self.state.zero2_enabled,
+                zero2=self.plan.zero2,
                 # Pallas hot-path kernels (docs/kernels.md): routes the
                 # ZeRO-1 writeback gather through the chunked ring and the
                 # quantized RS through the fused kernel; None-check off-path
                 kernels=self.kernels,
+                # per-param state shardings are the plan's to decide
+                # (ParallelPlan.state_spec delegates the ZeRO-1 layout rule)
+                plan=self.plan,
             )
         if offload_params:
             from .hooks import ParamOffloadHook, add_hook_to_module

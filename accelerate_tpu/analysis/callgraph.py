@@ -278,7 +278,16 @@ class _Collector(ast.NodeVisitor):
                         and ctor not in local_data
                     ):
                         ctor = self.factories[ctor]
-                    ctor_assigns.setdefault(target, []).append(ctor)
+                    elif isinstance(fn, ast.Name) and ctor in local_data:
+                        # v11: bare-name ctor shadowed by local data — with
+                        # factory maps now resolving through IMPORTS
+                        # (program.py), an unresolved name edge could later
+                        # mis-bind to an imported factory/class the local
+                        # binding actually shadows; record nothing so the
+                        # receiver stays uninferred
+                        ctor = None
+                    if ctor is not None:
+                        ctor_assigns.setdefault(target, []).append(ctor)
         ctor_of: dict[str, str] = {}
         for target, ctors in ctor_assigns.items():
             if target in params:
@@ -342,6 +351,9 @@ class CallGraph:
             f.qualname: f for f in collector.functions
         }
         self.classes: set[str] = set(collector.classes)
+        # exported for the program graph: other modules importing one of
+        # these factories resolve their receivers through it (v11)
+        self.factories: dict[str, str] = dict(collector.factories)
         self.by_leaf: dict[str, list[FunctionInfo]] = {}
         for f in collector.functions:
             self.by_leaf.setdefault(f.name, []).append(f)

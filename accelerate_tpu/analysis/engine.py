@@ -60,7 +60,17 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 # module-callable), and locally-shadowed names (an injected callable
 # parameter is DATA, not the module factory) all leave the receiver
 # uninferred.
-ANALYSIS_VERSION = "10"
+# v11: (a) new stage-boundary-vs-plan rule — pp axis sizes / stage layer
+# spans derived outside the resolved ParallelPlan (mesh.shape pp reads,
+# literal P('pp') specs, hand-sliced layers-per-stage arithmetic) fire in
+# consumer modules (docs/parallel_plan.md); (b) factory-return dispatch
+# inference through SINGLE-HOP imports — `from mod import make_thing;
+# obj = make_thing(); obj.m(x)` resolves through mod's v10 factory map to
+# the constructed class (factory→factory chains and re-exported factories
+# stay uninferred); (c) a bare-name constructor call whose name is locally
+# bound (parameter/assignment) now records NO ctor bind at all, so
+# shadowed names can never mis-resolve through the new import hop.
+ANALYSIS_VERSION = "11"
 
 # Names that mark a branch/function as profiling/benchmark plumbing, where a
 # deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the

@@ -98,6 +98,9 @@ class ModuleSummary:
     axes: list = dataclasses.field(default_factory=list)  # [axis, why]
     imports: list = dataclasses.field(default_factory=list)  # raw import records
     classes: list = dataclasses.field(default_factory=list)  # ClassDef qualnames
+    # {factory fn name: constructed class name} (callgraph.py v10 map) — the
+    # program graph resolves IMPORTED factories' receivers through it (v11)
+    factories: dict = dataclasses.field(default_factory=dict)
     error: Optional[str] = None  # set when the file failed to parse
     error_line: int = 0
 
@@ -110,6 +113,7 @@ class ModuleSummary:
             "axes": [list(a) for a in self.axes],
             "imports": self.imports,
             "classes": list(self.classes),
+            "factories": dict(self.factories),
             "error": self.error,
             "error_line": self.error_line,
         }
@@ -124,6 +128,7 @@ class ModuleSummary:
             axes=[tuple(a) for a in d.get("axes", [])],
             imports=d.get("imports", []),
             classes=list(d.get("classes", [])),
+            factories=dict(d.get("factories", {})),
             error=d.get("error"),
             error_line=d.get("error_line", 0),
         )
@@ -298,6 +303,7 @@ def extract_summary(module) -> ModuleSummary:
         axes=collect_axes(module),
         imports=module.import_records,
         classes=sorted(cg.classes),
+        factories=dict(getattr(cg, "factories", {})),
     )
 
 
@@ -481,12 +487,34 @@ class ProgramGraph:
             return self._resolve_class(sa[sym][0], sa[sym][1], depth + 1)
         return None
 
+    def _resolve_factory_class(self, module_name: str, sym: str):
+        """(module index, class qualname) constructed by factory ``sym`` of
+        ``module_name`` — the SINGLE import hop behind v11's
+        ``from mod import make_thing; obj = make_thing(); obj.method(x)``
+        inference.  Deliberately one hop: the factory must be defined (and
+        in the v10 factory map) of the module the import names directly —
+        factory→factory delegation chains and re-exported factories stay
+        uninferred (silent, never wrong)."""
+        j = self.by_name.get(module_name)
+        if j is None:
+            return None
+        ctor = self.records[j].summary.factories.get(sym)
+        if not ctor or "." in ctor:
+            # dotted ctor (alias.Cls) inside the factory: resolving it would
+            # need that module's own import table a second hop away — out of
+            # the single-hop contract
+            return None
+        return self._resolve_class(self.names[j], ctor)
+
     def _resolve_method(self, i: int, dotted: str):
         """Resolve an instance-dispatch edge — ``Cls.method`` with ``Cls``
         local or imported, or ``mod.Cls.method`` through a module alias —
         to the method's summary.  The cross-module half of the single-
         assignment type inference (callgraph.py): the edge names the
-        receiver's inferred constructor, this walks it to the class."""
+        receiver's inferred constructor, this walks it to the class.  When
+        the owner is not a class anywhere, it may be an IMPORTED factory
+        (``from mod import make_thing``): v11 resolves the class its
+        returns construct, one import hop only."""
         owner, _, method = dotted.rpartition(".")
         if not owner or not method:
             return None
@@ -498,6 +526,8 @@ class ProgramGraph:
                 sa = self.sym_aliases[i]
                 if owner in sa:
                     cls = self._resolve_class(sa[owner][0], sa[owner][1])
+                    if cls is None:
+                        cls = self._resolve_factory_class(sa[owner][0], sa[owner][1])
         else:
             head, _, rest = owner.partition(".")
             ma = self.mod_aliases[i]

@@ -8,6 +8,7 @@ from .host_sync import HostSyncInTrace
 from .pallas_hazard import PallasHazard
 from .recompile import RecompileHazard
 from .spec_drift import ShardingSpecDrift
+from .stage_boundary import StageBoundaryVsPlan
 from .transitive_donation import TransitiveDonation
 
 ALL_RULES = [
@@ -20,6 +21,7 @@ ALL_RULES = [
     BlockingInHotLoop,
     ShardingSpecDrift,
     PallasHazard,
+    StageBoundaryVsPlan,
 ]
 
 

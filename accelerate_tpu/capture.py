@@ -296,10 +296,12 @@ class CapturedStep:
             fleet.on_dispatch(self)
             generation = getattr(acc, "_mesh_generation", 0)
             if generation != self._mesh_generation:
-                # a resize re-meshed the run: every compiled variant binds
-                # the lost topology — drop them so the lookup below builds
-                # (or AOT-warm-loads) the surviving-topology program instead
-                # of dispatching against a mesh that no longer exists
+                # a resize re-meshed the run AND re-resolved the plan: every
+                # compiled variant binds the lost topology — drop them so
+                # the lookup below builds (or AOT-warm-loads) the surviving-
+                # topology program instead of dispatching against a mesh
+                # that no longer exists (the new builds fingerprint under
+                # the re-resolved plan via the cache's re-pinned context)
                 self._cache.clear()
                 self._layout_rebuilds.clear()
                 self._key_ids.clear()

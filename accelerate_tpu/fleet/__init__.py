@@ -276,7 +276,9 @@ class Fleet:
         if not self.handler.elastic:
             raise RuntimeError("elastic resize disabled (FleetKwargs.elastic=False)")
         mesh = accelerator.state.mesh
-        old_dp = dict(mesh.shape).get("dp", 1)
+        # the resolved ParallelPlan owns the dp axis (docs/parallel_plan.md)
+        # — no local mesh-dict rediscovery
+        old_dp = accelerator.plan.dp
         if target_dp is None:
             # default survivor model: half the fleet gone (one of two hosts)
             target_dp = max(self.handler.min_dp, old_dp // 2)
@@ -340,10 +342,18 @@ class Fleet:
         if not self.handler.elastic:
             raise RuntimeError("elastic resize disabled (FleetKwargs.elastic=False)")
         mesh = accelerator.state.mesh
-        old_dp = dict(mesh.shape).get("dp", 1)
+        # dp and the re-mesh constraint (devices per dp block) come from the
+        # resolved plan, not a local mesh-dict walk (docs/parallel_plan.md)
+        old_dp = accelerator.plan.dp
         if target_dp is None:
             # default rejoin model: the lost half came back
-            target_dp = min(old_dp * 2, max_growable_dp(mesh, devices=devices))
+            target_dp = min(
+                old_dp * 2,
+                max_growable_dp(
+                    mesh, devices=devices,
+                    non_dp_extent=accelerator.plan.non_dp_extent,
+                ),
+            )
         ckpt = checkpoint or self.drain(accelerator, output_dir)
         plan = grow_rendezvous(accelerator, target_dp, fleet=self, devices=devices)
         if plan is None:

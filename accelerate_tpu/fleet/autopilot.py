@@ -462,7 +462,13 @@ class Autopilot:
         else:
             from .grow import max_growable_dp
 
-            ceiling = max_growable_dp(mesh)
+            # the plan owns the re-mesh constraint (docs/parallel_plan.md);
+            # the mesh walk stays only for plan-less direct API use
+            plan = getattr(accelerator, "plan", None)
+            ceiling = max_growable_dp(
+                mesh,
+                non_dp_extent=plan.non_dp_extent if plan is not None else None,
+            )
             target = min(dp * 2, ceiling)
             if target <= dp:
                 if decision.get("hard"):

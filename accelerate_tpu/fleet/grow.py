@@ -49,13 +49,19 @@ def _device_pool(devices=None) -> list:
     return list(jax.devices())
 
 
-def max_growable_dp(mesh: Mesh, devices=None) -> int:
+def max_growable_dp(mesh: Mesh, devices=None, non_dp_extent=None) -> int:
     """The dp ceiling the visible device pool supports at this mesh's inner
-    extents — what a grow decision bounds its target by."""
-    inner = 1
-    for axis, size in dict(mesh.shape).items():
-        if axis != "dp":
-            inner *= size
+    extents — what a grow decision bounds its target by.  Callers with a
+    resolved plan pass ``plan.non_dp_extent`` (the plan owns the re-mesh
+    constraint, docs/parallel_plan.md); the mesh walk remains as the
+    plan-less fallback for direct API use."""
+    if non_dp_extent is not None:
+        inner = int(non_dp_extent)
+    else:
+        inner = 1
+        for axis, size in dict(mesh.shape).items():
+            if axis != "dp":
+                inner *= size
     pool = _device_pool(devices)
     return len(pool) // max(1, inner)
 

@@ -101,9 +101,11 @@ def remesh_accelerator(accelerator, new_mesh: Mesh) -> None:
 
     state = accelerator.state
     state.mesh = new_mesh
-    # keep the resolved parallelism layout honest: zero1_enabled, batch
-    # sharding and any later mesh rebuild read dp from here
-    state.parallelism_config.dp_size = dict(new_mesh.shape).get("dp", 1)
+    # RE-resolve the ONE ParallelPlan against the new mesh (bumping plan +
+    # mesh generations so fleet-armed CapturedSteps drop stale variants) —
+    # the plan re-sync also keeps parallelism_config's dp entry honest, the
+    # rediscovery this module used to do locally (docs/parallel_plan.md)
+    plan = accelerator._resolve_plan(bump=True)
     for model in accelerator._models:
         shard_module_params(
             model,
@@ -111,7 +113,7 @@ def remesh_accelerator(accelerator, new_mesh: Mesh) -> None:
             fsdp_plugin=state.fsdp_plugin,
             tp_plugin=state.tp_plugin,
         )
-    zero1_mesh = new_mesh if state.zero1_enabled else None
+    zero1_mesh = new_mesh if plan.zero1 else None
     offload_opt = bool(
         state.fsdp_plugin is not None
         and getattr(state.fsdp_plugin, "offload_optimizer", False)
@@ -126,11 +128,12 @@ def remesh_accelerator(accelerator, new_mesh: Mesh) -> None:
             offload_params=offload_params,
             zero1_mesh=zero1_mesh,
             compression=accelerator._compression,
-            zero2=state.zero2_enabled,
+            zero2=plan.zero2,
             # a resize must not silently disarm the Pallas kernel policy
             # (docs/kernels.md): the re-laid-out update keeps the same
             # ring/fused-RS routing the pre-loss steps compiled with
             kernels=accelerator.kernels,
+            plan=plan,
         )
     accelerator._refresh_zero2_grads()
     # gradients from the pre-loss steps are still committed to the lost
@@ -154,11 +157,26 @@ def remesh_accelerator(accelerator, new_mesh: Mesh) -> None:
     for loader in accelerator._dataloaders:
         if getattr(loader, "mesh", None) is not None:
             loader.mesh = new_mesh
-    # captured programs compiled for the old topology are invalid; bumping
-    # the generation makes every fleet-armed CapturedStep drop its variants
-    # before the next lookup (fleet-off steps never check — the resize API
-    # is only reachable through an enabled fleet)
-    accelerator._mesh_generation = getattr(accelerator, "_mesh_generation", 0) + 1
+    # captured programs compiled for the old topology are invalid; the plan
+    # re-resolve above already bumped the mesh generation, which makes every
+    # fleet-armed CapturedStep drop its variants before the next lookup
+    # (fleet-off steps never check — the resize API is only reachable
+    # through an enabled fleet)
+    #
+    # the AOT cache's canonical fingerprint must move WITH the mesh+plan —
+    # here, not only in prewarm_aot_cache: a direct remesh_accelerator
+    # caller that skips the prewarm would otherwise store new-topology
+    # executables under the pre-resize fingerprint, and a later warm
+    # restart at the old geometry would deserialize a program compiled for
+    # a mesh that no longer exists
+    cache = getattr(accelerator, "aot_cache", None)
+    if cache is not None and cache.enabled:
+        cache.set_context(
+            mesh=new_mesh,
+            compression=accelerator._compression.name,
+            kernels=accelerator.kernels.cache_tag(),
+            plan=plan.describe(),
+        )
 
 
 def prewarm_aot_cache(accelerator, compression_name: Optional[str] = None) -> int:
@@ -177,5 +195,9 @@ def prewarm_aot_cache(accelerator, compression_name: Optional[str] = None) -> in
         # the re-pin must hash the same armed set the new-topology
         # programs will compile with, or every prewarm lookup misses
         kernels=accelerator.kernels.cache_tag(),
+        # and on the re-resolved plan digest (docs/parallel_plan.md): the
+        # resized dp lives there, so the prewarm hashes what the new
+        # topology's programs will be stored under
+        plan=accelerator.plan.describe(),
     )
     return cache.prefetch()

@@ -565,10 +565,15 @@ class PipelinedGPTLMHeadModel(nn.Module):
 
     def forward(self, input_ids, labels=None):
         from ..parallel.pipeline import gpipe
+        from ..parallel.plan import current_plan
         from ..parallel.sharding import constrain_activation
         from ..state import AcceleratorState
 
         mesh = AcceleratorState().mesh if AcceleratorState._shared_state else None
+        # the resolved ParallelPlan owns schedule / stage layout / sp mode
+        # (docs/parallel_plan.md) — this model never pokes plugins or the
+        # mesh dict for axis sizes (graftlint stage-boundary-vs-plan)
+        plan = current_plan()
 
         ids = jnp.asarray(input_ids.data if isinstance(input_ids, Tensor) else input_ids)
         b, s = ids.shape
@@ -578,19 +583,12 @@ class PipelinedGPTLMHeadModel(nn.Module):
 
         cfg = self.config
         names = _StackedBlocks._ORDER
-        # SequenceParallelPlugin.mode selects the sp attention engine; the
-        # ulysses body needs heads divisible across the sp axis, else ring
-        from ..state import AcceleratorState
-
+        # the plan's sp mode selects the attention engine; the ulysses body
+        # needs heads divisible across the sp axis, else ring
         sp_mode = "ring"
-        state = AcceleratorState._shared_state and AcceleratorState()
-        sp_plugin = getattr(state, "sp_plugin", None) if state else None
-        if (
-            sp_plugin is not None
-            and mesh is not None
-            and getattr(sp_plugin, "mode", "ring") == "all_to_all"
-        ):
-            if cfg.n_head % mesh.shape.get("sp", 1) == 0:
+        sp_size = plan.sp if plan is not None else 1
+        if plan is not None and plan.sp_mode == "all_to_all" and sp_size > 1:
+            if cfg.n_head % sp_size == 0:
                 sp_mode = "all_to_all"
             else:
                 # captured steps keep whatever mode the first trace chose, so
@@ -598,7 +596,7 @@ class PipelinedGPTLMHeadModel(nn.Module):
                 warnings.warn(
                     f"SequenceParallelPlugin(mode='all_to_all') ignored: "
                     f"n_head={cfg.n_head} is not divisible by the sp axis "
-                    f"size {mesh.shape.get('sp', 1)}; falling back to ring "
+                    f"size {sp_size}; falling back to ring "
                     "attention for this (and, under capture, every) step.",
                     stacklevel=2,
                 )
@@ -610,22 +608,23 @@ class PipelinedGPTLMHeadModel(nn.Module):
                 sp_mode=sp_mode,
             )
 
-        # -- fused 1F1B training path (PipelineParallelPlugin.schedule) ------
-        pp_plugin = getattr(state, "pp_plugin", None) if state else None
-        schedule = getattr(pp_plugin, "schedule", "gpipe") if pp_plugin else "gpipe"
-        pp_size = mesh.shape.get("pp", 1) if mesh is not None else 1
-        if labels is not None and schedule == "1f1b" and pp_size > 1:
-            if mesh.shape.get("sp", 1) > 1:
+        # -- fused/interleaved 1F1B training path (plan.stage.schedule) ------
+        stage = plan.stage if plan is not None else None
+        schedule = stage.schedule if stage is not None else "gpipe"
+        pp_size = plan.pp if plan is not None else 1
+        if labels is not None and schedule in ("1f1b", "interleaved") and pp_size > 1:
+            if sp_size > 1:
                 raise NotImplementedError(
-                    "schedule='1f1b' computes the loss inside the pipeline and "
-                    "does not yet compose with sequence parallelism (the "
-                    "shifted CE crosses seq-chunk boundaries); use "
-                    "schedule='gpipe' with sp>1"
+                    f"schedule={schedule!r} computes the loss inside the "
+                    "pipeline and does not yet compose with sequence "
+                    "parallelism (the shifted CE crosses seq-chunk "
+                    "boundaries); use schedule='gpipe' with sp>1"
                 )
             from ..parallel.pipeline import pipeline_loss_1f1b
 
             lbl = jnp.asarray(labels.data if isinstance(labels, Tensor) else labels)
             n_names = len(names)
+            virtual = stage.virtual
 
             def fused(xv, *flat):
                 stacked = dict(zip(names, flat[:n_names]))
@@ -635,7 +634,8 @@ class PipelinedGPTLMHeadModel(nn.Module):
                     return _pure_lm_head_loss(out, lbl_mb, ep, eps=cfg.layer_norm_eps)
 
                 f = pipeline_loss_1f1b(
-                    stage_fn, loss_fn, lbl, self.num_microbatches, mesh=mesh
+                    stage_fn, loss_fn, lbl, self.num_microbatches, mesh=mesh,
+                    virtual=virtual,
                 )
                 return f(stacked, xv, extra)
 

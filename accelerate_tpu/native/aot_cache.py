@@ -87,7 +87,9 @@ def _set_jax_cache_layer_disarmed(value: bool) -> None:
 # bump when the entry layout / side-metadata schema changes: old entries
 # then report a format mismatch and fall through to a normal compile
 # (2: compiler flags joined the fingerprint as flat flag:* fields)
-AOT_CACHE_FORMAT = 2
+# (3: the resolved ParallelPlan digest joined as the `plan` field — a
+#  schedule/virtual-stage/ZeRO/compression flip is a loud miss naming it)
+AOT_CACHE_FORMAT = 3
 
 # compiler-mode flags that change the COMPILED PROGRAM without moving any
 # shape/dtype/topology field the fingerprint already hashes: a flip between
@@ -142,13 +144,18 @@ def _leaf_aval(x) -> list:
 
 
 def topology_fingerprint(mesh=None, compression: Optional[str] = None,
-                         kernels: Optional[str] = None) -> dict:
+                         kernels: Optional[str] = None,
+                         plan: Optional[dict] = None) -> dict:
     """The invalidation matrix (docs/aot_cache.md): any field moving between
     the storing and the loading process makes the entry stale.  ``kernels``
     is the armed Pallas-kernel set (``KernelPolicy.describe()``,
     docs/kernels.md): a kernel-armed program computes through different IR
     than the reference path, so flipping a kernel must be a loud miss
-    NAMING the ``kernels`` field — never a silently-stale executable."""
+    NAMING the ``kernels`` field — never a silently-stale executable.
+    ``plan`` is the resolved ``ParallelPlan.describe()`` digest
+    (docs/parallel_plan.md): the pipeline schedule / virtual-stage factor /
+    ZeRO modes shape the compiled program beyond the raw mesh dict, so a
+    plan flip must likewise be a loud miss NAMING the ``plan`` field."""
     import jax
     import jaxlib
 
@@ -164,6 +171,7 @@ def topology_fingerprint(mesh=None, compression: Optional[str] = None,
         "mesh": dict(mesh.shape) if mesh is not None else None,
         "compression": compression,
         "kernels": kernels or "none",
+        "plan": plan,
     }
     for flag in FINGERPRINT_FLAGS:
         # repr, not str: distinguishes unset (None) from the string "None",
@@ -356,17 +364,18 @@ class AOTCompilationCache:
 
     # -- fingerprint ---------------------------------------------------------
     def set_context(self, mesh=None, compression: Optional[str] = None,
-                    kernels: Optional[str] = None) -> None:
-        """Pin the owning run's mesh/compression/kernel-policy into the
-        cache's ONE canonical fingerprint (the Accelerator calls this at
-        construction).  Every consumer — captured-step digests, serving
-        warm, restore prefetch — must hash the same fingerprint, or a
-        prefetch that runs before the first step (the preemption-resume
-        flow) would pin a mesh-less fingerprint and every later lookup
-        would miss."""
+                    kernels: Optional[str] = None,
+                    plan: Optional[dict] = None) -> None:
+        """Pin the owning run's mesh/compression/kernel-policy/plan digest
+        into the cache's ONE canonical fingerprint (the Accelerator calls
+        this at construction; a fleet resize re-pins it).  Every consumer —
+        captured-step digests, serving warm, restore prefetch — must hash
+        the same fingerprint, or a prefetch that runs before the first step
+        (the preemption-resume flow) would pin a mesh-less fingerprint and
+        every later lookup would miss."""
         if self.enabled:
             self._fingerprint = topology_fingerprint(
-                mesh=mesh, compression=compression, kernels=kernels
+                mesh=mesh, compression=compression, kernels=kernels, plan=plan
             )
 
     def fingerprint(self) -> dict:

@@ -335,6 +335,7 @@ class Optimizer:
         compression=None,
         zero2: bool = False,
         kernels=None,
+        plan=None,
     ) -> None:
         """Move optimizer state + fp32 masters onto the params' shardings.
 
@@ -355,6 +356,12 @@ class Optimizer:
         move, and :meth:`step` constrains updated params back to the param
         layout so GSPMD emits reduce-scatter/all-gather around a 1/dp-local
         update inside the captured program.
+
+        ``plan``: the run's resolved :class:`ParallelPlan`
+        (docs/parallel_plan.md).  When given, the per-param state spec comes
+        from :meth:`ParallelPlan.state_spec` — the plan OWNS the ZeRO-1
+        layout rule — instead of this module re-deriving it; ``zero1_mesh``
+        stays the mesh handle the specs bind to.
         """
         self._ensure_master()
         self._offload_host = bool(offload_to_host)
@@ -374,9 +381,14 @@ class Optimizer:
         if zero1_mesh is not None:
             from .parallel.sharding import zero1_state_spec
 
+            def _state_spec(shape, param_spec):
+                if plan is not None:
+                    return plan.state_spec(shape, zero1_mesh, param_spec)
+                return zero1_state_spec(shape, zero1_mesh, param_spec)
+
             for i, (p, s) in enumerate(zip(self.param_list, shardings)):
                 if isinstance(s, jax.sharding.NamedSharding):
-                    spec = zero1_state_spec(tuple(p.shape), zero1_mesh, s.spec)
+                    spec = _state_spec(tuple(p.shape), s.spec)
                     state_shardings[i] = jax.sharding.NamedSharding(zero1_mesh, spec)
                     for j, entry in enumerate(spec):
                         in_entry = (

@@ -115,7 +115,7 @@ def _gpipe_local(
 # ---------------------------------------------------------------------------
 # 1F1B: fused forward+backward schedule (Megatron-style memory profile)
 # ---------------------------------------------------------------------------
-def residual_window(num_stages: int) -> int:
+def residual_window(num_stages: int, virtual: int = 1) -> int:
     """In-flight stage-input slots a 1F1B stage must hold: ``2·S − 1``.
 
     Derivation: stage ``s`` forwards microbatch ``f`` at tick ``s+f`` and
@@ -125,15 +125,57 @@ def residual_window(num_stages: int) -> int:
     the microbatch count — the 1F1B memory win over fill-drain GPipe is
     exactly ``M`` → ``2S−1`` stage inputs (reference obtains this from
     megatron.core's 1F1B forward_backward_func, utils/megatron_lm.py:40).
+
+    ``virtual > 1`` (interleaved 1F1B): each device hosts V virtual stages,
+    and across them holds at most ``V·(2S−1)`` in-flight CHUNK inputs — the
+    same ``2S−1`` order per hosted span, but each input is 1/V the fused
+    stage's activation (the chunk's span is 1/V the layers), so the byte
+    footprint stays at the fused profile.
     """
-    return 2 * num_stages - 1
+    return virtual * (2 * num_stages - 1)
 
 
-def schedule_ticks(num_microbatches: int, num_stages: int) -> int:
-    """Lockstep cycles for the fused schedule: ``M + 2S − 2`` (each cycle
-    is one forward slot + one backward slot; bubble fraction matches
-    non-interleaved 1F1B: ``(S−1)/(M+S−1)`` per direction)."""
-    return num_microbatches + 2 * num_stages - 2
+def schedule_ticks(num_microbatches: int, num_stages: int, virtual: int = 1) -> int:
+    """Lockstep trip count of the fused/interleaved 1F1B loop.
+
+    ``virtual == 1`` (fused): ``M + 2S − 2`` cycles, each one forward +
+    one backward FULL-STAGE slot.  ``virtual > 1`` (interleaved):
+    ``M·V + S·V + S − 2`` ticks, each one forward + one backward CHUNK
+    slot (1/V of a stage) — the fill/drain ramp runs at chunk granularity,
+    which is where the bubble shrinks (:func:`bubble_fraction`).
+    """
+    if virtual <= 1:
+        return num_microbatches + 2 * num_stages - 2
+    return (num_microbatches + num_stages) * virtual + num_stages - 2
+
+
+def bubble_ticks(num_microbatches: int, num_stages: int, virtual: int = 1,
+                 granularity: int = None) -> int:
+    """Fill+drain bubble of the SELF-CLOCKED schedule, in chunk slots of
+    ``1/granularity`` of a stage (default: the schedule's own chunk size).
+
+    The ramp each way is ``S−1`` hand-offs of one schedule chunk (a full
+    stage fused, ``1/V`` of a stage interleaved), so in a common unit the
+    interleaved bubble is the fused one divided by V — the MPMD paper's
+    gain (PAPERS.md #4).  Pass the SAME ``granularity`` (e.g. the larger
+    V) to compare schedules: ``bubble_ticks(M, S, 1, g) >
+    bubble_ticks(M, S, V, g)`` for any V > 1.
+
+    The lockstep SPMD rehearsal on virtual CPU devices pays masked slots
+    and does not realize this gain in wall clock; the per-stage captured
+    programs (AOT store) are what make the self-clocked timeline
+    realizable on MPMD hardware.
+    """
+    g = granularity or virtual
+    return 2 * (num_stages - 1) * g // virtual
+
+
+def bubble_fraction(num_microbatches: int, num_stages: int,
+                    virtual: int = 1) -> float:
+    """Pipeline-bubble fraction of the self-clocked schedule:
+    ``(S−1)/(V·M)`` — the fused 1F1B's ``(S−1)/M`` shrunk by the
+    interleave factor (Megatron/MPMD bubble math)."""
+    return (num_stages - 1) / (virtual * num_microbatches)
 
 
 def _one_f_one_b_local(
@@ -314,6 +356,216 @@ def _one_f_one_b_local(
     return loss, dparams, dx, dextra
 
 
+def _interleaved_1f1b_local(
+    stage_params,
+    x,
+    labels,
+    extra_params,
+    *,
+    stage_fn,
+    loss_fn,
+    axis_name: str,
+    num_microbatches: int,
+    num_stages: int,
+    virtual: int,
+    batch_axes_present: tuple = (),
+):
+    """Per-device INTERLEAVED fused fwd+bwd 1F1B under shard_map.
+
+    Each device hosts ``V = virtual`` non-contiguous virtual-stage layer
+    chunks (the plan's :meth:`StagePlan.layer_order` permutation groups its
+    local rows as ``[k*c:(k+1)*c] = chunk k`` = global virtual stage
+    ``k*S + d``), and every tick executes ONE forward chunk and ONE
+    backward chunk instead of a full stage — the fill/drain ramp runs at
+    chunk granularity, which is the whole interleaving win
+    (:func:`bubble_fraction`).
+
+    Slot mapping (derived so every ring hop is exactly one tick):
+    forward of (chunk ``k``, microbatch ``m``) runs on device ``d`` at tick
+    ``t = d + j`` with ``j = (k + (m//S)·V)·S + (m%S)``; the backward
+    mirrors it with device and chunk order reversed, offset
+    ``(S−1−d) + S·V−1`` so the last virtual stage seeds its own backward
+    in the same tick as its forward (exactly the fused code's property).
+    Both the same-chunk hop (device d→d+1) and the chunk-boundary hop
+    (device S−1 → device 0, next chunk) are the single up-ring ppermute;
+    cotangents ride the down-ring one.  Requires ``M % S == 0`` (the
+    classic Megatron constraint — the plan validates at construction).
+
+    Residual state: a ``(V, 2S)`` per-chunk input window (collision-free:
+    same-chunk in-flight microbatches are at most 2S apart) — the
+    ``residual_window(S, virtual=V) = V·(2S−1)``-order profile, each slot
+    1/V the fused stage's span.
+    """
+    s_idx = jax.lax.axis_index(axis_name)
+    M, S, V = num_microbatches, num_stages, virtual
+    if M % S != 0:
+        raise ValueError(
+            f"interleaved 1F1B needs num_microbatches ({M}) divisible by "
+            f"the pipeline size ({S})"
+        )
+    if x.shape[0] % M != 0:
+        raise ValueError(
+            f"per-device batch {x.shape[0]} not divisible by num_microbatches {M}"
+        )
+    mb = x.shape[0] // M
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+    labels_mb = labels.reshape(M, mb, *labels.shape[1:])
+    local_layers = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    if local_layers % V != 0:
+        raise ValueError(
+            f"local layer span {local_layers} not divisible by virtual={V}"
+        )
+    c = local_layers // V
+    Wm = 2 * S  # per-chunk window slots
+    T = schedule_ticks(M, S, virtual=V)
+
+    def chunk_params(k):
+        return jax.tree_util.tree_map(
+            lambda p: jax.lax.dynamic_slice_in_dim(p, k * c, c, axis=0),
+            stage_params,
+        )
+
+    def fwd_apply(p_chunk, inp):
+        return _apply_local_layers(stage_fn, p_chunk, inp)
+
+    sample_out = jax.eval_shape(fwd_apply, chunk_params(0), x_mb[0])
+    if sample_out.shape != x_mb.shape[1:] or sample_out.dtype != x_mb.dtype:
+        raise ValueError(
+            "1f1b requires shape/dtype-preserving stages (GPipe classic): "
+            f"stage maps {x_mb.shape[1:]}/{x_mb.dtype} → "
+            f"{sample_out.shape}/{sample_out.dtype}"
+        )
+
+    perm_up = [(i, (i + 1) % S) for i in range(S)]
+    perm_dn = [(i, (i - 1) % S) for i in range(S)]
+
+    carry0 = (
+        jnp.zeros(x_mb.shape[1:], x_mb.dtype),  # incoming activation
+        jnp.zeros(x_mb.shape[1:], x_mb.dtype),  # incoming cotangent
+        jnp.zeros((V, Wm) + x_mb.shape[1:], x_mb.dtype),  # chunk-input windows
+        jax.tree_util.tree_map(jnp.zeros_like, stage_params),  # grad accum
+        jax.tree_util.tree_map(jnp.zeros_like, extra_params),
+        jnp.zeros_like(x_mb),  # dx per microbatch (virtual stage 0 only)
+        jnp.zeros((), jnp.float32),  # loss-sum accumulator
+        jnp.zeros((), jnp.float32),  # loss-weight accumulator
+    )
+
+    def tick(t, carry):
+        act_in, cot_in, window, dparams, dextra, dx_mb, loss_sum, weight_sum = carry
+
+        # -- forward chunk slot --------------------------------------------
+        j = t - s_idx
+        f_active = jnp.logical_and(j >= 0, j < M * V)
+        jc = jnp.clip(j, 0, M * V - 1)
+        B, i = jc // S, jc % S
+        k_f = B % V
+        m_f = (B // V) * S + i
+        my_in = jnp.where(
+            jnp.logical_and(k_f == 0, s_idx == 0),  # global virtual stage 0
+            jax.lax.dynamic_index_in_dim(x_mb, m_f, keepdims=False),
+            act_in,
+        )
+        slot = m_f % Wm
+        keep = window[k_f, slot]
+        window = window.at[k_f, slot].set(jnp.where(f_active, my_in, keep))
+        out = fwd_apply(chunk_params(k_f), my_in)
+        out = jnp.where(f_active, out, jnp.zeros_like(out))
+        act_nxt = jax.lax.ppermute(out, axis_name, perm_up)
+
+        # -- backward chunk slot -------------------------------------------
+        jb = t - ((S - 1 - s_idx) + S * V - 1)
+        b_active = jnp.logical_and(jb >= 0, jb < M * V)
+        jbc = jnp.clip(jb, 0, M * V - 1)
+        Bb, ib = jbc // S, jbc % S
+        k_b = (V - 1) - (Bb % V)
+        m_b = (Bb // V) * S + ib
+        saved_in = window[k_b, m_b % Wm]
+        lbl = jax.lax.dynamic_index_in_dim(labels_mb, m_b, keepdims=False)
+        p_chunk = chunk_params(k_b)
+
+        def last_vstage(_):
+            # global virtual stage S·V−1: loss lives here — vjp through the
+            # chunk span + loss head, UN-normalised sum + weight exactly as
+            # the fused schedule (global token-mean after the loop)
+            def f_last(p, inp, ep):
+                return loss_fn(fwd_apply(p, inp), lbl, ep)
+
+            lsum, vjp, w = jax.vjp(f_last, p_chunk, saved_in, extra_params,
+                                   has_aux=True)
+            dp, dinp, dep = vjp(jnp.float32(1.0))
+            return lsum, jnp.asarray(w, jnp.float32), dp, dinp, dep
+
+        def mid_vstage(_):
+            def f_mid(p, inp):
+                return fwd_apply(p, inp)
+
+            _, vjp = jax.vjp(f_mid, p_chunk, saved_in)
+            dp, dinp = vjp(cot_in)
+            return (
+                jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.float32),
+                dp,
+                dinp,
+                jax.tree_util.tree_map(jnp.zeros_like, extra_params),
+            )
+
+        lsum, w, dp, dinp, dep = jax.lax.cond(
+            jnp.logical_and(k_b == V - 1, s_idx == S - 1),
+            last_vstage, mid_vstage, None,
+        )
+        bmask = b_active.astype(jnp.float32)
+        dparams = jax.tree_util.tree_map(
+            lambda a, g: jax.lax.dynamic_update_slice_in_dim(
+                a,
+                jax.lax.dynamic_slice_in_dim(a, k_b * c, c, axis=0)
+                + bmask.astype(g.dtype) * g,
+                k_b * c,
+                axis=0,
+            ),
+            dparams,
+            dp,
+        )
+        dextra = jax.tree_util.tree_map(
+            lambda a, g: a + bmask.astype(g.dtype) * g, dextra, dep
+        )
+        loss_sum = loss_sum + bmask * lsum
+        weight_sum = weight_sum + bmask * w
+        dinp = jnp.where(b_active, dinp, jnp.zeros_like(dinp))
+        dx_mb = jax.lax.cond(
+            jnp.logical_and(
+                b_active, jnp.logical_and(k_b == 0, s_idx == 0)
+            ),
+            lambda d: jax.lax.dynamic_update_index_in_dim(
+                d, dinp.astype(d.dtype), m_b, 0
+            ),
+            lambda d: d,
+            dx_mb,
+        )
+        cot_nxt = jax.lax.ppermute(dinp, axis_name, perm_dn)
+
+        return (act_nxt, cot_nxt, window, dparams, dextra, dx_mb, loss_sum, weight_sum)
+
+    (_, _, _, dparams, dextra, dx_mb, loss_sum, weight_sum) = jax.lax.fori_loop(
+        0, T, tick, carry0
+    )
+    # identical manual reductions to the fused schedule (see its comment)
+    ba = tuple(batch_axes_present)
+    total_sum = jax.lax.psum(loss_sum, (axis_name,) + ba)
+    total_w = jnp.maximum(jax.lax.psum(weight_sum, (axis_name,) + ba), 1e-9)
+    loss = total_sum / total_w
+    inv_w = 1.0 / total_w
+    dparams = jax.tree_util.tree_map(
+        lambda g: (jax.lax.psum(g, ba) if ba else g) * inv_w.astype(g.dtype),
+        dparams,
+    )
+    dextra = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, (axis_name,) + ba) * inv_w.astype(g.dtype),
+        dextra,
+    )
+    dx = (jax.lax.psum(dx_mb, axis_name) * inv_w).astype(x.dtype).reshape(x.shape)
+    return loss, dparams, dx, dextra
+
+
 def _resolve_pipeline_layout(
     stacked_params,
     mesh: Optional[Mesh],
@@ -377,15 +629,34 @@ def pipeline_train_1f1b(
     axis_name: str = "pp",
     batch_axes: tuple = ("dp", "fsdp"),
     seq_axis: Optional[str] = None,
+    virtual: int = 1,
 ):
-    """Fused 1F1B pipeline training step over the ``pp`` mesh axis.
+    """Fused (``virtual=1``) or interleaved (``virtual=V>1``) 1F1B pipeline
+    training step over the ``pp`` mesh axis.
 
     Returns ``(loss, dstacked_params, dx, dextra_params)``.  Unlike
     :func:`gpipe`, gradients are computed INSIDE the schedule (backward of
     microbatch ``b`` overlaps forward of ``b+1..``), so peak in-flight
-    activations per stage are ``residual_window(S)`` stage inputs instead of
-    ``num_microbatches`` — wrap with ``jax.custom_vjp`` (models do this) so
-    JAX never transposes this function.
+    activations per stage are ``residual_window(S, virtual)`` stage inputs
+    instead of ``num_microbatches`` — wrap with ``jax.custom_vjp`` (models
+    do this) so JAX never transposes this function.
+
+    Interleaving is a LAYOUT decision owned by the plan: the stacked layer
+    axis is permuted by :meth:`StagePlan.layer_order` (a host-computed
+    constant index vector, applied as an in-program gather) so the plain
+    contiguous ``P(pp)`` sharding hands each device its V non-contiguous
+    virtual-stage chunks, the schedule hops microbatches V× around the
+    ring, and the returned gradients are un-permuted back to the caller's
+    layer order — callers see the identical contract at every V.
+
+    Known cost: because the gather (and its inverse on the gradients) is
+    traced into the step, ~``(1-1/V)`` of the stacked layer params move
+    across pp devices inside every compiled step — invisible on the CPU
+    rehearsal, a real bandwidth tax on hardware.  The planned fix is to
+    commit the permuted layout ONCE at ``prepare()`` (ROADMAP: the
+    optimizer/checkpoint layout contract must then carry the plan's order),
+    at which point this in-program permutation becomes the plan-less
+    fallback.
     """
     mesh, n_stages, param_specs, data_spec = _resolve_pipeline_layout(
         stacked_params, mesh, axis_name, batch_axes, seq_axis,
@@ -399,6 +670,41 @@ def pipeline_train_1f1b(
     lbl_spec = data_spec(labels)
 
     batch_axes_present = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+
+    if virtual > 1:
+        from .plan import StagePlan
+
+        num_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        stage = StagePlan(
+            num_stages=n_stages, virtual=virtual,
+            num_microbatches=num_microbatches, schedule="interleaved",
+        )
+        order = jnp.asarray(stage.layer_order(num_layers))
+        inverse = jnp.asarray(stage.inverse_layer_order(num_layers))
+        permuted = jax.tree_util.tree_map(
+            lambda p: jnp.take(p, order, axis=0), stacked_params
+        )
+        local_fn = functools.partial(
+            _interleaved_1f1b_local,
+            stage_fn=stage_fn,
+            loss_fn=loss_fn,
+            axis_name=axis_name,
+            num_microbatches=num_microbatches,
+            num_stages=n_stages,
+            virtual=virtual,
+            batch_axes_present=batch_axes_present,
+        )
+        fn = shard_map_compat(
+            local_fn,
+            mesh=mesh,
+            in_specs=(param_specs, x_spec, lbl_spec, extra_specs),
+            out_specs=(P(), param_specs, x_spec, extra_specs),
+        )
+        loss, dpermuted, dx, dextra = fn(permuted, x, labels, extra_params)
+        dstacked = jax.tree_util.tree_map(
+            lambda g: jnp.take(g, inverse, axis=0), dpermuted
+        )
+        return loss, dstacked, dx, dextra
 
     fn = shard_map_compat(
         functools.partial(
@@ -426,8 +732,9 @@ def pipeline_loss_1f1b(
     axis_name: str = "pp",
     batch_axes: tuple = ("dp", "fsdp"),
     seq_axis: Optional[str] = None,
+    virtual: int = 1,
 ):
-    """Scalar-loss wrapper around the fused 1F1B schedule.
+    """Scalar-loss wrapper around the fused/interleaved 1F1B schedule.
 
     Returns ``f(stacked_params, x, extra_params) -> loss`` whose
     ``custom_vjp`` runs :func:`pipeline_train_1f1b` in the FORWARD pass
@@ -435,7 +742,8 @@ def pipeline_loss_1f1b(
     merely scales the stored gradients — JAX never transposes the pipeline,
     so the fill-drain activation blowup of differentiating :func:`gpipe`
     never materialises.  The primal-only path (inference/no-grad) runs the
-    cheap plain-forward gpipe instead.
+    cheap plain-forward gpipe instead (the forward's value is independent
+    of the stage interleaving, so no permutation is needed there).
     """
 
     @jax.custom_vjp
@@ -451,6 +759,7 @@ def pipeline_loss_1f1b(
         loss, dstacked, dx, dextra = pipeline_train_1f1b(
             stage_fn, stacked, x, labels, extra, loss_fn, num_microbatches,
             mesh=mesh, axis_name=axis_name, batch_axes=batch_axes, seq_axis=seq_axis,
+            virtual=virtual,
         )
         return loss, (dstacked, dx, dextra)
 

@@ -1,0 +1,268 @@
+"""ParallelPlan — dp × fsdp × pp × sp resolved ONCE, read everywhere.
+
+Before this module every subsystem rediscovered the parallelism layout for
+itself: the optimizer re-derived the ZeRO-1 dp axis, compression re-checked
+its wire axis, the pipelined model poked ``mesh.shape.get("pp")`` and the
+plugin registry, fleet resize re-read dp off the mesh, and the AOT cache
+hashed mesh shape + compression but not the schedule that shaped the
+program.  Each rediscovery was one more place a layout flip could silently
+disagree (ROADMAP, top ambitious item).
+
+Now ``Accelerator`` resolves ONE frozen :class:`ParallelPlan` from
+``ParallelismConfig``/plugins/env at construction (and re-resolves it on a
+fleet resize), publishes it on the Borg ``AcceleratorState`` so any module
+can call :func:`current_plan`, and every consumer reads the plan:
+
+* **capture** pins the plan and drops compiled variants when it moves;
+* **optimizer relayout** takes its ZeRO-1 state shardings from
+  :meth:`ParallelPlan.state_spec`;
+* **compression** reads the armed policy name and wire axis off the plan;
+* **AOT fingerprint** carries :meth:`ParallelPlan.describe` as a ``plan``
+  field, so a plan flip is a loud miss NAMING the field;
+* **fleet resize/grow** read dp and the re-mesh constraints from the plan
+  instead of the mesh dict;
+* **the pipelined model** reads schedule / stage layout / virtual-stage
+  factor from :attr:`ParallelPlan.stage`.
+
+graftlint's ``stage-boundary-vs-plan`` rule keeps it this way: literal
+``"pp"`` axis reads or hand-sliced layer spans outside the owner modules
+(this file, pipeline.py, mesh.py, the config layer) fire.
+
+Resolution precedence (tested): explicit plugin kwargs beat env vars
+(``PP_SCHEDULE``/``PP_VIRTUAL``/``PP_SIZE``), env beats defaults, and bad
+values raise at construction — never mid-first-step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# the canonical axis names this plan arbitrates; consumers import these
+# instead of spelling the literals (the graftlint rule watches for literals)
+DP_AXIS = "dp"
+FSDP_AXIS = "fsdp"
+TP_AXIS = "tp"
+SP_AXIS = "sp"
+EP_AXIS = "ep"
+PP_AXIS = "pp"
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePlan:
+    """Pipeline-stage layout: the ONE owner of stage/layer boundaries.
+
+    ``virtual`` is the interleave factor V (MPMD pipeline-parallelism,
+    PAPERS.md #4): each pp device hosts V non-contiguous virtual-stage layer
+    spans, microbatches hop V× around the ring, and the fill/drain bubble
+    shrinks by V (``parallel.pipeline.bubble_fraction``).  ``virtual == 1``
+    is the fused 1F1B (or GPipe) layout with one contiguous span per device.
+    """
+
+    num_stages: int
+    virtual: int = 1
+    num_microbatches: int = 1
+    schedule: str = "gpipe"  # "gpipe" | "1f1b" | "interleaved"
+
+    def __post_init__(self):
+        if self.num_stages < 1 or self.virtual < 1 or self.num_microbatches < 1:
+            raise ValueError(f"invalid stage plan {self!r}")
+        if self.virtual > 1 and self.schedule != "interleaved":
+            raise ValueError(
+                f"virtual_stages={self.virtual} requires schedule="
+                f"'interleaved', got {self.schedule!r}"
+            )
+        if self.schedule == "interleaved":
+            if self.virtual < 2:
+                raise ValueError(
+                    "schedule='interleaved' needs virtual_stages >= 2 "
+                    "(virtual_stages=1 IS the fused '1f1b' schedule)"
+                )
+            if self.num_stages > 1 and self.num_microbatches % self.num_stages:
+                raise ValueError(
+                    f"interleaved 1F1B needs num_microbatches "
+                    f"({self.num_microbatches}) divisible by the pipeline "
+                    f"size ({self.num_stages})"
+                )
+
+    @property
+    def total_virtual_stages(self) -> int:
+        return self.num_stages * self.virtual
+
+    def layers_per_virtual_stage(self, num_layers: int) -> int:
+        sv = self.total_virtual_stages
+        if num_layers % sv:
+            raise ValueError(
+                f"num_layers {num_layers} not divisible by "
+                f"num_stages×virtual = {self.num_stages}×{self.virtual}"
+            )
+        return num_layers // sv
+
+    def layer_spans(self, num_layers: int) -> tuple:
+        """``((start, stop), ...)`` in VIRTUAL-STAGE order: span ``v`` runs
+        on device ``v % num_stages`` as its chunk ``v // num_stages``."""
+        c = self.layers_per_virtual_stage(num_layers)
+        return tuple((v * c, (v + 1) * c) for v in range(self.total_virtual_stages))
+
+    def layer_order(self, num_layers: int) -> tuple:
+        """Host-computed permutation of the stacked layer axis so the plain
+        contiguous ``P(pp)`` sharding hands device ``d`` exactly its V
+        interleaved chunks, grouped: local rows ``[k*c:(k+1)*c]`` = chunk
+        ``k`` = global virtual stage ``k*S + d``.  Identity at V=1.  The
+        schedule applies it as an in-program gather today (see
+        ``pipeline_train_1f1b`` for the per-step cost and the prepare-time
+        follow-up)."""
+        c = self.layers_per_virtual_stage(num_layers)
+        order = []
+        for d in range(self.num_stages):
+            for k in range(self.virtual):
+                v = k * self.num_stages + d
+                order.extend(range(v * c, (v + 1) * c))
+        return tuple(order)
+
+    def inverse_layer_order(self, num_layers: int) -> tuple:
+        order = self.layer_order(num_layers)
+        inv = [0] * len(order)
+        for i, j in enumerate(order):
+            inv[j] = i
+        return tuple(inv)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelPlan:
+    """The resolved parallelism layout — one object, every axis.
+
+    Frozen and JSON-describable: :meth:`describe` is the ``plan`` field of
+    the AOT-cache topology fingerprint, so any flip that changes the
+    compiled program (an axis size, ZeRO mode, compression policy, pipeline
+    schedule or virtual factor) is a loud cache miss naming ``plan``.
+    ``generation`` moves when a fleet resize re-resolves the plan; captured
+    steps drop their compiled variants when it does.
+    """
+
+    axes: tuple  # ((name, size), ...) in mesh order
+    data_axes: tuple  # axes the global batch shards over
+    zero1: bool = False
+    zero2: bool = False
+    compression: str = "none"
+    sp_mode: str = "ring"
+    stage: Optional[StagePlan] = None
+    generation: int = 0
+
+    # -- axis accessors ------------------------------------------------------
+    @property
+    def axis_sizes(self) -> dict:
+        return dict(self.axes)
+
+    def axis_size(self, name: str) -> int:
+        return dict(self.axes).get(name, 1)
+
+    @property
+    def dp(self) -> int:
+        return self.axis_size(DP_AXIS)
+
+    @property
+    def fsdp(self) -> int:
+        return self.axis_size(FSDP_AXIS)
+
+    @property
+    def tp(self) -> int:
+        return self.axis_size(TP_AXIS)
+
+    @property
+    def sp(self) -> int:
+        return self.axis_size(SP_AXIS)
+
+    @property
+    def pp(self) -> int:
+        return self.axis_size(PP_AXIS)
+
+    @property
+    def non_dp_extent(self) -> int:
+        """Devices consumed per dp block — the re-mesh constraint fleet
+        grow uses to bound a target dp against the visible device pool."""
+        out = 1
+        for name, size in self.axes:
+            if name != DP_AXIS:
+                out *= size
+        return out
+
+    # -- state shardings (ZeRO-1 masters/moments) ----------------------------
+    def state_spec(self, shape: tuple, mesh, param_spec=None):
+        """PartitionSpec for one param's optimizer state (fp32 masters +
+        moments) under this plan: the ZeRO-1 dp sharding when the plan arms
+        it, else the param's own layout — the ONE rule the optimizer
+        relayout, checkpoint specs and fleet reshard all follow."""
+        from .sharding import canonical_spec, zero1_state_spec
+        from jax.sharding import PartitionSpec as P
+
+        if not self.zero1:
+            return canonical_spec(param_spec if param_spec is not None else P(), mesh)
+        return zero1_state_spec(shape, mesh, param_spec)
+
+    # -- fingerprint ---------------------------------------------------------
+    def describe(self) -> dict:
+        """JSON-able digest — the AOT fingerprint's ``plan`` field."""
+        out = {
+            "axes": {name: size for name, size in self.axes if size > 1},
+            "zero1": self.zero1,
+            "zero2": self.zero2,
+            "compression": self.compression,
+        }
+        if self.sp > 1:
+            out["sp_mode"] = self.sp_mode
+        if self.stage is not None and (
+            self.stage.num_stages > 1 or self.stage.virtual > 1
+        ):
+            out["schedule"] = self.stage.schedule
+            out["virtual"] = self.stage.virtual
+            out["microbatches"] = self.stage.num_microbatches
+        return out
+
+    # -- resolution ----------------------------------------------------------
+    @classmethod
+    def resolve(cls, state, compression: Optional[str] = None,
+                generation: int = 0) -> "ParallelPlan":
+        """Resolve the plan from the live AcceleratorState: mesh axis sizes,
+        plugins (already env-resolved with kwargs precedence by their own
+        ``__post_init__``), and the ZeRO flags.  Bad combinations raise HERE,
+        at construction, not mid-first-step."""
+        from .mesh import data_axes
+
+        mesh = state.mesh
+        axes = tuple((name, int(size)) for name, size in mesh.shape.items())
+        pp_size = dict(axes).get(PP_AXIS, 1)
+
+        pp_plugin = getattr(state, "pp_plugin", None)
+        stage = None
+        if pp_plugin is not None or pp_size > 1:
+            schedule = getattr(pp_plugin, "schedule", None) or "gpipe"
+            virtual = int(getattr(pp_plugin, "virtual_stages", 1) or 1)
+            microbatches = int(getattr(pp_plugin, "num_microbatches", 1) or 1)
+            stage = StagePlan(
+                num_stages=pp_size,
+                virtual=virtual,
+                num_microbatches=microbatches,
+                schedule=schedule,
+            )
+
+        sp_plugin = getattr(state, "sp_plugin", None)
+        return cls(
+            axes=axes,
+            data_axes=tuple(data_axes(mesh)),
+            zero1=bool(state.zero1_enabled),
+            zero2=bool(state.zero2_enabled),
+            compression=compression or "none",
+            sp_mode=getattr(sp_plugin, "mode", "ring") if sp_plugin else "ring",
+            stage=stage,
+            generation=generation,
+        )
+
+
+def current_plan() -> Optional[ParallelPlan]:
+    """The plan of the live Accelerator context (None outside one) — how
+    models and library code read the resolved layout without re-deriving
+    axis sizes from the mesh."""
+    from ..state import AcceleratorState
+
+    return AcceleratorState._shared_state.get("plan")

@@ -741,22 +741,86 @@ class PipelineParallelPlugin:
         megatron.core's get_forward_backward_func, utils/megatron_lm.py:40).
         Requires the loss to be computed by the pipelined program — models
         opt in via their pipelined loss path (PipelinedGPTLMHeadModel).
+      * ``"interleaved"`` — interleaved 1F1B (MPMD pipeline-parallelism,
+        PAPERS.md #4): each pp device hosts ``virtual_stages`` NON-contiguous
+        layer spans and microbatches hop V× around the ring, shrinking the
+        fill/drain bubble by the virtual factor while keeping the
+        ``2·S−1``-order residual window.  Needs ``num_microbatches``
+        divisible by ``pp_size`` and layers divisible by
+        ``pp_size × virtual_stages``.
+
+    The resolved values land in the run's ``ParallelPlan``
+    (``accelerator.plan.stage`` — docs/parallel_plan.md); consumers read
+    the plan, never this plugin directly.
     """
 
     pp_size: int = 1
     num_microbatches: int = 1
-    # None = unset: resolves to $PP_SCHEDULE, then "gpipe".  A sentinel (not
-    # a "gpipe" default) so an EXPLICIT schedule="gpipe" beats the env var.
-    schedule: Optional[str] = None  # "gpipe" | "1f1b"
+    # None/0 = unset: resolves to $PP_SCHEDULE / $PP_VIRTUAL, then the
+    # default.  Sentinels (not concrete defaults) so an EXPLICIT
+    # schedule="gpipe" / virtual_stages=1 beats the env var.
+    schedule: Optional[str] = None  # "gpipe" | "1f1b" | "interleaved"
+    virtual_stages: int = 0  # interleave factor V; 0 = unset
 
     def __post_init__(self):
         if self.pp_size == 1 and "PP_SIZE" in os.environ:
             self.pp_size = int(os.environ["PP_SIZE"])
+        explicit_schedule = self.schedule is not None
+        explicit_virtual = self.virtual_stages != 0
+        env_schedule = None
         if self.schedule is None:
-            self.schedule = os.environ.get("PP_SCHEDULE", "gpipe")
-        if self.schedule not in ("gpipe", "1f1b"):
+            env_schedule = os.environ.get("PP_SCHEDULE", None)
+            self.schedule = env_schedule
+        if self.virtual_stages == 0 and "PP_VIRTUAL" in os.environ:
+            self.virtual_stages = int(os.environ["PP_VIRTUAL"])
+            if explicit_schedule and (
+                (self.schedule in ("gpipe", "1f1b") and self.virtual_stages > 1)
+                or (self.schedule == "interleaved" and self.virtual_stages < 2)
+            ):
+                # kwargs beat env: an env-sourced virtual factor that is
+                # incompatible with the EXPLICIT schedule yields back to
+                # unset instead of raising or silently changing the
+                # schedule — gpipe/fused 1f1b cannot interleave (a
+                # different compiled program, fingerprint and M%S
+                # constraint), and an explicit interleaved keeps its
+                # default factor under an ambient PP_VIRTUAL=1
+                self.virtual_stages = 0
+        if explicit_virtual and env_schedule is not None:
+            # and symmetrically: an env-sourced schedule incompatible with
+            # the EXPLICIT virtual factor yields to the factor's canonical
+            # schedule (V=1 IS the fused 1f1b, V>1 IS interleaved)
+            if env_schedule == "interleaved" and self.virtual_stages == 1:
+                self.schedule = "1f1b"
+            elif env_schedule == "gpipe" and self.virtual_stages > 1:
+                self.schedule = "interleaved"
+        if self.virtual_stages == 0:
+            # interleaved without an explicit factor means "interleave at
+            # all": the smallest real factor
+            self.virtual_stages = 2 if self.schedule == "interleaved" else 1
+        if self.schedule is None:
+            self.schedule = "interleaved" if self.virtual_stages > 1 else "gpipe"
+        if self.schedule == "1f1b" and self.virtual_stages > 1:
+            # V>1 IS the interleaved schedule; normalize so the plan and the
+            # AOT fingerprint carry one canonical name
+            self.schedule = "interleaved"
+        if self.schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"unknown pipeline schedule {self.schedule!r}; use 'gpipe' or '1f1b'"
+                f"unknown pipeline schedule {self.schedule!r}; use 'gpipe', "
+                "'1f1b' or 'interleaved'"
+            )
+        if self.virtual_stages < 1:
+            raise ValueError(
+                f"virtual_stages must be >= 1, got {self.virtual_stages}"
+            )
+        if self.schedule == "gpipe" and self.virtual_stages > 1:
+            raise ValueError(
+                "virtual_stages > 1 interleaves the fused 1F1B schedule; it "
+                "cannot combine with schedule='gpipe'"
+            )
+        if self.schedule == "interleaved" and self.virtual_stages < 2:
+            raise ValueError(
+                "schedule='interleaved' needs virtual_stages >= 2 "
+                "(virtual_stages=1 is exactly the fused '1f1b' schedule)"
             )
 
 

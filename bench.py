@@ -1025,6 +1025,97 @@ def _kernels_ab_block(on_accel: bool) -> dict:
     return out
 
 
+def _pipeline_block(on_accel: bool) -> dict:
+    """Fused vs interleaved 1F1B A/B on the pp=2 × dp geometry
+    (docs/parallel_plan.md): step_ms for each schedule, the analytic
+    bubble-tick/bubble-fraction profile, and ``pipeline_interleave_speedup``
+    (fused/interleaved step_ms).  On the lockstep CPU rehearsal the masked
+    ramp slots keep wall clock near parity — the analytic bubble columns
+    carry the MPMD gain the per-stage AOT programs realize on hardware;
+    the first on-TPU window fills the measured speedup.
+    ``BENCH_PIPELINE=0`` disables the block; rows are fail-soft."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator, ParallelismConfig, TelemetryKwargs
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, PipelinedGPTLMHeadModel
+    from accelerate_tpu.parallel.pipeline import bubble_fraction, bubble_ticks
+    from accelerate_tpu.utils.dataclasses import PipelineParallelPlugin
+
+    n_dev = len(jax.devices())
+    out: dict = {}
+    if n_dev < 2 or n_dev % 2:
+        out["pipeline_skipped"] = f"needs an even device count >= 2, have {n_dev}"
+        return out
+    S, V, M = 2, 2, 8
+    import dataclasses as _dc
+
+    # layer count must divide S·V = 4: small() is 12, tiny bumps 2 → 4
+    cfg = (
+        GPTConfig.small() if on_accel else _dc.replace(GPTConfig.tiny(), n_layer=4)
+    )
+    batch, seq, steps = (BATCH * n_dev, SEQ, 20) if on_accel else (8 * n_dev, 64, 3)
+
+    def train_ms(schedule: str, virtual: int):
+        Accelerator._reset_state()
+        nn.manual_seed(0)
+        acc = Accelerator(
+            mixed_precision="bf16" if on_accel else "no",
+            parallelism_config=ParallelismConfig(pp_size=S),
+            pp_plugin=PipelineParallelPlugin(
+                pp_size=S, num_microbatches=M, schedule=schedule,
+                virtual_stages=virtual,
+            ),
+            kwargs_handlers=[TelemetryKwargs(enabled=True)],
+        )
+        model = PipelinedGPTLMHeadModel(cfg, num_microbatches=M)
+        opt = optim.AdamW(model.parameters(), lr=3e-4)
+        model, opt = acc.prepare(model, opt)
+
+        def step_fn(ids):
+            opt.zero_grad()
+            loss_out = model(ids, labels=ids)
+            acc.backward(loss_out["loss"])
+            opt.step()
+            return loss_out["loss"]
+
+        step = acc.compile_step(step_fn)
+        rng = np.random.default_rng(0)
+        batches = [
+            batch_to_global_array(
+                jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32),
+                mesh=acc.mesh,
+            )
+            for _ in range(4)
+        ]
+        _, dt, final_loss, recompile, _ = _timed_steps(
+            step, batches, steps, WARMUP if on_accel else 1
+        )
+        return dt / steps * 1e3, final_loss, recompile["count"]
+
+    try:
+        fused_ms, fused_loss, fused_rec = train_ms("1f1b", 1)
+        inter_ms, inter_loss, inter_rec = train_ms("interleaved", V)
+        out["pipeline_fused_step_ms"] = round(fused_ms, 2)
+        out["pipeline_interleaved_step_ms"] = round(inter_ms, 2)
+        out["pipeline_interleave_speedup"] = round(fused_ms / max(inter_ms, 1e-9), 3)
+        out["pipeline_loss_delta"] = round(abs(fused_loss - inter_loss), 6)
+        out["pipeline_recompiles"] = fused_rec + inter_rec
+        out["pipeline_bubble_ticks_fused"] = bubble_ticks(M, S, 1, granularity=V)
+        out["pipeline_bubble_ticks_interleaved"] = bubble_ticks(M, S, V, granularity=V)
+        out["pipeline_bubble_fraction_fused"] = bubble_fraction(M, S, 1)
+        out["pipeline_bubble_fraction_interleaved"] = bubble_fraction(M, S, V)
+        out["pipeline_geometry"] = {"pp": S, "virtual": V, "microbatches": M,
+                                    "dp": n_dev // S}
+    except Exception as exc:  # noqa: BLE001 — fail-soft per block contract
+        out["pipeline_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    return out
+
+
 def _opt_inference_workload(on_accel: bool) -> dict:
     """BASELINE.json config 5: OPT device_map='auto'-style sharded inference
     (reference benchmarks/big_model_inference/README.md:31-37 form: load
@@ -1425,6 +1516,14 @@ def main() -> None:
             result.update(_kernels_ab_block(on_accel))
         except Exception as exc:
             result["kernels_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    if os.environ.get("BENCH_PIPELINE", "1") != "0":
+        # fused vs interleaved 1F1B A/B (docs/parallel_plan.md): step_ms,
+        # interleave speedup, and the analytic bubble profile on the
+        # pp=2 × dp geometry — same-platform rows ride the bench gate
+        try:
+            result.update(_pipeline_block(on_accel))
+        except Exception as exc:
+            result["pipeline_error"] = f"{type(exc).__name__}: {exc}"[:300]
     _PRIMARY_RESULT.update(result)
     # secondary BASELINE.md workloads, gated so the default driver run stays
     # inside its time budget (each adds a multi-minute cold compile)

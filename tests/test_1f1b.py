@@ -18,7 +18,12 @@ import accelerate_tpu.optim as optim
 from accelerate_tpu import Accelerator, ParallelismConfig
 from accelerate_tpu.data_loader import batch_to_global_array
 from accelerate_tpu.models import GPTConfig, PipelinedGPTLMHeadModel
-from accelerate_tpu.parallel.pipeline import residual_window, schedule_ticks
+from accelerate_tpu.parallel.pipeline import (
+    bubble_fraction,
+    bubble_ticks,
+    residual_window,
+    schedule_ticks,
+)
 from accelerate_tpu.utils.dataclasses import PipelineParallelPlugin
 
 
@@ -30,17 +35,45 @@ def test_memory_window_beats_gpipe_at_m8_s2():
     assert schedule_ticks(8, 2) == 10
 
 
-def _train(schedule: str, steps: int = 3, microbatches: int = 8):
+def test_interleaved_profile_m8_s2_v2():
+    """The virtual factor's analytic profile (ISSUE 15 acceptance): at
+    M=8, S=2, V=2 the interleaved schedule shows STRICTLY fewer bubble
+    ticks than the fused one (compared in a common chunk granularity),
+    the bubble fraction drops from (S−1)/M to (S−1)/(V·M), the lockstep
+    trip count is M·V + S·V + S − 2 chunk ticks, and the residual window
+    keeps the 2·S−1 order per hosted span (V·(2S−1) chunk inputs, each
+    1/V the fused activation)."""
+    fused = bubble_ticks(8, 2, virtual=1, granularity=2)
+    interleaved = bubble_ticks(8, 2, virtual=2, granularity=2)
+    assert interleaved < fused, (interleaved, fused)
+    assert (fused, interleaved) == (4, 2)
+    assert bubble_fraction(8, 2, 2) < bubble_fraction(8, 2, 1)
+    assert bubble_fraction(8, 2, 2) == (2 - 1) / (2 * 8)
+    assert schedule_ticks(8, 2, virtual=2) == 20
+    assert residual_window(2, virtual=2) == 6
+    # degenerate V=1 reproduces the fused profile exactly
+    assert schedule_ticks(8, 2, virtual=1) == schedule_ticks(8, 2)
+    assert residual_window(2, virtual=1) == residual_window(2)
+
+
+def _train(schedule: str, steps: int = 3, microbatches: int = 8,
+           n_layer: int = 2, virtual: int = 0):
     Accelerator._reset_state()
     nn.manual_seed(0)
     acc = Accelerator(
         parallelism_config=ParallelismConfig(pp_size=2),
         pp_plugin=PipelineParallelPlugin(
-            pp_size=2, num_microbatches=microbatches, schedule=schedule
+            pp_size=2, num_microbatches=microbatches, schedule=schedule,
+            virtual_stages=virtual,
         ),
         mixed_precision="no",
     )
-    model = PipelinedGPTLMHeadModel(GPTConfig.tiny(), num_microbatches=microbatches)
+    cfg = GPTConfig.tiny()
+    if n_layer != cfg.n_layer:
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, n_layer=n_layer)
+    model = PipelinedGPTLMHeadModel(cfg, num_microbatches=microbatches)
     opt = optim.SGD(model.parameters(), lr=0.1)
     model, opt = acc.prepare(model, opt)
 
@@ -160,6 +193,46 @@ def test_1f1b_loss_decreases():
     assert losses[-1] < losses[0], losses
 
 
+def test_interleaved_grad_parity_with_gpipe_at_v2():
+    """ISSUE 15 acceptance: the interleaved schedule (V=2, each device
+    hosting two non-contiguous layer spans) trains identically to GPipe —
+    loss trajectory AND updated parameters agree on a 4-layer trunk."""
+    l_g, p_g = _train("gpipe", n_layer=4)
+    l_i, p_i = _train("interleaved", n_layer=4, virtual=2)
+    np.testing.assert_allclose(l_i, l_g, rtol=2e-5, atol=2e-5)
+    for name in p_g:
+        np.testing.assert_allclose(
+            p_i[name], p_g[name], rtol=3e-4, atol=3e-5, err_msg=name
+        )
+
+
+def test_interleaved_matches_fused_1f1b():
+    """Same seed/data: interleaving is a schedule/layout change, not a
+    numerics change — V=2 must track the fused 1F1B trajectory."""
+    l_f, p_f = _train("1f1b", n_layer=4)
+    l_i, p_i = _train("interleaved", n_layer=4, virtual=2)
+    np.testing.assert_allclose(l_i, l_f, rtol=2e-5, atol=2e-5)
+    for name in p_f:
+        np.testing.assert_allclose(
+            p_i[name], p_f[name], rtol=3e-4, atol=3e-5, err_msg=name
+        )
+
+
+def test_interleaved_rejects_indivisible_shapes():
+    """Bad geometry fails loudly at construction (plan resolution), not
+    mid-first-step: M not divisible by S, layers not divisible by S·V."""
+    with pytest.raises(ValueError, match="divisible"):
+        _train("interleaved", microbatches=3, n_layer=4, virtual=2)
+    # layers 2 vs S·V = 4: the layer-order derivation refuses
+    from accelerate_tpu.parallel.plan import StagePlan
+
+    with pytest.raises(ValueError, match="not divisible"):
+        StagePlan(
+            num_stages=2, virtual=2, num_microbatches=8,
+            schedule="interleaved",
+        ).layer_order(2)
+
+
 def test_1f1b_rejects_sequence_parallel():
     Accelerator._reset_state()
     nn.manual_seed(0)
@@ -178,4 +251,10 @@ def test_1f1b_rejects_sequence_parallel():
 
 def test_bad_schedule_name_rejected():
     with pytest.raises(ValueError, match="gpipe"):
-        PipelineParallelPlugin(pp_size=2, schedule="interleaved")
+        PipelineParallelPlugin(pp_size=2, schedule="zigzag")
+    # interleaving is a 1F1B property: gpipe can't take a virtual factor,
+    # and 'interleaved' with V=1 is a contradiction
+    with pytest.raises(ValueError, match="gpipe"):
+        PipelineParallelPlugin(pp_size=2, schedule="gpipe", virtual_stages=2)
+    with pytest.raises(ValueError, match="virtual_stages"):
+        PipelineParallelPlugin(pp_size=2, schedule="interleaved", virtual_stages=1)

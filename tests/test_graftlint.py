@@ -279,6 +279,29 @@ FIXTURES = {
             )(x)
         """,
     ),
+    "stage-boundary-vs-plan": (
+        """
+        from jax.sharding import PartitionSpec
+
+        def stage_spans(mesh, num_layers):
+            pp = mesh.shape.get("pp", 1)      # axis rediscovery
+            per_stage = num_layers // pp      # hand-sliced layer span
+            spec = PartitionSpec("pp")        # literal pp layout
+            return [
+                (s * per_stage, (s + 1) * per_stage) for s in range(pp)
+            ], spec
+
+        def ring_hop(x, axis_name="pp"):      # pp-defaulted parameter
+            return x
+        """,
+        4,
+        """
+        def stage_spans(plan, num_layers):
+            # the resolved ParallelPlan owns stage boundaries and the pp
+            # axis (docs/parallel_plan.md)
+            return plan.stage.layer_spans(num_layers), plan.pp
+        """,
+    ),
 }
 
 
@@ -1485,6 +1508,97 @@ def test_instance_dispatch_factory_rebound_or_decorated_silent(tmp_path):
         name="snippet2.py",
     )
     assert res2.new_findings == [], [f.render() for f in res2.new_findings]
+
+
+IMPORTED_FACTORY_DISPATCH_BAD = {
+    "impl.py": """
+        class Runner:
+            def work(self, x):
+                return x.item()
+
+        def make_runner():
+            return Runner()
+        """,
+    "train.py": """
+        import jax
+        from .impl import make_runner
+
+        @jax.jit
+        def step(x):
+            r = make_runner()        # factory IMPORTED from impl
+            return r.work(x)
+        """,
+}
+
+
+def test_instance_dispatch_through_imported_factory(tmp_path):
+    """ANALYSIS_VERSION 11 fixture (ROADMAP carried item): the v10 factory
+    map was per-module — a factory IMPORTED single-hop
+    (`from .impl import make_runner`) now resolves the receiver to the
+    class its returns construct, so the traced host sync in Runner.work
+    fires from another module's jitted step."""
+    res = lint_pkg(
+        tmp_path, IMPORTED_FACTORY_DISPATCH_BAD, rule="host-sync-in-trace"
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    f = res.new_findings[0]
+    assert f.path.endswith("impl.py") and f.symbol == "Runner.work"
+
+
+def test_imported_factory_shadowed_param_silent(tmp_path):
+    """The good twin: the imported factory's name rebound as a PARAMETER is
+    injected data — any callable could arrive there, so the receiver must
+    stay uninferred (the v11 local-shadow guard)."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "impl.py": IMPORTED_FACTORY_DISPATCH_BAD["impl.py"],
+            "train.py": """
+                import jax
+                from .impl import make_runner
+
+                @jax.jit
+                def step(x, make_runner):
+                    r = make_runner()    # the PARAMETER, not the import
+                    return r.work(x)
+                """,
+        },
+        rule="host-sync-in-trace",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_imported_factory_delegation_chain_silent(tmp_path):
+    """Single-hop only: a factory that DELEGATES to another factory records
+    the inner factory's name, which fails class resolution — the chain
+    stays uninferred (silent, never wrong)."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "impl.py": """
+                class Runner:
+                    def work(self, x):
+                        return x.item()
+
+                def make_inner():
+                    return Runner()
+
+                def make_runner():
+                    return make_inner()   # factory -> factory delegation
+                """,
+            "train.py": """
+                import jax
+                from .impl import make_runner
+
+                @jax.jit
+                def step(x):
+                    r = make_runner()
+                    return r.work(x)
+                """,
+        },
+        rule="host-sync-in-trace",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
 
 
 def test_partial_callback_crosses_module_boundary(tmp_path):

@@ -1,0 +1,396 @@
+"""ParallelPlan: dp × fsdp × pp resolved ONCE, read everywhere (ISSUE 15).
+
+The plan is resolved in ``Accelerator`` construction from
+``ParallelismConfig``/plugins/env (kwargs beat env, bad values raise at
+construction), published via ``current_plan()``, and consumed by the
+optimizer relayout, compression, capture, the AOT fingerprint (a plan flip
+is a loud miss NAMING the ``plan`` field), fleet resize, and the pipelined
+models.  The acceptance geometry — 2-stage × dp with ZeRO-1 + int8
+compression + gradient accumulation in ONE captured step — trains at
+≤1e-3 loss parity with the dp-only run, with zero steady-state recompiles
+and warm AOT restarts serving the stage program with zero trace/compile.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import accelerate_tpu.nn as nn
+import accelerate_tpu.optim as optim
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.data_loader import batch_to_global_array
+from accelerate_tpu.models import GPTConfig, PipelinedGPTLMHeadModel
+from accelerate_tpu.parallel.plan import ParallelPlan, StagePlan, current_plan
+from accelerate_tpu.utils.dataclasses import (
+    CompilationCacheKwargs,
+    CompressionKwargs,
+    PipelineParallelPlugin,
+    TelemetryKwargs,
+)
+
+N_DEV = len(jax.devices())
+
+
+def _fresh():
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+
+
+# ---------------------------------------------------------------------------
+# resolution: precedence, validation, equivalence with the legacy plugins
+# ---------------------------------------------------------------------------
+
+def test_explicit_kwargs_beat_env(monkeypatch):
+    monkeypatch.setenv("PP_SCHEDULE", "interleaved")
+    monkeypatch.setenv("PP_VIRTUAL", "4")
+    plugin = PipelineParallelPlugin(
+        pp_size=2, num_microbatches=8, schedule="1f1b", virtual_stages=2
+    )
+    # explicit 1f1b + V=2 normalizes to the canonical interleaved name,
+    # but the EXPLICIT virtual factor wins over $PP_VIRTUAL
+    assert plugin.schedule == "interleaved" and plugin.virtual_stages == 2
+    plugin = PipelineParallelPlugin(pp_size=2, schedule="gpipe")
+    assert plugin.schedule == "gpipe" and plugin.virtual_stages == 1
+
+
+def test_env_virtual_yields_to_explicit_fused_schedule(monkeypatch):
+    # an EXPLICIT fused 1f1b must not be silently upgraded to interleaved
+    # by ambient $PP_VIRTUAL — a different compiled program, fingerprint
+    # and M%S constraint (num_microbatches=6 is legal fused, not at S=2 V=2)
+    monkeypatch.setenv("PP_VIRTUAL", "2")
+    plugin = PipelineParallelPlugin(pp_size=2, num_microbatches=6, schedule="1f1b")
+    assert plugin.schedule == "1f1b" and plugin.virtual_stages == 1
+    # ...and an incompatible env factor under an EXPLICIT interleaved (or an
+    # env schedule under an EXPLICIT factor) yields instead of raising
+    monkeypatch.setenv("PP_VIRTUAL", "1")
+    plugin = PipelineParallelPlugin(pp_size=2, num_microbatches=8, schedule="interleaved")
+    assert plugin.schedule == "interleaved" and plugin.virtual_stages == 2
+    monkeypatch.delenv("PP_VIRTUAL")
+    monkeypatch.setenv("PP_SCHEDULE", "interleaved")
+    plugin = PipelineParallelPlugin(pp_size=2, num_microbatches=8, virtual_stages=1)
+    assert plugin.schedule == "1f1b" and plugin.virtual_stages == 1
+    monkeypatch.setenv("PP_SCHEDULE", "gpipe")
+    plugin = PipelineParallelPlugin(pp_size=2, num_microbatches=8, virtual_stages=3)
+    assert plugin.schedule == "interleaved" and plugin.virtual_stages == 3
+
+
+def test_repeated_construction_with_auto_config():
+    # plan resolution must not pin the auto-resolved dp back onto the
+    # caller's ParallelismConfig: a second Accelerator with an equivalent
+    # auto config would otherwise be a conflicting re-init on the Borg state
+    _fresh()
+    Accelerator(parallelism_config=ParallelismConfig())
+    acc = Accelerator(parallelism_config=ParallelismConfig())
+    assert acc.plan.dp == jax.device_count()
+    Accelerator._reset_state()
+
+
+def test_env_resolves_when_unset(monkeypatch):
+    monkeypatch.setenv("PP_SCHEDULE", "interleaved")
+    plugin = PipelineParallelPlugin(pp_size=2, num_microbatches=8)
+    assert plugin.schedule == "interleaved"
+    assert plugin.virtual_stages == 2  # interleaved defaults to the smallest V
+    monkeypatch.setenv("PP_VIRTUAL", "3")
+    plugin = PipelineParallelPlugin(pp_size=2, num_microbatches=8)
+    assert plugin.schedule == "interleaved" and plugin.virtual_stages == 3
+
+
+def test_bad_values_raise_at_construction():
+    with pytest.raises(ValueError, match="gpipe"):
+        PipelineParallelPlugin(pp_size=2, schedule="zigzag")
+    with pytest.raises(ValueError, match="virtual_stages"):
+        PipelineParallelPlugin(pp_size=2, virtual_stages=-1)
+    with pytest.raises(ValueError, match="divisible"):
+        StagePlan(num_stages=2, virtual=2, num_microbatches=3,
+                  schedule="interleaved")
+    # and through the Accelerator: plan resolution fails the construction
+    _fresh()
+    with pytest.raises(ValueError, match="divisible"):
+        Accelerator(
+            parallelism_config=ParallelismConfig(pp_size=2),
+            pp_plugin=PipelineParallelPlugin(
+                pp_size=2, num_microbatches=3, schedule="interleaved"
+            ),
+        )
+    Accelerator._reset_state()
+
+
+def test_plan_matches_legacy_plugin_resolution_dp_only():
+    _fresh()
+    acc = Accelerator()
+    plan = acc.plan
+    assert plan is current_plan()
+    assert plan.axis_sizes == dict(acc.mesh.shape)
+    assert plan.dp == N_DEV and plan.pp == 1
+    assert plan.zero1 == acc.state.zero1_enabled
+    assert plan.zero2 == acc.state.zero2_enabled
+    assert plan.compression == acc._compression.name
+    assert plan.stage is None  # no pipeline axis, no stage layout
+
+
+def test_plan_matches_legacy_plugin_resolution_dp_pp():
+    _fresh()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(pp_size=2),
+        pp_plugin=PipelineParallelPlugin(
+            pp_size=2, num_microbatches=8, schedule="interleaved"
+        ),
+    )
+    plan = acc.plan
+    assert plan.pp == 2 and plan.dp == N_DEV // 2
+    assert plan.stage.schedule == "interleaved"
+    assert plan.stage.virtual == 2
+    assert plan.stage.num_microbatches == 8
+    # stage boundaries: virtual-stage spans in ring order, device d's chunks
+    assert plan.stage.layer_spans(4) == ((0, 1), (1, 2), (2, 3), (3, 4))
+    assert plan.stage.layer_order(4) == (0, 2, 1, 3)
+    # zero1 follows the dp axis exactly as the legacy resolution did
+    assert plan.zero1 == acc.state.zero1_enabled
+    d = plan.describe()
+    assert d["schedule"] == "interleaved" and d["virtual"] == 2
+
+
+def test_default_off_capture_pytree_byte_identity():
+    """A plan-bearing accelerator with no pipeline must thread EXACTLY the
+    legacy capture state — the plan is read-only metadata, never a new
+    captured leaf."""
+    _fresh()
+    acc = Accelerator()
+    model = nn.Linear(4, 2)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(xb):
+        opt.zero_grad()
+        loss = model(nn.Tensor(xb)).sum()
+        acc.backward(loss)
+        opt.step()
+        return loss
+
+    step = acc.compile_step(step_fn)
+    state = step._collect_state()
+    assert set(state) == {
+        "params", "buffers", "grads", "opt", "rng", "scaler", "comm"
+    }
+    losses = [float(step(jnp.ones((8, 4)))) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    assert len(step._cache) == 1  # no plan-induced variants
+
+
+# ---------------------------------------------------------------------------
+# AOT coupling: a plan flip is a loud miss naming the `plan` field
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_mismatch_names_plan_field():
+    from accelerate_tpu.native.aot_cache import (
+        fingerprint_mismatch,
+        topology_fingerprint,
+    )
+
+    stored = topology_fingerprint(plan={"schedule": "1f1b", "virtual": 1})
+    live = topology_fingerprint(plan={"schedule": "interleaved", "virtual": 2})
+    cause = fingerprint_mismatch(stored, live)
+    assert "plan" in cause and "interleaved" in cause
+
+
+# the cold-store subprocess runs THIS module's _pipelined_cached_run, so
+# the step-fn source digest (part of the AOT variant identity) matches the
+# in-process warm run exactly — a `python -c` body would hash differently
+_COLD_STORE_BODY = """
+import json, sys
+sys.path.insert(0, sys.argv[4])
+sys.path.insert(0, sys.argv[4] + "/tests")
+import test_parallel_plan as t
+
+acc, losses = t._pipelined_cached_run(sys.argv[1], sys.argv[2], int(sys.argv[3]))
+first = acc.telemetry.timeline.records()[0]
+print(json.dumps({
+    "losses": losses,
+    "stores": acc.aot_cache.stores,
+    "compile_ms": first.compile_ms,
+}))
+"""
+
+
+def _pipelined_cached_run(cache_dir, schedule, virtual, steps=2):
+    """In-process run (safe for LOADING from the store; storing must happen
+    in a fresh subprocess — XLA:CPU refuses to serialize an executable once
+    the process compiled other programs)."""
+    _fresh()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(pp_size=2),
+        pp_plugin=PipelineParallelPlugin(
+            pp_size=2, num_microbatches=8, schedule=schedule,
+            virtual_stages=virtual,
+        ),
+        mixed_precision="no",
+        kwargs_handlers=[
+            TelemetryKwargs(enabled=True),
+            CompilationCacheKwargs(cache_dir=str(cache_dir)),
+        ],
+    )
+    cfg = dataclasses.replace(GPTConfig.tiny(), n_layer=4)
+    model = PipelinedGPTLMHeadModel(cfg, num_microbatches=8)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    ids = batch_to_global_array(
+        jnp.asarray(
+            np.random.default_rng(0).integers(0, 1024, (32, 32)), jnp.int32
+        ),
+        mesh=acc.mesh,
+    )
+    losses = [float(step(ids)) for _ in range(steps)]
+    return acc, losses
+
+
+@pytest.fixture(scope="module")
+def interleaved_cold_store(tmp_path_factory):
+    """COLD store of the interleaved stage program, in a fresh subprocess
+    (the only environment XLA:CPU serializes from — see memory note in
+    _pipelined_cached_run)."""
+    import json as _json
+    import subprocess
+    import sys
+
+    cache_dir = tmp_path_factory.mktemp("plan_aot") / "cache"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={max(N_DEV, 2)}"
+    )
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)  # isolate from the suite cache
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", _COLD_STORE_BODY,
+         str(cache_dir), "interleaved", "2", repo],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = _json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["stores"] >= 1, report
+    assert report["compile_ms"] > 0
+    return cache_dir, report
+
+
+def test_plan_flip_is_loud_aot_miss_naming_plan(interleaved_cold_store):
+    cache_dir, _ = interleaved_cold_store
+    # same model, same shapes, same variant digest — ONLY the plan flips
+    # (stored: interleaved V=2; live: fused 1f1b V=1)
+    acc, _ = _pipelined_cached_run(cache_dir, "1f1b", 1)
+    misses = [
+        e for e in acc.telemetry.aot_cache_events if e["event"] == "miss"
+    ]
+    assert misses, "plan flip produced no loud miss"
+    assert any("plan" in str(e.get("cause", "")) for e in misses), misses
+
+
+def test_warm_aot_restart_serves_stage_program_zero_trace_compile(
+    interleaved_cold_store,
+):
+    """ISSUE 15 acceptance: a warm restart serves the interleaved stage
+    program from the AOT store with zero trace/compile at bitwise-equal
+    losses."""
+    cache_dir, report = interleaved_cold_store
+    acc, losses = _pipelined_cached_run(cache_dir, "interleaved", 2)
+    warm_first = acc.telemetry.timeline.records()[0]
+    assert warm_first.built
+    assert warm_first.trace_ms == 0.0 and warm_first.compile_ms == 0.0
+    assert acc.aot_cache.hits >= 1
+    assert losses == report["losses"]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance geometry: 2-stage × dp, ZeRO-1 + int8 + grad accumulation
+# in ONE captured step, ≤1e-3 loss parity with the dp-only run, zero
+# steady-state recompiles (runs at dp=2 under `make multichip`'s 4 virtual
+# devices and at dp=4 under the default 8-device suite)
+# ---------------------------------------------------------------------------
+
+def _composed_run(pp: int, micro_steps: int = 8):
+    _fresh()
+    kwargs = dict(
+        mixed_precision="no",
+        gradient_accumulation_steps=2,
+        kwargs_handlers=[
+            TelemetryKwargs(enabled=True),
+            CompressionKwargs(policy="int8"),
+        ],
+    )
+    if pp > 1:
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(pp_size=pp),
+            pp_plugin=PipelineParallelPlugin(
+                pp_size=pp, num_microbatches=8, schedule="interleaved"
+            ),
+            **kwargs,
+        )
+    else:
+        acc = Accelerator(**kwargs)
+    cfg = dataclasses.replace(GPTConfig.tiny(), n_layer=4)
+    model = PipelinedGPTLMHeadModel(cfg, num_microbatches=8)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        with acc.accumulate(model):
+            opt.zero_grad()
+            out = model(ids, labels=ids)
+            acc.backward(out["loss"])
+            opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    rng = np.random.default_rng(0)
+    losses = []
+    # batch 64: divisible by M=8 microbatches per dp shard at both suite
+    # geometries (dp=N and dp=N/2 for N in {4, 8})
+    for _ in range(micro_steps):
+        ids = batch_to_global_array(
+            jnp.asarray(rng.integers(0, 1024, (64, 32)), jnp.int32),
+            mesh=acc.mesh,
+        )
+        losses.append(float(step(ids)))
+    return acc, step, losses
+
+
+@pytest.mark.skipif(N_DEV < 4 or N_DEV % 2, reason="needs >= 4 even devices")
+def test_pp2_composes_with_zero1_int8_accumulation_at_loss_parity():
+    acc_pp, step_pp, losses_pp = _composed_run(pp=2)
+    plan = acc_pp.plan
+    assert plan.pp == 2 and plan.dp == N_DEV // 2 and plan.dp > 1
+    assert plan.zero1  # ZeRO-1 armed over the dp axis alongside pp
+    assert plan.compression == "int8"
+    assert plan.stage.schedule == "interleaved"
+    # ZeRO-1 really sharded state over dp WITH the pp axis present
+    inner = acc_pp._optimizers[0].optimizer
+    assert any(a is not None for a in inner._dp_state_axis)
+
+    acc_dp, step_dp, losses_dp = _composed_run(pp=1)
+    assert acc_dp.plan.pp == 1 and acc_dp.plan.dp == N_DEV
+
+    diffs = [abs(a - b) for a, b in zip(losses_pp, losses_dp)]
+    assert max(diffs) <= 1e-3, f"loss divergence pp=2 vs dp-only: {diffs}"
+
+    # zero steady-state recompiles: two variants (sync on/off micro-steps)
+    # build on the first two calls — the expected second-variant key event —
+    # and every later call replays with no build and no new variant
+    for acc, step in ((acc_pp, step_pp), (acc_dp, step_dp)):
+        records = acc.telemetry.timeline.records()
+        assert not any(r.built for r in records[2:]), [
+            (r.step, r.built) for r in records
+        ]
+        assert acc.telemetry.recompiles_total <= 1  # only the variant-2 build
+        assert len(step._cache) == 2  # exactly the two accumulation variants
