@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke pipeline-smoke bench bench-gate
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold lint-sarif multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke pipeline-smoke bench bench-gate
 
 # graftlint: whole-program trace-safety & collective-correctness static
 # analysis (docs/graftlint.md). Runs before the suite. The on-disk cache
@@ -16,6 +16,18 @@ lint:
 lint-cold:
 	rm -rf .graftlint_cache
 	python tools/graftlint.py accelerate_tpu/ --cache-dir .graftlint_cache
+
+# SARIF smoke: emit the package report as SARIF (exit 0 expected — the
+# package lints clean), structurally validate it, then run the validator's
+# end-to-end self-test (known-bad fixture → graftlint subprocess → exit 1 →
+# valid document with a fix hint). Chained into `make test` so a SARIF
+# schema regression fails CI before any consumer sees it.
+lint-sarif:
+	mkdir -p .graftlint_cache
+	python tools/graftlint.py accelerate_tpu/ --cache-dir .graftlint_cache \
+	  --format sarif > .graftlint_cache/package.sarif
+	python tools/sarif_check.py .graftlint_cache/package.sarif
+	python tools/sarif_check.py --self-test
 
 # dp>1 sharded-update proof on a DIFFERENT mesh extent than the default
 # suite (which forces 8 virtual devices): ZeRO-1 numerics/memory/stability
@@ -121,7 +133,7 @@ pipeline-smoke:
 bench-gate:
 	python tools/bench_compare.py
 
-test: lint multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke pipeline-smoke bench-gate
+test: lint lint-sarif multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke pipeline-smoke bench-gate
 	python -m pytest tests/ -q
 
 test_core:

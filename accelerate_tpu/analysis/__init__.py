@@ -27,6 +27,7 @@ from .engine import (
     load_baseline,
     load_ckpt_specs,
     run_analysis,
+    sarif_report,
     write_baseline,
 )
 from .program import ModuleSummary, ProgramGraph, module_name_for
@@ -46,5 +47,6 @@ __all__ = [
     "load_ckpt_specs",
     "module_name_for",
     "run_analysis",
+    "sarif_report",
     "write_baseline",
 ]

@@ -138,10 +138,14 @@ def factory_returned_classes(tree: ast.AST) -> dict[str, str]:
     And a name REBOUND at module level — a later same-named def that does
     not itself qualify with the same class, or any plain assignment — is
     knocked out entirely: the live binding is whatever ran last, and a
-    stale mapping would be wrong, not conservative.  Single-level only: a
-    factory delegating to another factory records the inner factory's
-    NAME, which then fails class resolution downstream — silent, never
-    wrong."""
+    stale mapping would be wrong, not conservative.  Same-module
+    factory→factory delegation CHAINS resolve (v12): a delegating factory
+    records the inner factory's name, and a cycle-guarded post-pass chases
+    the map until it grounds (``make_a`` → ``make_b`` → ``Runner``).  A
+    chain whose last link is not in the map (an imported factory, a
+    knocked-out name) keeps that link as its ctor — program.py chases the
+    cross-module half — and a delegation cycle drops its members entirely
+    (no ground class exists)."""
     factories: dict[str, str] = {}
     knocked_out: set[str] = set()
     for node in getattr(tree, "body", []):
@@ -196,7 +200,18 @@ def factory_returned_classes(tree: ast.AST) -> dict[str, str]:
             knocked_out.add(name)
     for name in knocked_out:
         factories.pop(name, None)
-    return factories
+    # chase same-module delegation chains to their ground (cycle-guarded)
+    resolved: dict[str, str] = {}
+    for name in factories:
+        seen: set[str] = set()
+        tgt = name
+        while tgt in factories and tgt not in seen:
+            seen.add(tgt)
+            tgt = factories[tgt]
+        if tgt in seen:
+            continue  # delegation cycle: no ground class, drop the chain
+        resolved[name] = tgt
+    return resolved
 
 
 def _is_singleton_init(fn_node: ast.AST) -> bool:

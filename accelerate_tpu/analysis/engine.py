@@ -60,6 +60,19 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 # module-callable), and locally-shadowed names (an injected callable
 # parameter is DATA, not the module factory) all leave the receiver
 # uninferred.
+# v12: (a) new collective-divergence rule family — an interprocedural
+# rank-divergence taint pass (taint.py: rank-identity/rank-local-record/
+# fs-probe/wall-clock/per-host-env sources, gather/agree_* symmetry kills,
+# single-process world-size exemption) feeds three checks: a collective
+# sink guarded by rank-divergent control flow, early return/raise on a
+# tainted branch before a later collective, and mismatched collective
+# counts across sibling branches of a tainted conditional; the program
+# graph grew divergent-return and reaches-collective closures
+# (divergent_aliases / collective_aliases) to carry both facts across
+# modules.  (b) factory-return dispatch inference now chases
+# factory→factory delegation chains (same-module pre-resolution in
+# callgraph.py, cross-module chasing in program.py) and multi-hop
+# re-export paths, closing the v11 single-hop carve-out.
 # v11: (a) new stage-boundary-vs-plan rule — pp axis sizes / stage layer
 # spans derived outside the resolved ParallelPlan (mesh.shape pp reads,
 # literal P('pp') specs, hand-sliced layers-per-stage arithmetic) fire in
@@ -70,7 +83,7 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 # stay uninferred); (c) a bare-name constructor call whose name is locally
 # bound (parameter/assignment) now records NO ctor bind at all, so
 # shadowed names can never mis-resolve through the new import hop.
-ANALYSIS_VERSION = "11"
+ANALYSIS_VERSION = "12"
 
 # Names that mark a branch/function as profiling/benchmark plumbing, where a
 # deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the
@@ -132,6 +145,10 @@ class Rule:
     id: str = ""
     description: str = ""
     kind: str = "syntactic"
+    # one-line remediation shown in SARIF output (rule help + appended to
+    # each result message) so CI annotations carry the fix, not just the
+    # diagnosis
+    fix_hint: str = ""
 
     def check(self, module: "ModuleInfo", ctx: "AnalysisContext") -> list[Finding]:
         raise NotImplementedError
@@ -311,6 +328,13 @@ class AnalysisContext:
     # rel_path -> {visible callable name: chain} for functions that
     # transitively hit block_until_ready/effects_barrier (blocking rule)
     blocking_aliases: dict = dataclasses.field(default_factory=dict)
+    # rel_path -> {visible callable name / Cls.method qualname: chain} for
+    # functions whose RETURN VALUE is rank-divergent (taint.py sources
+    # propagated through the program graph's divergence closure)
+    divergent_aliases: dict = dataclasses.field(default_factory=dict)
+    # rel_path -> {visible callable name / qualname: chain} for functions
+    # that transitively issue a collective op (collective-divergence sinks)
+    collective_aliases: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -323,6 +347,10 @@ class AnalysisResult:
     cross_module: bool = True
     cache_hits: int = 0
     cache_misses: int = 0
+    # baseline fingerprints that matched NO current finding: the grand-
+    # fathered debt was paid (or the code moved), so the stale entry must
+    # leave the baseline — "exits 0 on exact matches only"
+    baseline_stale: list = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -330,6 +358,7 @@ class AnalysisResult:
             "duration_s": round(self.duration_s, 3),
             "suppressed": self.suppressed,
             "baseline_filtered": len(self.findings) - len(self.new_findings),
+            "baseline_stale": list(self.baseline_stale),
             "cross_module": self.cross_module,
             "cache_hits": self.cache_hits,
             "cache_misses": self.cache_misses,
@@ -458,6 +487,89 @@ def write_baseline(findings: Sequence[Finding], path: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# SARIF (CI annotation format; tools/sarif_check.py validates the shape)
+# ---------------------------------------------------------------------------
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def sarif_report(result: "AnalysisResult", rules: Sequence[Rule]) -> dict:
+    """Minimal SARIF 2.1.0 document for ``result.new_findings``: one run,
+    the rule table (with each rule's fix hint as its help text), and one
+    result per finding with rule id, level, message and a physical region.
+    The line-free fingerprint rides along as a partialFingerprint so SARIF
+    consumers dedupe across line drift exactly like the baseline does."""
+    by_id = {r.id: r for r in rules}
+    rules_meta = []
+    listed: set[str] = set()
+
+    def add_rule(rule_id: str, description: str, hint: str) -> None:
+        if rule_id in listed:
+            return
+        listed.add(rule_id)
+        meta = {
+            "id": rule_id,
+            "shortDescription": {"text": description},
+            "defaultConfiguration": {"level": "error"},
+        }
+        if hint:
+            meta["help"] = {"text": hint}
+        rules_meta.append(meta)
+
+    for r in rules:
+        add_rule(r.id, r.description, r.fix_hint)
+    results = []
+    for f in result.new_findings:
+        rule = by_id.get(f.rule)
+        if rule is None:
+            # syntax-error findings carry no Rule instance
+            add_rule(f.rule, "file failed to parse", "fix the syntax error")
+        message = f.message
+        hint = rule.fix_hint if rule is not None else ""
+        if hint:
+            message = f"{message} — fix: {hint}"
+        results.append(
+            {
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.path.replace(os.sep, "/")
+                            },
+                            "region": {
+                                "startLine": max(f.line, 1),
+                                "startColumn": f.col + 1,
+                            },
+                        }
+                    }
+                ],
+                "partialFingerprints": {"graftlint/v1": f.fingerprint()},
+            }
+        )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "version": ANALYSIS_VERSION,
+                        "informationUri": "docs/graftlint.md",
+                        "rules": rules_meta,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
+# ---------------------------------------------------------------------------
 # runner
 # ---------------------------------------------------------------------------
 
@@ -523,6 +635,8 @@ def _module_env_hash(rel: str, rule_ids: Sequence[str], ctx: AnalysisContext, ck
             for k, v in ctx.escape_aliases.get(rel, {}).items()
         ),
         "blocking": sorted(ctx.blocking_aliases.get(rel, {}).items()),
+        "divergent": sorted(ctx.divergent_aliases.get(rel, {}).items()),
+        "collective": sorted(ctx.collective_aliases.get(rel, {}).items()),
         "ckpt": ckpt_hash,
     }
     blob = json.dumps(payload, sort_keys=True).encode("utf-8")
@@ -614,6 +728,8 @@ def run_analysis(
     ctx.donor_aliases = program.donor_aliases
     ctx.escape_aliases = program.escape_aliases
     ctx.blocking_aliases = program.blocking_aliases
+    ctx.divergent_aliases = program.divergent_aliases
+    ctx.collective_aliases = program.collective_aliases
 
     ckpt_hash = (
         hashlib.sha256(
@@ -681,11 +797,13 @@ def run_analysis(
             cache.store(r.rel_path, r.content_hash, r.cache_entry)
 
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    new = (
-        [f for f in findings if f.fingerprint() not in baseline]
-        if baseline
-        else list(findings)
-    )
+    stale: list[str] = []
+    if baseline:
+        prints = {f.fingerprint() for f in findings}
+        new = [f for f in findings if f.fingerprint() not in baseline]
+        stale = sorted(baseline - prints)
+    else:
+        new = list(findings)
     return AnalysisResult(
         findings=findings,
         new_findings=new,
@@ -695,4 +813,5 @@ def run_analysis(
         cross_module=cross_module,
         cache_hits=cache_hits,
         cache_misses=cache_misses,
+        baseline_stale=stale,
     )

@@ -74,11 +74,18 @@ class FunctionSummary:
     blocks: bool  # unguarded block_until_ready/effects_barrier in own body
     guard: bool  # function name marks it as profiling/bench plumbing
     barrier: bool = False  # borg-singleton init: reachability stops here
+    # rank-divergence digest (taint.py, v12): the return value is divergent
+    # directly, or becomes divergent when one of the named callees is
+    div_direct: bool = False
+    div_via: list = dataclasses.field(default_factory=list)
+    # collective-sink tokens issued directly in the body (taint.py)
+    collectives: list = dataclasses.field(default_factory=list)
 
     def to_list(self) -> list:
         return [
             self.name, self.qualname, self.edges, self.escapes, self.blocks,
-            self.guard, self.barrier,
+            self.guard, self.barrier, self.div_direct, self.div_via,
+            self.collectives,
         ]
 
     @classmethod
@@ -263,19 +270,29 @@ def extract_summary(module) -> ModuleSummary:
     """Digest one parsed :class:`ModuleInfo` into its cacheable summary."""
     from .engine import collect_axes
 
+    from .taint import collective_leaves, return_flow
+
     cg = module.callgraph
-    functions = [
-        FunctionSummary(
-            name=info.name,
-            qualname=info.qualname,
-            edges=sorted(info.edges),
-            escapes=escaping_params(info.node),
-            blocks=_has_unguarded_block(info.node),
-            guard=bool(GUARD_NAME_RE.search(info.name)),
-            barrier=info.barrier,
+    functions = []
+    for info in cg.functions.values():
+        self_prefix = (
+            info.qualname.rsplit(".", 1)[0] if "." in info.qualname else None
         )
-        for info in cg.functions.values()
-    ]
+        div_direct, div_via = return_flow(module, info.node, self_prefix)
+        functions.append(
+            FunctionSummary(
+                name=info.name,
+                qualname=info.qualname,
+                edges=sorted(info.edges),
+                escapes=escaping_params(info.node),
+                blocks=_has_unguarded_block(info.node),
+                guard=bool(GUARD_NAME_RE.search(info.name)),
+                barrier=info.barrier,
+                div_direct=div_direct,
+                div_via=div_via,
+                collectives=collective_leaves(module, info.node),
+            )
+        )
     # names (bare or dotted) appearing inside trace-wrapper call arguments:
     # the per-module graph already rooted same-module matches; the program
     # graph resolves the rest through imports (`jax.jit(ops.step)`,
@@ -487,24 +504,57 @@ class ProgramGraph:
             return self._resolve_class(sa[sym][0], sa[sym][1], depth + 1)
         return None
 
-    def _resolve_factory_class(self, module_name: str, sym: str):
+    def _resolve_factory_class(self, module_name: str, sym: str, depth: int = 0):
         """(module index, class qualname) constructed by factory ``sym`` of
-        ``module_name`` — the SINGLE import hop behind v11's
-        ``from mod import make_thing; obj = make_thing(); obj.method(x)``
-        inference.  Deliberately one hop: the factory must be defined (and
-        in the v10 factory map) of the module the import names directly —
-        factory→factory delegation chains and re-exported factories stay
-        uninferred (silent, never wrong)."""
+        ``module_name``.  v11 resolved a single import hop only; v12 chases
+        the full chain, bounded by ``_MAX_REEXPORT_DEPTH``: ``sym`` may be a
+        RE-EXPORT of a factory defined elsewhere (``__init__.py`` chains,
+        like ``_resolve_class``), and the factory's recorded ctor may itself
+        be another factory — local (``make_a`` returning ``make_b()``,
+        pre-resolved same-module by ``factory_returned_classes`` but still
+        chased here for the knocked-out interplay), imported by symbol, or
+        dotted through a module alias (``helper.make_base()``), resolved
+        through THAT module's own import bindings.  Every link that fails to
+        ground in a real ClassDef leaves the receiver uninferred — silent,
+        never wrong."""
+        if depth > _MAX_REEXPORT_DEPTH:
+            return None
         j = self.by_name.get(module_name)
         if j is None:
             return None
         ctor = self.records[j].summary.factories.get(sym)
-        if not ctor or "." in ctor:
-            # dotted ctor (alias.Cls) inside the factory: resolving it would
-            # need that module's own import table a second hop away — out of
-            # the single-hop contract
+        if ctor is None:
+            # not a factory of this module: chase a re-exported name
+            sa = self.sym_aliases[j]
+            if sym in sa:
+                return self._resolve_factory_class(
+                    sa[sym][0], sa[sym][1], depth + 1
+                )
             return None
-        return self._resolve_class(self.names[j], ctor)
+        mn = self.names[j]
+        if "." in ctor:
+            # dotted ctor (`alias.Cls` / `alias.make_thing`): resolve through
+            # module j's own import bindings
+            head, _, rest = ctor.partition(".")
+            ma = self.mod_aliases[j]
+            if head not in ma or "." in rest:
+                return None
+            r = self._resolve_class(ma[head], rest)
+            if r is not None:
+                return r
+            return self._resolve_factory_class(ma[head], rest, depth + 1)
+        r = self._resolve_class(mn, ctor)
+        if r is not None:
+            return r
+        sa = self.sym_aliases[j]
+        if ctor in sa:
+            r = self._resolve_class(sa[ctor][0], sa[ctor][1])
+            if r is not None:
+                return r
+            return self._resolve_factory_class(sa[ctor][0], sa[ctor][1], depth + 1)
+        if ctor != sym and ctor in self.records[j].summary.factories:
+            return self._resolve_factory_class(mn, ctor, depth + 1)
+        return None
 
     def _resolve_method(self, i: int, dotted: str):
         """Resolve an instance-dispatch edge — ``Cls.method`` with ``Cls``
@@ -513,8 +563,9 @@ class ProgramGraph:
         assignment type inference (callgraph.py): the edge names the
         receiver's inferred constructor, this walks it to the class.  When
         the owner is not a class anywhere, it may be an IMPORTED factory
-        (``from mod import make_thing``): v11 resolves the class its
-        returns construct, one import hop only."""
+        (``from mod import make_thing``): v12 resolves the class its
+        returns construct, chasing re-export and factory→factory
+        delegation chains (bounded)."""
         owner, _, method = dotted.rpartition(".")
         if not owner or not method:
             return None
@@ -616,6 +667,19 @@ class ProgramGraph:
                 self.cross_reached.setdefault(self.records[i].rel_path, {})[qual] = reason
 
     # -- derived whole-program fact maps ------------------------------------
+    def _reverse_edges(self):
+        """caller-by-callee map, built once and shared by every reverse
+        closure (blocking, collective)."""
+        if getattr(self, "_rev_edges_cache", None) is None:
+            rev: dict[tuple[int, str], list[tuple[tuple[int, str], str]]] = {}
+            for i, r in enumerate(self.records):
+                for f in r.summary.functions:
+                    for edge in f.edges:
+                        for tgt in self._resolve_edge(i, edge):
+                            rev.setdefault(tgt, []).append(((i, f.qualname), edge))
+            self._rev_edges_cache = rev
+        return self._rev_edges_cache
+
     def _blocking_closure(self) -> dict[tuple[int, str], str]:
         """node -> human-readable chain, for functions that transitively call
         block_until_ready/effects_barrier.  Guard-named functions neither
@@ -625,13 +689,7 @@ class ProgramGraph:
             for f in r.summary.functions:
                 if f.blocks and not f.guard:
                     blocking[(i, f.qualname)] = "calls block_until_ready"
-        # reverse edges once
-        rev: dict[tuple[int, str], list[tuple[tuple[int, str], str]]] = {}
-        for i, r in enumerate(self.records):
-            for f in r.summary.functions:
-                for edge in f.edges:
-                    for tgt in self._resolve_edge(i, edge):
-                        rev.setdefault(tgt, []).append(((i, f.qualname), edge))
+        rev = self._reverse_edges()
         frontier = list(blocking)
         while frontier:
             node = frontier.pop()
@@ -647,6 +705,67 @@ class ProgramGraph:
                 blocking[caller] = f"via {where}, which {blocking[node]}"
                 frontier.append(caller)
         return blocking
+
+    def _collective_closure(self) -> dict[tuple[int, str], str]:
+        """node -> chain, for functions that (transitively) issue a
+        collective op every rank must enter together (taint.collective_sink
+        tokens).  Unlike blocking there is no guard exemption: a deliberate
+        sync is still a deadlock when only some ranks reach it."""
+        coll: dict[tuple[int, str], str] = {}
+        for i, r in enumerate(self.records):
+            for f in r.summary.functions:
+                if f.collectives:
+                    coll[(i, f.qualname)] = "issues " + "/".join(f.collectives)
+        rev = self._reverse_edges()
+        frontier = list(coll)
+        while frontier:
+            node = frontier.pop()
+            for caller, _edge in rev.get(node, []):
+                if caller in coll:
+                    continue
+                j, q2 = node
+                i, _ = caller
+                where = q2 if j == i else f"{self.records[j].rel_path}:{q2}"
+                coll[caller] = f"reaches {where}, which {coll[node]}"
+                frontier.append(caller)
+        return coll
+
+    def _divergence_closure(self) -> dict[tuple[int, str], str]:
+        """node -> chain, for functions whose RETURN VALUE is rank-divergent
+        (taint.return_flow digests).  Forward fixpoint: a function whose
+        return pends on a callee (``div_via``) becomes divergent when that
+        callee does — `local_restore_candidates` (fs probes) infects
+        `latest_local_checkpoint` infects its callers, until a symmetry
+        kill at some call site stops the chain."""
+        div: dict[tuple[int, str], str] = {}
+        for i, r in enumerate(self.records):
+            for f in r.summary.functions:
+                if f.div_direct:
+                    div[(i, f.qualname)] = "returns rank-divergent state"
+        changed = True
+        while changed:
+            changed = False
+            for i, r in enumerate(self.records):
+                for f in r.summary.functions:
+                    node = (i, f.qualname)
+                    if node in div or not f.div_via:
+                        continue
+                    for edge in f.div_via:
+                        hit = None
+                        for tgt in self._resolve_edge(i, edge):
+                            if tgt in div:
+                                hit = tgt
+                                break
+                        if hit is not None:
+                            j, q2 = hit
+                            where = (
+                                q2 if j == i
+                                else f"{self.records[j].rel_path}:{q2}"
+                            )
+                            div[node] = f"via {where}, which {div[hit]}"
+                            changed = True
+                            break
+        return div
 
     def _visible_callables(self, i: int):
         """Yield (visible name, (module idx, qualname)) for everything module
@@ -691,14 +810,20 @@ class ProgramGraph:
         # --no-cross-module the maps stay EMPTY so the escape hatch really is
         # the historical per-module behavior (direct calls only).
         blocking = self._blocking_closure() if self.cross else {}
+        divergence = self._divergence_closure() if self.cross else {}
+        collective = self._collective_closure() if self.cross else {}
         self.donor_aliases: dict[str, dict[str, list[int]]] = {}
         self.escape_aliases: dict[str, dict[str, dict]] = {}
         self.blocking_aliases: dict[str, dict[str, str]] = {}
+        self.divergent_aliases: dict[str, dict[str, str]] = {}
+        self.collective_aliases: dict[str, dict[str, str]] = {}
         for i, r in enumerate(self.records):
             rel = r.rel_path
             donors = dict(r.summary.donors)
             escapes: dict[str, dict] = {}
             blocks: dict[str, str] = {}
+            divergent: dict[str, str] = {}
+            coll: dict[str, str] = {}
             if self.cross:
                 for visible, (j, qual) in self._visible_callables(i):
                     f = self.fn_by_qual[j][qual]
@@ -710,6 +835,23 @@ class ProgramGraph:
                     chain = blocking.get((j, qual))
                     if chain is not None:
                         blocks.setdefault(visible, chain)
+                    chain = divergence.get((j, qual))
+                    if chain is not None:
+                        divergent.setdefault(visible, chain)
+                    chain = collective.get((j, qual))
+                    if chain is not None:
+                        coll.setdefault(visible, chain)
+                # own methods by qualname, so `self.helper()` call sites
+                # (candidate `Cls.helper`) resolve through the maps too
+                for f in r.summary.functions:
+                    if "." not in f.qualname:
+                        continue
+                    chain = divergence.get((i, f.qualname))
+                    if chain is not None:
+                        divergent.setdefault(f.qualname, chain)
+                    chain = collective.get((i, f.qualname))
+                    if chain is not None:
+                        coll.setdefault(f.qualname, chain)
             if self.cross:
                 for bound, (bm, nm) in self.sym_aliases[i].items():
                     pos = self._resolve_donor(bm, nm)
@@ -727,3 +869,7 @@ class ProgramGraph:
                 self.escape_aliases[rel] = escapes
             if blocks:
                 self.blocking_aliases[rel] = blocks
+            if divergent:
+                self.divergent_aliases[rel] = divergent
+            if coll:
+                self.collective_aliases[rel] = coll

@@ -2,6 +2,7 @@
 
 from .axis_names import AxisNameMismatch
 from .blocking import BlockingInHotLoop
+from .collective_divergence import CollectiveDivergence
 from .donation import DonationReuse
 from .dtype_widen import DtypeWiden
 from .host_sync import HostSyncInTrace
@@ -22,6 +23,7 @@ ALL_RULES = [
     ShardingSpecDrift,
     PallasHazard,
     StageBoundaryVsPlan,
+    CollectiveDivergence,
 ]
 
 

@@ -55,6 +55,10 @@ class AxisNameMismatch(Rule):
         "collective/PartitionSpec axis name not declared by any mesh "
         "(MESH_AXIS_* constants, Mesh(axis_names=...), make_mesh({...}))"
     )
+    fix_hint = (
+        "use an axis name the mesh declares (the MESH_AXIS_* constants) "
+        "instead of a free-hand string"
+    )
 
     def check(self, module, ctx):
         findings = []

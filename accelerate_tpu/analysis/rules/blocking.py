@@ -187,6 +187,10 @@ class BlockingInHotLoop(Rule):
         "cadence evidence"
     )
     kind = "reachability"
+    fix_hint = (
+        "sync once after the loop, or gate the barrier behind a sampled "
+        "profiling cadence (step % PROFILE_EVERY == 0)"
+    )
 
     def check(self, module, ctx):
         blocking_callables = ctx.blocking_aliases.get(module.rel_path, {})

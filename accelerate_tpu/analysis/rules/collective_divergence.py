@@ -1,0 +1,272 @@
+"""collective-divergence: a collective op reachable only under rank-divergent
+control flow.
+
+The mesh's lockstep contract (docs/elastic.md): every rank issues the same
+collective sequence, in the same order, or the mesh hangs.  This rule runs
+the rank-divergence taint engine (``analysis/taint.py``) over each function
+— seeded with the whole-program facts from ``ctx.divergent_aliases``
+(functions proven to RETURN rank-divergent state) and
+``ctx.collective_aliases`` (functions that transitively ISSUE a collective)
+— and flags three shapes:
+
+* **branch mismatch** — sibling branches of a rank-divergent conditional
+  issue different collective sequences (including the degenerate and most
+  common case: a collective on one side, nothing on the other — only the
+  ranks taking that side enter it);
+* **early exit** — a ``return``/``raise`` on a rank-divergent branch while
+  a collective still follows in the function: the exiting ranks never reach
+  it, the remaining ranks block in it forever;
+* **divergent loop** — a collective inside a loop whose condition (or
+  iterable) is rank-divergent: trip counts differ per rank, so the
+  collective sequence does too.
+
+The sanctioned fix shapes the rule recognizes (no suppression needed):
+deriving the guard from an all-ranks merge (``gather_object`` /
+``agree_*`` kill taint), and conjoining the branch with a single-process
+world-size test (``not _multi_process()``, ``num_processes == 1``) — the
+PR-13 serving-signal gate — which makes the branch unreachable on any
+multi-process run.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Rule
+from ..taint import FunctionTaint, collective_sink, single_process_conjunct
+
+_NESTED_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+class CollectiveDivergence(Rule):
+    id = "collective-divergence"
+    kind = "reachability"
+    description = (
+        "collective op (gather/broadcast/barrier/load_state/fleet resize) "
+        "guarded by rank-divergent state — only some ranks enter it and "
+        "the mesh deadlocks"
+    )
+    fix_hint = (
+        "derive the guard from an all-ranks merge (gather_object + agree_*) "
+        "so every rank sees the same value, or gate the branch single-"
+        "process (num_processes == 1 / not _multi_process())"
+    )
+
+    def check(self, module, ctx) -> list[Finding]:
+        findings: list[Finding] = []
+        div_map = ctx.divergent_aliases.get(module.rel_path, {})
+        coll_map = ctx.collective_aliases.get(module.rel_path, {})
+        for info in module.callgraph.functions.values():
+            self_prefix = (
+                info.qualname.rsplit(".", 1)[0]
+                if "." in info.qualname
+                else None
+            )
+            taint = FunctionTaint(
+                module, info.node, known=div_map, self_prefix=self_prefix
+            )
+            seen: set[tuple[int, str]] = set()
+
+            def fire(node, kind, message):
+                key = (node.lineno, kind)
+                if key in seen:
+                    return
+                seen.add(key)
+                findings.append(
+                    Finding(
+                        self.id,
+                        module.rel_path,
+                        node.lineno,
+                        node.col_offset,
+                        message,
+                        symbol=info.qualname,
+                    )
+                )
+
+            self._scan(
+                info.node.body, [], module, taint, coll_map, fire
+            )
+        return findings
+
+    # -- token collection ----------------------------------------------------
+    def _call_token(self, call, module, taint, coll_map):
+        """Collective token for one Call: a direct sink, or a call into a
+        function the program graph proved collective-bearing."""
+        tok = collective_sink(call, module)
+        if tok is not None:
+            return tok
+        for cand in taint.callee_names(call.func):
+            if cand in coll_map:
+                return cand
+        return None
+
+    def _expr_tokens(self, node, module, taint, coll_map):
+        out = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                tok = self._call_token(sub, module, taint, coll_map)
+                if tok is not None:
+                    out.append(tok)
+        return out
+
+    def _tokens(self, stmts, module, taint, coll_map):
+        """Collective tokens issued by a statement list, skipping nested
+        defs (their own call-graph nodes) and single-process-guarded
+        branches (unreachable on a multi-process run)."""
+        out = []
+        for stmt in stmts:
+            if isinstance(stmt, _NESTED_DEFS):
+                continue
+            if isinstance(stmt, ast.If) and single_process_conjunct(stmt.test):
+                out += self._expr_tokens(stmt.test, module, taint, coll_map)
+                out += self._tokens(stmt.orelse, module, taint, coll_map)
+                continue
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    out += self._tokens([child], module, taint, coll_map)
+                elif isinstance(child, ast.ExceptHandler):
+                    if child.type is not None:
+                        out += self._expr_tokens(
+                            child.type, module, taint, coll_map
+                        )
+                    out += self._tokens(child.body, module, taint, coll_map)
+                elif isinstance(child, ast.withitem):
+                    out += self._expr_tokens(
+                        child.context_expr, module, taint, coll_map
+                    )
+                elif hasattr(ast, "match_case") and isinstance(
+                    child, ast.match_case
+                ):
+                    out += self._tokens(child.body, module, taint, coll_map)
+                elif isinstance(child, ast.expr):
+                    out += self._expr_tokens(child, module, taint, coll_map)
+        return out
+
+    def _exits(self, stmts):
+        """Top-to-bottom ``return``/``raise`` statements inside a branch (any
+        nesting short of nested defs) — the exits that abandon the rest of
+        the function for the ranks that took this branch."""
+        out = []
+        for stmt in stmts:
+            if isinstance(stmt, _NESTED_DEFS):
+                continue
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                out.append(stmt)
+                continue
+            if isinstance(stmt, ast.If) and single_process_conjunct(stmt.test):
+                out += self._exits(stmt.orelse)
+                continue
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    out += self._exits([child])
+                elif isinstance(child, ast.ExceptHandler):
+                    out += self._exits(child.body)
+                elif hasattr(ast, "match_case") and isinstance(
+                    child, ast.match_case
+                ):
+                    out += self._exits(child.body)
+        return out
+
+    # -- the statement scan ----------------------------------------------------
+    def _scan(self, stmts, tail, module, taint, coll_map, fire):
+        """``tail`` carries the collective tokens that follow the current
+        block at every enclosing level — what an early exit would skip."""
+        for idx, stmt in enumerate(stmts):
+            if isinstance(stmt, _NESTED_DEFS):
+                continue
+            after = (
+                self._tokens(stmts[idx + 1:], module, taint, coll_map) + tail
+            )
+            if isinstance(stmt, ast.If):
+                if single_process_conjunct(stmt.test):
+                    # the branch never executes multi-process: nothing inside
+                    # it can diverge a mesh (the sanctioned PR-13 gate)
+                    self._scan(
+                        stmt.orelse, after, module, taint, coll_map, fire
+                    )
+                    continue
+                if taint.expr_tainted(stmt.test):
+                    desc = taint.describe(stmt.test)
+                    body_toks = self._tokens(
+                        stmt.body, module, taint, coll_map
+                    )
+                    else_toks = self._tokens(
+                        stmt.orelse, module, taint, coll_map
+                    )
+                    if sorted(body_toks) != sorted(else_toks):
+                        fire(
+                            stmt,
+                            "branch",
+                            "collective sequence diverges across ranks: "
+                            f"branch on rank-divergent {desc} issues "
+                            f"[{', '.join(sorted(body_toks)) or 'nothing'}] vs "
+                            f"[{', '.join(sorted(else_toks)) or 'nothing'}] "
+                            "on the sibling path — only some ranks enter, "
+                            "the mesh deadlocks",
+                        )
+                    if after:
+                        for branch in (stmt.body, stmt.orelse):
+                            for exit_stmt in self._exits(branch):
+                                word = (
+                                    "return"
+                                    if isinstance(exit_stmt, ast.Return)
+                                    else "raise"
+                                )
+                                fire(
+                                    exit_stmt,
+                                    "exit",
+                                    f"early {word} on a rank-divergent "
+                                    f"branch ({desc}) skips the later "
+                                    f"collective ({after[0]}) — exiting "
+                                    "ranks never reach it, the rest block "
+                                    "in it forever",
+                                )
+                self._scan(stmt.body, after, module, taint, coll_map, fire)
+                self._scan(stmt.orelse, after, module, taint, coll_map, fire)
+            elif isinstance(stmt, ast.While):
+                if not single_process_conjunct(stmt.test) and taint.expr_tainted(
+                    stmt.test
+                ):
+                    toks = self._tokens(stmt.body, module, taint, coll_map)
+                    if toks:
+                        fire(
+                            stmt,
+                            "loop",
+                            f"collective ({toks[0]}) inside a loop whose "
+                            "condition is rank-divergent "
+                            f"({taint.describe(stmt.test)}) — trip counts "
+                            "differ per rank, so the collective sequence "
+                            "does too",
+                        )
+                self._scan(stmt.body, after, module, taint, coll_map, fire)
+                self._scan(stmt.orelse, after, module, taint, coll_map, fire)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if taint.expr_tainted(stmt.iter):
+                    toks = self._tokens(stmt.body, module, taint, coll_map)
+                    if toks:
+                        fire(
+                            stmt,
+                            "loop",
+                            f"collective ({toks[0]}) inside a loop over a "
+                            "rank-divergent iterable "
+                            f"({taint.describe(stmt.iter)}) — trip counts "
+                            "differ per rank, so the collective sequence "
+                            "does too",
+                        )
+                self._scan(stmt.body, after, module, taint, coll_map, fire)
+                self._scan(stmt.orelse, after, module, taint, coll_map, fire)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._scan(stmt.body, after, module, taint, coll_map, fire)
+            elif isinstance(stmt, ast.Try) or (
+                hasattr(ast, "TryStar") and isinstance(stmt, ast.TryStar)
+            ):
+                self._scan(stmt.body, after, module, taint, coll_map, fire)
+                for h in stmt.handlers:
+                    self._scan(h.body, after, module, taint, coll_map, fire)
+                self._scan(stmt.orelse, after, module, taint, coll_map, fire)
+                self._scan(
+                    stmt.finalbody, after, module, taint, coll_map, fire
+                )
+            elif isinstance(stmt, ast.Match):
+                for case in stmt.cases:
+                    self._scan(case.body, after, module, taint, coll_map, fire)

@@ -155,6 +155,10 @@ class DonationReuse(Rule):
     id = "donation-reuse"
     description = "buffer read after appearing at a donate_argnums position"
     kind = "reachability"
+    fix_hint = (
+        "rebind the result over the donated name (x = step(x)) so the stale "
+        "buffer is unreachable, or drop donate_argnums for this argument"
+    )
 
     def check(self, module, ctx):
         donors = visible_donors(module, ctx)

@@ -40,6 +40,10 @@ class DtypeWiden(Rule):
     id = "dtype-widen"
     kind = "reachability"
     description = "float64 promotion on a TPU path (jnp dtype, astype, or jax_enable_x64)"
+    fix_hint = (
+        "use float32 (or bfloat16) — TPUs have no f64 ALU, so x64 silently "
+        "emulates at a large cost"
+    )
 
     def _is_wide(self, module, node: ast.AST, allow_builtin_float: bool) -> bool:
         resolved = module.resolve(node)

@@ -43,6 +43,10 @@ class HostSyncInTrace(Rule):
         "host transfer (.item()/.tolist()/float()/np.asarray/jax.device_get/"
         ".block_until_ready) reachable from jit/shard_map/compile_step-traced code"
     )
+    fix_hint = (
+        "keep the value on device (jnp ops) or move the read outside the "
+        "traced region; use jax.debug.print for trace-time logging"
+    )
 
     def check(self, module, ctx):
         findings = []

@@ -185,6 +185,10 @@ class PallasHazard(Rule):
         "no interpret=/policy-gated fallback in scope"
     )
     kind = "syntactic"
+    fix_hint = (
+        "use pl.when for branches and pl.debug_print for logging inside "
+        "kernels; thread KernelPolicy.interpret to the pallas_call site"
+    )
 
     def check(self, module, ctx):
         findings: list[Finding] = []

@@ -298,6 +298,10 @@ class RecompileHazard(Rule):
         "static_argnums, an unhashable static default, or a captured step fed "
         "unbucketed loader batches"
     )
+    fix_hint = (
+        "mark the argument static (static_argnums/static_argnames) or "
+        "bucket/pad the dynamic shape (TPU_PAD_MULTIPLE) so traces are reused"
+    )
 
     def check(self, module, ctx):
         findings = []

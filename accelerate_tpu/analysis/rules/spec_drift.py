@@ -133,6 +133,10 @@ class ShardingSpecDrift(Rule):
         "sharding plan or fsdp strategy disagrees with the checkpoint "
         "metadata records (needs --ckpt-index)"
     )
+    fix_hint = (
+        "match the plan to the checkpoint's recorded PartitionSpec, or "
+        "re-save the checkpoint under the new plan"
+    )
 
     def check(self, module, ctx):
         specs = getattr(ctx, "ckpt_specs", None)

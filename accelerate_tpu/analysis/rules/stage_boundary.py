@@ -71,6 +71,10 @@ class StageBoundaryVsPlan(Rule):
         "hand-sliced layers-per-stage arithmetic) — read current_plan() "
         "instead (docs/parallel_plan.md)"
     )
+    fix_hint = (
+        "read current_plan().pp and plan.stage_spans() instead of deriving "
+        "stage geometry by hand (docs/parallel_plan.md)"
+    )
 
     def check(self, module, ctx):
         rel = module.rel_path.replace(os.sep, "/")

@@ -129,6 +129,10 @@ class TransitiveDonation(Rule):
         "— the stored alias outlives the donation"
     )
     kind = "reachability"
+    fix_hint = (
+        "hand the helper a copy (helper(x.copy())) so the stored alias owns "
+        "its buffer, or drop the donation"
+    )
 
     def check(self, module, ctx):
         donors = visible_donors(module, ctx)

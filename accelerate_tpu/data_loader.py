@@ -1060,6 +1060,12 @@ class DataLoaderDispatcher(DataLoaderShard):
             return
         from .utils import operations as ops
 
+        # producer/consumer protocol: roles are rank-asymmetric by design but
+        # every yield pairs one broadcast_object_list + broadcast on BOTH
+        # sides, and the terminal "stop" broadcast_object_list pairs with the
+        # peers' final loop read — statically mismatched token counts,
+        # dynamically matched handshake (pinned by tests/test_data_loader.py)
+        # graftlint: disable=collective-divergence -- handshake-symmetric protocol
         if state.is_main_process:
             for host_batch, remainder in super()._host_batches(should_stop):
                 skeleton = ops.get_data_structure(host_batch)

@@ -30,6 +30,11 @@ class MultiProcessAdapter(logging.LoggerAdapter):
         in_order = kwargs.pop("in_order", False)
         kwargs.setdefault("stacklevel", 2)
         state = PartialState()
+        # in_order comes from the caller's kwargs, identical on every rank;
+        # the flow-insensitive taint fixpoint overtaints it through the later
+        # `msg, kwargs = self.process(...)` reassignment under _should_log
+        # (taint born below a read still poisons it — docs/graftlint.md)
+        # graftlint: disable=collective-divergence -- overtaint, guard is rank-symmetric
         if in_order and state.num_processes > 1:
             for i in range(state.num_processes):
                 if i == state.process_index:

@@ -302,6 +302,53 @@ FIXTURES = {
             return plan.stage.layer_spans(num_layers), plan.pp
         """,
     ),
+    # the PR-13 serving-signal deadlock shape: a rank-local telemetry record
+    # read guards fleet.resize (only ranks whose local queue is deep enter
+    # the collective resize), plus the classic main-process early return
+    # before a barrier
+    "collective-divergence": (
+        """
+        from accelerate_tpu.utils import telemetry
+
+
+        def autoscale(fleet):
+            record = telemetry.serving_signal()
+            if record and record.get("queue_depth", 0) > 8:
+                fleet.resize(2)
+
+
+        def drain(state):
+            if state.is_main_process:
+                return None
+            state.wait_for_everyone()
+        """,
+        2,
+        """
+        from accelerate_tpu.utils import telemetry
+        from accelerate_tpu.utils.operations import gather_object
+
+
+        def agree_depth(values):
+            return max(values)
+
+
+        def autoscale(fleet):
+            record = telemetry.serving_signal()
+            local_depth = record.get("queue_depth", 0) if record else 0
+            # rank-symmetric rewrite: every rank sees every rank's depth,
+            # so the resize guard agrees everywhere
+            depths = gather_object([local_depth])
+            if agree_depth(depths) > 8:
+                fleet.resize(2)
+
+
+        def drain(state):
+            state.wait_for_everyone()
+            if state.is_main_process:
+                return "drained"
+            return None
+        """,
+    ),
 }
 
 
@@ -1568,10 +1615,10 @@ def test_imported_factory_shadowed_param_silent(tmp_path):
     assert res.new_findings == [], [f.render() for f in res.new_findings]
 
 
-def test_imported_factory_delegation_chain_silent(tmp_path):
-    """Single-hop only: a factory that DELEGATES to another factory records
-    the inner factory's name, which fails class resolution — the chain
-    stays uninferred (silent, never wrong)."""
+def test_imported_factory_delegation_chain_resolves(tmp_path):
+    """v12: a factory that DELEGATES to another factory resolves through the
+    chain to the ground class, so dispatch through the imported outer
+    factory reaches Runner.work."""
     res = lint_pkg(
         tmp_path,
         {
@@ -1593,6 +1640,114 @@ def test_imported_factory_delegation_chain_silent(tmp_path):
                 @jax.jit
                 def step(x):
                     r = make_runner()
+                    return r.work(x)
+                """,
+        },
+        rule="host-sync-in-trace",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert res.new_findings[0].symbol == "Runner.work"
+    assert res.new_findings[0].path.endswith("impl.py")
+
+
+def test_factory_delegation_cycle_silent(tmp_path):
+    """Mutually-delegating factories have no ground class: the cycle is
+    dropped, never looped over or guessed at."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "impl.py": """
+                class Runner:
+                    def work(self, x):
+                        return x.item()
+
+                def make_a():
+                    return make_b()
+
+                def make_b():
+                    return make_a()
+                """,
+            "train.py": """
+                import jax
+                from .impl import make_a
+
+                @jax.jit
+                def step(x):
+                    r = make_a()
+                    return r.work(x)
+                """,
+        },
+        rule="host-sync-in-trace",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_factory_through_reexport_chain_resolves(tmp_path):
+    """Multi-hop: train imports the factory from an api module that
+    re-exports it from impl; the returned class still resolves."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "impl.py": """
+                class Runner:
+                    def work(self, x):
+                        return x.item()
+
+                def make_inner():
+                    return Runner()
+
+                def make_runner():
+                    return make_inner()
+                """,
+            "api.py": """
+                from .impl import make_runner
+                """,
+            "train.py": """
+                import jax
+                from .api import make_runner
+
+                @jax.jit
+                def step(x):
+                    r = make_runner()
+                    return r.work(x)
+                """,
+        },
+        rule="host-sync-in-trace",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert res.new_findings[0].symbol == "Runner.work"
+
+
+def test_factory_mixed_chain_still_silent(tmp_path):
+    """A delegation chain whose inner factory returns DIFFERENT classes on
+    different paths stays uninferred (silent, never wrong)."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "impl.py": """
+                class Runner:
+                    def work(self, x):
+                        return x.item()
+
+                class Other:
+                    def work(self, x):
+                        return x
+
+                def make_inner(fast):
+                    if fast:
+                        return Runner()
+                    return Other()
+
+                def make_runner(fast):
+                    return make_inner(fast)
+                """,
+            "train.py": """
+                import jax
+                from .impl import make_runner
+
+                @jax.jit
+                def step(x):
+                    r = make_runner(True)
                     return r.work(x)
                 """,
         },
@@ -2557,3 +2712,408 @@ def test_cache_second_run_still_hits_across_instances_same_branch(tmp_path):
     assert first.cache_misses > 0
     second = lint_pkg(tmp_path, CROSS_HOST_SYNC_GOOD, cache_dir=cache_dir)
     assert second.cache_misses == 0 and second.cache_hits == first.cache_misses
+
+
+# ---------------------------------------------------------------------------
+# collective-divergence: the rank-divergence taint rule (v12)
+# ---------------------------------------------------------------------------
+
+
+def _taint_for(tmp_path, source, fn_name, known=None, self_prefix=None):
+    """Build a FunctionTaint over one function of a one-file fixture."""
+    import ast
+
+    from accelerate_tpu.analysis.engine import ModuleInfo
+    from accelerate_tpu.analysis.taint import FunctionTaint
+
+    f = tmp_path / "snippet.py"
+    f.write_text(textwrap.dedent(source))
+    mod = ModuleInfo(str(f), "snippet.py", f.read_text())
+    fn = next(
+        n
+        for n in ast.walk(mod.tree)
+        if isinstance(n, ast.FunctionDef) and n.name == fn_name
+    )
+    return FunctionTaint(mod, fn, known=known or {}, self_prefix=self_prefix)
+
+
+def test_taint_sources_seed_locals(tmp_path):
+    ft = _taint_for(
+        tmp_path,
+        """
+        import os
+        import time
+
+        def f(state):
+            rank = state.process_index
+            host = os.environ["LOCAL_RANK"]
+            probe = os.path.exists("/tmp/flag")
+            now = time.monotonic()
+            clean = state.num_processes
+        """,
+        "f",
+    )
+    assert {"rank", "host", "probe", "now"} <= ft.tainted
+    assert "clean" not in ft.tainted
+
+
+def test_taint_propagates_through_assignment_chains(tmp_path):
+    ft = _taint_for(
+        tmp_path,
+        """
+        def f(state):
+            rank = state.process_index
+            doubled = rank * 2
+            label = f"worker-{doubled}"
+            other = state.num_processes + 1
+        """,
+        "f",
+    )
+    assert {"rank", "doubled", "label"} <= ft.tainted
+    assert "other" not in ft.tainted
+
+
+def test_taint_killed_by_symmetry_merge(tmp_path):
+    ft = _taint_for(
+        tmp_path,
+        """
+        from ops import gather_object
+
+        def f(state):
+            local = state.process_index
+            merged = gather_object([local])
+            depth = agree_max(merged)
+        """,
+        "f",
+    )
+    assert "local" in ft.tainted
+    assert "merged" not in ft.tainted
+    assert "depth" not in ft.tainted
+
+
+def test_taint_joins_over_branches(tmp_path):
+    """A name clean on one path and divergent on the other joins to
+    divergent."""
+    ft = _taint_for(
+        tmp_path,
+        """
+        def f(state, fallback):
+            if fallback:
+                who = 0
+            else:
+                who = state.process_index
+            return who
+        """,
+        "f",
+    )
+    assert "who" in ft.tainted
+    assert ft.return_direct
+
+
+def test_taint_implicit_flow_under_divergent_test(tmp_path):
+    """An assignment under a rank-divergent test is itself divergent even
+    when the assigned value is clean."""
+    ft = _taint_for(
+        tmp_path,
+        """
+        def f(state):
+            mode = "idle"
+            if state.is_main_process:
+                mode = "lead"
+            return mode
+        """,
+        "f",
+    )
+    assert "mode" in ft.tainted
+    assert ft.return_direct
+
+
+def test_taint_single_process_body_assignments_stay_clean(tmp_path):
+    """Inside a single-process gate nothing can diverge a mesh: the branch
+    is unreachable multi-process, so its assignments don't taint."""
+    ft = _taint_for(
+        tmp_path,
+        """
+        def f(state):
+            mode = "idle"
+            if state.num_processes == 1:
+                mode = local_probe()
+            return mode
+        """,
+        "f",
+    )
+    assert "mode" not in ft.tainted
+    assert not ft.return_direct
+
+
+def test_return_flow_digest(tmp_path):
+    import ast
+
+    from accelerate_tpu.analysis.engine import ModuleInfo
+    from accelerate_tpu.analysis.taint import return_flow
+
+    f = tmp_path / "snippet.py"
+    f.write_text(
+        textwrap.dedent(
+            """
+            def direct(state):
+                return state.process_index
+
+            def pending(state):
+                return helper(state)
+
+            def clean(state):
+                return state.num_processes
+            """
+        )
+    )
+    mod = ModuleInfo(str(f), "snippet.py", f.read_text())
+    fns = {
+        n.name: n for n in ast.walk(mod.tree) if isinstance(n, ast.FunctionDef)
+    }
+    assert return_flow(mod, fns["direct"]) == (True, [])
+    assert return_flow(mod, fns["pending"]) == (False, ["helper"])
+    assert return_flow(mod, fns["clean"]) == (False, [])
+
+
+def test_divergence_mismatched_counts_both_branches(tmp_path):
+    """Both branches issue collectives, but different sequences — still a
+    divergent schedule."""
+    res = lint(
+        tmp_path,
+        """
+        from ops import broadcast, gather_object
+
+        def f(state, x):
+            if state.process_index == 0:
+                broadcast(x)
+                broadcast(x)
+            else:
+                broadcast(x)
+        """,
+        rule="collective-divergence",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert "broadcast" in res.new_findings[0].message
+
+
+def test_divergence_loop_over_fs_probe(tmp_path):
+    """Polling a filesystem flag around a collective: hosts observe the flag
+    at different times, so trip counts diverge."""
+    res = lint(
+        tmp_path,
+        """
+        import os
+
+        def wait_for_go(state):
+            while not os.path.exists("/tmp/go"):
+                state.wait_for_everyone()
+        """,
+        rule="collective-divergence",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert "loop" in res.new_findings[0].message
+
+
+def test_divergence_single_process_gate_exempts(tmp_path):
+    """The sanctioned PR-13 autopilot shape: the divergent serving signal
+    only drives a resize under a single-process world gate."""
+    res = lint(
+        tmp_path,
+        """
+        def _multi_process(state):
+            return state.num_processes > 1
+
+        def autoscale(state, fleet, telemetry):
+            record = telemetry.serving_signal()
+            if record and not _multi_process(state):
+                fleet.resize(2)
+        """,
+        rule="collective-divergence",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_divergence_symmetric_guard_after_gather_is_clean(tmp_path):
+    """The rank-symmetric rewrite of the serving-signal gate: gather first,
+    agree on the merged view, then resize on every rank together."""
+    res = lint(
+        tmp_path,
+        """
+        from ops import gather_object
+
+        def autoscale(state, fleet, telemetry):
+            record = telemetry.serving_signal()
+            depth = record.get("queue_depth", 0) if record else 0
+            merged = gather_object([depth])
+            if agree_max(merged) > 8:
+                fleet.resize(2)
+        """,
+        rule="collective-divergence",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_divergence_cross_module_collective_helper(tmp_path):
+    """The collective hides behind a helper in another module; the
+    collective-closure alias map carries it to the divergent guard."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "sync.py": """
+                def rendezvous(state):
+                    state.wait_for_everyone()
+                """,
+            "train.py": """
+                from .sync import rendezvous
+
+                def run(state):
+                    if state.is_main_process:
+                        rendezvous(state)
+                """,
+        },
+        rule="collective-divergence",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert res.new_findings[0].symbol == "run"
+    assert "rendezvous" in res.new_findings[0].message
+
+
+def test_divergence_cross_module_needs_whole_program(tmp_path):
+    """Same fixture with cross-module analysis off: the helper's collective
+    is invisible, the rule stays silent (kind=reachability contract)."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "sync.py": """
+                def rendezvous(state):
+                    state.wait_for_everyone()
+                """,
+            "train.py": """
+                from .sync import rendezvous
+
+                def run(state):
+                    if state.is_main_process:
+                        rendezvous(state)
+                """,
+        },
+        rule="collective-divergence",
+        cross_module=False,
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_divergence_return_closure_crosses_modules(tmp_path):
+    """A helper in another module RETURNS rank-divergent state; branching on
+    its result over a collective fires at the caller."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "ident.py": """
+                def whoami(state):
+                    return state.process_index
+                """,
+            "train.py": """
+                from .ident import whoami
+
+                def run(state, fleet):
+                    if whoami(state) == 0:
+                        fleet.resize(2)
+                """,
+        },
+        rule="collective-divergence",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert res.new_findings[0].symbol == "run"
+    assert "whoami" in res.new_findings[0].message
+
+
+def test_divergence_early_raise_before_collective(tmp_path):
+    res = lint(
+        tmp_path,
+        """
+        def run(state):
+            if state.is_main_process:
+                raise RuntimeError("lead only")
+            state.wait_for_everyone()
+        """,
+        rule="collective-divergence",
+    )
+    assert len(res.new_findings) == 1, [f.render() for f in res.new_findings]
+    assert "raise" in res.new_findings[0].message
+
+
+def test_package_suppressions_are_load_bearing():
+    """The two in-tree suppressions (logging in_order overtaint, dispatcher
+    handshake protocol) must each cover a finding the rule still detects:
+    stripping the disable comment re-fires it.  Guards against the
+    suppression rotting after the underlying code moves."""
+    for rel in ("accelerate_tpu/logging.py", "accelerate_tpu/data_loader.py"):
+        src = open(os.path.join(REPO, rel)).read()
+        assert "graftlint: disable=collective-divergence" in src, rel
+        with_suppression = run_analysis(
+            [os.path.join(REPO, rel)], rules=get_rules(["collective-divergence"])
+        )
+        assert with_suppression.new_findings == [], rel
+        assert with_suppression.suppressed >= 1, rel
+
+
+def test_cli_sarif_output(tmp_path):
+    bad, expected, _ = FIXTURES["collective-divergence"]
+    (tmp_path / "bad.py").write_text(textwrap.dedent(bad))
+    proc = _run_cli(str(tmp_path), "--format", "sarif")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    results = run["results"]
+    assert len(results) == expected
+    for r in results:
+        assert r["ruleId"] == "collective-divergence"
+        assert r["ruleId"] in declared
+        assert r["level"] == "error"
+        assert "fix:" in r["message"]["text"]
+        region = r["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] >= 1 and region["startColumn"] >= 1
+        assert "graftlint/v1" in r["partialFingerprints"]
+    # rule metadata carries the fix hint as SARIF help text
+    by_id = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+    assert by_id["collective-divergence"]["help"]["text"]
+
+
+def test_cli_sarif_validates_under_sarif_check(tmp_path):
+    """The exact pipeline `make lint-sarif` runs: graftlint --format sarif
+    piped into tools/sarif_check.py."""
+    bad, _, _ = FIXTURES["collective-divergence"]
+    (tmp_path / "bad.py").write_text(textwrap.dedent(bad))
+    proc = _run_cli(str(tmp_path), "--format", "sarif")
+    check = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sarif_check.py")],
+        input=proc.stdout,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert check.returncode == 0, check.stdout + check.stderr
+
+
+def test_cli_stale_baseline_fails(tmp_path):
+    """A baseline matches exactly or fails: once the finding is fixed, the
+    leftover entry must flunk the run until the baseline is regenerated."""
+    bad, _, good = FIXTURES["collective-divergence"]
+    f = tmp_path / "code.py"
+    f.write_text(textwrap.dedent(bad))
+    baseline = tmp_path / "baseline.json"
+    assert _run_cli(str(tmp_path), "--write-baseline", str(baseline)).returncode == 0
+    # baselined run is green while the finding exists
+    assert _run_cli(str(tmp_path), "--baseline", str(baseline)).returncode == 0
+    # the fix lands; the stale baseline entries must now fail the run
+    f.write_text(textwrap.dedent(good))
+    proc = _run_cli(str(tmp_path), "--baseline", str(baseline))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "stale" in proc.stdout
+    data = json.loads(
+        _run_cli(str(tmp_path), "--baseline", str(baseline), "--format", "json").stdout
+    )
+    assert data["baseline_stale"]

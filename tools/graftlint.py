@@ -3,6 +3,7 @@
 
     python tools/graftlint.py accelerate_tpu/                # human output
     python tools/graftlint.py accelerate_tpu/ --format json
+    python tools/graftlint.py accelerate_tpu/ --format sarif
     python tools/graftlint.py accelerate_tpu/ --cache-dir .graftlint_cache
     python tools/graftlint.py accelerate_tpu/ --no-cross-module
     python tools/graftlint.py --list-rules
@@ -41,7 +42,7 @@ def main(argv=None):
         prog="graftlint", description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
     )
     parser.add_argument("paths", nargs="*", help="files or directories to analyze")
-    parser.add_argument("--format", choices=("human", "json"), default="human")
+    parser.add_argument("--format", choices=("human", "json", "sarif"), default="human")
     parser.add_argument("--rules", help="comma-separated rule ids to run (default: all)")
     parser.add_argument("--list-rules", action="store_true", help="print rules and exit")
     parser.add_argument("--baseline", help="JSON allowlist; baselined findings don't fail the run")
@@ -138,6 +139,8 @@ def main(argv=None):
 
     if args.format == "json":
         print(json.dumps(result.to_dict(), indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(analysis.sarif_report(result, rules or analysis.get_rules()), indent=2))
     else:
         for f in result.new_findings:
             print(f.render())
@@ -148,11 +151,19 @@ def main(argv=None):
             extra += f", cache {result.cache_hits} hit/{result.cache_misses} miss"
         if not result.cross_module:
             extra += ", cross-module OFF"
+        if result.baseline_stale:
+            # a baseline must match exactly: stale (fixed/moved) entries fail
+            # the run so the baseline shrinks monotonically instead of rotting
+            print(
+                f"graftlint: {len(result.baseline_stale)} stale baseline "
+                "entr(ies) match no current finding — regenerate with "
+                "--write-baseline"
+            )
         print(
             f"graftlint: {len(result.new_findings)} finding(s) in "
             f"{result.files_analyzed} file(s) ({result.duration_s:.2f}s{extra})"
         )
-    return 1 if result.new_findings else 0
+    return 1 if result.new_findings or result.baseline_stale else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""sarif_check — structural validator for graftlint's SARIF output.
+
+    python tools/graftlint.py pkg/ --format sarif | python tools/sarif_check.py
+    python tools/sarif_check.py report.sarif
+    python tools/sarif_check.py --self-test
+
+Checks the shape CI consumers (GitHub code scanning et al.) actually rely
+on: schema/version headers, the tool.driver rule table, and for every
+result a rule id that the driver declares, a level, a message and a
+1-based region.  Pure stdlib — no jsonschema dependency, mirroring the
+linter's own zero-dependency rule.
+
+``--self-test`` is the end-to-end smoke: write a known-bad fixture to a
+temp dir, run graftlint --format sarif on it via a subprocess, require
+exit 1, validate the document, and require at least one result whose
+message carries a fix hint.
+
+Exit codes: 0 valid, 1 structural problem(s), 2 usage error.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SARIF_VERSION = "2.1.0"
+
+
+def validate(doc) -> list:
+    """Return a list of human-readable structural problems (empty = valid)."""
+    errors = []
+    if not isinstance(doc, dict):
+        return ["top level is not a JSON object"]
+    if doc.get("version") != SARIF_VERSION:
+        errors.append(f"version is {doc.get('version')!r}, want {SARIF_VERSION!r}")
+    if not str(doc.get("$schema", "")).startswith("http"):
+        errors.append("$schema missing or not a URL")
+    runs = doc.get("runs")
+    if not isinstance(runs, list) or not runs:
+        return errors + ["runs missing or empty"]
+    for ri, run in enumerate(runs):
+        where = f"runs[{ri}]"
+        driver = (run.get("tool") or {}).get("driver")
+        if not isinstance(driver, dict):
+            errors.append(f"{where}: tool.driver missing")
+            continue
+        if not driver.get("name"):
+            errors.append(f"{where}: tool.driver.name missing")
+        declared = set()
+        for di, rule in enumerate(driver.get("rules") or []):
+            rwhere = f"{where}.tool.driver.rules[{di}]"
+            rid = rule.get("id")
+            if not rid:
+                errors.append(f"{rwhere}: id missing")
+                continue
+            declared.add(rid)
+            if not (rule.get("shortDescription") or {}).get("text"):
+                errors.append(f"{rwhere}: shortDescription.text missing")
+        for si, res in enumerate(run.get("results") or []):
+            swhere = f"{where}.results[{si}]"
+            rid = res.get("ruleId")
+            if not rid:
+                errors.append(f"{swhere}: ruleId missing")
+            elif rid not in declared:
+                errors.append(f"{swhere}: ruleId {rid!r} not declared by the driver")
+            if res.get("level") not in ("error", "warning", "note"):
+                errors.append(f"{swhere}: level {res.get('level')!r} invalid")
+            if not (res.get("message") or {}).get("text"):
+                errors.append(f"{swhere}: message.text missing")
+            locs = res.get("locations") or []
+            if not locs:
+                errors.append(f"{swhere}: locations missing")
+                continue
+            phys = (locs[0] or {}).get("physicalLocation") or {}
+            if not (phys.get("artifactLocation") or {}).get("uri"):
+                errors.append(f"{swhere}: artifactLocation.uri missing")
+            region = phys.get("region") or {}
+            if not isinstance(region.get("startLine"), int) or region["startLine"] < 1:
+                errors.append(f"{swhere}: region.startLine missing or < 1")
+    return errors
+
+
+_SELF_TEST_BAD = """\
+from accelerate_tpu.utils import telemetry
+
+
+def autoscale(fleet):
+    record = telemetry.serving_signal()
+    if record and record.get("queue_depth", 0) > 8:
+        fleet.resize(2)
+"""
+
+
+def self_test() -> int:
+    """End-to-end: graftlint --format sarif on a known-bad fixture must exit
+    1, produce a valid document, and carry a fix hint in the message."""
+    graftlint = os.path.join(_REPO, "tools", "graftlint.py")
+    with tempfile.TemporaryDirectory(prefix="sarif_check_") as tmp:
+        bad = os.path.join(tmp, "bad_resize.py")
+        with open(bad, "w") as fh:
+            fh.write(_SELF_TEST_BAD)
+        proc = subprocess.run(
+            [sys.executable, graftlint, tmp, "--format", "sarif"],
+            capture_output=True,
+            text=True,
+        )
+    if proc.returncode != 1:
+        print(
+            f"sarif_check: self-test expected graftlint exit 1, got "
+            f"{proc.returncode}\n{proc.stderr}",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        doc = json.loads(proc.stdout)
+    except ValueError as e:
+        print(f"sarif_check: self-test output is not JSON: {e}", file=sys.stderr)
+        return 1
+    errors = validate(doc)
+    results = doc["runs"][0].get("results", []) if not errors else []
+    if not errors and not results:
+        errors.append("self-test fixture produced no results")
+    if not errors and not any(
+        "fix:" in r["message"]["text"] for r in results
+    ):
+        errors.append("no result message carries a fix hint")
+    for e in errors:
+        print(f"sarif_check: self-test: {e}", file=sys.stderr)
+    if not errors:
+        print(f"sarif_check: self-test ok ({len(results)} result(s))")
+    return 1 if errors else 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv == ["--self-test"]:
+        return self_test()
+    if len(argv) > 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        if argv:
+            with open(argv[0]) as fh:
+                doc = json.load(fh)
+        else:
+            doc = json.load(sys.stdin)
+    except (OSError, ValueError) as e:
+        print(f"sarif_check: cannot read document: {e}", file=sys.stderr)
+        return 2
+    errors = validate(doc)
+    for e in errors:
+        print(f"sarif_check: {e}", file=sys.stderr)
+    if not errors:
+        n = sum(len(run.get("results", [])) for run in doc["runs"])
+        print(f"sarif_check: ok ({n} result(s))")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
