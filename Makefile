@@ -162,7 +162,7 @@ test_parallel:
 	python -m pytest tests/test_sharding_plan.py tests/test_zero_sharding.py \
 	  tests/test_zero1.py tests/test_compression.py \
 	  tests/test_pipeline.py tests/test_1f1b.py tests/test_parallel_plan.py \
-	  tests/test_ring_attention.py \
+	  tests/test_stagewise.py tests/test_ring_attention.py \
 	  tests/test_flash_attention.py tests/test_sliding_window.py -q
 
 test_cli:

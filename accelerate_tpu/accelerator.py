@@ -574,6 +574,12 @@ class Accelerator:
         result = []
         for obj in args:
             result.append(self._prepare_one(obj))
+        # commit the plan's interleaved layer layout BEFORE the optimizer
+        # relayout below: masters/moments snapshot the params, so ZeRO-1
+        # state is born permuted and updates stay permuted-in-place — the
+        # captured step never sees a permutation (docs/parallel_plan.md
+        # §layout contract)
+        self._commit_layer_layout()
         # re-lay-out optimizer state (Adam moments, fp32 masters) onto the
         # params' mesh shardings: tx.init ran before prepare() sharded the
         # params, so without this the opt state stays on the old layout and
@@ -626,6 +632,147 @@ class Accelerator:
         self._record_collectives()
         self._record_kernels()
         return result[0] if len(result) == 1 else tuple(result)
+
+    # ------------------------------------------------- layer layout contract
+    def _stacked_layer_params(self, model):
+        """``(name, param)`` pairs whose leading axis is the plan's stacked
+        layer axis — identified by the pp-sharded leading dim (the tp_plan
+        rule that makes a stack a stack) or by an existing commit marker."""
+        from .parallel.plan import PP_AXIS
+
+        out = []
+        seen = set()
+        for name, p in model.named_parameters():
+            if id(p) in seen:
+                continue  # tied params appear once
+            seen.add(id(p))
+            if getattr(p, "_layer_layout_committed", False):
+                out.append((name, p))
+                continue
+            data = getattr(p, "data", None)
+            s = getattr(data, "sharding", None)
+            spec = getattr(s, "spec", None)
+            if not spec:
+                continue
+            first = spec[0] if len(spec) else None
+            names = first if isinstance(first, tuple) else (first,)
+            if PP_AXIS in names:
+                out.append((name, p))
+        return out
+
+    def _commit_layer_layout(self) -> None:
+        """Physically reorder every stacked layer param into the plan's
+        ``StagePlan.layer_order`` ONCE — the layout of record under
+        ``layer_layout == "committed"`` (docs/parallel_plan.md §layout
+        contract).  After this the captured 1F1B step consumes the stack in
+        place and moves zero permutation bytes; each param carries a
+        ``_layer_layout_committed`` marker (the runtime source of truth the
+        model's forward keys on, and the idempotency guard a re-prepare or
+        fleet resize relies on)."""
+        stage = getattr(self.plan, "stage", None)
+        if (
+            stage is None
+            or stage.virtual <= 1
+            or self.plan.layer_layout != "committed"
+        ):
+            return
+        from .parallel.pipeline import apply_layer_order
+
+        for model in self._models:
+            for _, p in self._stacked_layer_params(model):
+                if getattr(p, "_layer_layout_committed", False):
+                    continue
+                data = p.data
+                order = stage.layer_order(int(data.shape[0]))
+                p.data = jax.device_put(
+                    apply_layer_order(data, order), data.sharding
+                )
+                p._layer_layout_committed = True
+
+    def _layer_layout_record(self) -> Optional[dict]:
+        """Checkpoint meta descriptor of the live stacked-layer layout —
+        ``None`` when plain (saved checkpoints then match every pre-layout
+        reader bitwise)."""
+        stage = getattr(self.plan, "stage", None)
+        if (
+            stage is None
+            or stage.virtual <= 1
+            or self.plan.layer_layout != "committed"
+        ):
+            return None
+        if not any(
+            getattr(p, "_layer_layout_committed", False)
+            for m in self._models
+            for _, p in self._stacked_layer_params(m)
+        ):
+            return None
+        return {
+            "layer_layout": {
+                "layout": "committed",
+                "num_stages": stage.num_stages,
+                "virtual": stage.virtual,
+            }
+        }
+
+    def _retarget_layer_layout(self, ckpt_rec: Optional[dict]) -> None:
+        """Transpose just-restored stacked arrays from the CHECKPOINT's
+        layer layout into the LIVE one (either direction; no-op when they
+        match — including the pre-layout-checkpoint → plain-run case, which
+        stays bitwise).  Covers model params and, through
+        ``Optimizer.relayout_layer_axis``, the fp32 masters and moments —
+        bitwise after transposition."""
+        stage = getattr(self.plan, "stage", None)
+        live_committed = any(
+            getattr(p, "_layer_layout_committed", False)
+            for m in self._models
+            for _, p in self._stacked_layer_params(m)
+        )
+        ckpt_committed = bool(ckpt_rec) and ckpt_rec.get("layout") == "committed"
+        if not live_committed and not ckpt_committed:
+            return
+        from .parallel.pipeline import apply_layer_order
+        from .parallel.plan import _layer_orders
+
+        def composed(num_layers: int):
+            # committed array C satisfies C[i] = plain[order[i]]; the ckpt→
+            # live transposition is one take by inv_ckpt ∘ order_live
+            ident = tuple(range(num_layers))
+            inv0 = (
+                _layer_orders(
+                    int(ckpt_rec["num_stages"]), int(ckpt_rec["virtual"]),
+                    num_layers,
+                )[1]
+                if ckpt_committed
+                else ident
+            )
+            order1 = (
+                stage.layer_order(num_layers)
+                if live_committed and stage is not None
+                else ident
+            )
+            perm = tuple(inv0[j] for j in order1)
+            return None if perm == ident else perm
+
+        transposed: set[int] = set()
+        for model in self._models:
+            for _, p in self._stacked_layer_params(model):
+                data = p.data
+                perm = composed(int(data.shape[0]))
+                transposed.add(id(p))
+                if perm is None:
+                    continue
+                p.data = jax.device_put(
+                    apply_layer_order(data, perm), data.sharding
+                )
+        for opt in self._optimizers:
+            inner = getattr(opt, "optimizer", opt)
+            indices = [
+                i
+                for i, p in enumerate(getattr(inner, "param_list", []))
+                if id(p) in transposed
+            ]
+            if indices:
+                inner.relayout_layer_axis(indices, composed)
 
     def _refresh_zero2_grads(self) -> None:
         """Collect the (param, accumulation-sharding) pairs ZeRO-2 armed at
@@ -1318,6 +1465,10 @@ class Accelerator:
             safe_serialization=safe_serialization,
             sharded_state=sharded_state,
             snapshot=async_save,
+            # spec-carrying layout descriptor: stacked layer arrays are
+            # written AS-IS (committed order); the record lets a restore
+            # into a different layout transpose them (docs/parallel_plan.md)
+            extra_meta=self._layer_layout_record(),
         )
         if not async_save:
             write_accelerator_save(plan)
@@ -1473,6 +1624,11 @@ class Accelerator:
             custom_objects=self._custom_objects,
             scaler=self.scaler,
         )
+        # cross-layout restore: transpose stacked layer arrays (params +
+        # masters/moments) from the checkpoint's layer layout into the live
+        # one; bitwise no-op when they match (incl. pre-layout checkpoints
+        # into plain runs)
+        self._retarget_layer_layout(override.pop("layer_layout", None))
         if "step" in override:
             self.step = override["step"]
 

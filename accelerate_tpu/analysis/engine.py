@@ -83,7 +83,12 @@ _RULE_TOKEN_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*$")
 # stay uninferred); (c) a bare-name constructor call whose name is locally
 # bound (parameter/assignment) now records NO ctor bind at all, so
 # shadowed names can never mis-resolve through the new import hop.
-ANALYSIS_VERSION = "12"
+# v13: stage-boundary-vs-plan learned the prepare-time layer-layout
+# contract — jnp.take/jnp.argsort driven by a layer-order index (an
+# in-program stacked-layer permutation inside a captured pipeline body)
+# fires in consumer modules with a commit-at-prepare fix hint
+# (docs/parallel_plan.md §layout contract).
+ANALYSIS_VERSION = "13"
 
 # Names that mark a branch/function as profiling/benchmark plumbing, where a
 # deliberate host sync is legitimate.  Shared by blocking-in-hot-loop and the

@@ -12,9 +12,17 @@ ownership boundary: outside the owner modules, code that
 * reads the pp axis off a mesh dict (``*.shape.get("pp", ...)`` or
   ``*.shape["pp"]``),
 * lays out a ``PartitionSpec`` naming the literal ``"pp"`` axis,
-* passes ``axis_name="pp"`` (or defaults a parameter to it), or
+* passes ``axis_name="pp"`` (or defaults a parameter to it),
 * hand-derives a per-stage layer count (``layers // pp``-shaped arithmetic
-  rooted in a pp size)
+  rooted in a pp size), or
+* permutes a stacked layer axis IN-PROGRAM — ``jnp.take``/``jnp.argsort``
+  driven by a layer-order index inside a captured pipeline body.  The
+  interleave permutation is committed ONCE at ``prepare()`` (ISSUE 17,
+  docs/parallel_plan.md §layout contract); a per-step gather pays
+  ``(1−1/V)`` of the stack in permutation bytes every step and silently
+  diverges from the layout of record after a plan flip.  Consumers go
+  through ``apply_layer_order``/``StagePlan.layer_order`` at relayout
+  time (the one blessed restore/transpose path), never inside the step.
 
 fires — the fix is to read ``current_plan()`` / ``plan.stage`` instead.
 Owners: the plan itself, the pipeline schedules, mesh construction, the
@@ -46,6 +54,13 @@ _PP = "pp"
 _SPEC_LEAVES = {"PartitionSpec"}
 # names that mark the pp side of the "layers per stage" arithmetic heuristic
 _PPISH = frozenset({"pp", "pp_size", "num_stages", "n_stages"})
+
+
+def _layer_orderish(name: str) -> bool:
+    """A name that denotes the stacked-layer permutation vector (e.g.
+    ``layer_order``, ``inverse_layer_order``, ``layer_perm``)."""
+    n = name.lower()
+    return "layer" in n and ("order" in n or "perm" in n)
 
 
 def _is_shape_attr(node: ast.AST) -> bool:
@@ -112,6 +127,29 @@ class StageBoundaryVsPlan(Rule):
                         fire(default, "parameter defaulting to the literal 'pp' axis")
             elif isinstance(node, ast.Call):
                 fn = node.func
+                # jnp.take(stack, layer_order)/jnp.argsort(layer_order): an
+                # in-program stacked-layer permutation — the layout is
+                # committed once at prepare() (ISSUE 17); per-step gathers
+                # move (1-1/V) of the stack and drift after a plan flip
+                if isinstance(fn, ast.Attribute) and fn.attr in (
+                    "take", "argsort",
+                ):
+                    involved = [
+                        n
+                        for a in list(node.args)
+                        + [kw.value for kw in node.keywords]
+                        for n in _names_in(a)
+                    ]
+                    if any(_layer_orderish(n) for n in involved):
+                        fire(
+                            node,
+                            f"in-program stacked-layer permutation "
+                            f"({fn.attr} over a layer-order index) — commit "
+                            "the layout at prepare() and consume the stack "
+                            "in place (apply_layer_order at relayout time "
+                            "only)",
+                        )
+                        continue
                 # mesh.shape.get("pp", ...) — axis-size rediscovery
                 if (
                     isinstance(fn, ast.Attribute)

@@ -189,6 +189,7 @@ def prepare_accelerator_save(
     sharded_state: bool = False,
     rng_states: Optional[dict] = None,
     snapshot: bool = False,
+    extra_meta: Optional[dict] = None,
 ) -> SavePlan:
     """Assemble a :class:`SavePlan`: the collective/device half of a save.
 
@@ -305,6 +306,13 @@ def prepare_accelerator_save(
     meta = {"step": step}
     if scaler is not None:
         meta["scaler"] = _copy_if_snapshot(scaler.state_dict())
+    if extra_meta:
+        # spec-carrying descriptors the Accelerator owns — e.g. the
+        # ``layer_layout`` record (docs/parallel_plan.md §layout contract):
+        # arrays are saved AS-IS in the run's committed layer order, and the
+        # descriptor is what lets a restore into a DIFFERENT layout
+        # transpose them (pre-layout checkpoints simply lack the field)
+        meta.update(_copy_if_snapshot(dict(extra_meta)))
 
     # RNG state is per-process (reference checkpointing.py:143-172) and
     # captured at call time so async saves don't leak later draws
@@ -522,6 +530,12 @@ def load_accelerator_state(
         with open(meta_path) as f:
             meta = json.load(f)
         overrides["step"] = meta.get("step", 0)
+        if "layer_layout" in meta:
+            # the saver's stacked-layer-axis layout descriptor; the caller
+            # (Accelerator.load_state) transposes restored arrays when it
+            # differs from the live layout.  Absent on every pre-layout
+            # checkpoint — those are plain and load bitwise into plain runs.
+            overrides["layer_layout"] = meta["layer_layout"]
         if scaler is not None and "scaler" in meta:
             scaler.load_state_dict(meta["scaler"])
 

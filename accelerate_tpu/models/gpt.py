@@ -612,6 +612,15 @@ class PipelinedGPTLMHeadModel(nn.Module):
         stage = plan.stage if plan is not None else None
         schedule = stage.schedule if stage is not None else "gpipe"
         pp_size = plan.pp if plan is not None else 1
+        # Layer layout of record (docs/parallel_plan.md §layout contract):
+        # the prepare-time commit stamps the stacked params, so the RUNTIME
+        # source of truth is the marker, not the plan alone — an unprepared
+        # model (plain stack) under a committed plan still runs correctly
+        # through the in-program-gather fallback.
+        committed = bool(
+            getattr(self.blocks.qkv_w, "_layer_layout_committed", False)
+        )
+        trunk_virtual = stage.virtual if stage is not None else 1
         if labels is not None and schedule in ("1f1b", "interleaved") and pp_size > 1:
             if sp_size > 1:
                 raise NotImplementedError(
@@ -636,6 +645,7 @@ class PipelinedGPTLMHeadModel(nn.Module):
                 f = pipeline_loss_1f1b(
                     stage_fn, loss_fn, lbl, self.num_microbatches, mesh=mesh,
                     virtual=virtual,
+                    layout="committed" if committed else None,
                 )
                 return f(stacked, xv, extra)
 
@@ -649,6 +659,13 @@ class PipelinedGPTLMHeadModel(nn.Module):
 
         def trunk(xv, *flat_params):
             stacked = dict(zip(names, flat_params))
+            if committed:
+                # cold/inference path only: view the committed stack in
+                # plain model order for the sequential gpipe trunk (the
+                # captured 1F1B training step above never runs this)
+                from ..parallel.pipeline import uncommit_layer_layout
+
+                stacked = uncommit_layer_layout(stacked, trunk_virtual, mesh=mesh)
             return gpipe(
                 stage_fn,
                 stacked,

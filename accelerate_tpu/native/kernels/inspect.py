@@ -42,6 +42,7 @@ __all__ = [
     "check_collective_matmul",
     "check_quantize_rs",
     "check_paged_attention",
+    "check_pipeline_layout",
     "run_all",
 ]
 
@@ -202,6 +203,73 @@ def check_paged_attention(*, slots: int = 3, bps: int = 4, n_kv: int = 2,
         "contrast lost its meaning; update the harness"
     )
     assert facts["pallas_call_in_jaxpr"]
+    return facts
+
+
+def check_pipeline_layout(mesh=None, *, num_stages: int = 2, virtual: int = 3,
+                          num_layers: int = 6, dim: int = 8,
+                          microbatches: int = 4) -> dict:
+    """Zero permutation bytes in the committed interleaved 1F1B step
+    (ISSUE 17 acceptance): the committed-layout lowering contains NO
+    gather op and NO ``num_layers``-long index vector anywhere, while the
+    legacy ``gather`` layout's lowering carries both — the in-program
+    ``jnp.take`` of the layer order (and its inverse on the gradients)
+    that the prepare-time commit removed."""
+    from ...parallel.pipeline import apply_layer_order, pipeline_train_1f1b
+    from ...parallel.plan import _layer_orders
+
+    if mesh is None:
+        mesh = jax.make_mesh((num_stages,), ("pp",))
+    S, V, L = num_stages, virtual, num_layers
+    ks = jax.random.split(jax.random.key(0), L)
+    plain = {
+        "w": jnp.stack([jax.random.normal(k, (dim, dim)) * 0.5 for k in ks]),
+        "b": jnp.zeros((L, dim)),
+    }
+    committed = apply_layer_order(plain, _layer_orders(S, V, L)[0])
+    batch = microbatches * 2
+    x = jax.random.normal(jax.random.key(1), (batch, dim))
+    labels = jax.random.normal(jax.random.key(2), (batch, dim))
+    extra = {"head": jnp.eye(dim)}
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def loss_fn(out, lbl, e):
+        err = (out @ e["head"] - lbl) ** 2
+        return err.sum(), jnp.float32(err.size)
+
+    def lowered(layout, params):
+        def f(p, x_, l_, e_):
+            return pipeline_train_1f1b(
+                stage_fn, p, x_, l_, e_, loss_fn, microbatches,
+                mesh=mesh, virtual=V, layout=layout,
+            )
+
+        return stablehlo_text(f, params, x, labels, extra)
+
+    gather_op = re.compile(r"stablehlo\.(?:dynamic_)?gather")
+    idx_vec = f"tensor<{L}xi32>"  # the traced layer-order index vector
+    committed_text = lowered("committed", committed)
+    gather_text = lowered("gather", plain)
+    facts = {
+        "geometry": {"num_stages": S, "virtual": V, "num_layers": L},
+        "committed_gather_ops": len(gather_op.findall(committed_text)),
+        "committed_order_vectors": committed_text.count(idx_vec),
+        "gather_gather_ops": len(gather_op.findall(gather_text)),
+        "gather_order_vectors": gather_text.count(idx_vec),
+    }
+    assert facts["committed_gather_ops"] == 0, (
+        "committed-layout 1F1B lowering still contains a gather — the "
+        "stacked-layer permutation the prepare-time commit exists to remove"
+    )
+    assert facts["committed_order_vectors"] == 0, (
+        "committed-layout lowering carries a layer-order index vector"
+    )
+    assert facts["gather_gather_ops"] > 0 and facts["gather_order_vectors"] > 0, (
+        "gather-layout reference no longer traces the in-program permutation "
+        "— the inspection contrast lost its meaning; update the harness"
+    )
     return facts
 
 

@@ -280,6 +280,33 @@ class Optimizer:
             new_leaves.append(leaf)
         self.opt_state = jax.tree_util.tree_unflatten(treedef, new_leaves)
 
+    def relayout_layer_axis(self, param_indices, perm_fn) -> None:
+        """Permute the leading (stacked-layer) axis of the masters and
+        per-param moments owned by ``param_indices``: ``perm_fn(dim0)``
+        returns the permutation for that leading extent (or ``None`` for
+        identity).  The checkpoint-restore half of the prepare-time layer
+        layout contract (docs/parallel_plan.md): state saved under one
+        layout transposes into the live one — bitwise, sharding preserved.
+        The steady-state update never calls this; it runs once per restore.
+        """
+        from .parallel.pipeline import apply_layer_order
+
+        wanted = set(param_indices)
+
+        def per_param(leaf, i):
+            if i not in wanted or getattr(leaf, "ndim", 0) < 1:
+                return leaf
+            perm = perm_fn(int(leaf.shape[0]))
+            if perm is None:
+                return leaf
+            out = apply_layer_order(leaf, perm)
+            s = getattr(leaf, "sharding", None)
+            if isinstance(s, jax.sharding.NamedSharding):
+                out = jax.device_put(out, s)
+            return out
+
+        self._map_per_param_state(per_param)
+
     def stage_params_on_device(self) -> None:
         """Move host-offloaded PARAMS into device memory (traced h2d inside a
         captured step; eager device_put otherwise).  No-op unless param

@@ -566,6 +566,44 @@ def _interleaved_1f1b_local(
     return loss, dparams, dx, dextra
 
 
+def apply_layer_order(stacked_params, order):
+    """Physically reorder the stacked layer axis of every leaf by ``order``
+    (a host-computed tuple from :meth:`StagePlan.layer_order` or
+    :meth:`StagePlan.inverse_layer_order`).
+
+    This is the ONE place the permutation is spelled as a gather: the
+    one-time commit in ``Accelerator.prepare()`` and the checkpoint-restore
+    transposition both call it, OUTSIDE any captured step — the steady-state
+    program under the ``committed`` layout contains no permutation at all
+    (graftlint's ``stage-boundary-vs-plan`` rule keeps stray ``jnp.take``
+    permutations of the stacked-layer axis out of captured pipeline bodies).
+    """
+    idx = jnp.asarray(order)
+    return jax.tree_util.tree_map(
+        lambda p: jnp.take(p, idx, axis=0), stacked_params
+    )
+
+
+def uncommit_layer_layout(stacked_params, virtual: int,
+                          mesh: Optional[Mesh] = None, axis_name: str = "pp"):
+    """View a COMMITTED (prepare-time permuted) layer stack in plain model
+    order — cold paths only (the inference/primal gpipe trunk, debugging).
+    Identity at ``virtual <= 1``; never traced into the 1F1B step."""
+    if virtual <= 1:
+        return stacked_params
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        if AcceleratorState._shared_state:
+            mesh = AcceleratorState().mesh
+    n_stages = mesh.shape.get(axis_name, 1) if mesh is not None else 1
+    from .plan import _layer_orders
+
+    num_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    _, inverse = _layer_orders(n_stages, virtual, num_layers)
+    return apply_layer_order(stacked_params, inverse)
+
+
 def _resolve_pipeline_layout(
     stacked_params,
     mesh: Optional[Mesh],
@@ -630,6 +668,7 @@ def pipeline_train_1f1b(
     batch_axes: tuple = ("dp", "fsdp"),
     seq_axis: Optional[str] = None,
     virtual: int = 1,
+    layout: Optional[str] = None,
 ):
     """Fused (``virtual=1``) or interleaved (``virtual=V>1``) 1F1B pipeline
     training step over the ``pp`` mesh axis.
@@ -641,22 +680,22 @@ def pipeline_train_1f1b(
     instead of ``num_microbatches`` — wrap with ``jax.custom_vjp`` (models
     do this) so JAX never transposes this function.
 
-    Interleaving is a LAYOUT decision owned by the plan: the stacked layer
-    axis is permuted by :meth:`StagePlan.layer_order` (a host-computed
-    constant index vector, applied as an in-program gather) so the plain
-    contiguous ``P(pp)`` sharding hands each device its V non-contiguous
-    virtual-stage chunks, the schedule hops microbatches V× around the
-    ring, and the returned gradients are un-permuted back to the caller's
-    layer order — callers see the identical contract at every V.
+    Interleaving is a LAYOUT decision owned by the plan
+    (docs/parallel_plan.md §layout contract).  ``layout`` picks who applies
+    :meth:`StagePlan.layer_order`:
 
-    Known cost: because the gather (and its inverse on the gradients) is
-    traced into the step, ~``(1-1/V)`` of the stacked layer params move
-    across pp devices inside every compiled step — invisible on the CPU
-    rehearsal, a real bandwidth tax on hardware.  The planned fix is to
-    commit the permuted layout ONCE at ``prepare()`` (ROADMAP: the
-    optimizer/checkpoint layout contract must then carry the plan's order),
-    at which point this in-program permutation becomes the plan-less
-    fallback.
+    * ``"committed"`` — the caller's stack IS already physically permuted
+      (``Accelerator.prepare()`` committed it once via
+      :func:`apply_layer_order`); the step consumes it in place and returns
+      gradients in the SAME committed order, elementwise-aligned with the
+      params/masters/moments — the steady-state step moves **zero
+      permutation bytes**.
+    * ``"gather"`` (default when unset and ``virtual > 1``) — the legacy
+      plan-less fallback and A/B reference arm: the order (and its inverse
+      on the gradients) is traced into the step as a ``jnp.take``, moving
+      ~``(1−1/V)`` of the stacked layer params + grads across pp devices
+      inside every compiled step, twice
+      (:meth:`StagePlan.permutation_bytes`).
     """
     mesh, n_stages, param_specs, data_spec = _resolve_pipeline_layout(
         stacked_params, mesh, axis_name, batch_axes, seq_axis,
@@ -672,18 +711,7 @@ def pipeline_train_1f1b(
     batch_axes_present = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
 
     if virtual > 1:
-        from .plan import StagePlan
-
-        num_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
-        stage = StagePlan(
-            num_stages=n_stages, virtual=virtual,
-            num_microbatches=num_microbatches, schedule="interleaved",
-        )
-        order = jnp.asarray(stage.layer_order(num_layers))
-        inverse = jnp.asarray(stage.inverse_layer_order(num_layers))
-        permuted = jax.tree_util.tree_map(
-            lambda p: jnp.take(p, order, axis=0), stacked_params
-        )
+        layout = layout or "gather"
         local_fn = functools.partial(
             _interleaved_1f1b_local,
             stage_fn=stage_fn,
@@ -700,10 +728,20 @@ def pipeline_train_1f1b(
             in_specs=(param_specs, x_spec, lbl_spec, extra_specs),
             out_specs=(P(), param_specs, x_spec, extra_specs),
         )
+        if layout == "committed":
+            # the stack was physically permuted ONCE at prepare(): consume
+            # in place, hand gradients back in the same committed order —
+            # no permutation tensor exists anywhere in this program
+            return fn(stacked_params, x, labels, extra_params)
+        # legacy in-program gather (the plan-less fallback / A/B reference):
+        # order the stack on the way in, un-order the grads on the way out
+        from .plan import _layer_orders
+
+        num_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        order, inverse = _layer_orders(n_stages, virtual, num_layers)
+        permuted = apply_layer_order(stacked_params, order)
         loss, dpermuted, dx, dextra = fn(permuted, x, labels, extra_params)
-        dstacked = jax.tree_util.tree_map(
-            lambda g: jnp.take(g, inverse, axis=0), dpermuted
-        )
+        dstacked = apply_layer_order(dpermuted, inverse)
         return loss, dstacked, dx, dextra
 
     fn = shard_map_compat(
@@ -733,6 +771,7 @@ def pipeline_loss_1f1b(
     batch_axes: tuple = ("dp", "fsdp"),
     seq_axis: Optional[str] = None,
     virtual: int = 1,
+    layout: Optional[str] = None,
 ):
     """Scalar-loss wrapper around the fused/interleaved 1F1B schedule.
 
@@ -742,12 +781,18 @@ def pipeline_loss_1f1b(
     merely scales the stored gradients — JAX never transposes the pipeline,
     so the fill-drain activation blowup of differentiating :func:`gpipe`
     never materialises.  The primal-only path (inference/no-grad) runs the
-    cheap plain-forward gpipe instead (the forward's value is independent
-    of the stage interleaving, so no permutation is needed there).
+    cheap plain-forward gpipe instead; under the ``committed`` layout it
+    first views the stack in plain model order
+    (:func:`uncommit_layer_layout` — a COLD path: the captured training
+    step traces ``f_fwd``, which consumes the committed stack in place).
     """
 
     @jax.custom_vjp
     def f(stacked, x, extra):
+        if layout == "committed":
+            stacked = uncommit_layer_layout(
+                stacked, virtual, mesh=mesh, axis_name=axis_name
+            )
         out = gpipe(
             stage_fn, stacked, x, num_microbatches,
             mesh=mesh, axis_name=axis_name, batch_axes=batch_axes, seq_axis=seq_axis,
@@ -759,7 +804,7 @@ def pipeline_loss_1f1b(
         loss, dstacked, dx, dextra = pipeline_train_1f1b(
             stage_fn, stacked, x, labels, extra, loss_fn, num_microbatches,
             mesh=mesh, axis_name=axis_name, batch_axes=batch_axes, seq_axis=seq_axis,
-            virtual=virtual,
+            virtual=virtual, layout=layout,
         )
         return loss, (dstacked, dx, dextra)
 

@@ -36,6 +36,7 @@ values raise at construction — never mid-first-step.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 # the canonical axis names this plan arbitrates; consumers import these
@@ -46,6 +47,40 @@ TP_AXIS = "tp"
 SP_AXIS = "sp"
 EP_AXIS = "ep"
 PP_AXIS = "pp"
+
+# the three resolvable layer layouts (docs/parallel_plan.md §layout contract):
+# "plain"     — stacked layer axis in model order (identity; the ONLY layout
+#               at V=1, and what every pre-layout checkpoint holds)
+# "committed" — stacked layer axis physically permuted into
+#               ``StagePlan.layer_order`` ONCE at ``Accelerator.prepare()``;
+#               the captured step moves zero permutation bytes (default V>1)
+# "gather"    — legacy in-program ``jnp.take`` of the order every step; kept
+#               as the A/B reference arm and the unprepared-model fallback
+LAYER_LAYOUTS = ("plain", "committed", "gather")
+
+
+@functools.lru_cache(maxsize=None)
+def _layer_orders(num_stages: int, virtual: int, num_layers: int) -> tuple:
+    """``(order, inverse)`` permutations of the stacked layer axis for one
+    ``(num_stages, virtual, num_layers)`` geometry, computed once per process
+    (``inverse_layer_order`` sits on the loss-wrapper path — recomputing the
+    full order and inverting it on every call was measurable)."""
+    sv = num_stages * virtual
+    if num_layers % sv:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by "
+            f"num_stages×virtual = {num_stages}×{virtual}"
+        )
+    c = num_layers // sv
+    order = []
+    for d in range(num_stages):
+        for k in range(virtual):
+            v = k * num_stages + d
+            order.extend(range(v * c, (v + 1) * c))
+    inv = [0] * len(order)
+    for i, j in enumerate(order):
+        inv[j] = i
+    return tuple(order), tuple(inv)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,10 +98,32 @@ class StagePlan:
     virtual: int = 1
     num_microbatches: int = 1
     schedule: str = "gpipe"  # "gpipe" | "1f1b" | "interleaved"
+    # resolved layer layout of record (LAYER_LAYOUTS above).  None = resolve
+    # the default: "plain" at V=1 (identity — nothing to commit), "committed"
+    # at V>1 (prepare() permutes once, the step moves zero permutation bytes)
+    layout: Optional[str] = None
 
     def __post_init__(self):
         if self.num_stages < 1 or self.virtual < 1 or self.num_microbatches < 1:
             raise ValueError(f"invalid stage plan {self!r}")
+        if self.layout is None:
+            object.__setattr__(
+                self, "layout", "plain" if self.virtual == 1 else "committed"
+            )
+        if self.layout not in LAYER_LAYOUTS:
+            raise ValueError(
+                f"layer layout {self.layout!r} not in {LAYER_LAYOUTS}"
+            )
+        if self.virtual == 1 and self.layout != "plain":
+            raise ValueError(
+                f"layer_layout={self.layout!r} is meaningless at virtual=1 "
+                "(the interleave order is the identity) — use 'plain'"
+            )
+        if self.virtual > 1 and self.layout == "plain":
+            raise ValueError(
+                "virtual_stages > 1 needs layer_layout 'committed' (default) "
+                "or the legacy 'gather' reference arm, not 'plain'"
+            )
         if self.virtual > 1 and self.schedule != "interleaved":
             raise ValueError(
                 f"virtual_stages={self.virtual} requires schedule="
@@ -108,24 +165,31 @@ class StagePlan:
         """Host-computed permutation of the stacked layer axis so the plain
         contiguous ``P(pp)`` sharding hands device ``d`` exactly its V
         interleaved chunks, grouped: local rows ``[k*c:(k+1)*c]`` = chunk
-        ``k`` = global virtual stage ``k*S + d``.  Identity at V=1.  The
-        schedule applies it as an in-program gather today (see
-        ``pipeline_train_1f1b`` for the per-step cost and the prepare-time
-        follow-up)."""
-        c = self.layers_per_virtual_stage(num_layers)
-        order = []
-        for d in range(self.num_stages):
-            for k in range(self.virtual):
-                v = k * self.num_stages + d
-                order.extend(range(v * c, (v + 1) * c))
-        return tuple(order)
+        ``k`` = global virtual stage ``k*S + d``.  Identity at V=1.  Under
+        the (default) ``committed`` layout ``Accelerator.prepare()`` applies
+        this ONCE, physically, and the captured step never permutes; the
+        legacy ``gather`` layout applies it as an in-program ``jnp.take``
+        every step (the A/B reference arm)."""
+        return _layer_orders(self.num_stages, self.virtual, num_layers)[0]
 
     def inverse_layer_order(self, num_layers: int) -> tuple:
-        order = self.layer_order(num_layers)
-        inv = [0] * len(order)
-        for i, j in enumerate(order):
-            inv[j] = i
-        return tuple(inv)
+        """Inverse of :meth:`layer_order` — cached per geometry with it."""
+        return _layer_orders(self.num_stages, self.virtual, num_layers)[1]
+
+    def permutation_bytes(self, stacked_params) -> int:
+        """Analytic bytes the in-program ``gather`` layout moves per step:
+        the order leaves only the ``1/V`` of rows already resident in place,
+        and the gather runs twice (params forward, grads backward).  Zero
+        under ``committed``/``plain`` — the bench A/B row."""
+        if self.layout != "gather" or self.virtual == 1:
+            return 0
+        import jax
+
+        moved_frac = 1.0 - 1.0 / self.virtual
+        total = sum(
+            leaf.nbytes for leaf in jax.tree_util.tree_leaves(stacked_params)
+        )
+        return int(total * moved_frac) * 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -178,6 +242,13 @@ class ParallelPlan:
         return self.axis_size(PP_AXIS)
 
     @property
+    def layer_layout(self) -> str:
+        """The resolved stacked-layer-axis layout of record ("plain" /
+        "committed" / "gather", LAYER_LAYOUTS) — who owns the interleave
+        permutation.  "plain" outside a pipeline plan."""
+        return self.stage.layout if self.stage is not None else "plain"
+
+    @property
     def non_dp_extent(self) -> int:
         """Devices consumed per dp block — the re-mesh constraint fleet
         grow uses to bound a target dp against the visible device pool."""
@@ -217,6 +288,13 @@ class ParallelPlan:
             out["schedule"] = self.stage.schedule
             out["virtual"] = self.stage.virtual
             out["microbatches"] = self.stage.num_microbatches
+            if self.stage.virtual > 1:
+                # committed vs gather compile DIFFERENT steady-state programs
+                # (no permutation tensors vs two takes) — a layout flip must
+                # be a loud AOT miss naming layer_layout.  Not emitted at
+                # V=1 ("plain" is the only layout there; emitting it would
+                # gratuitously invalidate every stored fused-1F1B entry).
+                out["layer_layout"] = self.stage.layout
         return out
 
     # -- resolution ----------------------------------------------------------
@@ -244,6 +322,7 @@ class ParallelPlan:
                 virtual=virtual,
                 num_microbatches=microbatches,
                 schedule=schedule,
+                layout=getattr(pp_plugin, "layout", None) or None,
             )
 
         sp_plugin = getattr(state, "sp_plugin", None)

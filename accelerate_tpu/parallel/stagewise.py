@@ -1,0 +1,352 @@
+"""Per-stage captured programs for the interleaved 1F1B schedule.
+
+The lockstep SPMD rehearsal (``_interleaved_1f1b_local``) runs every
+(stage, chunk) slot on every device every tick and masks the inactive
+ones — the right shape for a shard_map parity rehearsal on virtual CPU
+devices, but not the execution model the schedule targets.  On MPMD
+hardware each pp stage runs its OWN program, self-clocked: a stage fires
+a chunk's forward the moment its activation arrives and a chunk's
+backward the moment the cotangent does, with no global barrier per tick.
+
+This module is that execution model, split into two halves:
+
+* :class:`StagewisePrograms` — one captured program per
+  ``(stage_id, virtual chunk, role)`` where role is ``fwd`` /
+  ``bwd_mid`` / ``bwd_last``, lowered with ``jit().lower().compile()``
+  and keyed in the AOT store under a digest of
+  ``(plan describe(), stage_id, chunk, role, avals)`` plus the store's
+  pinned topology fingerprint.  A warm process deserializes every stage
+  program off disk — zero trace, zero XLA compile — before its first
+  microbatch moves (the ``loaded`` / ``compiled`` counters are the
+  smoke-test surface).
+* :func:`stagewise_train_1f1b` — a self-clocked host dispatcher driven
+  by :func:`tick_schedule`: the same slot formulas as the lockstep loop
+  (forward of chunk ``k``, microbatch ``m`` on device ``d`` at tick
+  ``t = d + j`` with ``j = (k + (m//S)·V)·S + (m%S)``; the backward
+  mirrored with chunk order reversed, offset ``(S−1−d) + S·V − 1``),
+  but executing ONLY the active slots and handing activations /
+  cotangents through one-tick delivery queues.  A slot that fires
+  before its input arrived raises — the dispatcher doubles as a
+  machine-checked proof that the tick schedule is self-consistent.
+
+The params are consumed in the COMMITTED layout (the layout of record:
+``Accelerator.prepare()`` permuted the stack once, block ``d·V + k`` =
+device ``d``'s chunk ``k`` = global virtual stage ``k·S + d``), and
+gradients come back in the same committed order — like the lockstep
+path, zero permutation bytes anywhere.
+
+Scope: the pp schedule only, one process (the MPMD dispatch rehearsal).
+Stage bodies must be mesh-free — a stage_fn that needs named axes (ring
+attention over ``sp``) stays on the lockstep path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .pipeline import _apply_local_layers, schedule_ticks
+
+
+def tick_schedule(num_microbatches: int, num_stages: int, virtual: int):
+    """Per-tick event lists of the interleaved 1F1B schedule.
+
+    Returns ``events[t] = [("fwd"|"bwd", device, chunk, microbatch), ...]``
+    for ``t`` in ``range(schedule_ticks(M, S, virtual=V))`` — the exact
+    active slots the lockstep loop's masks select, enumerated host-side.
+    Every (chunk, microbatch) pair appears exactly once per direction per
+    device: ``2·M·V`` events per device, ``2·M·V·S`` total.
+    """
+    M, S, V = num_microbatches, num_stages, virtual
+    if M % S:
+        raise ValueError(
+            f"interleaved 1F1B needs num_microbatches ({M}) divisible by "
+            f"the pipeline size ({S})"
+        )
+    T = schedule_ticks(M, S, virtual=V)
+    # two passes so every tick lists its forward slots BEFORE its backward
+    # slots — the lockstep loop's within-tick order, and load-bearing: the
+    # last virtual stage seeds its backward in the SAME tick as its forward
+    # (the window it reads is written by that forward)
+    events = [[] for _ in range(T)]
+    for d in range(S):
+        for j in range(M * V):
+            B, i = divmod(j, S)
+            events[d + j].append(("fwd", d, B % V, (B // V) * S + i))
+    for d in range(S):
+        for j in range(M * V):
+            B, i = divmod(j, S)
+            k_b = (V - 1) - (B % V)
+            events[j + (S - 1 - d) + S * V - 1].append(
+                ("bwd", d, k_b, (B // V) * S + i)
+            )
+    return events
+
+
+class StagewisePrograms:
+    """The per-(stage, chunk, role) captured programs of one geometry.
+
+    ``stage_fn(layer_params, h) -> h`` and ``loss_fn(out, labels, extra)
+    -> (loss_sum, weight)`` follow the pipeline contracts.  Programs are
+    lowered lazily on first dispatch and served from the AOT ``cache``
+    when one is armed (scope ``"stagewise"``; a layout/plan flip moves
+    the ``plan_desc`` inside the variant digest AND the store's pinned
+    fingerprint, so stale entries are loud misses, never wrong
+    dispatches).
+    """
+
+    def __init__(self, stage_fn: Callable, loss_fn: Callable, *,
+                 num_stages: int, virtual: int, cache=None,
+                 plan_desc: Optional[dict] = None):
+        self.stage_fn = stage_fn
+        self.loss_fn = loss_fn
+        self.num_stages = num_stages
+        self.virtual = virtual
+        self.cache = cache
+        self.plan_desc = plan_desc or {}
+        self.compiled = 0  # programs built by lower().compile() here
+        self.loaded = 0  # programs deserialized from the AOT store
+        self._programs: dict = {}
+
+    # -- role bodies ---------------------------------------------------------
+    def _role_fn(self, role: str) -> Callable:
+        stage_fn, loss_fn = self.stage_fn, self.loss_fn
+
+        if role == "fwd":
+            def fwd(p_chunk, h):
+                return _apply_local_layers(stage_fn, p_chunk, h)
+
+            return fwd
+        if role == "bwd_mid":
+            def bwd_mid(p_chunk, saved_in, cot):
+                _, vjp = jax.vjp(
+                    lambda p, i: _apply_local_layers(stage_fn, p, i),
+                    p_chunk, saved_in,
+                )
+                return vjp(cot)
+
+            return bwd_mid
+        if role == "bwd_last":
+            def bwd_last(p_chunk, saved_in, lbl, extra):
+                def f_last(p, inp, ep):
+                    return loss_fn(
+                        _apply_local_layers(stage_fn, p, inp), lbl, ep
+                    )
+
+                lsum, vjp, w = jax.vjp(
+                    f_last, p_chunk, saved_in, extra, has_aux=True
+                )
+                dp, dinp, dep = vjp(jnp.float32(1.0))
+                return lsum, jnp.asarray(w, jnp.float32), dp, dinp, dep
+
+            return bwd_last
+        raise ValueError(f"unknown stagewise role {role!r}")
+
+    # -- AOT keying ----------------------------------------------------------
+    def _variant_digest(self, stage_id: int, chunk: int, role: str,
+                        args) -> str:
+        from ..native.aot_cache import _digest, _leaf_aval
+
+        return _digest({
+            "plan": self.plan_desc,
+            "stage": stage_id,
+            "chunk": chunk,
+            "role": role,
+            "avals": [_leaf_aval(x) for x in jax.tree_util.tree_leaves(args)],
+        })
+
+    def program(self, stage_id: int, chunk: int, role: str, args):
+        """The compiled program for one ``(stage, chunk, role)`` slot —
+        memory, then AOT store, then a fresh ``lower().compile()`` (stored
+        back when a cache is armed).  ``args`` are example/abstract inputs
+        of the role's signature."""
+        key = (stage_id, chunk, role)
+        compiled = self._programs.get(key)
+        if compiled is not None:
+            return compiled
+        key_desc = f"stagewise:s{stage_id}c{chunk}:{role}"
+        cache = self.cache if (self.cache is not None
+                               and self.cache.enabled) else None
+        variant = self._variant_digest(stage_id, chunk, role, args)
+        if cache is not None:
+            entry = cache.lookup(variant, cache.fingerprint(), "stagewise",
+                                 key_desc, defer_hit=True)
+            if entry is not None:
+                try:
+                    from jax.experimental import serialize_executable
+
+                    compiled = serialize_executable.deserialize_and_load(
+                        entry["payload"], entry["in_tree"], entry["out_tree"]
+                    )
+                except Exception as exc:
+                    cache.record_miss(
+                        "stagewise", key_desc,
+                        f"deserialize failed "
+                        f"({type(exc).__name__}: {exc})"[:200],
+                    )
+                else:
+                    cache.commit_hit(entry, "stagewise", key_desc)
+                    self.loaded += 1
+                    self._programs[key] = compiled
+                    return compiled
+        t0 = time.perf_counter()
+        lowered = jax.jit(self._role_fn(role)).lower(*args)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        self.compiled += 1
+        if cache is not None:
+            cache.store(
+                variant, cache.fingerprint(), compiled, {"sig": key_desc},
+                "stagewise", key_desc,
+                trace_ms=(t1 - t0) * 1e3, compile_ms=(t2 - t1) * 1e3,
+            )
+        self._programs[key] = compiled
+        return compiled
+
+
+def stagewise_train_1f1b(
+    stage_fn: Callable,
+    committed_params,
+    x: jax.Array,
+    labels: jax.Array,
+    extra_params,
+    loss_fn: Callable,
+    num_microbatches: int,
+    *,
+    num_stages: int,
+    virtual: int,
+    programs: Optional[StagewisePrograms] = None,
+    cache=None,
+    plan_desc: Optional[dict] = None,
+):
+    """Self-clocked per-stage dispatch of one interleaved 1F1B step.
+
+    ``committed_params``: the stacked layer tree ALREADY in the committed
+    layout (block ``d·V + k`` of the leading axis = device ``d``'s chunk
+    ``k``).  Returns ``(loss, dcommitted_params, dx, dextra_params)`` with
+    gradients in the same committed order and identical normalisation to
+    the lockstep path (global token mean) — the parity contract the tests
+    pin.  Pass a :class:`StagewisePrograms` to reuse programs across
+    steps; otherwise one is built (and returned state discarded).
+    """
+    M, S, V = num_microbatches, num_stages, virtual
+    if programs is None:
+        programs = StagewisePrograms(
+            stage_fn, loss_fn, num_stages=S, virtual=V,
+            cache=cache, plan_desc=plan_desc,
+        )
+    leaves = jax.tree_util.tree_leaves(committed_params)
+    L = leaves[0].shape[0]
+    if L % (S * V):
+        raise ValueError(
+            f"num_layers {L} not divisible by num_stages×virtual = {S}×{V}"
+        )
+    if x.shape[0] % M:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by num_microbatches {M}"
+        )
+    c = L // (S * V)
+    mb = x.shape[0] // M
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+    labels_mb = labels.reshape(M, mb, *labels.shape[1:])
+
+    def chunk_params(d, k):
+        b = d * V + k
+        return jax.tree_util.tree_map(
+            lambda p: jax.lax.slice_in_dim(p, b * c, (b + 1) * c, axis=0),
+            committed_params,
+        )
+
+    p_chunks = {(d, k): chunk_params(d, k)
+                for d in range(S) for k in range(V)}
+
+    acts: dict = {}  # (consumer virtual stage, microbatch) -> activation
+    cots: dict = {}  # (consumer virtual stage, microbatch) -> cotangent
+    windows: dict = {}  # (device, chunk, microbatch) -> saved stage input
+    dchunks = {b: None for b in range(S * V)}  # committed-block grad accum
+    dextra = jax.tree_util.tree_map(jnp.zeros_like, extra_params)
+    dx_mb = [None] * M
+    loss_sum = jnp.zeros((), jnp.float32)
+    weight_sum = jnp.zeros((), jnp.float32)
+
+    def add(acc, g):
+        return g if acc is None else jax.tree_util.tree_map(
+            lambda a, b: a + b, acc, g
+        )
+
+    for tick_events in tick_schedule(M, S, V):
+        arriving_acts: dict = {}
+        arriving_cots: dict = {}
+        for role, d, k, m in tick_events:
+            v = k * S + d  # global virtual stage of this slot
+            if role == "fwd":
+                # v=0 reads its microbatch; everyone else consumes the
+                # activation delivered by v−1 — pop() raising KeyError IS
+                # the self-clocking check (input must exist by this tick)
+                my_in = x_mb[m] if v == 0 else acts.pop((v, m))
+                windows[(d, k, m)] = my_in
+                out = programs.program(d, k, "fwd", (p_chunks[(d, k)], my_in))(
+                    p_chunks[(d, k)], my_in
+                )
+                if v < S * V - 1:
+                    arriving_acts[(v + 1, m)] = out
+                # the last virtual stage's forward output is dropped: its
+                # backward recomputes through the loss head (stage-granular
+                # activation checkpointing, exactly the lockstep policy)
+            else:
+                saved_in = windows.pop((d, k, m))
+                if v == S * V - 1:
+                    args = (p_chunks[(d, k)], saved_in, labels_mb[m],
+                            extra_params)
+                    lsum, w, dp, dinp, dep = programs.program(
+                        d, k, "bwd_last", args
+                    )(*args)
+                    loss_sum = loss_sum + lsum
+                    weight_sum = weight_sum + w
+                    dextra = jax.tree_util.tree_map(
+                        lambda a, g: a + g, dextra, dep
+                    )
+                else:
+                    cot = cots.pop((v, m))
+                    args = (p_chunks[(d, k)], saved_in, cot)
+                    dp, dinp = programs.program(d, k, "bwd_mid", args)(*args)
+                dchunks[d * V + k] = add(dchunks[d * V + k], dp)
+                if v == 0:
+                    dx_mb[m] = dinp
+                else:
+                    arriving_cots[(v - 1, m)] = dinp
+        # one-tick delivery: what this tick produced becomes visible next
+        # tick (the host image of the lockstep loop's ppermute hand-off)
+        acts.update(arriving_acts)
+        cots.update(arriving_cots)
+
+    if acts or cots or windows:
+        raise AssertionError(
+            f"self-clocked schedule left undelivered state: "
+            f"{len(acts)} acts, {len(cots)} cots, {len(windows)} windows"
+        )
+
+    total_w = jnp.maximum(weight_sum, 1e-9)
+    loss = loss_sum / total_w
+    inv_w = 1.0 / total_w
+    dcommitted = jax.tree_util.tree_map(
+        lambda *gs: jnp.concatenate(gs, axis=0)
+        * inv_w.astype(gs[0].dtype),
+        *[dchunks[b] for b in range(S * V)],
+    )
+    dextra = jax.tree_util.tree_map(
+        lambda g: g * inv_w.astype(g.dtype), dextra
+    )
+    dx = (jnp.stack(dx_mb) * inv_w).astype(x.dtype).reshape(x.shape)
+    return loss, dcommitted, dx, dextra
+
+
+__all__ = [
+    "StagewisePrograms",
+    "stagewise_train_1f1b",
+    "tick_schedule",
+]

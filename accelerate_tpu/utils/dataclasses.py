@@ -761,10 +761,25 @@ class PipelineParallelPlugin:
     # schedule="gpipe" / virtual_stages=1 beats the env var.
     schedule: Optional[str] = None  # "gpipe" | "1f1b" | "interleaved"
     virtual_stages: int = 0  # interleave factor V; 0 = unset
+    # stacked-layer-axis layout of record (docs/parallel_plan.md §layout
+    # contract).  None = unset: resolves to $PP_LAYOUT, then the plan's
+    # default ("plain" at V=1, "committed" at V>1 — prepare() permutes the
+    # layer stack once and the step moves zero permutation bytes).
+    # "gather" keeps the legacy per-step in-program permutation (A/B arm).
+    layout: Optional[str] = None  # "committed" | "gather"
 
     def __post_init__(self):
         if self.pp_size == 1 and "PP_SIZE" in os.environ:
             self.pp_size = int(os.environ["PP_SIZE"])
+        explicit_layout = self.layout is not None
+        if self.layout is None:
+            self.layout = os.environ.get("PP_LAYOUT", None) or None
+        if self.layout is not None and self.layout not in ("committed", "gather"):
+            raise ValueError(
+                f"unknown pipeline layer layout {self.layout!r}; use "
+                "'committed' (prepare-time permute, default) or 'gather' "
+                "(legacy per-step in-program permutation)"
+            )
         explicit_schedule = self.schedule is not None
         explicit_virtual = self.virtual_stages != 0
         env_schedule = None
@@ -822,6 +837,17 @@ class PipelineParallelPlugin:
                 "schedule='interleaved' needs virtual_stages >= 2 "
                 "(virtual_stages=1 is exactly the fused '1f1b' schedule)"
             )
+        if self.virtual_stages == 1 and self.layout is not None:
+            if explicit_layout:
+                raise ValueError(
+                    f"layout={self.layout!r} needs virtual_stages >= 2: at "
+                    "V=1 the interleave order is the identity and the only "
+                    "layer layout is 'plain'"
+                )
+            # kwargs beat env: an ambient PP_LAYOUT cannot apply to a run
+            # whose (explicit or resolved) factor is V=1 — yield to unset
+            # instead of raising on an unrelated fused/gpipe run
+            self.layout = None
 
 
 @dataclass

@@ -1060,7 +1060,7 @@ def _pipeline_block(on_accel: bool) -> dict:
     )
     batch, seq, steps = (BATCH * n_dev, SEQ, 20) if on_accel else (8 * n_dev, 64, 3)
 
-    def train_ms(schedule: str, virtual: int):
+    def train_ms(schedule: str, virtual: int, layout: str = None):
         Accelerator._reset_state()
         nn.manual_seed(0)
         acc = Accelerator(
@@ -1068,13 +1068,23 @@ def _pipeline_block(on_accel: bool) -> dict:
             parallelism_config=ParallelismConfig(pp_size=S),
             pp_plugin=PipelineParallelPlugin(
                 pp_size=S, num_microbatches=M, schedule=schedule,
-                virtual_stages=virtual,
+                virtual_stages=virtual, layout=layout,
             ),
             kwargs_handlers=[TelemetryKwargs(enabled=True)],
         )
         model = PipelinedGPTLMHeadModel(cfg, num_microbatches=M)
         opt = optim.AdamW(model.parameters(), lr=3e-4)
         model, opt = acc.prepare(model, opt)
+        # analytic permutation traffic of THIS run's resolved layout
+        # (StagePlan.permutation_bytes: gather moves ~(1−1/V)·stack twice
+        # per step, committed/plain move zero — the layout A/B row)
+        from accelerate_tpu.models.gpt import _StackedBlocks
+
+        stacked = {n: getattr(model.blocks, n).data for n in _StackedBlocks._ORDER}
+        perm_bytes = (
+            acc.plan.stage.permutation_bytes(stacked)
+            if acc.plan.stage is not None else 0
+        )
 
         def step_fn(ids):
             opt.zero_grad()
@@ -1095,11 +1105,11 @@ def _pipeline_block(on_accel: bool) -> dict:
         _, dt, final_loss, recompile, _ = _timed_steps(
             step, batches, steps, WARMUP if on_accel else 1
         )
-        return dt / steps * 1e3, final_loss, recompile["count"]
+        return dt / steps * 1e3, final_loss, recompile["count"], perm_bytes
 
     try:
-        fused_ms, fused_loss, fused_rec = train_ms("1f1b", 1)
-        inter_ms, inter_loss, inter_rec = train_ms("interleaved", V)
+        fused_ms, fused_loss, fused_rec, _ = train_ms("1f1b", 1)
+        inter_ms, inter_loss, inter_rec, inter_pb = train_ms("interleaved", V)
         out["pipeline_fused_step_ms"] = round(fused_ms, 2)
         out["pipeline_interleaved_step_ms"] = round(inter_ms, 2)
         out["pipeline_interleave_speedup"] = round(fused_ms / max(inter_ms, 1e-9), 3)
@@ -1111,6 +1121,21 @@ def _pipeline_block(on_accel: bool) -> dict:
         out["pipeline_bubble_fraction_interleaved"] = bubble_fraction(M, S, V)
         out["pipeline_geometry"] = {"pp": S, "virtual": V, "microbatches": M,
                                     "dp": n_dev // S}
+        # layout A/B (ISSUE 17): committed (prepare-time permutation, the
+        # default above) vs the legacy in-program gather — same math
+        # (expected bitwise), different steady-state program
+        gat_ms, gat_loss, gat_rec, gat_pb = train_ms(
+            "interleaved", V, layout="gather"
+        )
+        out["pipeline_layout_step_ms"] = {
+            "committed": round(inter_ms, 2), "gather": round(gat_ms, 2),
+        }
+        out["pipeline_layout_speedup"] = round(gat_ms / max(inter_ms, 1e-9), 3)
+        out["pipeline_permutation_bytes"] = {
+            "committed": inter_pb, "gather": gat_pb,
+        }
+        out["pipeline_layout_loss_delta"] = round(abs(inter_loss - gat_loss), 9)
+        out["pipeline_recompiles"] += gat_rec
     except Exception as exc:  # noqa: BLE001 — fail-soft per block contract
         out["pipeline_error"] = f"{type(exc).__name__}: {exc}"[:300]
     return out

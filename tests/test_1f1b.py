@@ -56,15 +56,30 @@ def test_interleaved_profile_m8_s2_v2():
     assert residual_window(2, virtual=1) == residual_window(2)
 
 
+def _plain_params(acc, model):
+    """Param dict in PLAIN layer order: prepare() commits the interleave
+    permutation physically at V>1 (docs/parallel_plan.md §layout contract),
+    so cross-schedule comparisons view committed stacks through the plan's
+    inverse order.  No-op for uncommitted (plain) runs."""
+    stage = acc.plan.stage
+    out = {}
+    for n, p in model.named_parameters():
+        a = np.asarray(p.data)
+        if getattr(p, "_layer_layout_committed", False) and stage is not None:
+            a = a[np.asarray(stage.inverse_layer_order(a.shape[0]))]
+        out[n] = a
+    return out
+
+
 def _train(schedule: str, steps: int = 3, microbatches: int = 8,
-           n_layer: int = 2, virtual: int = 0):
+           n_layer: int = 2, virtual: int = 0, layout: str = None):
     Accelerator._reset_state()
     nn.manual_seed(0)
     acc = Accelerator(
         parallelism_config=ParallelismConfig(pp_size=2),
         pp_plugin=PipelineParallelPlugin(
             pp_size=2, num_microbatches=microbatches, schedule=schedule,
-            virtual_stages=virtual,
+            virtual_stages=virtual, layout=layout,
         ),
         mixed_precision="no",
     )
@@ -92,8 +107,7 @@ def _train(schedule: str, steps: int = 3, microbatches: int = 8,
         mesh=acc.mesh,
     )
     losses = [float(step(ids)) for _ in range(steps)]
-    params = {n: np.asarray(p.data) for n, p in model.named_parameters()}
-    return losses, params
+    return losses, _plain_params(acc, model)
 
 
 def test_loss_and_grad_parity_with_gpipe():
@@ -216,6 +230,243 @@ def test_interleaved_matches_fused_1f1b():
         np.testing.assert_allclose(
             p_i[name], p_f[name], rtol=3e-4, atol=3e-5, err_msg=name
         )
+
+
+def test_committed_layout_matches_gather_reference():
+    """ISSUE 17 acceptance: the prepare-time committed layout (zero
+    permutation bytes per step) trains bitwise-identically to the legacy
+    in-program gather layout — the permutation moved, the math didn't."""
+    l_c, p_c = _train("interleaved", n_layer=4, virtual=2)
+    l_g, p_g = _train("interleaved", n_layer=4, virtual=2, layout="gather")
+    np.testing.assert_array_equal(np.asarray(l_c), np.asarray(l_g))
+    for name in p_g:
+        np.testing.assert_array_equal(p_c[name], p_g[name], err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# cross-layout checkpoints + fleet resize (ISSUE 17 layout contract)
+# ---------------------------------------------------------------------------
+def _ckpt_run(layout, mp="no", schedule="interleaved", virtual=2):
+    """An interleaved pp=2, V=2 AdamW run for checkpoint-matrix tests
+    (``schedule="1f1b", virtual=0`` gives the plain-layout V=1 twin)."""
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(pp_size=2),
+        pp_plugin=PipelineParallelPlugin(
+            pp_size=2, num_microbatches=8, schedule=schedule,
+            virtual_stages=virtual, layout=layout,
+        ),
+        mixed_precision=mp,
+    )
+    import dataclasses as _dc
+
+    cfg = _dc.replace(GPTConfig.tiny(), n_layer=4)
+    model = PipelinedGPTLMHeadModel(cfg, num_microbatches=8)
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    ids = batch_to_global_array(
+        jnp.asarray(
+            np.random.default_rng(0).integers(0, 1024, (32, 32)), jnp.int32
+        ),
+        mesh=acc.mesh,
+    )
+    return acc, model, opt, step, ids
+
+
+def _plain_opt_state(acc, model, opt):
+    """Moments (+ masters when present) for STACKED params, viewed in plain
+    layer order — the cross-layout bitwise-comparison unit.  Leaf→param
+    ownership follows ``Optimizer._map_per_param_state``'s SequenceKey +
+    exact-shape rule."""
+    import jax
+
+    stage = acc.plan.stage
+    inner = getattr(opt, "optimizer", opt)
+    stacked_ids = {id(p) for _, p in acc._stacked_layer_params(model)}
+    committed = {
+        id(p)
+        for _, p in acc._stacked_layer_params(model)
+        if getattr(p, "_layer_layout_committed", False)
+    }
+    shapes = [tuple(p.shape) for p in inner.param_list]
+
+    def view(leaf, p):
+        a = np.asarray(leaf)
+        if id(p) in committed and a.ndim:
+            a = a[np.asarray(stage.inverse_layer_order(a.shape[0]))]
+        return a
+
+    out = {}
+    for i, p in enumerate(inner.param_list):
+        if id(p) in stacked_ids and inner.master_params[i] is not None:
+            out[f"master.{i}"] = view(inner.master_params[i], p)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(inner.opt_state)[0]:
+        idx = next(
+            (k.idx for k in reversed(path)
+             if isinstance(k, jax.tree_util.SequenceKey)),
+            None,
+        )
+        if (
+            idx is not None
+            and idx < len(shapes)
+            and hasattr(leaf, "shape")
+            and tuple(leaf.shape) == shapes[idx]
+            and id(inner.param_list[idx]) in stacked_ids
+        ):
+            out[f"state.{idx}.{jax.tree_util.keystr(path)}"] = view(
+                leaf, inner.param_list[idx]
+            )
+    return out
+
+
+@pytest.mark.parametrize(
+    "save_kw,load_kw",
+    [
+        ({"layout": None}, {"layout": "gather"}),
+        ({"layout": "gather"}, {"layout": None}),
+        pytest.param(
+            {"layout": None},
+            {"layout": None, "schedule": "1f1b", "virtual": 0},
+            marks=pytest.mark.slow,
+        ),
+        pytest.param(
+            {"layout": None, "schedule": "1f1b", "virtual": 0},
+            {"layout": None},
+            marks=pytest.mark.slow,
+        ),
+    ],
+    ids=[
+        "committed_to_gather",
+        "gather_to_committed",
+        "committed_to_plain_v1",
+        "plain_v1_to_committed",
+    ],
+)
+def test_checkpoint_cross_layout_matrix(tmp_path, save_kw, load_kw):
+    """Checkpoints written under one stacked-layer layout restore into a
+    run living under ANOTHER — the restore transposition covers params,
+    fp32 masters, and moments bitwise, and the resumed trajectory tracks
+    the uninterrupted one.  Gather- and V=1-layout checkpoints carry no
+    ``layer_layout`` meta (byte-identical to pre-layout-era ones), so the
+    *→committed legs double as the backward-compat proof."""
+    acc, model, opt, step, ids = _ckpt_run(**save_kw)
+    for _ in range(2):
+        float(step(ids))
+    out = str(tmp_path / "ckpt")
+    acc.save_state(out)
+    saved_params = _plain_params(acc, model)
+    saved_opt = _plain_opt_state(acc, model, opt)
+    cont = [float(step(ids)) for _ in range(2)]
+
+    acc2, model2, opt2, step2, ids2 = _ckpt_run(**load_kw)
+    acc2.load_state(out)
+    # params + optimizer state bitwise in the plain view after transposition
+    got_params = _plain_params(acc2, model2)
+    for name in saved_params:
+        np.testing.assert_array_equal(
+            got_params[name], saved_params[name], err_msg=name
+        )
+    got_opt = _plain_opt_state(acc2, model2, opt2)
+    assert set(got_opt) == set(saved_opt)
+    for name in saved_opt:
+        np.testing.assert_array_equal(got_opt[name], saved_opt[name], err_msg=name)
+    resumed = [float(step2(ids2)) for _ in range(2)]
+    np.testing.assert_allclose(resumed, cont, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_checkpoint_masters_transpose_bitwise(tmp_path):
+    """bf16 params give the optimizer real fp32 masters; a committed-layout
+    save restored into a gather-layout run must hand back the SAME master
+    bytes in the plain view."""
+    acc, model, opt, step, ids = _ckpt_run(None, mp="bf16")
+    float(step(ids))
+    out = str(tmp_path / "ckpt")
+    acc.save_state(out)
+    saved = _plain_opt_state(acc, model, opt)
+    masters = [k for k in saved if k.startswith("master.")]
+    assert masters, "bf16 run grew no fp32 masters for stacked params"
+
+    acc2, model2, opt2, step2, ids2 = _ckpt_run("gather", mp="bf16")
+    acc2.load_state(out)
+    got = _plain_opt_state(acc2, model2, opt2)
+    for name in masters:
+        np.testing.assert_array_equal(got[name], saved[name], err_msg=name)
+
+
+@pytest.mark.slow
+def test_fleet_resize_preserves_committed_layout(tmp_path):
+    """A dp resize (drain → re-mesh → reshard restore) must keep the
+    prepare-time layout of record: the survivors' stacked params stay
+    COMMITTED (markers intact, plan still says so), their plain view is
+    bitwise the pre-resize one, and training continues."""
+    from accelerate_tpu import FleetKwargs
+
+    Accelerator._reset_state()
+    nn.manual_seed(0)
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(pp_size=2),
+        pp_plugin=PipelineParallelPlugin(
+            pp_size=2, num_microbatches=8, schedule="interleaved",
+            virtual_stages=2,
+        ),
+        mixed_precision="no",
+        kwargs_handlers=[FleetKwargs(enabled=True)],
+    )
+    import dataclasses as _dc
+
+    cfg = _dc.replace(GPTConfig.tiny(), n_layer=4)
+    model = PipelinedGPTLMHeadModel(cfg, num_microbatches=8)
+    opt = optim.SGD(model.parameters(), lr=0.1)
+    model, opt = acc.prepare(model, opt)
+    dp = acc.plan.dp
+    if dp < 2:
+        pytest.skip("needs dp >= 2 beside pp=2")
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    ids = batch_to_global_array(
+        jnp.asarray(
+            np.random.default_rng(0).integers(0, 1024, (32, 32)), jnp.int32
+        ),
+        mesh=acc.mesh,
+    )
+    float(step(ids))
+    before = _plain_params(acc, model)
+
+    acc.fleet.resize(acc, target_dp=dp // 2, output_dir=str(tmp_path / "drain"))
+    assert acc.plan.pp == 2 and acc.plan.dp == dp // 2
+    assert acc.plan.layer_layout == "committed"
+    stacked = acc._stacked_layer_params(model)
+    assert stacked and all(
+        getattr(p, "_layer_layout_committed", False) for _, p in stacked
+    )
+    after = _plain_params(acc, model)
+    for name in before:
+        np.testing.assert_array_equal(after[name], before[name], err_msg=name)
+    ids2 = batch_to_global_array(
+        jnp.asarray(
+            np.random.default_rng(0).integers(0, 1024, (32, 32)), jnp.int32
+        ),
+        mesh=acc.mesh,
+    )
+    assert np.isfinite(float(step(ids2)))
 
 
 def test_interleaved_rejects_indivisible_shapes():

@@ -281,6 +281,7 @@ FIXTURES = {
     ),
     "stage-boundary-vs-plan": (
         """
+        import jax.numpy as jnp
         from jax.sharding import PartitionSpec
 
         def stage_spans(mesh, num_layers):
@@ -293,13 +294,25 @@ FIXTURES = {
 
         def ring_hop(x, axis_name="pp"):      # pp-defaulted parameter
             return x
+
+        def step(params, layer_order):
+            # in-program stacked-layer permutation: gathers (1-1/V) of the
+            # stack EVERY step instead of committing the layout at prepare()
+            stacked = jnp.take(params["w"], layer_order, axis=0)
+            inverse = jnp.argsort(layer_order)
+            return stacked, inverse
         """,
-        4,
+        6,
         """
         def stage_spans(plan, num_layers):
             # the resolved ParallelPlan owns stage boundaries and the pp
             # axis (docs/parallel_plan.md)
             return plan.stage.layer_spans(num_layers), plan.pp
+
+        def step(params):
+            # layout committed once at prepare() (§layout contract):
+            # the captured body consumes the stack in place
+            return params["w"]
         """,
     ),
     # the PR-13 serving-signal deadlock shape: a rank-local telemetry record

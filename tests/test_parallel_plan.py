@@ -182,6 +182,64 @@ def test_default_off_capture_pytree_byte_identity():
 
 
 # ---------------------------------------------------------------------------
+# layer layout: committed at prepare-time is the layout of record (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def test_layer_layout_defaults_and_validation():
+    # V=1 is always plain; V>1 defaults to committed; gather is opt-in
+    fused = StagePlan(num_stages=2, virtual=1, num_microbatches=8,
+                      schedule="1f1b")
+    assert fused.layout == "plain"
+    inter = StagePlan(num_stages=2, virtual=2, num_microbatches=8,
+                      schedule="interleaved")
+    assert inter.layout == "committed"
+    ref = StagePlan(num_stages=2, virtual=2, num_microbatches=8,
+                    schedule="interleaved", layout="gather")
+    assert ref.layout == "gather"
+    with pytest.raises(ValueError, match="layout"):
+        StagePlan(num_stages=2, virtual=1, num_microbatches=8,
+                  schedule="1f1b", layout="committed")
+    with pytest.raises(ValueError, match="layout"):
+        StagePlan(num_stages=2, virtual=2, num_microbatches=8,
+                  schedule="interleaved", layout="plain")
+    with pytest.raises(ValueError, match="layout"):
+        StagePlan(num_stages=2, virtual=2, num_microbatches=8,
+                  schedule="interleaved", layout="zigzag")
+
+
+def test_layer_order_inverse_composition_and_cache_identity():
+    """Satellite: order∘inverse == identity for every geometry in the test
+    envelope, and the per-(S,V,L) derivation is computed once — repeated
+    calls return the SAME cached tuples, not fresh allocations."""
+    for s, v, L in [(2, 2, 4), (2, 2, 8), (2, 3, 12), (4, 2, 16), (2, 4, 8)]:
+        sp = StagePlan(num_stages=s, virtual=v, num_microbatches=s * v,
+                       schedule="interleaved")
+        order, inverse = sp.layer_order(L), sp.inverse_layer_order(L)
+        assert sorted(order) == list(range(L))
+        assert tuple(order[i] for i in inverse) == tuple(range(L))
+        assert tuple(inverse[i] for i in order) == tuple(range(L))
+        # lru_cache identity: no per-call recomputation
+        assert sp.layer_order(L) is order
+        assert sp.inverse_layer_order(L) is inverse
+
+
+def test_permutation_bytes_analytic():
+    """The bench analytic: the gather layout moves the full stacked-param
+    footprint minus the resident 1/V twice per step (fwd take + bwd inverse
+    take); committed and plain move ZERO bytes."""
+    params = {"w": jnp.zeros((4, 8, 8), jnp.float32)}  # 1024 bytes
+    gather = StagePlan(num_stages=2, virtual=2, num_microbatches=8,
+                       schedule="interleaved", layout="gather")
+    committed = StagePlan(num_stages=2, virtual=2, num_microbatches=8,
+                          schedule="interleaved")
+    fused = StagePlan(num_stages=2, virtual=1, num_microbatches=8,
+                      schedule="1f1b")
+    assert gather.permutation_bytes(params) == 1024  # 1024·(1−1/2)·2
+    assert committed.permutation_bytes(params) == 0
+    assert fused.permutation_bytes(params) == 0
+
+
+# ---------------------------------------------------------------------------
 # AOT coupling: a plan flip is a loud miss naming the `plan` field
 # ---------------------------------------------------------------------------
 
@@ -195,6 +253,34 @@ def test_fingerprint_mismatch_names_plan_field():
     live = topology_fingerprint(plan={"schedule": "interleaved", "virtual": 2})
     cause = fingerprint_mismatch(stored, live)
     assert "plan" in cause and "interleaved" in cause
+
+
+def test_layer_layout_flip_is_loud_fingerprint_field():
+    """ISSUE 17: describe() carries the resolved layer_layout at V>1 (never
+    at V=1 — stored fused entries stay valid), so a committed↔gather flip
+    is a loud AOT miss NAMING the moved field and both values."""
+    from accelerate_tpu.native.aot_cache import (
+        fingerprint_mismatch,
+        topology_fingerprint,
+    )
+
+    def plan_desc(layout, virtual=2, schedule="interleaved"):
+        stage = StagePlan(num_stages=2, virtual=virtual, num_microbatches=8,
+                          schedule=schedule, layout=layout)
+        return ParallelPlan(
+            axes=(("pp", 2), ("dp", 1)), data_axes=("dp",), stage=stage
+        ).describe()
+
+    committed, gather = plan_desc(None), plan_desc("gather")
+    assert committed["layer_layout"] == "committed"
+    assert gather["layer_layout"] == "gather"
+    cause = fingerprint_mismatch(
+        topology_fingerprint(plan=committed), topology_fingerprint(plan=gather)
+    )
+    assert "layer_layout" in cause
+    assert "committed" in cause and "gather" in cause
+    # V=1 emits NO layout field: the fused program's identity is unchanged
+    assert "layer_layout" not in plan_desc(None, virtual=1, schedule="1f1b")
 
 
 # the cold-store subprocess runs THIS module's _pipelined_cached_run, so

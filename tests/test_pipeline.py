@@ -3,7 +3,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from accelerate_tpu.parallel.pipeline import gpipe
+from accelerate_tpu.parallel.pipeline import bubble_fraction, bubble_ticks, gpipe
 from accelerate_tpu.state import AcceleratorState
 from accelerate_tpu.utils.dataclasses import ParallelismConfig
 
@@ -67,6 +67,29 @@ def test_gpipe_bad_microbatch():
     params = make_stages(4, 8)
     with pytest.raises(ValueError):
         gpipe(stage_fn, params, jnp.ones((6, 8)), num_microbatches=4, mesh=state.mesh)
+
+
+def test_bubble_profile_common_granularity():
+    """Pin the bench's A/B bubble accounting (bench.py _pipeline_block):
+    BOTH arms must be quoted in the SAME chunk unit (granularity=V), where
+    the fused profile is exactly V× the interleaved one.  At each
+    schedule's OWN default granularity the two are numerically equal
+    (2·(S−1) self-sized chunks each) — comparing defaults would silently
+    erase the interleaving gain, which is the bug this test pins out."""
+    # the bench geometry: M=8, S=2, V=2 quoted in 1/2-stage chunks
+    assert bubble_ticks(8, 2, 1, granularity=2) == 4
+    assert bubble_ticks(8, 2, 2, granularity=2) == 2
+    for S in (2, 4):
+        for V in (2, 3, 4):
+            fused = bubble_ticks(8, S, 1, granularity=V)
+            inter = bubble_ticks(8, S, V, granularity=V)
+            assert fused == V * inter, (S, V, fused, inter)
+            assert inter < fused, (S, V)
+            # default granularity is the schedule's own chunk: both sides
+            # collapse to 2*(S-1) and the comparison loses its meaning
+            assert bubble_ticks(8, S, V) == bubble_ticks(8, S, 1) == 2 * (S - 1)
+    # the analytic fraction carries the same monotone gain
+    assert bubble_fraction(8, 2, 2) == bubble_fraction(8, 2, 1) / 2
 
 
 # ---------------------------------------------------------------------------

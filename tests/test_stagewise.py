@@ -1,0 +1,140 @@
+"""Per-stage captured programs (ISSUE 17 tentpole): the self-clocked
+stagewise dispatcher must train bit-comparably to the lockstep SPMD
+rehearsal, reuse its compiled programs across steps, and enumerate a
+self-consistent tick schedule (a slot firing before its input arrives
+raises inside the dispatcher — delivery order is machine-checked)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.parallel.pipeline import (
+    apply_layer_order,
+    pipeline_train_1f1b,
+    schedule_ticks,
+)
+from accelerate_tpu.parallel.plan import _layer_orders
+from accelerate_tpu.parallel.stagewise import (
+    StagewisePrograms,
+    stagewise_train_1f1b,
+    tick_schedule,
+)
+from accelerate_tpu.state import AcceleratorState
+from accelerate_tpu.utils.dataclasses import ParallelismConfig
+
+N_DEV = len(jax.devices())
+
+
+def stage_fn(params, h):
+    return jnp.tanh(h @ params["w"] + params["b"])
+
+
+def loss_fn(out, labels, extra):
+    err = (out @ extra["head"] - labels) ** 2
+    return err.sum(), jnp.float32(err.size)
+
+
+def _problem(S=2, V=2, L=4, M=4, dim=8, dp=1):
+    ks = jax.random.split(jax.random.key(0), L)
+    plain = {
+        "w": jnp.stack([jax.random.normal(k, (dim, dim)) * 0.5 for k in ks]),
+        "b": jnp.zeros((L, dim)),
+    }
+    order, _ = _layer_orders(S, V, L)
+    committed = apply_layer_order(plain, order)
+    batch = M * dp
+    x = jax.random.normal(jax.random.key(1), (batch, dim))
+    labels = jax.random.normal(jax.random.key(2), (batch, dim))
+    extra = {"head": jnp.eye(dim) + 0.1}
+    return committed, x, labels, extra
+
+
+def test_tick_schedule_complete_and_ordered():
+    M, S, V = 8, 2, 2
+    events = tick_schedule(M, S, V)
+    assert len(events) == schedule_ticks(M, S, virtual=V)
+    flat = [e for tick in events for e in tick]
+    assert len(flat) == 2 * M * V * S  # every slot exactly once per device
+    seen = set()
+    for role, d, k, m in flat:
+        assert (role, d, k, m) not in seen
+        seen.add((role, d, k, m))
+    # the pipeline starts with virtual stage 0's first microbatch, alone
+    assert events[0] == [("fwd", 0, 0, 0)]
+    # the drain ends with device 0's backward of chunk 0 (virtual stage 0)
+    assert events[-1] == [("bwd", 0, 0, M - 1)]
+    # bad geometry refuses (M % S)
+    with pytest.raises(ValueError, match="divisible"):
+        tick_schedule(3, 2, 2)
+
+
+@pytest.mark.skipif(N_DEV < 2 or N_DEV % 2, reason="needs >= 2 even devices")
+def test_stagewise_parity_with_lockstep_committed():
+    """The self-clocked per-stage dispatch computes the SAME loss and the
+    SAME committed-order gradients as the lockstep shard_map rehearsal."""
+    S, V, L, M = 2, 2, 4, 4
+    dp = N_DEV // S
+    committed, x, labels, extra = _problem(S=S, V=V, L=L, M=M, dp=dp)
+
+    state = AcceleratorState(
+        parallelism_config=ParallelismConfig(pp_size=S, dp_size=dp)
+    )
+    ref_loss, ref_dp, ref_dx, ref_de = pipeline_train_1f1b(
+        stage_fn, committed, x, labels, extra, loss_fn, M,
+        mesh=state.mesh, virtual=V, layout="committed",
+    )
+    got_loss, got_dp, got_dx, got_de = stagewise_train_1f1b(
+        stage_fn, committed, x, labels, extra, loss_fn, M,
+        num_stages=S, virtual=V,
+    )
+    np.testing.assert_allclose(float(got_loss), float(ref_loss), rtol=1e-5)
+    for name in ref_dp:
+        np.testing.assert_allclose(
+            np.asarray(got_dp[name]), np.asarray(ref_dp[name]),
+            rtol=1e-5, atol=1e-7, err_msg=name,
+        )
+    np.testing.assert_allclose(
+        np.asarray(got_dx), np.asarray(ref_dx), rtol=1e-5, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_de["head"]), np.asarray(ref_de["head"]),
+        rtol=1e-5, atol=1e-7,
+    )
+
+
+def test_stagewise_programs_compile_once_and_are_reused():
+    """2·S·V programs exist per geometry (one fwd per chunk, one backward
+    per chunk — the last virtual stage's carries the loss head) and a
+    second step dispatches with ZERO new compiles."""
+    S, V, M = 2, 2, 4
+    committed, x, labels, extra = _problem(S=S, V=V, L=4, M=M, dp=1)
+    programs = StagewisePrograms(
+        stage_fn, loss_fn, num_stages=S, virtual=V,
+    )
+    loss1, *_ = stagewise_train_1f1b(
+        stage_fn, committed, x, labels, extra, loss_fn, M,
+        num_stages=S, virtual=V, programs=programs,
+    )
+    assert programs.compiled == 2 * S * V
+    assert programs.loaded == 0
+    loss2, *_ = stagewise_train_1f1b(
+        stage_fn, committed, x, labels, extra, loss_fn, M,
+        num_stages=S, virtual=V, programs=programs,
+    )
+    assert programs.compiled == 2 * S * V  # steady state: no recompiles
+    assert float(loss1) == float(loss2)
+
+
+def test_stagewise_rejects_bad_geometry():
+    committed, x, labels, extra = _problem(S=2, V=2, L=4, M=4, dp=1)
+    with pytest.raises(ValueError, match="divisible"):
+        stagewise_train_1f1b(
+            stage_fn, committed, x, labels, extra, loss_fn, 4,
+            num_stages=3, virtual=2,
+        )
+    with pytest.raises(ValueError, match="divisible"):
+        stagewise_train_1f1b(
+            stage_fn, committed, x, labels, extra, loss_fn, 3,
+            num_stages=2, virtual=2,
+        )

@@ -15,11 +15,20 @@ Exit 0 requires:
   (no builds after the two accumulation variants);
 * the interleaved schedule's analytic bubble profile is strictly better
   than the fused one (bubble_ticks and bubble_fraction at V=2);
-* interleaved-vs-fused training parity holds (same trajectory).
+* interleaved-vs-fused training parity holds (same trajectory);
+* (ISSUE 17) the committed layout's lowering contains NO stacked-layer
+  gather while the legacy gather layout's does
+  (``native.kernels.inspect.check_pipeline_layout``), and the per-stage
+  captured programs round-trip the AOT store across two FRESH
+  subprocesses: the warm leg loads every ``(stage, chunk, role)``
+  program off disk with ZERO trace/compile at a bitwise-equal loss.
 """
 
+import json
 import os
+import subprocess
 import sys
+import tempfile
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
@@ -85,6 +94,86 @@ def _train(pp: int, schedule: str = "interleaved", micro_steps: int = 6):
     return acc, step, losses
 
 
+def _stagewise_leg(cache_dir: str, out_path: str) -> None:
+    """One stagewise process against the AOT store — runs in a FRESH
+    subprocess both cold (compile + store every per-stage program) and
+    warm (load every program off disk; XLA:CPU only serializes reliably
+    from a process that hasn't accumulated unrelated JIT state, which is
+    exactly the restart shape this leg proves anyway)."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.native.aot_cache import AOTCompilationCache
+    from accelerate_tpu.parallel.pipeline import apply_layer_order
+    from accelerate_tpu.parallel.plan import StagePlan
+    from accelerate_tpu.parallel.stagewise import (
+        StagewisePrograms,
+        stagewise_train_1f1b,
+    )
+    from accelerate_tpu.utils.dataclasses import CompilationCacheKwargs
+
+    S, V, L, M, dim = 2, 2, 4, 4, 8
+    stage = StagePlan(num_stages=S, virtual=V, num_microbatches=M,
+                      schedule="interleaved")
+    plan_desc = {"schedule": "interleaved", "virtual": V, "microbatches": M,
+                 "layer_layout": stage.layout}
+    ks = jax.random.split(jax.random.key(0), L)
+    plain = {
+        "w": jnp.stack([jax.random.normal(k, (dim, dim)) * 0.5 for k in ks]),
+        "b": jnp.zeros((L, dim)),
+    }
+    committed = apply_layer_order(plain, stage.layer_order(L))
+    x = jax.random.normal(jax.random.key(1), (M, dim))
+    labels = jax.random.normal(jax.random.key(2), (M, dim))
+    extra = {"head": jnp.eye(dim) + 0.1}
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def loss_fn(out, lbl, e):
+        err = (out @ e["head"] - lbl) ** 2
+        return err.sum(), jnp.float32(err.size)
+
+    cache = AOTCompilationCache(CompilationCacheKwargs(cache_dir=cache_dir))
+    cache.set_context(plan=plan_desc)
+    programs = StagewisePrograms(
+        stage_fn, loss_fn, num_stages=S, virtual=V,
+        cache=cache, plan_desc=plan_desc,
+    )
+    loss, *_ = stagewise_train_1f1b(
+        stage_fn, committed, x, labels, extra, loss_fn, M,
+        num_stages=S, virtual=V, programs=programs,
+    )
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump({
+            "loss": repr(float(loss)),  # bitwise contract
+            "compiled": programs.compiled,
+            "loaded": programs.loaded,
+            "stores": cache.stores,
+            "hits": cache.hits,
+            "programs": 2 * S * V,
+        }, f)
+
+
+def _run_stagewise_leg(cache_dir: str, label: str) -> dict:
+    out_path = os.path.join(cache_dir, f"{label}.result.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single device: no virtual mesh needed
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--stagewise-leg",
+         cache_dir, out_path],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    if proc.returncode != 0:
+        print(f"pipeline_smoke: stagewise {label} leg failed "
+              f"rc={proc.returncode}", file=sys.stderr)
+        print(proc.stderr[-4000:], file=sys.stderr)
+        sys.exit(1)
+    with open(out_path, encoding="utf-8") as f:
+        return json.load(f)
+
+
 def main() -> int:
     from accelerate_tpu.parallel.pipeline import bubble_fraction, bubble_ticks
     from accelerate_tpu.parallel.plan import current_plan
@@ -129,10 +218,55 @@ def main() -> int:
     if max(fdiffs) > 1e-4:
         failures.append(f"interleaved vs fused trajectory diverged: {fdiffs}")
 
+    # the committed layout resolved as the layout of record (default V>1)
+    if plan.layer_layout != "committed":
+        failures.append(
+            f"interleaved plan resolved layer_layout={plan.layer_layout!r}, "
+            "expected the committed default"
+        )
+
+    # zero permutation bytes, proven structurally: no gather op / no layer-
+    # order index vector in the committed lowering, both in the gather arm's
+    ir_facts = {}
+    try:
+        from accelerate_tpu.native.kernels.inspect import check_pipeline_layout
+
+        ir_facts = check_pipeline_layout()
+    except AssertionError as exc:
+        failures.append(f"layout IR inspection: {exc}")
+
+    # per-stage captured programs round-trip the AOT store across fresh
+    # processes: cold compiles+stores all 2·S·V programs, warm loads every
+    # one with zero compiles at a bitwise-equal loss
+    cache_dir = tempfile.mkdtemp(prefix="atpu_pipeline_smoke_")
+    cold = _run_stagewise_leg(cache_dir, "cold")
+    warm = _run_stagewise_leg(cache_dir, "warm")
+    if cold["compiled"] != cold["programs"] or cold["loaded"] != 0:
+        failures.append(f"stagewise cold leg: {cold}")
+    if cold["stores"] != cold["programs"]:
+        failures.append(
+            f"stagewise cold leg stored {cold['stores']}/{cold['programs']} "
+            "programs"
+        )
+    if warm["compiled"] != 0 or warm["loaded"] != warm["programs"]:
+        failures.append(
+            f"stagewise warm leg paid compiles: compiled={warm['compiled']} "
+            f"loaded={warm['loaded']}/{warm['programs']}"
+        )
+    if warm["loss"] != cold["loss"]:
+        failures.append(
+            f"stagewise warm loss not bitwise-equal: cold={cold['loss']} "
+            f"warm={warm['loss']}"
+        )
+
     print(
         f"pipeline_smoke: plan {plan.describe()} | losses pp2={losses_pp[-1]:.4f} "
         f"dp={losses_dp[-1]:.4f} (max diff {max(diffs):.2e}) | bubble "
-        f"{fused_b}->{inter_b} ticks"
+        f"{fused_b}->{inter_b} ticks | layout IR gather ops "
+        f"{ir_facts.get('gather_gather_ops')}->"
+        f"{ir_facts.get('committed_gather_ops')} | stagewise warm "
+        f"{warm['loaded']}/{warm['programs']} programs from store, "
+        f"{warm['compiled']} compiles"
     )
     for failure in failures:
         print(f"pipeline_smoke: FAIL: {failure}", file=sys.stderr)
@@ -141,4 +275,7 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--stagewise-leg":
+        _stagewise_leg(sys.argv[2], sys.argv[3])
+        sys.exit(0)
     sys.exit(main())
