@@ -57,8 +57,12 @@ multichip:
 	  tests/test_kernels.py tests/test_parallel_plan.py -q
 
 # telemetry pipeline proof (docs/telemetry.md): tiny model, 3 steps + a
-# forced shape change with telemetry on, JSONL export validated through
-# tools/telemetry_report.py (step phases present, recompile cause attributed)
+# forced shape change with telemetry + trace export on, JSONL validated
+# through tools/telemetry_report.py (step phases present, recompile cause
+# attributed), flight-ring health + trace tracks checked; then the
+# injected-hang leg — a real 2-process gloo world where rank 1 hangs, the
+# watchdog dumps both ranks and tools/blackbox_report.py must name the
+# stalled rank and first divergent collective
 telemetry-smoke:
 	JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 

@@ -1714,6 +1714,11 @@ class Accelerator:
             # no-op unless a JSONL dump path was configured; the tracker
             # bridge, when present, already wrote it in finish()
             self.telemetry.write_jsonl()
+        # black-box forensics teardown: the joined Chrome/Perfetto timeline
+        # (no-op without a configured path), then the watchdog thread — the
+        # flight ring itself stays live for any later manual dump
+        self.telemetry.export_trace()
+        self.telemetry.close_watchdog()
         self.telemetry.close_metrics()  # stop serving /metrics for this run
         self.wait_for_everyone()
 

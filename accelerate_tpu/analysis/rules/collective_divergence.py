@@ -33,7 +33,12 @@ from __future__ import annotations
 import ast
 
 from ..engine import Finding, Rule
-from ..taint import FunctionTaint, collective_sink, single_process_conjunct
+from ..taint import (
+    FunctionTaint,
+    collective_sink,
+    rank_local_by_design,
+    single_process_conjunct,
+)
 
 _NESTED_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
 
@@ -53,6 +58,13 @@ class CollectiveDivergence(Rule):
     )
 
     def check(self, module, ctx) -> list[Finding]:
+        if rank_local_by_design(module.rel_path):
+            # the postmortem-writer exemption (taint.RANK_LOCAL_MODULE_
+            # SUFFIXES): rank identity / wall clock / fs probes here are the
+            # point, so the divergence scan is waived — and the INVERTED
+            # contract is enforced instead: a module that may run while the
+            # mesh is deadlocked must never bear a collective at all.
+            return self._check_rank_local_contract(module)
         findings: list[Finding] = []
         div_map = ctx.divergent_aliases.get(module.rel_path, {})
         coll_map = ctx.collective_aliases.get(module.rel_path, {})
@@ -85,6 +97,34 @@ class CollectiveDivergence(Rule):
 
             self._scan(
                 info.node.body, [], module, taint, coll_map, fire
+            )
+        return findings
+
+    def _check_rank_local_contract(self, module) -> list[Finding]:
+        """The no-collective contract for rank-local-by-design modules: every
+        collective sink anywhere in the module (function bodies AND module
+        level) is a finding, unconditionally — divergence analysis does not
+        apply because the module must not collectivize at all."""
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tok = collective_sink(node, module)
+            if tok is None:
+                continue
+            findings.append(
+                Finding(
+                    self.id,
+                    module.rel_path,
+                    node.lineno,
+                    node.col_offset,
+                    f"collective ({tok}) in a rank-local-by-design module: "
+                    "the postmortem/watchdog path may run while the mesh is "
+                    "deadlocked — coordinating over the stalled mesh hangs "
+                    "the postmortem too.  Move the collective out of this "
+                    "module; the rank-local exemption is conditional on "
+                    "bearing none",
+                )
             )
         return findings
 

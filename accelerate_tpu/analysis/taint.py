@@ -216,6 +216,34 @@ _WORLD_SIZE_RE = re.compile(
     r"num_processes|world_size|process_count", re.IGNORECASE
 )
 
+# ---------------------------------------------------------------------------
+# rank-local-by-design modules (docs/telemetry.md §flight recorder)
+# ---------------------------------------------------------------------------
+
+# Postmortem writers run while the mesh may already be deadlocked: they read
+# rank identity, the wall clock and the filesystem ON PURPOSE (the dump must
+# name its rank and stamp its time), so the divergence scan would drown them
+# in by-design findings.  The exemption is a CONTRACT, not a blanket waiver:
+# in exchange, these modules must never contain a collective sink — a
+# watchdog that gathers about the hang deadlocks the postmortem too.  The
+# collective-divergence rule enforces the inverted direction on exactly this
+# set (tests/test_graftlint.py pins both).
+RANK_LOCAL_MODULE_SUFFIXES = frozenset(
+    {
+        "telemetry/flightrec.py",
+        "telemetry/watchdog.py",
+        "telemetry/trace_export.py",
+    }
+)
+
+
+def rank_local_by_design(rel_path: str) -> bool:
+    """True when ``rel_path`` names a module declared rank-local by design
+    (per-rank postmortem writers — exempt from the divergence scan, but
+    forbidden from ever issuing a collective)."""
+    path = rel_path.replace("\\", "/")
+    return any(path.endswith(suffix) for suffix in RANK_LOCAL_MODULE_SUFFIXES)
+
 
 def _call_leaf(fn: ast.AST) -> Optional[str]:
     if isinstance(fn, ast.Attribute):

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 
 from .nn import random as nn_random
 from .nn.tape import Tensor
+from .telemetry import flightrec as _flightrec
+from .telemetry import watchdog as _watchdog
 from .telemetry.recompile import RecompileEvent, diff_keys, key_id
 from .telemetry.timeline import StepRecord
 
@@ -186,6 +188,12 @@ class CapturedStep:
         # recorded; when OFF every line below runs exactly as before.
         tel = getattr(accelerator, "telemetry", None)
         self._telemetry = tel if (tel is not None and tel.enabled) else None
+        # flight recorder (docs/telemetry.md §flight recorder): the one
+        # always-ON telemetry stream — pinned here so the kill switch
+        # ($ACCELERATE_FLIGHTREC=0) costs the hot path a single None-check
+        rec = _flightrec.recorder()
+        self._flightrec = rec if rec.enabled else None
+        self._flight_steps = 0  # step-index fallback when telemetry is OFF
         # resilience (docs/resilience.md): same pinning discipline — when
         # OFF the dispatch below is byte-identical to the pre-resilience
         # path; when ON, dispatch faults are classified/retried and the
@@ -279,6 +287,15 @@ class CapturedStep:
     def __call__(self, *args):
         t_call = _time.perf_counter()
         tel = self._telemetry
+        # flight event: dispatch begin, stamped with the step index this call
+        # will carry (telemetry's global counter when ON, a local one when
+        # OFF).  The begin/end pair is the trace-export anchor and — in a
+        # postmortem — the proof of which step the process died inside.
+        flight = self._flightrec
+        flight_step = -1
+        if flight is not None:
+            flight_step = tel.steps_total if tel is not None else self._flight_steps
+            flight.record("step_begin", step=flight_step)
         dl_wait_ms = tel.pop_dataloader_wait_ms() if tel is not None else 0.0
         # sampled device-time attribution (docs/telemetry.md): every Nth
         # step the dispatch below runs inside a jax.profiler trace session
@@ -423,7 +440,16 @@ class CapturedStep:
                 kid = self._key_ids.get(key)
                 if kid is None:
                     kid = self._key_ids[key] = key_id(key)
-                device_record = prof.stop(prof_step, kid, (new_state, out))
+                # prof.stop blocks on this call's outputs — the one
+                # unconditional device sync in the step — so it is deadline-
+                # guarded when a hang watchdog is armed (docs/telemetry.md)
+                wd = _watchdog.current_watchdog()
+                with (
+                    wd.guard(f"profiler_stop step {prof_step}")
+                    if wd is not None
+                    else contextlib.nullcontext()
+                ):
+                    device_record = prof.stop(prof_step, kid, (new_state, out))
                 if device_record is not None:
                     tel.record_device_step(device_record)
         except BaseException:
@@ -538,6 +564,10 @@ class CapturedStep:
             # should_resize loop) pay one extra None-check, fleet-off runs
             # none at all.
             fleet.on_dispatch_end(self)
+        if flight is not None:
+            flight.record("step_end", step=flight_step, built=built)
+            if tel is None:
+                self._flight_steps += 1
         return out
 
     def _dispatch_aot(self, tel, key, entry, state, args, dev_leaves, host_leaves, flat_args):

@@ -336,8 +336,14 @@ def write_accelerator_save(plan: SavePlan) -> None:
     """Pure file IO — no collectives, no device access.  Safe to run from a
     background thread on every process concurrently with training."""
     from .native.st import pick_save_file
+    from .telemetry import flightrec
     from .utils.fsdp_utils import SHARD_FILE_METADATA
 
+    # flight events bracket the IO (docs/telemetry.md §flight recorder): a
+    # process that dies mid-checkpoint shows ckpt_write_begin with no _end
+    flightrec.record(
+        "ckpt_write_begin", dir=plan.output_dir, shards=len(plan.shard_files)
+    )
     os.makedirs(plan.output_dir, exist_ok=True)
     save_file = pick_save_file()
     for fname, arrays in plan.shard_files:
@@ -354,6 +360,7 @@ def write_accelerator_save(plan: SavePlan) -> None:
                     pickle.dump(payload, f)
     with open(os.path.join(plan.output_dir, plan.rng_filename), "wb") as f:
         pickle.dump(plan.rng_payload, f)
+    flightrec.record("ckpt_write_end", dir=plan.output_dir)
     # NOTE: accelerator_meta.json — the completion sentinel — is written in
     # finalize_accelerator_save, AFTER the cross-process barrier: only then
     # have EVERY rank's shard/rng writes landed, so its presence proves the
@@ -459,9 +466,12 @@ def load_accelerator_state(
 ) -> dict:
     """Reference load_accelerator_state checkpointing.py:175. Returns
     overrides (e.g. {'step': n})."""
+    from .telemetry import flightrec
+
     state = PartialState()
     if not os.path.isdir(input_dir):
         raise FileNotFoundError(f"checkpoint dir {input_dir} does not exist")
+    flightrec.record("ckpt_load_begin", dir=input_dir)
 
     from .utils.fsdp_utils import load_sharded_resharded, sharded_index_path
 
@@ -545,6 +555,7 @@ def load_accelerator_state(
     if os.path.exists(rng_file):
         with open(rng_file, "rb") as f:
             _restore_rng_states(pickle.load(f))
+    flightrec.record("ckpt_load_end", dir=input_dir)
     logger.info(f"Loaded accelerator state from {input_dir}")
     return overrides
 

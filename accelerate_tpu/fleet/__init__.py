@@ -100,6 +100,19 @@ class Fleet:
         self.events.append(payload)
         if self.telemetry is not None:
             self.telemetry.record_fleet(dict(payload))
+        # mirror the scalar shape into the flight ring (docs/telemetry.md
+        # §flight recorder): vote / rendezvous / resize phases must survive
+        # a crash even when the telemetry hub is off or its JSONL unflushed
+        from ..telemetry import flightrec
+
+        # payload keys colliding with the ring's slot schema (autopilot
+        # decisions carry their own "kind") come back ``field_``-prefixed
+        flightrec.record(
+            "fleet",
+            event=event,
+            **{k: v for k, v in fields.items()
+               if v is None or isinstance(v, (bool, int, float, str))},
+        )
         return payload
 
     # -- capture-path hook ---------------------------------------------------

@@ -98,11 +98,22 @@ def vote_restore_point(accelerator, fleet=None) -> Optional[dict]:
     does).  Allgathers each rank's offers and returns the agreement; every
     rank computes it from the same gathered list, so no second broadcast is
     needed.  Records a ``restore_vote`` fleet event with the full ballot."""
+    from ..telemetry import flightrec
+
     local = local_restore_candidates(accelerator)
+    flightrec.record("fleet_vote_begin", offers=len(local))
     # gather_object flattens one list level: each rank contributes
     # [its offer list] and everyone receives [rank0_offers, rank1_offers, ...]
     per_rank = gather_object([local])
+    # the agree_* merge ticks the collective-sequence counter: every rank
+    # computes it at the same ordinal position, so the seq stays the
+    # cross-rank alignment key through the vote (docs/telemetry.md)
+    flightrec.note_collective("agree_restore_point", ranks=len(per_rank))
     agreed = agree_restore_point(per_rank)
+    flightrec.record(
+        "fleet_vote_end",
+        agreed=agreed["path"] if agreed is not None else None,
+    )
     if fleet is not None:
         fleet.record_event(
             "restore_vote",

@@ -361,6 +361,18 @@ class AOTCompilationCache:
     def _record(self, event: str, **fields) -> None:
         if self._telemetry is not None:
             self._telemetry.record_aot_cache({"event": event, **fields})
+        # scalar mirror into the flight ring (docs/telemetry.md §flight
+        # recorder): AOT-store I/O — hit / miss / store / store_failed —
+        # is postmortem-relevant (a hang inside deserialize_and_load shows
+        # as a hit with no following step_begin)
+        from ..telemetry import flightrec
+
+        flightrec.record(
+            "aot_cache",
+            event=event,
+            **{k: v for k, v in fields.items()
+               if v is None or isinstance(v, (bool, int, float, str))},
+        )
 
     # -- fingerprint ---------------------------------------------------------
     def set_context(self, mesh=None, compression: Optional[str] = None,

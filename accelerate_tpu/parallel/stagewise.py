@@ -278,7 +278,15 @@ def stagewise_train_1f1b(
             lambda a, b: a + b, acc, g
         )
 
-    for tick_events in tick_schedule(M, S, V):
+    from ..telemetry import flightrec
+
+    for tick_index, tick_events in enumerate(tick_schedule(M, S, V)):
+        # flight event per tick (docs/telemetry.md §flight recorder): in a
+        # postmortem the last recorded tick names exactly which (stage,
+        # chunk, microbatch) slots the dispatcher died between
+        flightrec.record(
+            "pipeline_tick", tick=tick_index, slots=len(tick_events)
+        )
         arriving_acts: dict = {}
         arriving_cots: dict = {}
         for role, d, k, m in tick_events:

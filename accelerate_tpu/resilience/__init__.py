@@ -98,6 +98,17 @@ class Resilience:
         self.events.append(payload)
         if self.telemetry is not None:
             self.telemetry.record_resilience(dict(payload))
+        # scalar mirror into the flight ring: preemption / retry / rollback
+        # phases are exactly what a postmortem needs, and the ring survives
+        # where an unflushed telemetry JSONL does not (docs/telemetry.md)
+        from ..telemetry import flightrec
+
+        flightrec.record(
+            "resilience",
+            event=event,
+            **{k: v for k, v in fields.items()
+               if v is None or isinstance(v, (bool, int, float, str))},
+        )
         return payload
 
     def _on_signal(self, signum: int) -> None:
@@ -115,6 +126,7 @@ class Resilience:
         self.dispatch_calls += 1
         if self.injector is not None:
             self.injector.maybe_sigterm(index)
+            self.injector.maybe_hang(index)
         return index
 
     # -- preemption flags ----------------------------------------------------

@@ -27,6 +27,12 @@ semicolon-separated directives, ``key=int`` options after a colon:
   ``step`` (the rejoin beacon a scheduler sends when a reclaimed host comes
   back).  Consumed by the elastic fleet runtime (``fleet.should_grow``):
   the survivors drain and re-mesh dp *up* over the rejoined blocks.
+* ``hang:step=2`` / ``hang:step=2,seconds=30`` — the process sleeps for
+  ``seconds`` (default 3600 — effectively forever on a test clock) right
+  before the dispatch of global step ``step``.  The rank never reaches its
+  next collective, so every OTHER rank blocks inside theirs — the canonical
+  stalled-rank scenario the hang watchdog (``telemetry/watchdog.py``) and
+  blackbox postmortem (``tools/blackbox_report.py``) exist for.
 * ``signal_storm:step=2,times=6`` — for the next ``times`` autopilot
   evaluation ticks starting at dispatch ``step``, flap the observed
   straggler-skew signal alternately above and below the autopilot's
@@ -55,10 +61,11 @@ class InjectedTransientError(RuntimeError):
 
 @dataclass
 class _Directive:
-    kind: str  # init_hang | dispatch | sigterm | host_lost | host_gained | signal_storm
-    step: Optional[int] = None  # dispatch index (dispatch/sigterm)
+    kind: str  # init_hang | dispatch | sigterm | host_lost | host_gained | signal_storm | hang
+    step: Optional[int] = None  # dispatch index (dispatch/sigterm/hang)
     times: int = 1  # how many firings remain
     fired: int = 0
+    seconds: int = 3600  # hang duration (hang only)
 
 
 @dataclass
@@ -76,12 +83,12 @@ class FaultPlan:
             kind = kind.strip()
             if kind not in (
                 "init_hang", "dispatch", "sigterm", "host_lost",
-                "host_gained", "signal_storm",
+                "host_gained", "signal_storm", "hang",
             ):
                 raise ValueError(
                     f"unknown fault directive {kind!r} in {spec!r}; use "
                     "init_hang / dispatch / sigterm / host_lost / "
-                    "host_gained / signal_storm"
+                    "host_gained / signal_storm / hang"
                 )
             opts: dict[str, int] = {}
             for pair in opts_raw.split(","):
@@ -95,18 +102,20 @@ class FaultPlan:
                     raise ValueError(
                         f"fault option {pair!r} in {spec!r} is not key=int"
                     ) from None
-            unknown = set(opts) - {"step", "times"}
+            allowed = {"step", "times"} | ({"seconds"} if kind == "hang" else set())
+            unknown = set(opts) - allowed
             if unknown:
                 raise ValueError(f"unknown fault options {sorted(unknown)} in {raw!r}")
             if (
                 kind in ("dispatch", "sigterm", "host_lost", "host_gained",
-                         "signal_storm")
+                         "signal_storm", "hang")
                 and "step" not in opts
             ):
                 raise ValueError(f"{kind!r} directive needs step=N ({raw!r})")
             directives.append(
                 _Directive(
-                    kind=kind, step=opts.get("step"), times=opts.get("times", 1)
+                    kind=kind, step=opts.get("step"), times=opts.get("times", 1),
+                    seconds=opts.get("seconds", 3600),
                 )
             )
         return cls(directives)
@@ -192,6 +201,26 @@ class FaultInjector:
                 d.fired += 1
                 return d.fired % 2 == 1  # spike first, then drop, then spike...
         return None
+
+    def maybe_hang(self, dispatch_index: int) -> bool:
+        """Sleep for the directive's ``seconds`` right before the given
+        dispatch — this rank goes silent while its peers block in their next
+        collective.  Records a ``hang_injected`` flight event *before*
+        sleeping (so the postmortem dump shows the injection, not a
+        mystery); returns True when a hang fired."""
+        directive = self._pending("hang", step=dispatch_index)
+        if directive is None:
+            return False
+        directive.fired += 1
+        from ..telemetry import flightrec
+
+        flightrec.record(
+            "hang_injected", step=dispatch_index, seconds=directive.seconds
+        )
+        import time
+
+        time.sleep(directive.seconds)
+        return True
 
     def maybe_dispatch_fault(self, dispatch_index: int) -> None:
         """Raise a transient fault for the given dispatch; retries of the same

@@ -92,6 +92,20 @@ class PreemptionGuard:
         repeat = self._triggered and self._signum == signum
         self._triggered = True
         self._signum = signum
+        try:
+            # flight event + best-effort blackbox dump (docs/telemetry.md):
+            # a preempted process may never reach its drain point, so the
+            # forensic record is written the moment the signal lands.  Both
+            # are rank-local and async-signal-tolerant (pure python, no
+            # collectives); any failure must not eat the sticky flag.
+            from ..telemetry import flightrec, watchdog
+
+            flightrec.record("signal", signum=int(signum), repeat=repeat)
+            wd = watchdog.current_watchdog()
+            if wd is not None:
+                wd.dump_now(reason="preemption_signal")
+        except Exception:
+            pass
         if self._on_trigger is not None:
             try:
                 self._on_trigger(signum)

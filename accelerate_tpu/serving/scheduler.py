@@ -590,14 +590,28 @@ class DecodeService:
         block → evict); returns the requests that completed during it."""
         from .engine import run_decode, run_decode_n
 
+        from ..telemetry import flightrec
+
         n = self.config.decode_steps
         admitted = self._admit()
+        if admitted:
+            # flight event: admissions (docs/telemetry.md §flight recorder)
+            # — in a hang postmortem the last admit/decode_window pair shows
+            # whether the engine died admitting or mid-block
+            flightrec.record(
+                "serving_admit",
+                count=len(admitted), queue_depth=len(self._queue),
+            )
         completed = [r for r in admitted if r.state == "done"]
         slot_evictions = 0
         emitted = 0
         active = [i for i, r in enumerate(self._slot_req) if r is not None]
         uploads_before = self.stats["h2d_uploads"]
         if active:
+            flightrec.record(
+                "decode_window",
+                step=self.stats["steps"], active=len(active), decode_steps=n,
+            )
             if n > 1:
                 self._flush_device_state()
             common = dict(

@@ -34,6 +34,15 @@ Four pillars, all default-OFF and zero-overhead when off:
    ``Telemetry.serve_metrics()``): step-phase timings, recompile/fault
    counters, collective bytes, device-time gauges, and any registered
    provider (the decode service self-registers its ``metrics()`` snapshot).
+8. **Black-box forensics** (`flightrec.py` / `watchdog.py` /
+   `trace_export.py`) — the flight recorder is the ONE exception to the
+   default-off convention: an always-on, bounded, per-process event ring
+   (step dispatches, collective-sequence ticks, fleet/serving/checkpoint
+   phases) that the default-off hang watchdog dumps — with faulthandler
+   stacks — to a per-rank JSON on stall/signal/exit, and
+   ``tools/blackbox_report.py`` merges across ranks by collective sequence
+   number.  ``trace_export.py`` joins the ring with the host/device step
+   records into one Chrome/Perfetto timeline.
 
 Enable with ``ACCELERATE_TELEMETRY=1`` or
 ``Accelerator(kwargs_handlers=[TelemetryKwargs(enabled=True)])``.  With the
@@ -181,6 +190,24 @@ class Telemetry:
         # wait time to the previous run's (possibly defunct) instance
         displaced = _ACTIVE
         _set_active(self if self.enabled else None)
+        # black-box forensics (flightrec.py/watchdog.py): the recorder is
+        # process-global and always-on; the watchdog arms from its knob
+        # INDEPENDENTLY of `enabled` — hang forensics must not require the
+        # full telemetry pipeline (docs/telemetry.md §watchdog)
+        from . import flightrec as _flightrec
+
+        self.flightrec = _flightrec.recorder()
+        self.watchdog = None
+        self.trace_export_path = getattr(handler, "trace_export_path", None)
+        watchdog_s = getattr(handler, "watchdog_s", None)
+        if watchdog_s:
+            from .watchdog import HangWatchdog
+
+            self.watchdog = HangWatchdog(
+                timeout_s=watchdog_s,
+                dump_dir=getattr(handler, "blackbox_dir", None) or "blackbox",
+                recorder=self.flightrec,
+            ).start()
         metrics_port = getattr(handler, "metrics_port", None)
         if self.enabled and metrics_port is not None:
             if displaced is not None and displaced.metrics_server is not None:
@@ -484,6 +511,9 @@ class Telemetry:
             out["device_collective_share_mean"] = round(
                 sum(r.collective_share for r in records) / len(records), 4
             )
+        # flight-recorder health rides the summary record so a JSONL dump
+        # documents whether the black box was recording (and how full)
+        out["flightrec"] = self.flightrec.health()
         return out
 
     def all_records(self) -> list[dict]:
@@ -606,6 +636,21 @@ class Telemetry:
         server, self.metrics_server = self.metrics_server, None
         if server is not None:
             server.close()
+
+    def close_watchdog(self) -> None:
+        watchdog, self.watchdog = self.watchdog, None
+        if watchdog is not None:
+            watchdog.stop()
+
+    def export_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the joined Chrome/Perfetto timeline (trace_export.py) when
+        a path is configured or given; fail-soft ``None`` otherwise."""
+        path = path or self.trace_export_path
+        if path is None:
+            return None
+        from .trace_export import export_chrome_trace
+
+        return export_chrome_trace(path, telemetry=self, recorder=self.flightrec)
 
     def write_jsonl(self, path: Optional[str] = None) -> Optional[str]:
         from .export import write_jsonl

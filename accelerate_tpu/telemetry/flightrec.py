@@ -1,0 +1,259 @@
+"""Black-box flight recorder (docs/telemetry.md §flight recorder).
+
+A bounded, lock-cheap, per-process ring of structured events that is ON BY
+DEFAULT — the one deliberate exception to the telemetry package's
+default-off convention, because a recorder that must be switched on before
+the hang is not a flight recorder.  Producers across the stack append
+events the postmortem tooling needs to reconstruct *what the process was
+doing when it stopped*:
+
+* captured-step dispatch begin/end with the global step index
+  (``capture.py``);
+* a **collective-sequence counter** tick at every host collective —
+  ``gather`` / ``gather_object`` / ``broadcast`` / ``reduce``
+  (``utils/operations.py``) and every ``agree_*`` merge
+  (``fleet/coordinate.py``) — the cross-rank alignment key
+  ``tools/blackbox_report.py`` joins dumps on;
+* stagewise 1F1B tick dispatch (``parallel/stagewise.py``);
+* fleet vote / rendezvous / resize phases (``fleet/``);
+* serving admissions and decode windows (``serving/``);
+* checkpoint and AOT-store I/O (``checkpointing.py``, ``native/aot_cache.py``).
+
+Each event is stamped with ``time.monotonic()`` and a per-process sequence
+number; the rank is resolved lazily at dump time (recording must work
+before — and during — distributed init).  The ring is a preallocated slot
+list guarded by one tiny critical section per append (~100 ns uncontended,
+far under the ≤1 % of ``step_ms`` budget the bench A/B row asserts); when
+it wraps, the oldest events are overwritten and ``dropped`` counts them.
+
+The recorder never issues a collective, never raises into the hot path, and
+its dump (:meth:`FlightRecorder.dump`) writes a *per-rank* JSON file — this
+module is declared rank-local-by-design to the graftlint taint pass
+(``analysis/taint.py``), which in exchange asserts it contains no
+collective sink.
+
+Kill switch: ``ACCELERATE_FLIGHTREC=0`` turns recording into a no-op (the
+bench A/B's "off" arm); ``ACCELERATE_FLIGHTREC_CAPACITY`` resizes the ring
+(default 2048 events).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Optional
+
+_DEFAULT_CAPACITY = 2048
+
+
+def _env_capacity() -> int:
+    raw = os.environ.get("ACCELERATE_FLIGHTREC_CAPACITY")
+    if not raw:
+        return _DEFAULT_CAPACITY
+    try:
+        return max(16, int(raw))
+    except ValueError:
+        return _DEFAULT_CAPACITY
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("ACCELERATE_FLIGHTREC", "1").strip().lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+def resolve_rank() -> int:
+    """Best-effort process rank, resolved at *dump* time only — jax may not
+    be importable (or distributed-initialized) when events are recorded."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return int(os.environ.get("ACCELERATE_FLIGHTREC_RANK", "0") or 0)
+
+
+class FlightRecorder:
+    """The per-process event ring.  One module-level instance
+    (:func:`recorder`) serves the whole process; constructing private
+    instances is for tests."""
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY, enabled: bool = True):
+        self.capacity = max(16, int(capacity))
+        self.enabled = bool(enabled)
+        self._slots: list = [None] * self.capacity
+        self._n = 0  # events ever appended (monotone; ring head = n % cap)
+        self._collective_seq = 0
+        self._last_monotonic: Optional[float] = None
+        self._lock = threading.Lock()
+        # monotonic↔wall anchor: collective seqs align ranks *ordinally*;
+        # the wall anchor lets tools place per-rank monotonic stamps on one
+        # absolute timeline (outage_summary --blackbox join)
+        self._anchor_wall = time.time()
+        self._anchor_monotonic = time.monotonic()
+
+    # -- producers (hot path) ------------------------------------------------
+    @staticmethod
+    def _shield_reserved(fields: dict, names: tuple) -> dict:
+        """The ring owns the slot schema keys; a producer passing a payload
+        dict through (``**payload``) must not collide with them — remap to a
+        ``field_`` prefix instead of raising or silently clobbering."""
+        for reserved in names:
+            if reserved in fields:
+                fields[f"field_{reserved}"] = fields.pop(reserved)
+        return fields
+
+    def record(self, kind: str, /, **fields) -> None:
+        """Append one event.  Never raises; no-op when disabled."""
+        if not self.enabled:
+            return
+        fields = self._shield_reserved(fields, ("kind", "seq", "t"))
+        now = time.monotonic()
+        with self._lock:
+            self._slots[self._n % self.capacity] = (self._n, now, kind, fields)
+            self._n += 1
+            self._last_monotonic = now
+
+    def note_collective(self, op: str, /, **fields) -> int:
+        """Tick the collective-sequence counter and record the event.
+        Returns the 1-based sequence number of THIS collective — the value
+        every rank must agree on, and the join key the blackbox report
+        aligns per-rank dumps with."""
+        if not self.enabled:
+            return self._collective_seq
+        fields = self._shield_reserved(fields, ("kind", "seq", "t", "cseq", "op"))
+        now = time.monotonic()
+        with self._lock:
+            self._collective_seq += 1
+            seq = self._collective_seq
+            fields["cseq"] = seq
+            fields["op"] = op
+            self._slots[self._n % self.capacity] = (self._n, now, "collective", fields)
+            self._n += 1
+            self._last_monotonic = now
+        return seq
+
+    # -- consumers -----------------------------------------------------------
+    @property
+    def collective_seq(self) -> int:
+        return self._collective_seq
+
+    @property
+    def events_total(self) -> int:
+        return self._n
+
+    @property
+    def depth(self) -> int:
+        return min(self._n, self.capacity)
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self._n - self.capacity)
+
+    def seconds_since_last_event(self) -> Optional[float]:
+        last = self._last_monotonic
+        if last is None:
+            return None
+        return max(0.0, time.monotonic() - last)
+
+    def health(self) -> dict:
+        """Recorder self-diagnostics for the Prometheus endpoint
+        (telemetry/metrics.py): ring depth, drop count, staleness."""
+        age = self.seconds_since_last_event()
+        return {
+            "depth": self.depth,
+            "capacity": self.capacity,
+            "events_total": self._n,
+            "dropped_total": self.dropped,
+            "collective_seq": self._collective_seq,
+            "last_event_age_seconds": round(age, 3) if age is not None else None,
+        }
+
+    def snapshot(self) -> list[dict]:
+        """Retained events, oldest first, as dicts — safe to call from the
+        watchdog thread while producers keep appending."""
+        with self._lock:
+            n, cap = self._n, self.capacity
+            slots = list(self._slots)
+        start = max(0, n - cap)
+        out = []
+        for i in range(start, n):
+            slot = slots[i % cap]
+            if slot is None:
+                continue
+            seq, t, kind, fields = slot
+            event = {"seq": seq, "t": round(t, 6), "kind": kind}
+            if fields:
+                event.update(fields)
+            out.append(event)
+        return out
+
+    def to_dict(self, reason: str = "manual") -> dict:
+        """The full per-rank dump payload (watchdog stall, fatal signal,
+        atexit, or an explicit tool call)."""
+        now_wall, now_mono = time.time(), time.monotonic()
+        return {
+            "kind": "blackbox",
+            "reason": reason,
+            "rank": resolve_rank(),
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "time_unix": round(now_wall, 3),
+            "monotonic": round(now_mono, 6),
+            # wall = monotonic + (anchor_wall - anchor_monotonic): lets the
+            # postmortem place every event on the absolute timeline
+            "anchor_wall": round(self._anchor_wall, 3),
+            "anchor_monotonic": round(self._anchor_monotonic, 6),
+            "collective_seq": self._collective_seq,
+            "events_total": self._n,
+            "dropped": self.dropped,
+            "events": self.snapshot(),
+        }
+
+    def dump(self, dir_or_path: str, reason: str = "manual",
+             extra: Optional[dict] = None) -> Optional[str]:
+        """Write the per-rank JSON dump.  ``dir_or_path`` naming a directory
+        (or ending in a separator) gets the canonical ``blackbox_rank{N}.json``
+        filename appended.  Fail-soft: returns the path, or ``None`` on any
+        I/O error — a postmortem writer must never crash the job it is
+        documenting."""
+        try:
+            payload = self.to_dict(reason=reason)
+            if extra:
+                payload.update(extra)
+            path = dir_or_path
+            if path.endswith(os.sep) or os.path.isdir(path) or not path.endswith(".json"):
+                os.makedirs(path, exist_ok=True)
+                path = os.path.join(path, f"blackbox_rank{payload['rank']}.json")
+            else:
+                parent = os.path.dirname(path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None
+
+
+# the process-wide recorder: constructed eagerly (a few KB) so the very
+# first event — backend init, distributed rendezvous — is never lost
+_RECORDER = FlightRecorder(capacity=_env_capacity(), enabled=_env_enabled())
+
+
+def recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def record(kind: str, /, **fields) -> None:
+    """Module-level shortcut for producers: ``flightrec.record(...)``."""
+    _RECORDER.record(kind, **fields)
+
+
+def note_collective(op: str, /, **fields) -> int:
+    return _RECORDER.note_collective(op, **fields)

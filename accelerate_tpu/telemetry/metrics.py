@@ -207,6 +207,14 @@ def telemetry_metrics(telemetry) -> dict:
         out["device_samples_total"] = len(telemetry.device_records)
         if dev.mfu is not None:
             out["device_mfu"] = dev.mfu
+    # flight-recorder self-health (docs/telemetry.md §flight recorder):
+    # ring depth, drop count and staleness — an alert on
+    # atpu_telemetry_flightrec_last_event_age_seconds is the cheapest
+    # external hang detector there is.  _flatten drops the None age of a
+    # ring that has never recorded.
+    rec = getattr(telemetry, "flightrec", None)
+    if rec is not None:
+        out["flightrec"] = rec.health()
     return out
 
 

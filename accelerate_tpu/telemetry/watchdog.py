@@ -1,0 +1,267 @@
+"""Hang watchdog (docs/telemetry.md §watchdog) — default OFF.
+
+A background daemon thread that arms a wall-clock deadline around the
+process's *blocking* sections — host collectives (``utils/operations.py``
+guards every gather/broadcast/reduce) and blocking device syncs (the
+profiler's ``block_until_ready`` in ``capture.py``) — and, when a section
+overruns its deadline, dumps the postmortem: ``faulthandler`` stacks for
+every thread plus the flight-recorder ring (``telemetry/flightrec.py``) to
+a **per-rank** JSON file.  The same dump path fires on a fatal signal
+(SIGTERM/SIGABRT, chained to any previously-installed handler such as the
+resilience :class:`~..resilience.preemption.PreemptionGuard`) and at
+``atexit``, so a rank that dies *without* hanging still leaves its half of
+the cross-rank story for ``tools/blackbox_report.py`` (the atexit dump
+yields to an earlier stall/signal dump rather than overwriting it — the
+stalled rank's exit usually *follows* the stall).
+
+Two invariants, both load-bearing:
+
+* **The watchdog never issues a collective.**  It names the stalled
+  section; coordinating about the stall over the very mesh that is stalled
+  would deadlock the postmortem too.  This module is declared
+  rank-local-by-design to the graftlint taint pass (``analysis/taint.py``),
+  which asserts the no-collective contract statically.
+* **Zero overhead when off** (the telemetry package convention): nothing
+  here runs — no thread, no signal handlers — unless
+  ``TelemetryKwargs(watchdog_s=...)`` / ``$ACCELERATE_WATCHDOG_S`` armed
+  it, and the producer-side guard sites pay one module-attribute read plus
+  a ``None``-check.
+
+The dump itself is fail-soft (an unwritable dir yields a warning, never an
+exception) and firing does not kill the process: the stalled collective may
+yet complete (a transient network partition), and killing ranks is the
+fleet layer's decision, not the recorder's.
+"""
+
+from __future__ import annotations
+
+import atexit
+import faulthandler
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from contextlib import contextmanager
+from typing import Optional
+
+from ..logging import get_logger
+from . import flightrec
+
+logger = get_logger(__name__)
+
+# the armed watchdog (latest-wins, like telemetry's _ACTIVE slot); None when
+# the feature is off — every guard site gates on that None
+_ACTIVE: Optional["HangWatchdog"] = None
+
+
+def current_watchdog() -> Optional["HangWatchdog"]:
+    return _ACTIVE
+
+
+def _set_active(watchdog: Optional["HangWatchdog"]) -> None:
+    global _ACTIVE
+    _ACTIVE = watchdog
+
+
+def _thread_stacks() -> dict:
+    """Python stacks for every live thread, embeddable in the JSON dump
+    (the ``faulthandler`` text goes to a sidecar — its C-level dump cannot
+    be captured into a string without a pipe)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, '?')}:{ident}"
+        out[label] = traceback.format_stack(frame)
+    return out
+
+
+class HangWatchdog:
+    """Deadline-armed stall detector over the flight-recorder ring."""
+
+    def __init__(
+        self,
+        timeout_s: float,
+        dump_dir: str = "blackbox",
+        recorder: Optional[flightrec.FlightRecorder] = None,
+        poll_s: Optional[float] = None,
+        install_signal_handlers: bool = True,
+        dump_at_exit: bool = True,
+    ):
+        self.timeout_s = max(0.1, float(timeout_s))
+        self.dump_dir = dump_dir
+        self.recorder = recorder if recorder is not None else flightrec.recorder()
+        self.poll_s = poll_s if poll_s is not None else min(1.0, self.timeout_s / 4.0)
+        self._install_signals = bool(install_signal_handlers)
+        self._dump_at_exit = bool(dump_at_exit)
+        # the armed section: (label, deadline_monotonic) — written by the
+        # guarded thread, read by the watchdog thread; a tuple swap is
+        # atomic enough (torn reads are impossible, stale reads self-heal
+        # one poll later)
+        self._armed: Optional[tuple] = None
+        self._guard_depth = 0
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._prev_handlers: dict = {}
+        self._exit_hook = None
+        self.fired = 0
+        self.last_dump_path: Optional[str] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "HangWatchdog":
+        """Arm the watchdog: spawn the poll thread, install the fatal-signal
+        and atexit dump hooks, publish to the module slot."""
+        if self._thread is not None:
+            return self
+        displaced = _ACTIVE
+        if displaced is not None and displaced is not self:
+            displaced.stop()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="atpu-watchdog", daemon=True
+        )
+        self._thread.start()
+        if self._install_signals:
+            self._install_signal_dumps()
+        if self._dump_at_exit:
+            self._exit_hook = self._dump_at_exit_hook
+            atexit.register(self._exit_hook)
+        _set_active(self)
+        self.recorder.record("watchdog_armed", timeout_s=self.timeout_s)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2 * self.poll_s + 1.0)
+        for signum, prev in self._prev_handlers.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, TypeError):
+                pass
+        self._prev_handlers.clear()
+        if self._exit_hook is not None:
+            try:
+                atexit.unregister(self._exit_hook)
+            except Exception:
+                pass
+            self._exit_hook = None
+        if _ACTIVE is self:
+            _set_active(None)
+
+    # -- guard sites ---------------------------------------------------------
+    @contextmanager
+    def guard(self, label: str, timeout_s: Optional[float] = None):
+        """Arm the deadline around one blocking section.  Reentrant: nested
+        guards keep the OUTERMOST deadline (the outer section's budget
+        already covers its inner calls)."""
+        self.arm(label, timeout_s=timeout_s)
+        try:
+            yield
+        finally:
+            self.disarm()
+
+    def arm(self, label: str, timeout_s: Optional[float] = None) -> None:
+        with self._lock:
+            self._guard_depth += 1
+            if self._guard_depth == 1:
+                budget = self.timeout_s if timeout_s is None else float(timeout_s)
+                self._armed = (label, time.monotonic() + budget, time.monotonic())
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._guard_depth = max(0, self._guard_depth - 1)
+            if self._guard_depth == 0:
+                self._armed = None
+
+    # -- the poll thread -----------------------------------------------------
+    def _run(self) -> None:
+        fired_for = None  # the armed tuple a dump already described
+        while not self._stop.wait(self.poll_s):
+            armed = self._armed
+            if armed is None:
+                fired_for = None
+                continue
+            label, deadline, since = armed
+            if time.monotonic() < deadline or armed is fired_for:
+                continue
+            fired_for = armed
+            self.fired += 1
+            stalled_s = time.monotonic() - since
+            self.recorder.record(
+                "watchdog_stall", label=label, stalled_s=round(stalled_s, 3)
+            )
+            logger.error(
+                "watchdog: %r blocked for %.1fs (budget %.1fs) — dumping "
+                "flight ring + stacks to %s",
+                label, stalled_s, self.timeout_s, self.dump_dir,
+            )
+            self._dump("watchdog_stall", label=label, stalled_s=stalled_s)
+
+    # -- dumps ---------------------------------------------------------------
+    def _dump(self, reason: str, label: Optional[str] = None,
+              stalled_s: Optional[float] = None) -> Optional[str]:
+        """Write the per-rank postmortem (flight ring + thread stacks) and a
+        ``faulthandler`` sidecar.  Fail-soft, collective-free, callable from
+        the watchdog thread, a signal handler, or atexit."""
+        extra = {
+            "watchdog_timeout_s": self.timeout_s,
+            "watchdog_fired": self.fired,
+            "stalled_label": label,
+            "stalled_s": round(stalled_s, 3) if stalled_s is not None else None,
+            "threads": _thread_stacks(),
+        }
+        path = self.recorder.dump(self.dump_dir, reason=reason, extra=extra)
+        if path is None:
+            logger.warning("watchdog: blackbox dump to %r failed", self.dump_dir)
+            return None
+        self.last_dump_path = path
+        try:
+            with open(f"{path}.stacks.txt", "w", encoding="utf-8") as f:
+                faulthandler.dump_traceback(file=f, all_threads=True)
+        except Exception:
+            pass  # the JSON dump already carries the python-level stacks
+        return path
+
+    def dump_now(self, reason: str = "manual") -> Optional[str]:
+        return self._dump(reason)
+
+    def _dump_at_exit_hook(self) -> None:
+        # the atexit dump covers a rank that dies WITHOUT a stall or fatal
+        # signal; if a more specific dump already landed (the stalled rank's
+        # collective raising once a peer dies makes exit follow the stall),
+        # overwriting it with "atexit" would erase the postmortem
+        if self.last_dump_path is None:
+            self._dump("atexit")
+
+    # -- fatal-signal chaining -----------------------------------------------
+    def _install_signal_dumps(self) -> None:
+        """Dump-then-chain on fatal signals.  Chaining (rather than
+        replacing) composes with the resilience PreemptionGuard in either
+        install order: the dump is recorded, then the previous handler —
+        sticky-flag guard, user handler, or OS default — runs unchanged."""
+        for signum in (signal.SIGTERM, signal.SIGABRT):
+            try:
+                self._prev_handlers[signum] = signal.signal(
+                    signum, self._handle_signal
+                )
+            except ValueError:
+                # not the main thread: the atexit + watchdog dumps still
+                # cover the postmortem, so stay inert rather than fail
+                self._prev_handlers.clear()
+                return
+
+    def _handle_signal(self, signum, frame) -> None:
+        self.recorder.record("fatal_signal", signum=int(signum))
+        self._dump("signal")
+        prev = self._prev_handlers.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            # re-deliver with the default disposition restored so the
+            # process still dies with the right status
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)

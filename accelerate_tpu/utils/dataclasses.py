@@ -102,6 +102,19 @@ def env_int(name, default):
         return default
 
 
+def env_float(name, default):
+    """Float env knob with the same failure mode as :func:`env_int` (the
+    watchdog deadline is fractional-seconds-valued in tests)."""
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    try:
+        return float(value)
+    except ValueError:
+        warnings.warn(f"{name}={value!r} is not a number; ignoring")
+        return default
+
+
 # ---------------------------------------------------------------------------
 # Kwargs handlers (typed pass-throughs; reference dataclasses.py:62-551)
 # ---------------------------------------------------------------------------
@@ -201,6 +214,19 @@ class TelemetryKwargs(KwargsHandler):
     keeps the raw xprof dumps on disk instead of deleting them after
     parsing.  ``metrics_port`` (``$ACCELERATE_METRICS_PORT``; 0 = ephemeral
     port) serves live Prometheus text on ``/metrics``.
+
+    ``watchdog_s`` (``$ACCELERATE_WATCHDOG_S``; default off) arms the hang
+    watchdog (telemetry/watchdog.py): a background thread with that many
+    seconds of budget around every blocking collective/device sync, dumping
+    faulthandler stacks plus the flight-recorder ring to a per-rank JSON
+    under ``blackbox_dir`` (``$ACCELERATE_BLACKBOX_DIR``, default
+    ``blackbox/``) on stall, fatal signal, or exit.  The watchdog arms even
+    when ``enabled`` is off — hang forensics must not require the full
+    telemetry pipeline.  ``trace_export_path``
+    (``$ACCELERATE_TRACE_EXPORT``; default off) writes the joined
+    Chrome/Perfetto timeline (telemetry/trace_export.py) at
+    ``end_training``.  The flight recorder itself has no knob here: it is
+    on by default process-wide (``$ACCELERATE_FLIGHTREC=0`` kills it).
     """
 
     enabled: Optional[bool] = None  # None → $ACCELERATE_TELEMETRY, default off
@@ -212,6 +238,9 @@ class TelemetryKwargs(KwargsHandler):
     profile_every_n: Optional[int] = None  # None → env, default 0 (off)
     profile_dir: Optional[str] = None
     metrics_port: Optional[int] = None  # None → env, default no endpoint
+    watchdog_s: Optional[float] = None  # None → env, default off
+    blackbox_dir: Optional[str] = None  # None → env, default "blackbox"
+    trace_export_path: Optional[str] = None  # None → env, default off
 
     def __post_init__(self):
         if self.enabled is None:
@@ -227,6 +256,12 @@ class TelemetryKwargs(KwargsHandler):
             self.profile_dir = os.environ.get("ACCELERATE_TELEMETRY_PROFILE_DIR")
         if self.metrics_port is None:
             self.metrics_port = self._env_int("ACCELERATE_METRICS_PORT", None)
+        if self.watchdog_s is None:
+            self.watchdog_s = env_float("ACCELERATE_WATCHDOG_S", None)
+        if self.blackbox_dir is None:
+            self.blackbox_dir = os.environ.get("ACCELERATE_BLACKBOX_DIR", "blackbox")
+        if self.trace_export_path is None:
+            self.trace_export_path = os.environ.get("ACCELERATE_TRACE_EXPORT")
 
     @staticmethod
     def _env_int(name, default):

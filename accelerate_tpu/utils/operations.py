@@ -19,6 +19,7 @@ and Python scalars.
 from __future__ import annotations
 
 import pickle
+from contextlib import contextmanager
 from functools import wraps
 from typing import Any, Callable, Mapping, Optional
 
@@ -220,6 +221,28 @@ def _num_processes() -> int:
     return jax.process_count()
 
 
+@contextmanager
+def _blackbox(op: str):
+    """Black-box instrumentation around one *multi-process* host collective
+    (docs/telemetry.md §flight recorder): tick the flight recorder's
+    collective-sequence counter — the cross-rank alignment key every rank
+    must advance identically, which is how ``tools/blackbox_report.py``
+    names the lagging rank after a hang — and, when the hang watchdog is
+    armed, put the blocking section on its deadline.  Single-process calls
+    short-circuit before reaching this, so the unsynchronized path pays
+    nothing and the sequence counts exactly the real collectives."""
+    from ..telemetry import flightrec
+    from ..telemetry import watchdog as _watchdog
+
+    seq = flightrec.note_collective(op, world=_num_processes())
+    wd = _watchdog.current_watchdog()
+    if wd is None:
+        yield
+        return
+    with wd.guard(f"collective:{op} #{seq}"):
+        yield
+
+
 def verify_operation(function: Callable):
     """Debug-mode shape verification before a collective (reference :364).
 
@@ -270,7 +293,10 @@ def gather(tensor):
 
         return multihost_utils.process_allgather(np.asarray(t), tiled=True)
 
-    return recursively_apply(_gather, tensor, error_on_other_type=True)
+    if _num_processes() == 1:
+        return recursively_apply(_gather, tensor, error_on_other_type=True)
+    with _blackbox("gather"):
+        return recursively_apply(_gather, tensor, error_on_other_type=True)
 
 
 def gather_object(object: Any):
@@ -284,18 +310,19 @@ def gather_object(object: Any):
         return object
     from jax.experimental import multihost_utils
 
-    payload = np.frombuffer(pickle.dumps(object), dtype=np.uint8)
-    size = np.array([payload.size], dtype=np.int64)
-    all_sizes = multihost_utils.process_allgather(size)
-    max_size = int(all_sizes.max())
-    padded = np.zeros(max_size, dtype=np.uint8)
-    padded[: payload.size] = payload
-    gathered = multihost_utils.process_allgather(padded)
-    per_process = [
-        pickle.loads(gathered[i, : int(all_sizes[i, 0])].tobytes())
-        for i in range(gathered.shape[0])
-    ]
-    return [x for y in per_process for x in y]
+    with _blackbox("gather_object"):
+        payload = np.frombuffer(pickle.dumps(object), dtype=np.uint8)
+        size = np.array([payload.size], dtype=np.int64)
+        all_sizes = multihost_utils.process_allgather(size)
+        max_size = int(all_sizes.max())
+        padded = np.zeros(max_size, dtype=np.uint8)
+        padded[: payload.size] = payload
+        gathered = multihost_utils.process_allgather(padded)
+        per_process = [
+            pickle.loads(gathered[i, : int(all_sizes[i, 0])].tobytes())
+            for i in range(gathered.shape[0])
+        ]
+        return [x for y in per_process for x in y]
 
 
 @verify_operation
@@ -311,7 +338,10 @@ def broadcast(tensor, from_process: int = 0):
             np.asarray(jax.device_get(t)), is_source=jax.process_index() == from_process
         )
 
-    return recursively_apply(_broadcast, tensor, error_on_other_type=True)
+    if _num_processes() == 1:
+        return recursively_apply(_broadcast, tensor, error_on_other_type=True)
+    with _blackbox("broadcast"):
+        return recursively_apply(_broadcast, tensor, error_on_other_type=True)
 
 
 def broadcast_object_list(object_list: list, from_process: int = 0):
@@ -329,16 +359,17 @@ def broadcast_object_list(object_list: list, from_process: int = 0):
     from jax.experimental import multihost_utils
 
     is_source = jax.process_index() == from_process
-    if is_source:
-        payload = np.frombuffer(pickle.dumps(list(object_list)), dtype=np.uint8)
-    else:
-        payload = np.zeros(0, dtype=np.uint8)
-    size = multihost_utils.broadcast_one_to_all(
-        np.array([payload.size], dtype=np.int64), is_source=is_source
-    )
-    buf = payload if is_source else np.zeros(int(size[0]), dtype=np.uint8)
-    data = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
-    src = pickle.loads(np.asarray(data).tobytes())
+    with _blackbox("broadcast_object_list"):
+        if is_source:
+            payload = np.frombuffer(pickle.dumps(list(object_list)), dtype=np.uint8)
+        else:
+            payload = np.zeros(0, dtype=np.uint8)
+        size = multihost_utils.broadcast_one_to_all(
+            np.array([payload.size], dtype=np.int64), is_source=is_source
+        )
+        buf = payload if is_source else np.zeros(int(size[0]), dtype=np.uint8)
+        data = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+        src = pickle.loads(np.asarray(data).tobytes())
     for i in range(len(object_list)):
         object_list[i] = src[i]
     return object_list
@@ -360,7 +391,10 @@ def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
             out = out / _num_processes()
         return jnp.asarray(out)
 
-    return recursively_apply(_reduce, tensor, error_on_other_type=True)
+    if _num_processes() == 1:
+        return recursively_apply(_reduce, tensor, error_on_other_type=True)
+    with _blackbox("reduce"):
+        return recursively_apply(_reduce, tensor, error_on_other_type=True)
 
 
 @verify_operation

@@ -519,6 +519,89 @@ def _compression_ab_block(on_accel: bool) -> dict:
     return out
 
 
+def _flightrec_ab_block(on_accel: bool) -> dict:
+    """Flight-recorder overhead A/B for the primary row (docs/telemetry.md):
+    the SAME GPT geometry stepped with the always-on black-box flight
+    recorder enabled (the default) vs force-disabled, reporting both
+    ``step_ms`` rows and the relative overhead.  The recorder ships
+    ON by default, so this row is the standing proof the ring's two
+    lock-guarded dict writes per step stay inside the <=1%% budget.
+
+    The recorder is pinned per CapturedStep at construction, so each arm
+    flips ``flightrec.recorder().enabled`` BEFORE ``compile_step`` and a
+    fresh Accelerator; the flag is restored afterwards regardless.
+    ``BENCH_FLIGHTREC=0`` disables the block."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import accelerate_tpu.nn as nn
+    import accelerate_tpu.optim as optim
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import batch_to_global_array
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+    from accelerate_tpu.telemetry import flightrec
+
+    out: dict = {}
+    cfg = GPTConfig.small() if on_accel else GPTConfig.tiny()
+    batch, seq, steps = (BATCH, SEQ, 20) if on_accel else (4, 128, 25)
+    rec = flightrec.recorder()
+    prior_enabled = rec.enabled
+    try:
+        Accelerator._reset_state()
+        nn.manual_seed(0)
+        acc = Accelerator(mixed_precision="bf16")
+        model = GPTLMHeadModel(cfg)
+        opt = optim.AdamW(model.parameters(), lr=3e-4, weight_decay=0.1)
+        model, opt = acc.prepare(model, opt)
+
+        def step_fn(ids):
+            opt.zero_grad()
+            loss_out = model(ids, labels=ids)
+            acc.backward(loss_out["loss"])
+            opt.step()
+            return loss_out["loss"]
+
+        # the recorder is pinned per CapturedStep at construction, so two
+        # replays of the SAME program — one instrumented, one not — coexist
+        # in one session and can be timed in alternating windows: interleaving
+        # cancels the slow thermal/scheduler drift that dwarfs the ring's
+        # two dict writes per step, and the min window per arm drops the noise
+        rec.enabled = True
+        step_on = acc.compile_step(step_fn)
+        rec.enabled = False
+        step_off = acc.compile_step(step_fn)
+        rng = np.random.default_rng(0)
+        batches = [
+            batch_to_global_array(
+                jnp.asarray(
+                    rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32
+                ),
+                mesh=acc.mesh,
+            )
+            for _ in range(4)
+        ]
+        warmup = WARMUP if on_accel else 2
+        best = {"on": None, "off": None}
+        final_loss = None
+        for _ in range(4):
+            for arm, step in (("on", step_on), ("off", step_off)):
+                _, dt, final_loss, _, _ = _timed_steps(
+                    step, batches, steps, warmup
+                )
+                if best[arm] is None or dt < best[arm]:
+                    best[arm] = dt
+        for arm, dt in best.items():
+            out[f"flightrec_{arm}_step_ms"] = round(dt / steps * 1e3, 3)
+        out["flightrec_final_loss"] = round(final_loss, 3)
+    finally:
+        rec.enabled = prior_enabled
+    on_ms = out.get("flightrec_on_step_ms")
+    off_ms = out.get("flightrec_off_step_ms")
+    if on_ms and off_ms:
+        out["flightrec_overhead_pct"] = round((on_ms - off_ms) / off_ms * 100, 2)
+    return out
+
+
 def _aot_cache_block(on_accel: bool) -> dict:
     """Cold/warm AOT-executable-cache A/B for the primary row
     (docs/aot_cache.md): the SAME GPT step built twice against one cache
@@ -1508,6 +1591,14 @@ def main() -> None:
             result.update(_compression_ab_block(on_accel))
         except Exception as exc:
             result["compression_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    if os.environ.get("BENCH_FLIGHTREC", "1") != "0":
+        # always-on flight-recorder overhead A/B (docs/telemetry.md): the
+        # same geometry with the ring enabled (default) vs disabled — the
+        # standing proof the recorder stays inside its <=1% budget; fail-soft
+        try:
+            result.update(_flightrec_ab_block(on_accel))
+        except Exception as exc:
+            result["flightrec_ab_error"] = f"{type(exc).__name__}: {exc}"[:300]
     if os.environ.get("BENCH_AOT_CACHE", "1") != "0":
         # zero-cold-start A/B (docs/aot_cache.md): cold vs warm first-step
         # latency against a fresh cache dir — fail-soft like the extras;

@@ -3056,6 +3056,73 @@ def test_divergence_early_raise_before_collective(tmp_path):
     assert "raise" in res.new_findings[0].message
 
 
+def test_rank_local_watchdog_module_waives_divergence_scan(tmp_path):
+    """telemetry/{flightrec,watchdog,trace_export}.py are rank-local by
+    design (taint.RANK_LOCAL_MODULE_SUFFIXES): rank probes, per-rank dump
+    files and divergent early exits ARE the point of a postmortem writer,
+    so the divergence scan is waived for them."""
+    res = lint_pkg(
+        tmp_path,
+        {
+            "telemetry/watchdog.py": """
+                import json
+
+                def dump(state, events, path):
+                    if state.process_index != 0:
+                        path = f"{path}.rank{state.process_index}"
+                    if not events:
+                        return None
+                    with open(path, "w") as f:
+                        json.dump({"rank": state.process_index}, f)
+                    return path
+                """,
+        },
+        rule="collective-divergence",
+    )
+    assert res.new_findings == [], [f.render() for f in res.new_findings]
+
+
+def test_rank_local_module_must_not_bear_a_collective(tmp_path):
+    """The exemption's inverted contract: ANY collective in a rank-local-by-
+    design module fires — even an unconditional one the divergence scan
+    would never flag.  The postmortem path may run while the mesh is
+    deadlocked; coordinating over the stalled mesh hangs the postmortem."""
+    source = """
+        def dump(state):
+            state.wait_for_everyone()
+            return state.process_index
+        """
+    exempt = lint_pkg(
+        tmp_path / "exempt",
+        {"telemetry/watchdog.py": source},
+        rule="collective-divergence",
+    )
+    assert len(exempt.new_findings) == 1, [
+        f.render() for f in exempt.new_findings
+    ]
+    assert "rank-local-by-design" in exempt.new_findings[0].message
+    # the same unconditional collective is fine in an ordinary module: the
+    # contract is inverted only where the divergence scan is waived
+    plain = lint_pkg(
+        tmp_path / "plain", {"sync.py": source}, rule="collective-divergence"
+    )
+    assert plain.new_findings == [], [f.render() for f in plain.new_findings]
+
+
+def test_rank_local_suffix_list_pins_the_postmortem_modules():
+    from accelerate_tpu.analysis.taint import rank_local_by_design
+
+    assert rank_local_by_design("accelerate_tpu/telemetry/watchdog.py")
+    assert rank_local_by_design("accelerate_tpu/telemetry/flightrec.py")
+    assert rank_local_by_design("accelerate_tpu/telemetry/trace_export.py")
+    assert rank_local_by_design("telemetry\\watchdog.py")  # windows seps
+    # the exemption stays narrow: the rest of telemetry (and everything
+    # else) keeps the full divergence scan
+    assert not rank_local_by_design("accelerate_tpu/telemetry/__init__.py")
+    assert not rank_local_by_design("accelerate_tpu/telemetry/metrics.py")
+    assert not rank_local_by_design("accelerate_tpu/capture.py")
+
+
 def test_package_suppressions_are_load_bearing():
     """The two in-tree suppressions (logging in_order overtaint, dispatcher
     handshake protocol) must each cover a finding the rule still detects:

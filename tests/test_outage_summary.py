@@ -248,3 +248,78 @@ def test_cli_telemetry_jsonl_join(tmp_path):
     assert human.returncode == 0, human.stderr
     assert "shrink(host_lost) fired dp 4->2" in human.stdout
     assert "suppressed" in human.stdout and "debounce" in human.stdout
+
+
+# --------------------------------------------------------- --blackbox join
+from outage_summary import join_blackbox, load_blackbox_dumps  # noqa: E402
+
+
+def _write_blackbox(tmp_path, rank, time_unix=None, reason="watchdog_stall",
+                    seq=3, subdir="blackbox"):
+    d = tmp_path / subdir
+    d.mkdir(exist_ok=True)
+    payload = {"kind": "blackbox", "reason": reason, "rank": rank,
+               "collective_seq": seq, "events": []}
+    if time_unix is not None:
+        payload["time_unix"] = time_unix
+    path = d / f"blackbox_rank{rank}.json"
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+def test_blackbox_join_places_dumps_on_the_outage_timeline(tmp_path):
+    windows = down_windows(parse_log(_write(tmp_path)))
+    _write_blackbox(tmp_path, 0, time_unix=2000)  # inside DOWN 1600→2500
+    _write_blackbox(tmp_path, 1, time_unix=2600, reason="signal")  # outside
+    dumps = load_blackbox_dumps(str(tmp_path / "blackbox"))
+    assert len(dumps) == 2
+    joined = join_blackbox("blackbox", dumps, windows)
+    assert joined["in_down_windows"] == 1
+    by_rank = {d["rank"]: d for d in joined["dumps"]}
+    assert by_rank[0]["in_down_window"] is True
+    assert by_rank[0]["down_window"] == {"start": 1600, "end": 2500,
+                                         "seconds": 900}
+    assert by_rank[0]["reason"] == "watchdog_stall"
+    assert by_rank[0]["collective_seq"] == 3
+    assert by_rank[1]["in_down_window"] is False
+
+
+def test_blackbox_join_without_timestamp_reports_unknown(tmp_path):
+    windows = down_windows(parse_log(_write(tmp_path)))
+    _write_blackbox(tmp_path, 0)  # no time_unix: overlap unknowable
+    dumps = load_blackbox_dumps(str(tmp_path / "blackbox"))
+    joined = join_blackbox("blackbox", dumps, windows)
+    assert joined["dumps"][0]["in_down_window"] is None
+    assert joined["in_down_windows"] == 0  # unknown is not counted as inside
+
+
+def test_cli_blackbox_join(tmp_path):
+    log = _write(tmp_path)
+    _write_blackbox(tmp_path, 0, time_unix=3000)  # inside DOWN 2800→3400
+    _write_blackbox(tmp_path, 1, time_unix=2600, reason="signal")
+    blackbox_dir = str(tmp_path / "blackbox")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "outage_summary.py"),
+         "--json", log, "--blackbox", blackbox_dir],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    (joined,) = payload["blackbox_join"]
+    assert joined["in_down_windows"] == 1
+    by_rank = {d["rank"]: d for d in joined["dumps"]}
+    assert by_rank[0]["down_window"] == {"start": 2800, "end": 3400,
+                                         "seconds": 600}
+    # human rendering names the rank, reason, seq and the verdict
+    human = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "outage_summary.py"),
+         log, "--blackbox", blackbox_dir],
+        capture_output=True,
+        text=True,
+    )
+    assert human.returncode == 0, human.stderr
+    assert "rank 0 (watchdog_stall, seq=3)" in human.stdout
+    assert "inside DOWN" in human.stdout
+    assert "rank 1 (signal, seq=3)" in human.stdout
+    assert "NOT inside any observed DOWN window" in human.stdout

@@ -94,6 +94,36 @@ def test_injector_dispatch_fault_fires_exactly_times():
     inj.maybe_dispatch_fault(1)  # times exhausted: clean
 
 
+def test_fault_plan_parses_hang_directive():
+    plan = FaultPlan.parse("hang:step=2,seconds=7")
+    (d,) = plan.directives
+    assert (d.kind, d.step, d.seconds) == ("hang", 2, 7)
+    # seconds defaults to effectively-forever (the watchdog is the way out)
+    assert FaultPlan.parse("hang:step=1").directives[0].seconds == 3600
+    with pytest.raises(ValueError):
+        FaultPlan.parse("hang:times=2")  # hang requires an anchor step
+    with pytest.raises(ValueError):
+        FaultPlan.parse("dispatch:step=1,seconds=5")  # seconds is hang-only
+
+
+def test_injector_hang_sleeps_and_records_flight_event(monkeypatch):
+    from accelerate_tpu.telemetry import flightrec
+    from accelerate_tpu.telemetry.flightrec import FlightRecorder
+
+    fresh = FlightRecorder(capacity=32)
+    monkeypatch.setattr(flightrec, "_RECORDER", fresh)
+    naps = []
+    monkeypatch.setattr("time.sleep", lambda s: naps.append(s))
+    inj = FaultInjector(FaultPlan.parse("hang:step=2,seconds=5"))
+    assert inj.maybe_hang(0) is False and naps == []
+    assert inj.maybe_hang(2) is True
+    assert naps == [5]
+    assert inj.maybe_hang(2) is False  # times exhausted: one hang only
+    events = [e for e in fresh.snapshot() if e["kind"] == "hang_injected"]
+    assert len(events) == 1
+    assert events[0]["step"] == 2 and events[0]["seconds"] == 5
+
+
 # ---------------------------------------------------------------------------
 # pillar 1: hardened backend init
 # ---------------------------------------------------------------------------

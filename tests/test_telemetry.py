@@ -478,3 +478,363 @@ def test_bridge_attach_backfills_pre_attach_records(tmp_path):
     # both the pre-attach step and the post-attach recompile were exported
     assert "telemetry/step/total_ms" in keys
     assert "telemetry/recompile/cause" in keys
+
+
+# ---------------------------------------------------------------------------
+# pillar 5: black-box flight recorder (always-on) + hang watchdog
+# ---------------------------------------------------------------------------
+
+import signal
+import time
+
+from accelerate_tpu.telemetry import flightrec
+from accelerate_tpu.telemetry.flightrec import FlightRecorder
+from accelerate_tpu.telemetry.watchdog import HangWatchdog, current_watchdog
+
+
+def test_flightrec_ring_wraps_and_counts_drops():
+    rec = FlightRecorder(capacity=16)
+    for i in range(40):
+        rec.record("tick", i=i)
+    assert rec.events_total == 40
+    assert rec.depth == 16
+    assert rec.dropped == 24
+    events = rec.snapshot()
+    # oldest retained first; exactly the last `capacity` survive the wrap
+    assert [e["seq"] for e in events] == list(range(24, 40))
+    assert [e["i"] for e in events] == list(range(24, 40))
+    health = rec.health()
+    assert health["events_total"] == 40
+    assert health["dropped_total"] == 24
+    assert health["depth"] == 16
+    assert health["last_event_age_seconds"] >= 0.0
+
+
+def test_flightrec_collective_seq_and_dump_roundtrip(tmp_path):
+    rec = FlightRecorder(capacity=64)
+    assert rec.health()["last_event_age_seconds"] is None  # nothing yet
+    assert [rec.note_collective("gather_object", world=2) for _ in range(3)] \
+        == [1, 2, 3]
+    rec.record("step_begin", step=0)
+    path = rec.dump(str(tmp_path), reason="manual", extra={"note": "hi"})
+    assert path is not None and os.path.basename(path).startswith("blackbox_rank")
+    dump = json.load(open(path, encoding="utf-8"))
+    assert dump["kind"] == "blackbox"
+    assert dump["reason"] == "manual"
+    assert dump["collective_seq"] == 3
+    assert dump["note"] == "hi"
+    collectives = [e for e in dump["events"] if e["kind"] == "collective"]
+    assert [e["cseq"] for e in collectives] == [1, 2, 3]
+    assert all(e["op"] == "gather_object" for e in collectives)
+    # the wall anchor lets tools place monotonic stamps on absolute time
+    assert dump["anchor_wall"] > 0 and dump["time_unix"] > 0
+    # an explicit .json path is honored verbatim (no rank suffix appended)
+    explicit = rec.dump(str(tmp_path / "sub" / "my.json"), reason="manual")
+    assert explicit is not None and explicit.endswith("my.json")
+    assert json.load(open(explicit))["events_total"] == rec.events_total
+
+
+def test_flightrec_disabled_is_noop():
+    rec = FlightRecorder(capacity=32, enabled=False)
+    rec.record("tick")
+    assert rec.note_collective("gather") == 0  # seq untouched
+    assert rec.events_total == 0 and rec.depth == 0
+    assert rec.snapshot() == []
+
+
+def test_flightrec_shields_slot_schema_keys_from_payload_passthrough():
+    # producers mirror whole payload dicts (``**payload``) into the ring;
+    # payload keys named like the slot schema (fleet autopilot decisions
+    # carry their own "kind") must neither raise nor clobber the schema
+    rec = FlightRecorder(capacity=32)
+    rec.record("fleet", **{"kind": "skew", "t": 9.9, "seq": 7, "event": "x"})
+    got = rec.note_collective("gather", **{"op": "inner", "cseq": 99, "kind": "y"})
+    assert got == 1
+    ev, coll = rec.snapshot()
+    assert ev["kind"] == "fleet" and ev["seq"] == 0
+    assert (ev["field_kind"], ev["field_t"], ev["field_seq"]) == ("skew", 9.9, 7)
+    assert coll["kind"] == "collective" and coll["op"] == "gather"
+    assert coll["cseq"] == 1
+    assert (coll["field_op"], coll["field_cseq"]) == ("inner", 99)
+
+
+def test_captured_step_records_flight_events_without_telemetry(monkeypatch):
+    """The recorder is the default-off convention's one exception: with
+    telemetry fully off, captured-step begin/end still lands in the ring
+    (with a locally-maintained step index)."""
+    fresh = FlightRecorder(capacity=64)
+    monkeypatch.setattr(flightrec, "_RECORDER", fresh)
+    nn.manual_seed(0)
+    acc = Accelerator()  # telemetry off
+    model = GPTLMHeadModel(_tiny_cfg())
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    assert step._telemetry is None
+    batch = _batch(acc)
+    for _ in range(3):
+        step(batch)
+    kinds = [(e["kind"], e.get("step")) for e in fresh.snapshot()
+             if e["kind"] in ("step_begin", "step_end")]
+    assert kinds == [
+        ("step_begin", 0), ("step_end", 0),
+        ("step_begin", 1), ("step_end", 1),
+        ("step_begin", 2), ("step_end", 2),
+    ]
+
+
+def test_captured_step_skips_ring_when_recorder_disabled(monkeypatch):
+    """The bench A/B "off" arm: a recorder disabled BEFORE compile_step is
+    never consulted again on the hot path (pinned None at construction)."""
+    fresh = FlightRecorder(capacity=64, enabled=False)
+    monkeypatch.setattr(flightrec, "_RECORDER", fresh)
+    nn.manual_seed(0)
+    acc = Accelerator()
+    model = GPTLMHeadModel(_tiny_cfg())
+    opt = optim.AdamW(model.parameters(), lr=1e-3)
+    model, opt = acc.prepare(model, opt)
+
+    def step_fn(ids):
+        opt.zero_grad()
+        out = model(ids, labels=ids)
+        acc.backward(out["loss"])
+        opt.step()
+        return out["loss"]
+
+    step = acc.compile_step(step_fn)
+    assert step._flightrec is None
+    step(_batch(acc))
+    fresh.enabled = True  # re-enabling later does not reach the pinned step
+    step(_batch(acc))
+    assert all(e["kind"] != "step_begin" for e in fresh.snapshot())
+
+
+def _test_watchdog(tmp_path, **kwargs):
+    rec = FlightRecorder(capacity=128)
+    wd = HangWatchdog(
+        timeout_s=kwargs.pop("timeout_s", 0.3),
+        dump_dir=str(tmp_path),
+        recorder=rec,
+        poll_s=0.05,
+        install_signal_handlers=kwargs.pop("install_signal_handlers", False),
+        dump_at_exit=kwargs.pop("dump_at_exit", False),
+        **kwargs,
+    )
+    return rec, wd
+
+
+def test_watchdog_fires_on_stall_and_dump_is_valid(tmp_path):
+    rec, wd = _test_watchdog(tmp_path)
+    wd.start()
+    try:
+        assert current_watchdog() is wd
+        rec.note_collective("gather_object")
+        with wd.guard("collective:gather_object #1"):
+            # the "hung" section: wait on the dump path (set AFTER the poll
+            # thread finishes writing), not the fired counter (set before)
+            deadline = time.monotonic() + 10.0
+            while wd.last_dump_path is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+        assert wd.fired >= 1
+        assert wd.last_dump_path is not None
+        dump = json.load(open(wd.last_dump_path, encoding="utf-8"))
+        assert dump["reason"] == "watchdog_stall"
+        assert dump["stalled_label"] == "collective:gather_object #1"
+        assert dump["stalled_s"] >= 0.3
+        assert dump["collective_seq"] == 1
+        assert dump["threads"]  # python stacks for every live thread
+        assert any(e["kind"] == "watchdog_stall" for e in dump["events"])
+        assert os.path.exists(f"{wd.last_dump_path}.stacks.txt")  # sidecar
+    finally:
+        wd.stop()
+    assert current_watchdog() is None
+
+
+def test_watchdog_fires_once_per_armed_section(tmp_path):
+    rec, wd = _test_watchdog(tmp_path)
+    wd.start()
+    try:
+        with wd.guard("slow"):
+            deadline = time.monotonic() + 10.0
+            while wd.fired == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            time.sleep(0.5)  # well past a second deadline: must NOT re-fire
+        assert wd.fired == 1
+        # a fresh armed section can fire again
+        with wd.guard("slow again"):
+            deadline = time.monotonic() + 10.0
+            while wd.fired == 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+        assert wd.fired == 2
+    finally:
+        wd.stop()
+
+
+def test_watchdog_nested_guard_keeps_outermost_deadline(tmp_path):
+    _, wd = _test_watchdog(tmp_path, timeout_s=30.0)
+    with wd.guard("outer"):
+        with wd.guard("inner", timeout_s=0.01):
+            label, deadline, _ = wd._armed
+            assert label == "outer"  # inner arm did not displace the outer
+            assert deadline > time.monotonic() + 10
+        assert wd._armed is not None  # still armed until the outer exits
+    assert wd._armed is None
+
+
+def test_watchdog_stop_restores_signal_handlers_and_slot(tmp_path):
+    prev_term = signal.getsignal(signal.SIGTERM)
+    prev_abrt = signal.getsignal(signal.SIGABRT)
+    rec, wd = _test_watchdog(tmp_path, install_signal_handlers=True)
+    wd.start()
+    assert signal.getsignal(signal.SIGTERM) == wd._handle_signal
+    assert signal.getsignal(signal.SIGABRT) == wd._handle_signal
+    wd.stop()
+    assert signal.getsignal(signal.SIGTERM) is prev_term
+    assert signal.getsignal(signal.SIGABRT) is prev_abrt
+    assert current_watchdog() is None
+    # manual dumps work without the thread (the preemption-guard hook path)
+    path = wd.dump_now(reason="preemption_signal")
+    assert json.load(open(path))["reason"] == "preemption_signal"
+
+
+def test_watchdog_atexit_dump_yields_to_earlier_stall_dump(tmp_path):
+    # the stalled rank usually EXITS after the stall (its collective raises
+    # once a peer dies): the atexit dump must not overwrite the stall dump
+    rec, wd = _test_watchdog(tmp_path, dump_at_exit=True)
+    wd.start()
+    try:
+        assert wd._exit_hook is not None
+        rec.note_collective("gather_object")
+        with wd.guard("collective:gather_object #1"):
+            deadline = time.monotonic() + 10.0
+            while wd.last_dump_path is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+        assert wd.last_dump_path is not None
+        wd._exit_hook()  # what atexit would run at interpreter shutdown
+        dump = json.load(open(wd.last_dump_path, encoding="utf-8"))
+        assert dump["reason"] == "watchdog_stall"
+    finally:
+        wd.stop()
+
+    # a rank that dies without ever stalling still leaves its half
+    rec2, wd2 = _test_watchdog(tmp_path / "clean", dump_at_exit=True)
+    wd2.start()
+    try:
+        rec2.note_collective("broadcast")
+        wd2._exit_hook()
+        assert wd2.last_dump_path is not None
+        dump = json.load(open(wd2.last_dump_path, encoding="utf-8"))
+        assert dump["reason"] == "atexit"
+    finally:
+        wd2.stop()
+
+
+def test_watchdog_start_displaces_prior_instance(tmp_path):
+    _, first = _test_watchdog(tmp_path)
+    _, second = _test_watchdog(tmp_path)
+    first.start()
+    try:
+        second.start()
+        assert current_watchdog() is second
+        assert first._thread is None  # stopped, not leaked
+    finally:
+        second.stop()
+        first.stop()
+
+
+def test_trace_export_writes_joinable_tracks(tmp_path, monkeypatch):
+    from accelerate_tpu.telemetry.trace_export import validate_trace
+
+    # fresh ring: the process-global recorder carries earlier tests' steps
+    monkeypatch.setattr(flightrec, "_RECORDER", FlightRecorder(capacity=256))
+    trace_path = str(tmp_path / "trace.json")
+    acc, _, step = _make_step(profile_every_n=1, trace_export_path=trace_path)
+    for _ in range(2):
+        step(_batch(acc))
+    acc.end_training()
+    doc = json.load(open(trace_path, encoding="utf-8"))
+    assert validate_trace(doc) == []
+    by_tid = {}
+    for ev in doc["traceEvents"]:
+        step_arg = (ev.get("args") or {}).get("step")
+        if step_arg is not None:
+            by_tid.setdefault(ev["tid"], set()).add(step_arg)
+    # host phases (1), device ops (2) and flight events (3) share the steps
+    assert by_tid.get(1) == by_tid.get(2) == by_tid.get(3) == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# pillar 6 edge cases: fleet aggregation on degenerate per-rank shapes
+# ---------------------------------------------------------------------------
+
+from accelerate_tpu.telemetry.aggregate import fleet_skew, merge_rank_records
+
+
+def _replay(total_ms, dispatch_ms=0.0, **extra):
+    return {"kind": "step", "built": False, "total_ms": total_ms,
+            "dispatch_ms": dispatch_ms, **extra}
+
+
+def test_fleet_skew_single_rank_reports_without_comparing():
+    out = fleet_skew([[_replay(10.0), _replay(12.0)]])
+    assert out["kind"] == "fleet" and out["ranks"] == 1
+    assert out["per_rank"][0]["replay_steps"] == 2
+    assert out["per_rank"][0]["replay_total_ms_mean"] == 11.0
+    # a one-rank fleet has no skew pair to compare
+    assert "slowest_rank" not in out and "skew_ms" not in out
+
+
+def test_fleet_skew_empty_and_ragged_inputs():
+    assert fleet_skew([]) == {"kind": "fleet", "ranks": 0, "per_rank": []}
+    # ragged: one rank with replays, one empty, one with only builds /
+    # malformed records — none of it may crash or fabricate a comparison
+    ragged = [
+        [_replay(10.0)],
+        [],
+        [{"kind": "step", "built": True, "total_ms": 9.0},
+         {"kind": "step", "built": False, "total_ms": None},
+         {"kind": "recompile"}],
+    ]
+    out = fleet_skew(ragged)
+    assert [s["replay_steps"] for s in out["per_rank"]] == [1, 0, 0]
+    assert "slowest_rank" not in out  # only one usable rank
+
+
+def test_fleet_skew_names_straggler_and_phase():
+    per_rank = [
+        [_replay(10.0, dispatch_ms=8.0)],
+        [_replay(30.0, dispatch_ms=27.0)],
+    ]
+    out = fleet_skew(per_rank)
+    assert out["slowest_rank"] == 1 and out["fastest_rank"] == 0
+    assert out["skew_ms"] == 20.0 and out["skew_pct"] == 200.0
+    assert out["straggler_phase"] == "dispatch_ms"
+    assert out["straggler_phase_delta_ms"] == 19.0
+
+
+def test_merge_rank_records_tags_without_mutating_and_dedups_periodic():
+    rank0 = [_replay(10.0), {"kind": "fleet", "periodic": True, "ranks": 2}]
+    rank1 = [_replay(11.0), {"kind": "fleet", "periodic": True, "ranks": 2}]
+    originals = [dict(r) for r in rank0]
+    merged = merge_rank_records([rank0, rank1])
+    assert rank0 == originals  # inputs untouched
+    # rank-tagged copies; rank 1's periodic fleet duplicate dropped
+    fleet_periodic = [r for r in merged if r.get("periodic")]
+    assert len(fleet_periodic) == 1 and fleet_periodic[0]["rank"] == 0
+    steps = [(r["rank"], r["total_ms"]) for r in merged if r["kind"] == "step"]
+    assert steps == [(0, 10.0), (1, 11.0)]
+    # the appended summary record is the fleet_skew of the same inputs
+    assert merged[-1]["kind"] == "fleet" and merged[-1]["ranks"] == 2
+
+
+def test_merge_rank_records_empty_world():
+    merged = merge_rank_records([])
+    assert merged == [{"kind": "fleet", "ranks": 0, "per_rank": []}]

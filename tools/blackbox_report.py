@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""blackbox_report — merge per-rank flight-recorder dumps into one postmortem.
+
+    python tools/blackbox_report.py blackbox/
+    python tools/blackbox_report.py --json blackbox/blackbox_rank*.json
+
+Input: the ``blackbox_rank{N}.json`` dumps the hang watchdog
+(``accelerate_tpu/telemetry/watchdog.py``) writes on a stall deadline,
+fatal signal, or atexit — each carries the rank's flight-event ring and its
+**collective-sequence counter** (``accelerate_tpu/telemetry/flightrec.py``):
+the number of host collectives this rank has *entered*.  Every rank runs
+the same collective program, so the counters must agree at any aligned
+moment; they are the ordinal join key that needs no cross-rank clock.
+
+The report aligns ranks by that counter and answers the two questions a
+hang postmortem starts with:
+
+* **which rank is stalled** — the rank(s) with the LOWEST counter: they
+  never reached the collective everyone else is blocked inside;
+* **which collective diverged first** — sequence number ``min+1``, named
+  via the collective flight event any ahead rank recorded at that seq
+  (and cross-checked against a watchdog ``stalled_label`` of the form
+  ``collective:<op> #<seq>`` when one rank's watchdog fired while blocked).
+
+Exit 0 on success, 2 when no parseable dumps were found.  ``--json``
+emits the merged structure for drivers (tools/telemetry_smoke.py asserts
+on it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_STALL_RE = re.compile(r"collective:(?P<op>[\w.]+) #(?P<seq>\d+)")
+
+
+def find_dumps(paths: list[str]) -> list[str]:
+    """Expand directories to their ``blackbox_rank*.json`` files; keep
+    explicit files as given."""
+    out: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            out.extend(sorted(glob.glob(os.path.join(path, "blackbox_rank*.json"))))
+        else:
+            out.append(path)
+    return out
+
+
+def load_dump(path: str) -> dict | None:
+    """One parsed dump, or None when unreadable/not a blackbox payload."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or data.get("kind") != "blackbox":
+        return None
+    data["_path"] = path
+    return data
+
+
+def _collective_ops(dump: dict) -> dict[int, str]:
+    """cseq -> op for every collective event retained in this rank's ring."""
+    ops: dict[int, str] = {}
+    for ev in dump.get("events") or []:
+        if isinstance(ev, dict) and ev.get("kind") == "collective":
+            seq = ev.get("cseq")
+            if isinstance(seq, int):
+                ops[seq] = str(ev.get("op", "?"))
+    return ops
+
+
+def _rank_summary(dump: dict) -> dict:
+    ops = _collective_ops(dump)
+    last_seq = dump.get("collective_seq") or 0
+    out = {
+        "rank": dump.get("rank"),
+        "path": dump.get("_path"),
+        "reason": dump.get("reason"),
+        "collective_seq": last_seq,
+        "last_collective_op": ops.get(last_seq),
+        "events_total": dump.get("events_total"),
+        "dropped": dump.get("dropped"),
+        "time_unix": dump.get("time_unix"),
+    }
+    label = dump.get("stalled_label")
+    if label:
+        out["stalled_label"] = label
+        m = _STALL_RE.search(str(label))
+        if m:
+            # this rank's watchdog fired while BLOCKED INSIDE a collective:
+            # it is a victim waiting at seq, not the stall's origin
+            out["blocked_in"] = {"op": m.group("op"), "seq": int(m.group("seq"))}
+    injected = [
+        ev for ev in (dump.get("events") or [])
+        if isinstance(ev, dict) and ev.get("kind") == "hang_injected"
+    ]
+    if injected:
+        out["hang_injected"] = injected[-1]
+    return out
+
+
+def merge(dumps: list[dict]) -> dict:
+    """Align ranks by collective sequence; name the lagging rank(s) and the
+    first divergent collective."""
+    ranks = sorted(
+        (_rank_summary(d) for d in dumps),
+        key=lambda r: (r["rank"] if r["rank"] is not None else 1 << 30),
+    )
+    seqs = [r["collective_seq"] for r in ranks]
+    min_seq, max_seq = min(seqs), max(seqs)
+    aligned = min_seq == max_seq
+    report: dict = {
+        "ranks": ranks,
+        "world": len(ranks),
+        "aligned": aligned,
+        "min_collective_seq": min_seq,
+        "max_collective_seq": max_seq,
+    }
+    if aligned:
+        report["stalled_ranks"] = []
+        report["first_divergent_seq"] = None
+        report["first_divergent_op"] = None
+        return report
+    # the hung rank(s): lowest counter — never entered collective min+1,
+    # which every ahead rank is (or was) blocked inside
+    stalled = [r["rank"] for r in ranks if r["collective_seq"] == min_seq]
+    divergent_seq = min_seq + 1
+    divergent_op = None
+    for r in ranks:
+        blocked = r.get("blocked_in")
+        if blocked and blocked.get("seq") == divergent_seq:
+            divergent_op = blocked["op"]  # a victim named it directly
+            break
+    if divergent_op is None:
+        for d in dumps:
+            op = _collective_ops(d).get(divergent_seq)
+            if op is not None:
+                divergent_op = op
+                break
+    report["stalled_ranks"] = stalled
+    report["first_divergent_seq"] = divergent_seq
+    report["first_divergent_op"] = divergent_op
+    return report
+
+
+def render(report: dict) -> str:
+    lines = [
+        f"{report['world']} rank dump(s), collective seq "
+        f"{report['min_collective_seq']}..{report['max_collective_seq']}"
+    ]
+    if report["aligned"]:
+        lines.append(
+            "  ranks ALIGNED at the same collective sequence — no "
+            "collective divergence in these dumps"
+        )
+    else:
+        stalled = ", ".join(str(r) for r in report["stalled_ranks"])
+        op = report["first_divergent_op"] or "?"
+        lines.append(
+            f"  STALLED rank(s): {stalled} — never entered collective "
+            f"#{report['first_divergent_seq']} ({op}); the other rank(s) "
+            "are blocked inside it"
+        )
+    for r in report["ranks"]:
+        detail = (
+            f"  rank {r['rank']}: seq={r['collective_seq']} "
+            f"reason={r['reason']}"
+        )
+        if r.get("blocked_in"):
+            detail += (
+                f" blocked_in={r['blocked_in']['op']}"
+                f"#{r['blocked_in']['seq']}"
+            )
+        if r.get("hang_injected"):
+            detail += f" hang_injected@step={r['hang_injected'].get('step')}"
+        if r.get("dropped"):
+            detail += f" dropped={r['dropped']}"
+        lines.append(detail)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="blackbox_report", description=__doc__)
+    parser.add_argument(
+        "paths", nargs="+",
+        help="blackbox_rank*.json dumps, or directories holding them",
+    )
+    parser.add_argument("--json", action="store_true", help="machine output")
+    args = parser.parse_args(argv)
+
+    dumps = []
+    for path in find_dumps(args.paths):
+        dump = load_dump(path)
+        if dump is None:
+            print(f"blackbox_report: cannot parse {path}", file=sys.stderr)
+            continue
+        dumps.append(dump)
+    if not dumps:
+        print("blackbox_report: no blackbox dumps found", file=sys.stderr)
+        return 2
+
+    report = merge(dumps)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

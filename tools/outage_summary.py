@@ -32,12 +32,20 @@ outage — which signal fired, whether it resized or suppressed, and the
 dp move — instead of reconstructing it from scattered logs.  Decisions
 carry a wall-clock ``ts``; records without one are counted but cannot be
 joined.
+
+``--blackbox`` joins the logs' DOWN windows against per-rank flight-
+recorder dumps (``blackbox_rank*.json`` files or directories holding
+them — docs/telemetry.md §flight recorder): each dump's wall-clock
+``time_unix`` stamp places the watchdog stall / fatal signal on the same
+absolute timeline as the probe log, answering whether a recorded hang
+happened while the watcher independently saw the accelerator DOWN.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -252,6 +260,68 @@ def render_autopilot_join(joined: dict) -> str:
     return "\n".join(lines)
 
 
+def load_blackbox_dumps(path: str) -> list[dict]:
+    """Per-rank blackbox payloads from a dump file or a directory of them
+    (tools/blackbox_report.py owns the parsing rules)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import blackbox_report
+
+    dumps = []
+    for p in blackbox_report.find_dumps([path]):
+        dump = blackbox_report.load_dump(p)
+        if dump is not None:
+            dumps.append(dump)
+    return dumps
+
+
+def join_blackbox(path: str, dumps: list[dict], windows: list[dict]) -> dict:
+    """Did each rank's dump land inside an observed DOWN window?"""
+    per_dump = []
+    for dump in dumps:
+        entry = {
+            "rank": dump.get("rank"),
+            "reason": dump.get("reason"),
+            "collective_seq": dump.get("collective_seq"),
+            "time_unix": dump.get("time_unix"),
+        }
+        ts = dump.get("time_unix")
+        if ts is None:
+            entry["in_down_window"] = None
+        else:
+            entry["in_down_window"] = False
+            for window in windows:
+                if window["start"] <= ts <= window["end"]:
+                    entry["in_down_window"] = True
+                    entry["down_window"] = window
+                    break
+        per_dump.append(entry)
+    return {
+        "blackbox": path,
+        "dumps": per_dump,
+        "in_down_windows": sum(1 for d in per_dump if d["in_down_window"]),
+    }
+
+
+def render_blackbox_join(joined: dict) -> str:
+    lines = [
+        f"{joined['blackbox']}: {len(joined['dumps'])} blackbox dump(s), "
+        f"{joined['in_down_windows']} inside observed DOWN windows"
+    ]
+    for d in joined["dumps"]:
+        if d["in_down_window"] is None:
+            verdict = "no timestamp"
+        elif d["in_down_window"]:
+            w = d["down_window"]
+            verdict = f"inside DOWN {_utc(w['start'])} → {_utc(w['end'])}"
+        else:
+            verdict = "NOT inside any observed DOWN window"
+        lines.append(
+            f"  rank {d['rank']} ({d['reason']}, seq={d['collective_seq']}) "
+            f"at {_utc(d['time_unix'])}: {verdict}"
+        )
+    return "\n".join(lines)
+
+
 def render_bench_join(joined: dict) -> str:
     label = "init failed" if joined["init_failed"] else "init ok"
     detail = (
@@ -323,6 +393,15 @@ def main(argv=None) -> int:
         help="telemetry JSONL dumps whose kind=\"autopilot\" decision "
         "records are joined against the logs' DOWN windows",
     )
+    parser.add_argument(
+        "--blackbox",
+        nargs="+",
+        default=[],
+        metavar="DUMP",
+        help="blackbox_rank*.json flight-recorder dumps (or directories of "
+        "them) whose wall-clock stamps are joined against the logs' DOWN "
+        "windows",
+    )
     args = parser.parse_args(argv)
 
     summaries = {}
@@ -363,12 +442,31 @@ def main(argv=None) -> int:
             continue
         autopilot_joins.append(join_autopilot(path, records, all_windows))
 
+    blackbox_joins: list[dict] = []
+    for path in args.blackbox:
+        try:
+            dumps = load_blackbox_dumps(path)
+        except OSError as e:
+            print(
+                f"outage_summary: cannot read blackbox {path}: {e}",
+                file=sys.stderr,
+            )
+            continue
+        if not dumps:
+            print(
+                f"outage_summary: no blackbox dumps in {path}", file=sys.stderr
+            )
+            continue
+        blackbox_joins.append(join_blackbox(path, dumps, all_windows))
+
     if args.json:
         payload: dict = dict(summaries)
         if bench_joins:
             payload["bench_join"] = bench_joins
         if autopilot_joins:
             payload["autopilot_join"] = autopilot_joins
+        if blackbox_joins:
+            payload["blackbox_join"] = blackbox_joins
         print(json.dumps(payload, indent=2))
     else:
         for path, s in summaries.items():
@@ -377,6 +475,8 @@ def main(argv=None) -> int:
             print(render_bench_join(joined))
         for joined in autopilot_joins:
             print(render_autopilot_join(joined))
+        for joined in blackbox_joins:
+            print(render_blackbox_join(joined))
     return 0
 
 

@@ -2,16 +2,37 @@
 """telemetry_smoke — `make telemetry-smoke`: prove the telemetry pipeline
 end-to-end on CPU in seconds.
 
-Tiny model, 3 captured steps with telemetry on, full export to JSONL, then
-schema validation through tools/telemetry_report.py (the same validator a
-user would run on a real run's dump).  Exit 0 = a well-formed telemetry
-JSONL with >= 3 step records, a build with nonzero trace/compile time, and
-a recompile event attributing a forced shape change.
+Two legs:
+
+1. **Single-process pipeline** — tiny model, 4 captured steps with
+   telemetry + per-step profiling + Chrome trace export on, full JSONL
+   export, then schema validation through tools/telemetry_report.py and
+   structural validation of the exported trace
+   (``telemetry.trace_export.validate_trace``): the host-phase, device-op
+   and flight-event tracks must all carry events for the same steps, and
+   the always-on flight recorder must have recorded every step.
+
+2. **Two-process injected hang** — a REAL 2-rank ``jax.distributed``
+   gloo/CPU world where rank 1's fault injector sleeps
+   (``hang:step=2``) before its third ``gather_object``: rank 0 blocks
+   inside the collective, its hang watchdog fires on the stall deadline
+   and writes ``blackbox_rank0.json``; a SIGTERM to the sleeping rank 1
+   exercises the watchdog's fatal-signal dump path; then
+   tools/blackbox_report.py must merge the dumps and name the stalled
+   rank (1) and the first divergent collective (#3, gather_object).
+
+Exit 0 = both legs pass.
 """
 
+import json
 import os
+import signal
+import socket
+import subprocess
 import sys
 import tempfile
+import textwrap
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -19,7 +40,8 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def main() -> int:
+def _pipeline_leg() -> list[str]:
+    """Leg 1: the single-process telemetry pipeline + trace export."""
     import numpy as np
     import jax.numpy as jnp
 
@@ -28,13 +50,22 @@ def main() -> int:
     from accelerate_tpu import Accelerator, TelemetryKwargs
     from accelerate_tpu.data_loader import batch_to_global_array
     from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+    from accelerate_tpu.telemetry.trace_export import validate_trace
 
     from telemetry_report import load_records, validate
 
-    path = os.path.join(tempfile.mkdtemp(prefix="atpu_telemetry_"), "run.jsonl")
+    tmp = tempfile.mkdtemp(prefix="atpu_telemetry_")
+    path = os.path.join(tmp, "run.jsonl")
+    trace_path = os.path.join(tmp, "trace.json")
     nn.manual_seed(0)
     acc = Accelerator(
-        kwargs_handlers=[TelemetryKwargs(enabled=True, jsonl_path=path)]
+        kwargs_handlers=[
+            TelemetryKwargs(
+                enabled=True, jsonl_path=path,
+                profile_every_n=1,  # every step sampled → device-op track
+                trace_export_path=trace_path,
+            )
+        ]
     )
     model = GPTLMHeadModel(
         GPTConfig(vocab_size=256, n_positions=64, n_embd=32, n_layer=1, n_head=2)
@@ -60,7 +91,8 @@ def main() -> int:
         loss = step(batch(32))
     float(loss)
     step(batch(48))  # forced shape change → recompile event with a cause
-    acc.end_training()  # writes the JSONL dump
+    health = acc.telemetry.flightrec.health()
+    acc.end_training()  # writes the JSONL dump + the Chrome trace
 
     records = load_records(path)
     errors = validate(records, min_steps=4)
@@ -70,15 +102,198 @@ def main() -> int:
     recompiles = [r for r in records if r.get("kind") == "recompile"]
     if not any("arg[0] shape changed" in (r.get("cause") or "") for r in recompiles):
         errors.append(f"shape-change recompile cause missing: {recompiles}")
+
+    # the always-on flight recorder saw every captured step and is healthy
+    if health["events_total"] < 8:  # >= 4 step_begin/step_end pairs
+        errors.append(f"flight recorder too quiet: {health}")
+    if health["dropped_total"] != 0:
+        errors.append(f"flight recorder dropped events: {health}")
+
+    # the exported Chrome trace is well-formed and carries host-phase,
+    # device-op and flight-event tracks for the SAME steps
+    try:
+        with open(trace_path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        doc = None
+        errors.append(f"trace export unreadable: {e}")
+    if doc is not None:
+        errors.extend(validate_trace(doc))
+        host_steps, device_steps, flight_steps = set(), set(), set()
+        for ev in doc.get("traceEvents", []):
+            step_arg = (ev.get("args") or {}).get("step")
+            if step_arg is None:
+                continue
+            if ev.get("tid") == 1 and ev.get("ph") == "X":
+                host_steps.add(step_arg)
+            elif ev.get("tid") == 2 and ev.get("ph") == "X":
+                device_steps.add(step_arg)
+            elif ev.get("tid") == 3:
+                flight_steps.add(step_arg)
+        common = host_steps & device_steps & flight_steps
+        if len(common) < 4:
+            errors.append(
+                "trace tracks do not share steps: host="
+                f"{sorted(host_steps)} device={sorted(device_steps)} "
+                f"flight={sorted(flight_steps)}"
+            )
+    if not errors:
+        steps = [r for r in records if r.get("kind") == "step"]
+        print(
+            f"telemetry-smoke: pipeline ok — {len(steps)} steps, "
+            f"{len(builds)} builds, {len(recompiles)} recompile event(s), "
+            f"{health['events_total']} flight events, trace at {trace_path}"
+        )
+    return errors
+
+
+_HANG_WORKER = textwrap.dedent(
+    """
+    import json
+    import os
+    import sys
+
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    blackbox_dir = sys.argv[3]
+    out_path = sys.argv[4]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)  # 1 local device per process
+    import jax
+
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    sys.path.insert(0, "@REPO@")
+
+    from accelerate_tpu.resilience.inject import FaultInjector
+    from accelerate_tpu.telemetry import flightrec
+    from accelerate_tpu.telemetry.watchdog import HangWatchdog
+    from accelerate_tpu.utils.operations import gather_object
+
+    # rank 1 goes silent right before the step-2 collective; rank 0 will
+    # block inside gather_object #3 until its watchdog deadline fires
+    injector = (
+        FaultInjector.from_spec("hang:step=2,seconds=600") if pid == 1 else None
+    )
+    wd = HangWatchdog(timeout_s=3.0, dump_dir=blackbox_dir).start()
+
+    for step in range(4):
+        flightrec.record("step_begin", step=step)
+        if injector is not None:
+            injector.maybe_hang(step)
+        gathered = gather_object([step])
+        flightrec.record("step_end", step=step)
+
+    # only reached if nothing hung (a failure of this leg)
+    wd.stop()
+    with open(out_path, "w") as f:
+        json.dump({"pid": pid, "completed": True}, f)
+    """
+).replace("@REPO@", REPO)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_for(path: str, timeout_s: float) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.25)
+    return False
+
+
+def _hang_leg() -> list[str]:
+    """Leg 2: injected hang in a real 2-process world → watchdog dumps →
+    merged blackbox report names the stalled rank and collective."""
+    from blackbox_report import load_dump, merge
+
+    errors: list[str] = []
+    tmp = tempfile.mkdtemp(prefix="atpu_blackbox_")
+    blackbox_dir = os.path.join(tmp, "blackbox")
+    worker = os.path.join(tmp, "worker.py")
+    with open(worker, "w", encoding="utf-8") as f:
+        f.write(_HANG_WORKER)
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(port), blackbox_dir,
+             os.path.join(tmp, f"rank{i}.json")],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        for i in range(2)
+    ]
+    dump0 = os.path.join(blackbox_dir, "blackbox_rank0.json")
+    dump1 = os.path.join(blackbox_dir, "blackbox_rank1.json")
+    try:
+        # rank 0 blocks in gather #3; its 3s watchdog deadline must produce
+        # the stall dump (generous ceiling covers the distributed handshake)
+        if not _wait_for(dump0, timeout_s=120):
+            errors.append("rank 0 watchdog never dumped on the stall")
+        # the hung rank's dump comes from the fatal-signal path: SIGTERM the
+        # sleeping rank 1, its watchdog handler dumps then chains to death
+        if procs[1].poll() is None:
+            procs[1].send_signal(signal.SIGTERM)
+        if not _wait_for(dump1, timeout_s=60):
+            errors.append("rank 1 watchdog never dumped on SIGTERM")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                errors.append("worker did not die on SIGKILL")
+    if errors:
+        return errors
+
+    dumps = [d for d in (load_dump(dump0), load_dump(dump1)) if d is not None]
+    if len(dumps) != 2:
+        return [f"expected 2 parseable dumps, got {len(dumps)}"]
+    report = merge(dumps)
+    if report["stalled_ranks"] != [1]:
+        errors.append(f"stalled rank not identified: {report}")
+    if report["first_divergent_seq"] != 3:
+        errors.append(f"first divergent collective seq != 3: {report}")
+    if report["first_divergent_op"] != "gather_object":
+        errors.append(f"divergent op not named: {report}")
+    ranks = {r["rank"]: r for r in report["ranks"]}
+    if ranks.get(0, {}).get("reason") != "watchdog_stall":
+        errors.append(f"rank 0 dump reason != watchdog_stall: {ranks.get(0)}")
+    if ranks.get(1, {}).get("reason") != "signal":
+        errors.append(f"rank 1 dump reason != signal: {ranks.get(1)}")
+    if not ranks.get(1, {}).get("hang_injected"):
+        errors.append("rank 1 dump does not show the injected hang")
+    if not errors:
+        print(
+            "telemetry-smoke: hang leg ok — watchdog dumped both ranks, "
+            f"report names rank {report['stalled_ranks']} stalled at "
+            f"collective #{report['first_divergent_seq']} "
+            f"({report['first_divergent_op']})"
+        )
+    return errors
+
+
+def main() -> int:
+    errors = _pipeline_leg()
+    errors += _hang_leg()
     for error in errors:
         print(f"telemetry-smoke: FAIL: {error}", file=sys.stderr)
     if errors:
         return 1
-    steps = [r for r in records if r.get("kind") == "step"]
-    print(
-        f"telemetry-smoke: ok — {len(steps)} steps, {len(builds)} builds, "
-        f"{len(recompiles)} recompile event(s), JSONL at {path}"
-    )
+    print("telemetry-smoke: ok — pipeline + injected-hang legs passed")
     return 0
 
 
