@@ -3,7 +3,7 @@
 # the TPU-native layout. All targets run on the virtual 8-device CPU mesh
 # (tests/conftest.py forces it) — no hardware needed.
 
-.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold lint-sarif multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke pipeline-smoke bench bench-gate
+.PHONY: test test_core test_models test_parallel test_cli test_big_modeling test_checkpoint test_examples test_analysis test_slow lint lint-cold lint-sarif multichip telemetry-smoke resilience-smoke serve-smoke serve-chaos-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke pipeline-smoke bench bench-gate
 
 # graftlint: whole-program trace-safety & collective-correctness static
 # analysis (docs/graftlint.md). Runs before the suite. The on-disk cache
@@ -53,7 +53,8 @@ lint-sarif:
 multichip:
 	XLA_FLAGS=--xla_force_host_platform_device_count=4 python -m pytest \
 	  tests/test_zero1.py tests/test_zero_sharding.py \
-	  tests/test_compression.py tests/test_serving.py tests/test_fleet.py \
+	  tests/test_compression.py tests/test_serving.py \
+	  tests/test_serving_recovery.py tests/test_fleet.py \
 	  tests/test_kernels.py tests/test_parallel_plan.py -q
 
 # telemetry pipeline proof (docs/telemetry.md): tiny model, 3 steps + a
@@ -79,6 +80,16 @@ resilience-smoke:
 # kind="serving" telemetry records present
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serving_smoke.py
+
+# fault-tolerant serving proof (docs/serving.md §fault tolerance): tiny
+# GPT, staggered requests through a journaled replica with an injected
+# transient decode fault and a mid-flight SIGTERM — asserts the fault is
+# retried without a recompile, the drain leaves every open request in the
+# journal, a restarted replica completes all of them bitwise-equal to
+# generate() (zero lost), and the second pass against the same AOT store
+# recovers with ZERO compiles
+serve-chaos-smoke:
+	JAX_PLATFORMS=cpu python tools/serve_chaos_smoke.py
 
 # device-time proof (docs/telemetry.md): tiny GPT, 3 steps with every call
 # profiled (profile_every_n=1) — asserts a nonempty per-device busy/idle +
@@ -137,7 +148,7 @@ pipeline-smoke:
 bench-gate:
 	python tools/bench_compare.py
 
-test: lint lint-sarif multichip telemetry-smoke resilience-smoke serve-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke pipeline-smoke bench-gate
+test: lint lint-sarif multichip telemetry-smoke resilience-smoke serve-smoke serve-chaos-smoke profile-smoke cache-smoke elastic-smoke autopilot-smoke kernel-smoke pipeline-smoke bench-gate
 	python -m pytest tests/ -q
 
 test_core:

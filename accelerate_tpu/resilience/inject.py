@@ -39,6 +39,15 @@ semicolon-separated directives, ``key=int`` options after a colon:
   threshold.  Consumed by the fleet autopilot (docs/elastic.md): the
   hysteresis/debounce proof — a storm must produce suppressed-decision
   telemetry and exactly zero resizes.
+* ``decode_fault:step=2`` / ``decode_fault:step=2,times=3`` — the serving
+  engine iteration with index ``step`` raises an
+  :class:`InjectedTransientError` inside the decode dispatch N times
+  (retries keep faulting until ``times`` is spent — how the serving
+  retry-exhaustion requeue is driven).  Consumed by
+  :class:`~..serving.DecodeService` (docs/serving.md §fault tolerance).
+* ``serving_sigterm:step=2`` — deliver a real ``SIGTERM`` right before
+  serving engine step ``step``, with slots in flight — the mid-decode
+  preemption the request journal + drain path exists for.
 
 Injection points are reached only when resilience is enabled AND a plan is
 configured — production runs never pay for (or trip over) this module.
@@ -61,7 +70,7 @@ class InjectedTransientError(RuntimeError):
 
 @dataclass
 class _Directive:
-    kind: str  # init_hang | dispatch | sigterm | host_lost | host_gained | signal_storm | hang
+    kind: str  # init_hang | dispatch | sigterm | host_lost | host_gained | signal_storm | hang | decode_fault | serving_sigterm
     step: Optional[int] = None  # dispatch index (dispatch/sigterm/hang)
     times: int = 1  # how many firings remain
     fired: int = 0
@@ -84,11 +93,13 @@ class FaultPlan:
             if kind not in (
                 "init_hang", "dispatch", "sigterm", "host_lost",
                 "host_gained", "signal_storm", "hang",
+                "decode_fault", "serving_sigterm",
             ):
                 raise ValueError(
                     f"unknown fault directive {kind!r} in {spec!r}; use "
                     "init_hang / dispatch / sigterm / host_lost / "
-                    "host_gained / signal_storm / hang"
+                    "host_gained / signal_storm / hang / decode_fault / "
+                    "serving_sigterm"
                 )
             opts: dict[str, int] = {}
             for pair in opts_raw.split(","):
@@ -108,7 +119,8 @@ class FaultPlan:
                 raise ValueError(f"unknown fault options {sorted(unknown)} in {raw!r}")
             if (
                 kind in ("dispatch", "sigterm", "host_lost", "host_gained",
-                         "signal_storm", "hang")
+                         "signal_storm", "hang", "decode_fault",
+                         "serving_sigterm")
                 and "step" not in opts
             ):
                 raise ValueError(f"{kind!r} directive needs step=N ({raw!r})")
@@ -221,6 +233,31 @@ class FaultInjector:
 
         time.sleep(directive.seconds)
         return True
+
+    def maybe_decode_fault(self, step_index: int) -> None:
+        """Raise a transient fault inside the serving decode dispatch for
+        the given ENGINE STEP index (``DecodeService.stats["steps"]``);
+        retries of the same step keep hitting this until ``times`` is
+        exhausted — which is how the eviction-and-requeue exhaustion path
+        is driven (docs/serving.md §fault tolerance)."""
+        directive = self._pending("decode_fault", step=step_index)
+        if directive is None:
+            return
+        directive.fired += 1
+        raise InjectedTransientError(
+            f"UNAVAILABLE: injected transient decode fault at engine step "
+            f"{step_index} (firing {directive.fired}/{directive.times})"
+        )
+
+    def maybe_serving_sigterm(self, step_index: int) -> None:
+        """Deliver a real SIGTERM right before the given serving engine
+        step — the mid-decode preemption (slots in flight) the request
+        journal + drain path recovers from."""
+        directive = self._pending("serving_sigterm", step=step_index)
+        if directive is None:
+            return
+        directive.fired += 1
+        os.kill(os.getpid(), signal.SIGTERM)
 
     def maybe_dispatch_fault(self, dispatch_index: int) -> None:
         """Raise a transient fault for the given dispatch; retries of the same

@@ -18,6 +18,12 @@ package turns it into a serving path:
   ``stacked_params_for_mode`` contracts the one-shot engine uses —
   quantized int8/int4 weight modes and ``shard_for_inference`` layouts
   compose unchanged.
+* :mod:`~.recovery` — fault tolerance (docs/serving.md §fault
+  tolerance): the bounded request journal (WAL of admissions + emitted
+  tokens), deterministic teacher-forced re-prefill recovery, bounded
+  decode-dispatch retry, preemption drain, and deadline/queue-depth
+  shedding.  Default off; armed by ``ServingConfig(journal_dir=...)`` /
+  ``$ACCELERATE_SERVING_JOURNAL``.
 
 Steady state is **zero recompiles** — asserted through the telemetry
 recompile forensics (``CompileWatcher``), benched by bench.py's serving
@@ -25,14 +31,18 @@ block, and smoke-tested by ``make serve-smoke``.
 """
 
 from .kv_blocks import BlockPool, blocks_for_request, bucket_length, make_pools
+from .recovery import QueueFullError, RequestJournal, replay_journal
 from .scheduler import DecodeService, Request, ServingConfig
 
 __all__ = [
     "BlockPool",
     "DecodeService",
+    "QueueFullError",
     "Request",
+    "RequestJournal",
     "ServingConfig",
     "blocks_for_request",
     "bucket_length",
     "make_pools",
+    "replay_journal",
 ]

@@ -1,0 +1,334 @@
+"""Fault-tolerant serving: request journal + deterministic recovery.
+
+The serving tentpole's availability story (docs/serving.md §fault
+tolerance).  A ``DecodeService`` replica on a preemptible slice dies two
+ways — a transient runtime fault mid-decode, or a SIGTERM reclaiming the
+host — and before this module either one silently lost every in-flight
+request.  The warm AOT store (docs/aot_cache.md) already makes a replica
+*restart* compile-free; what was missing is the *request* state.  This
+module supplies it:
+
+* :class:`RequestJournal` — a bounded JSONL write-ahead log of admissions
+  (rid, prompt, sampling config, timestamps) and per-request emitted-token
+  appends.  Appends are single-``write()`` line records (torn trailing
+  lines are dropped at replay); compaction rewrites only the still-open
+  requests through a temp file + ``os.replace`` so the log never grows
+  with completed history.  Armed by ``ServingConfig(journal_dir=...)`` /
+  ``$ACCELERATE_SERVING_JOURNAL``; off (the default) the scheduler's hot
+  path is byte-identical — one ``None``-check, the same discipline as
+  telemetry and resilience.
+* :func:`replay_journal` — rebuild per-request state from the log: which
+  requests completed, which are open, and every open request's emitted
+  prefix.  Token records carry their absolute offset (``at``), so replay
+  is idempotent under duplicate or re-logged records.
+* :func:`advance_rng` — re-advance a request's sampling stream to its
+  journaled position.  The engine's stream discipline is fixed (one
+  ``jax.random.split`` per sampled token, the "next" key always row 0 of
+  the split — engine.py), so the stream state after ``k`` emitted tokens
+  is ``advance_rng(fold_in(base, 2*rid+1), k)``.  Recovery hands prefill
+  the stream advanced to ``k-1``: the prefill's own internal split lands
+  it at exactly ``k``, which is what makes a recovered request's sampled
+  continuation bitwise-identical to the uninterrupted run.
+
+Recovery itself is *re-prefill, teacher-forced*: the scheduler rebuilds a
+request's KV cache by running the ordinary bucketed prefill over
+``prompt + tokens[:-1]`` (the journaled prefix, minus the last token,
+which becomes the next decode step's input) — the same captured program
+family the service already pins, so a warm-store replica recovers with
+ZERO compiles.  The prefill's sampled token is discarded in favor of the
+journaled one; per-token math identity between the prefill and decode
+programs (engine.py's parity contract) makes the rebuilt cache
+bitwise-equivalent for every position that matters.
+
+Queueing back-pressure lives here too: :class:`QueueFullError` is the
+bounded-queue (``ServingConfig(max_queue_depth=...)``) rejection, carrying
+a ``retry_after_ms`` hint derived from the service's recent TPOT window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+ENV_JOURNAL_DIR = "ACCELERATE_SERVING_JOURNAL"
+
+JOURNAL_SCHEMA_VERSION = 1
+
+# journal file name inside journal_dir: one service replica, one log.  A
+# fresh replica pointed at the same dir appends to the same file — replay
+# is offset-idempotent, so the combined history stays consistent.
+JOURNAL_FILENAME = "journal.jsonl"
+
+
+class QueueFullError(RuntimeError):
+    """Bounded-queue back-pressure: the submit was REJECTED (nothing was
+    enqueued).  ``retry_after_ms`` is the service's best estimate of when
+    capacity frees up — recent-TPOT-derived, never zero."""
+
+    def __init__(self, message: str, retry_after_ms: float):
+        super().__init__(message)
+        self.retry_after_ms = float(retry_after_ms)
+
+
+def advance_rng(rng, n: int):
+    """Advance a per-request sampling stream ``n`` split-steps.
+
+    One engine-sampled token consumes exactly one ``jax.random.split``;
+    the surviving stream is always row 0 of the split (prefill's
+    ``rng_out`` and decode's ``nk`` — engine.py).  Eager and host-side:
+    recovery runs it once per resumed request, never on the hot path."""
+    import jax
+
+    for _ in range(int(n)):
+        rng = jax.random.split(rng)[0]
+    return rng
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    """One request's replayed state."""
+
+    rid: int
+    prompt: np.ndarray  # (prompt_len,) int32
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    tokens: list = dataclasses.field(default_factory=list)
+    done: bool = False
+    shed: bool = False
+
+    @property
+    def open(self) -> bool:
+        return not (self.done or self.shed)
+
+
+@dataclasses.dataclass
+class JournalState:
+    """:func:`replay_journal` output."""
+
+    meta: dict = dataclasses.field(default_factory=dict)
+    entries: dict = dataclasses.field(default_factory=dict)  # rid -> JournalEntry
+    drained: bool = False
+
+    @property
+    def open_requests(self) -> list:
+        """Recoverable requests in submission (rid) order."""
+        return [e for _, e in sorted(self.entries.items()) if e.open]
+
+
+def _journal_path(path: str) -> str:
+    """Accept either the journal directory or the file itself."""
+    if path.endswith(".jsonl"):
+        return path
+    return os.path.join(path, JOURNAL_FILENAME)
+
+
+class RequestJournal:
+    """Bounded JSONL WAL of serving admissions and emitted tokens.
+
+    Write discipline: every record is one ``json.dumps`` line written in a
+    single ``write()`` call and flushed — a crash mid-write tears at most
+    the final line, which replay drops.  Compaction (every
+    ``compact_every`` appended records, when closed requests exist)
+    rewrites ONLY the open requests into a temp file and ``os.replace``s
+    it over the log — atomic on POSIX, so a crash mid-compaction leaves
+    either the old complete log or the new complete log, never a hybrid.
+    """
+
+    def __init__(self, journal_dir: str, meta: Optional[dict] = None,
+                 compact_every: int = 512):
+        self.dir = journal_dir
+        os.makedirs(journal_dir, exist_ok=True)
+        self.path = _journal_path(journal_dir)
+        self.meta = dict(meta or {})
+        self.compact_every = max(1, int(compact_every))
+        self._since_compact = 0
+        self.compactions = 0
+        self.closed = False
+        # live mirror of what the log describes — compaction's source, and
+        # how log_tokens knows each record's absolute offset
+        self._entries: dict[int, JournalEntry] = {}
+        fresh = not os.path.exists(self.path) or os.path.getsize(self.path) == 0
+        if not fresh:
+            # appending to an existing log (replica restart pointed at the
+            # same dir): seed the mirror so offsets continue correctly
+            state = replay_journal(self.path)
+            self._entries = state.entries
+        self._fh = open(self.path, "a", encoding="utf-8")
+        if fresh:
+            self._append({
+                "ev": "meta", "schema": JOURNAL_SCHEMA_VERSION, **self.meta,
+            })
+
+    # -- writes --------------------------------------------------------------
+    def _append(self, record: dict) -> None:
+        if self.closed:
+            return
+        self._fh.write(json.dumps(record, separators=(",", ":")) + "\n")
+        self._fh.flush()
+        self._since_compact += 1
+
+    def log_submit(self, rid: int, prompt, max_new_tokens: int,
+                   eos_token_id: Optional[int],
+                   deadline_ms: Optional[float] = None,
+                   tokens: Optional[list] = None) -> None:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        entry = JournalEntry(
+            rid=int(rid), prompt=prompt, max_new_tokens=int(max_new_tokens),
+            eos_token_id=None if eos_token_id is None else int(eos_token_id),
+            tokens=[int(t) for t in (tokens or [])],
+        )
+        self._entries[entry.rid] = entry
+        record = {
+            "ev": "submit", "rid": entry.rid,
+            "prompt": [int(t) for t in prompt],
+            "max_new": entry.max_new_tokens, "eos": entry.eos_token_id,
+            "t": time.time(),
+        }
+        if deadline_ms is not None:
+            record["deadline_ms"] = float(deadline_ms)
+        if entry.tokens:
+            # a re-logged recovered request carries its prefix inline
+            record["tokens"] = entry.tokens
+        self._append(record)
+
+    def log_tokens(self, rid: int, tokens: list) -> None:
+        """Append newly emitted tokens; the record carries the absolute
+        offset of its first token so replay is idempotent."""
+        entry = self._entries.get(int(rid))
+        if entry is None:  # unknown rid: a journal opened mid-stream
+            return
+        at = len(entry.tokens)
+        entry.tokens.extend(int(t) for t in tokens)
+        self._append({"ev": "tok", "rid": int(rid), "at": at,
+                      "toks": [int(t) for t in tokens]})
+        self._maybe_compact()
+
+    def log_complete(self, rid: int) -> None:
+        entry = self._entries.get(int(rid))
+        if entry is not None:
+            entry.done = True
+        self._append({"ev": "done", "rid": int(rid)})
+        self._maybe_compact()
+
+    def log_shed(self, rid: int, reason: str) -> None:
+        entry = self._entries.get(int(rid))
+        if entry is not None:
+            entry.shed = True
+        self._append({"ev": "shed", "rid": int(rid), "reason": reason})
+        self._maybe_compact()
+
+    def log_drain(self, open_rids: list) -> None:
+        self._append({"ev": "drain", "open": [int(r) for r in open_rids],
+                      "t": time.time()})
+
+    # -- lifecycle -----------------------------------------------------------
+    def _maybe_compact(self) -> None:
+        if self._since_compact < self.compact_every:
+            return
+        if not any(not e.open for e in self._entries.values()):
+            return  # nothing to drop yet — rewriting would shrink nothing
+        self.compact()
+
+    def compact(self) -> None:
+        """Rewrite the log with only the still-open requests (atomic)."""
+        self._entries = {r: e for r, e in self._entries.items() if e.open}
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps({
+                "ev": "meta", "schema": JOURNAL_SCHEMA_VERSION, **self.meta,
+            }, separators=(",", ":")) + "\n")
+            for _, entry in sorted(self._entries.items()):
+                record = {
+                    "ev": "submit", "rid": entry.rid,
+                    "prompt": [int(t) for t in entry.prompt],
+                    "max_new": entry.max_new_tokens, "eos": entry.eos_token_id,
+                }
+                if entry.tokens:
+                    record["tokens"] = entry.tokens
+                f.write(json.dumps(record, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._since_compact = 0
+        self.compactions += 1
+
+    def close(self) -> None:
+        """Finalize: flush and close the handle (drain path).  Further
+        appends are silently dropped — a drained service must never crash
+        trying to journal its own teardown."""
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+        except OSError:  # best-effort: the log's existing lines are safe
+            pass
+
+
+def replay_journal(path: str) -> JournalState:
+    """Rebuild request state from a journal directory or file.
+
+    Tolerant by construction: a torn final line (crash mid-append) is
+    dropped; token records apply at their recorded offset, so duplicated
+    or re-logged records never double-append; records for unknown rids
+    are skipped."""
+    state = JournalState()
+    journal_file = _journal_path(path)
+    if not os.path.exists(journal_file):
+        return state
+    with open(journal_file, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue  # torn write — the line after a crash
+            ev = record.get("ev")
+            if ev == "meta":
+                meta = dict(record)
+                meta.pop("ev", None)
+                state.meta = meta
+            elif ev == "submit":
+                entry = JournalEntry(
+                    rid=int(record["rid"]),
+                    prompt=np.asarray(record.get("prompt", []), np.int32),
+                    max_new_tokens=int(record.get("max_new", 1)),
+                    eos_token_id=record.get("eos"),
+                    tokens=[int(t) for t in record.get("tokens", [])],
+                )
+                state.entries[entry.rid] = entry
+            elif ev == "tok":
+                entry = state.entries.get(int(record.get("rid", -1)))
+                if entry is None:
+                    continue
+                at = int(record.get("at", len(entry.tokens)))
+                toks = [int(t) for t in record.get("toks", [])]
+                if at > len(entry.tokens):
+                    continue  # a gap means a lost record: don't fabricate
+                entry.tokens[at:at + len(toks)] = toks
+            elif ev == "done":
+                entry = state.entries.get(int(record.get("rid", -1)))
+                if entry is not None:
+                    entry.done = True
+            elif ev == "shed":
+                entry = state.entries.get(int(record.get("rid", -1)))
+                if entry is not None:
+                    entry.shed = True
+            elif ev == "drain":
+                state.drained = True
+    return state

@@ -54,6 +54,11 @@ from .kv_blocks import BlockPool, blocks_for_request, bucket_length, make_pools
 
 logger = get_logger(__name__)
 
+# metrics() snapshot-retry bound: the scrape thread races the stepping
+# thread's deque appends; four attempts at most, then the scrape proceeds
+# without percentiles (and says so — see metrics())
+_METRICS_SNAPSHOT_RETRIES = 4
+
 
 @dataclasses.dataclass
 class ServingConfig:
@@ -98,14 +103,33 @@ class ServingConfig:
     # completions retained for the metrics() sliding window (TTFT/TPOT
     # p50/p99 on the live endpoint, docs/telemetry.md §metrics endpoint)
     metrics_window: int = 512
+    # fault tolerance (docs/serving.md §fault tolerance): journal_dir arms
+    # the request WAL + deterministic recovery + preemption drain; off
+    # (the default) the hot path is byte-identical.  None of these reach a
+    # program shape, so none ride the AOT service fingerprint — a warm
+    # store serves journaled and journal-less replicas alike.
+    journal_dir: Optional[str] = None  # None → $ACCELERATE_SERVING_JOURNAL
+    # bounded queueing: submits past this depth raise QueueFullError with
+    # a retry-after hint instead of growing host memory without bound
+    max_queue_depth: Optional[int] = None
+    # transient decode-dispatch faults are retried this many times against
+    # the SAME compiled program before the batch is evicted-and-requeued
+    max_decode_retries: Optional[int] = None  # None → $ACCELERATE_SERVING_MAX_RETRIES
+    retry_backoff_s: float = 0.05
 
     def __post_init__(self):
-        if self.decode_steps is None:
-            from ..utils.dataclasses import env_int
+        from ..utils.dataclasses import env_int
 
+        if self.decode_steps is None:
             # malformed values warn and keep the single-token default —
             # the one shared env-int parser (utils/dataclasses.env_int)
             self.decode_steps = env_int("ACCELERATE_SERVING_DECODE_STEPS", 1)
+        if self.journal_dir is None:
+            import os
+
+            self.journal_dir = os.environ.get("ACCELERATE_SERVING_JOURNAL") or None
+        if self.max_decode_retries is None:
+            self.max_decode_retries = env_int("ACCELERATE_SERVING_MAX_RETRIES", 2)
 
 
 @dataclasses.dataclass
@@ -116,11 +140,15 @@ class Request:
     eos_token_id: Optional[int]
     bucket_len: int
     blocks_needed: int
-    state: str = "queued"  # queued -> running -> done
+    state: str = "queued"  # queued -> running -> done (or -> shed)
     tokens: list = dataclasses.field(default_factory=list)
     submitted_t: float = 0.0
     first_token_t: Optional[float] = None
     done_t: Optional[float] = None
+    # per-request latency budget: a queued request whose age exceeds this
+    # is SHED at admission time (state="shed", never prefilled) — an
+    # expired request must not burn a slot its caller stopped waiting for
+    deadline_ms: Optional[float] = None
 
     @property
     def prompt_len(self) -> int:
@@ -159,7 +187,7 @@ class DecodeService:
     """
 
     def __init__(self, model, config: Optional[ServingConfig] = None, telemetry=None,
-                 aot_cache=None, kernels=None):
+                 aot_cache=None, kernels=None, preemption_guard=None):
         from ..models.generation import stacked_params_for_mode
 
         # Pallas paged-attention decode (docs/kernels.md): explicit handle
@@ -251,6 +279,22 @@ class DecodeService:
         self._pool_sharding = (
             replicated if param_sharding is not None else None
         )
+
+        # pool rebuild hook for the retry-exhaustion recovery path: a fault
+        # that fires MID-EXECUTION may have consumed the donated pools; the
+        # requeue re-prefills every sequence anyway, so fresh zeroed pools
+        # (same shape, dtype and sharding) are a complete replacement
+        def _rebuild_pools():
+            kp, vp = make_pools(
+                n_layers, num_blocks, dcfg.n_kv_head, cfg.block_size,
+                dcfg.head_dim, act_dtype,
+            )
+            if self._pool_sharding is not None:
+                kp = jax.device_put(kp, self._pool_sharding)
+                vp = jax.device_put(vp, self._pool_sharding)
+            return kp, vp
+
+        self._pool_factory = _rebuild_pools
         slots = cfg.max_slots
         self._tables = np.zeros((slots, blocks_per_slot), np.int32)
         self._positions = np.zeros(slots, np.int32)
@@ -297,6 +341,11 @@ class DecodeService:
 
             aot_cache = current_aot_cache()
         self._aot = None
+        # /healthz readiness input: True once the bucket programs exist in
+        # this process (warmed from the AOT store, or built by the first
+        # admission) — a scrape-ready replica is one that can serve its
+        # first token without a cold compile stall
+        self._programs_warmed = False
         if aot_cache is not None and aot_cache.enabled:
             import jax as _jax
 
@@ -340,7 +389,43 @@ class DecodeService:
                 # always had.
                 service_fingerprint["decode_steps"] = int(cfg.decode_steps)
             self._aot = AOTServingPrograms(aot_cache, service_fingerprint)
-            self._aot.warm()
+            self._programs_warmed = self._aot.warm() > 0
+        # fault tolerance (docs/serving.md §fault tolerance): everything
+        # below is None / False when the journal is off — the hot path
+        # pays one None-check per site, byte-identical to the pre-recovery
+        # service (pinned by tests/test_serving_recovery.py)
+        self._draining = False
+        self._journal = None
+        self._guard = preemption_guard
+        if cfg.journal_dir:
+            from .recovery import RequestJournal
+
+            self._journal = RequestJournal(cfg.journal_dir, meta={
+                # sampling determinism rides these: resume validates them
+                # so a mismatched replica fails loudly instead of emitting
+                # a silently different continuation
+                "temperature": float(cfg.temperature),
+                "rng_seed": int(cfg.rng_seed),
+                "quantize_weights": cfg.quantize_weights,
+                "decode_steps": int(cfg.decode_steps),
+            })
+            if self._guard is None:
+                from ..resilience.preemption import PreemptionGuard
+
+                # sticky-flag SIGTERM/SIGINT guard (resilience pillar 2):
+                # step() polls it and drains at its own safe point.
+                # install() is a no-op off the main thread — a journaled
+                # service on a worker thread still journals, it just
+                # relies on an explicit drain() call
+                self._guard = PreemptionGuard()
+            if not self._guard.installed:
+                self._guard.install()
+        # deterministic fault injection (resilience pillar 4): armed only
+        # when $ACCELERATE_FAULT_PLAN names serving verbs — production
+        # runs carry a None here
+        from ..resilience.inject import FaultInjector
+
+        self._injector = FaultInjector.from_spec(None)
         self.stats = {
             "steps": 0,
             "admitted": 0,
@@ -359,6 +444,17 @@ class DecodeService:
             "decode_syncs": 0,
             "decode_tokens": 0,
             "h2d_uploads": 0,
+            # fault-tolerance accounting (docs/serving.md §fault
+            # tolerance): shed completions, recovered (re-prefilled)
+            # admissions, retry attempts, exhaustion requeues, pool
+            # rebuilds after a consumed-donation fault, and metrics-scrape
+            # snapshot retries that ran the cap dry
+            "shed": 0,
+            "recovered": 0,
+            "decode_retries": 0,
+            "requeued": 0,
+            "pool_rebuilds": 0,
+            "metrics_snapshot_retry_exhausted": 0,
         }
         # sliding (ttft_ms, tpot_ms) window behind metrics() — the live
         # endpoint's SLO percentiles must reflect *recent* traffic, not the
@@ -390,10 +486,20 @@ class DecodeService:
 
             self._hub.register_metrics_provider("serving", _serving_metrics)
 
+            def _serving_health():
+                service = service_ref()
+                return service.health() if service is not None else {}
+
+            # /healthz rides the same endpoint (telemetry/metrics.py): a
+            # dropped service renders as an absent section, never a stale
+            # "ready"
+            self._hub.register_health_provider("serving", _serving_health)
+
     # -- request intake ------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int,
                eos_token_id: Optional[int] = None,
-               arrival_t: Optional[float] = None) -> int:
+               arrival_t: Optional[float] = None,
+               deadline_ms: Optional[float] = None) -> int:
         """Queue one request; returns its id.  Validation happens here so a
         request that can NEVER be admitted fails loudly at submit time
         instead of deadlocking the queue.
@@ -402,7 +508,15 @@ class DecodeService:
         TTFT clock to when the request actually ARRIVED rather than when
         the driver got around to calling submit — an open-loop load
         generator must pass it or its p99 TTFT silently excludes the
-        queueing delay it exists to measure (coordinated omission)."""
+        queueing delay it exists to measure (coordinated omission).
+
+        ``deadline_ms`` bounds the request's queueing age: a request still
+        queued past it is SHED at admission time (a ``state="shed"``
+        completion record, never prefilled).  With
+        ``ServingConfig(max_queue_depth=...)`` set, a submit against a full
+        queue raises :class:`~.recovery.QueueFullError` carrying a
+        TPOT-derived ``retry_after_ms`` — bounded host memory under
+        overload instead of unbounded queue growth."""
         prompt = np.asarray(
             prompt.data if hasattr(prompt, "data") else prompt, np.int32
         ).reshape(-1)
@@ -427,6 +541,34 @@ class DecodeService:
                 f"request needs {needed} blocks but the pool only has "
                 f"{self.pool.usable_blocks}: raise num_blocks"
             )
+        if self._draining or (
+            self.config.max_queue_depth is not None
+            and len(self._queue) >= self.config.max_queue_depth
+        ):
+            # bounded queueing / drain back-pressure: reject with a
+            # retry-after hint — the caller's load balancer re-routes or
+            # re-submits, and host memory stays bounded under overload
+            from .recovery import QueueFullError
+
+            reason = "draining" if self._draining else "queue_full"
+            retry_after = self._retry_after_ms()
+            self.stats["shed"] += 1
+            from ..telemetry import flightrec
+
+            flightrec.record(
+                "serving_shed", reason=reason, queue_depth=len(self._queue),
+            )
+            if self._hub is not None:
+                self._hub.record_serving({
+                    "event": "shed", "reason": reason,
+                    "queue_depth": len(self._queue),
+                    "retry_after_ms": retry_after,
+                })
+            raise QueueFullError(
+                f"submit rejected ({reason}): queue depth "
+                f"{len(self._queue)}; retry in ~{retry_after:.0f} ms",
+                retry_after_ms=retry_after,
+            )
         rid = self._next_rid
         self._next_rid += 1
         req = Request(
@@ -437,7 +579,13 @@ class DecodeService:
             ),
             bucket_len=blen, blocks_needed=needed,
             submitted_t=arrival_t if arrival_t is not None else time.perf_counter(),
+            deadline_ms=deadline_ms,
         )
+        if self._journal is not None:
+            self._journal.log_submit(
+                rid, prompt, max_new_tokens, req.eos_token_id,
+                deadline_ms=deadline_ms,
+            )
         self._queue.append(req)
         self.stats["queue_peak"] = max(self.stats["queue_peak"], len(self._queue))
         return rid
@@ -476,10 +624,26 @@ class DecodeService:
         admitted = []
         while self._queue:
             req = self._queue[0]
+            if req.deadline_ms is not None and (
+                (time.perf_counter() - req.submitted_t) * 1e3 > req.deadline_ms
+            ):
+                # expired while queued: shed BEFORE the slot gate — an
+                # abandoned request must neither burn a prefill nor block
+                # the head of the line
+                self._queue.popleft()
+                self._shed(req, "deadline")
+                continue
             slot = self._free_slot()
             if slot is None or not self.pool.can_alloc(req.blocks_needed):
                 break
             self._queue.popleft()
+            if req.tokens:
+                # journal-recovered (or retry-requeued) request: rebuild
+                # its KV by teacher-forced re-prefill over the emitted
+                # prefix (docs/serving.md §fault tolerance)
+                self._admit_recovering(req, slot)
+                admitted.append(req)
+                continue
             row = self.pool.alloc(slot, req.blocks_needed)
             table_row = np.zeros(self.pool.blocks_per_slot, np.int32)
             table_row[: len(row)] = row
@@ -496,11 +660,14 @@ class DecodeService:
                 watcher=self.watcher, aot=self._aot,
             )
             self.stats["host_syncs"] += 1
+            self._programs_warmed = True
             first = int(tok)
             req.first_token_t = time.perf_counter()
             req.tokens.append(first)
             req.state = "running"
             self.stats["admitted"] += 1
+            if self._journal is not None:
+                self._journal.log_tokens(req.rid, [first])
             admitted.append(req)
             if req.max_new_tokens == 1 or (
                 req.eos_token_id is not None and first == req.eos_token_id
@@ -542,6 +709,8 @@ class DecodeService:
         self.results[req.rid] = req
         while len(self.results) > self.config.max_retained_results:
             self.results.pop(next(iter(self.results)))
+        if self._journal is not None:
+            self._journal.log_complete(req.rid)
         self.stats["completed"] += 1
         self._latency_window.append((req.ttft_ms, req.tpot_ms))
         if req.ttft_ms is not None:
@@ -556,6 +725,287 @@ class DecodeService:
                 "ttft_ms": req.ttft_ms,
                 "tpot_ms": req.tpot_ms,
             })
+
+    # -- fault tolerance -----------------------------------------------------
+    def _shed(self, req: Request, reason: str) -> None:
+        """Complete a request WITHOUT serving it: ``state="shed"``, a
+        completion record the caller can poll, a journal entry so a
+        recovering replica never resurrects it — and nothing in the
+        latency window, which describes served traffic only."""
+        req.done_t = time.perf_counter()
+        req.state = "shed"
+        self.results[req.rid] = req
+        while len(self.results) > self.config.max_retained_results:
+            self.results.pop(next(iter(self.results)))
+        self.stats["shed"] += 1
+        if self._journal is not None:
+            self._journal.log_shed(req.rid, reason)
+        from ..telemetry import flightrec
+
+        flightrec.record("serving_shed", rid=req.rid, reason=reason)
+        if self._hub is not None:
+            self._hub.record_serving({
+                "event": "shed", "rid": req.rid, "reason": reason,
+                "queued_ms": (req.done_t - req.submitted_t) * 1e3,
+            })
+
+    def _retry_after_ms(self) -> float:
+        """Back-pressure hint for rejected submits: roughly one decode
+        block at the service's recent median TPOT — when capacity next
+        frees up, not a magic constant.  Falls back to 100 ms before any
+        completion has been observed."""
+        tpots = sorted(
+            p for _, p in list(self._latency_window) if p is not None
+        )
+        if not tpots:
+            return 100.0
+        return max(1.0, tpots[len(tpots) // 2] * self.config.decode_steps)
+
+    def _queue_recovery(self, reqs: list, front: bool = False) -> None:
+        """(Re)queue requests carrying an emitted prefix: recompute each
+        one's bucket and block reservation for the RECOVERY sequence
+        (prompt + prefix-minus-last re-prefilled, the last journaled token
+        re-fed as the next decode input) and restore FIFO order."""
+        reqs = sorted(reqs, key=lambda r: r.rid)
+        for req in reqs:
+            k = len(req.tokens)
+            seq_len = req.prompt_len + max(0, k - 1)
+            remaining = req.max_new_tokens - k + 1 if k else req.max_new_tokens
+            req.bucket_len = bucket_length(
+                seq_len, self.config.prompt_bucket, cap=self.capacity
+            )
+            req.blocks_needed = blocks_for_request(
+                seq_len, remaining, req.bucket_len, self.config.block_size,
+                decode_steps=self.config.decode_steps,
+                blocks_per_slot=self.pool.blocks_per_slot,
+            )
+            req.state = "queued"
+        if front:
+            self._queue.extendleft(reversed(reqs))
+        else:
+            self._queue.extend(reqs)
+        self.stats["queue_peak"] = max(self.stats["queue_peak"], len(self._queue))
+
+    def _admit_recovering(self, req: Request, slot: int) -> None:
+        """Teacher-forced re-prefill: rebuild the slot's KV by running the
+        ordinary bucketed prefill over ``prompt + tokens[:-1]`` — the same
+        captured program family the service pins, so a warm-AOT replica
+        recovers with zero compiles — then feed the LAST journaled token
+        as the next decode input at its true position.  The prefill's own
+        sampled token is discarded (the journal is the source of truth),
+        and the per-request RNG stream is re-advanced so a sampled
+        continuation is bitwise-identical to the uninterrupted run: the
+        stream consumes one split per sampled token, so handing prefill
+        the stream at position ``k-1`` lands its internal split exactly at
+        ``k`` (recovery.advance_rng)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .engine import run_prefill
+        from .recovery import advance_rng
+
+        k = len(req.tokens)
+        seq = np.concatenate(
+            [req.prompt, np.asarray(req.tokens[:-1], np.int32)]
+        )
+        seq_len = int(seq.size)
+        row = self.pool.alloc(slot, req.blocks_needed)
+        table_row = np.zeros(self.pool.blocks_per_slot, np.int32)
+        table_row[: len(row)] = row
+        padded_ids = np.full((1, req.bucket_len), self.config.pad_token_id, np.int32)
+        padded_ids[0, :seq_len] = seq
+        rng = jax.random.fold_in(self._base_rng, 2 * req.rid + 1)
+        if float(self.config.temperature) > 0.0:
+            rng = advance_rng(rng, k - 1)
+        self._k_pool, self._v_pool, tok, rng_out = run_prefill(
+            self._k_pool, self._v_pool, self._g, self._layers,
+            jnp.asarray(padded_ids), jnp.asarray(table_row),
+            jnp.asarray(seq_len, jnp.int32), rng,
+            family=self.spec.family, cfg=self.spec.cfg,
+            qbits=self._qbits,
+            temperature=float(self.config.temperature),
+            watcher=self.watcher, aot=self._aot,
+        )
+        self.stats["host_syncs"] += 1
+        int(tok)  # block for the prefill; the sample itself is teacher-forced away
+        self._programs_warmed = True
+        req.state = "running"
+        if req.first_token_t is None:
+            # resumed from a dead replica's journal: the recovered TTFT
+            # clock starts at resubmission (perf_counter doesn't survive
+            # a process boundary)
+            req.first_token_t = time.perf_counter()
+        self.stats["admitted"] += 1
+        self.stats["recovered"] += 1
+        from ..telemetry import flightrec
+
+        flightrec.record(
+            "serving_recovered", rid=req.rid, prefix_tokens=k,
+        )
+        if self._hub is not None:
+            self._hub.record_serving_recovery({
+                "event": "recovered_admit", "rid": req.rid,
+                "prefix_tokens": k, "seq_len": seq_len,
+            })
+        last = int(req.tokens[-1])
+        if len(req.tokens) >= req.max_new_tokens or (
+            req.eos_token_id is not None and last == req.eos_token_id
+        ):
+            # the journaled prefix already satisfied the budget/stop: the
+            # request is complete — nothing left to decode
+            self.pool.free_slot(slot)
+            self._finish(req)
+            return
+        self._slot_req[slot] = req
+        self._tables[slot] = table_row
+        self._positions[slot] = seq_len
+        self._tokens[slot] = last
+        self._state_dirty = True
+        self._rngs = self._rngs.at[slot].set(rng_out)
+
+    def _requeue_active(self, reason: str, error=None) -> None:
+        """Decode-retry exhaustion path: evict every active slot and send
+        its request back through journal-style recovery (the emitted
+        prefixes live in the host Request objects) instead of crashing the
+        service.  A mid-execution fault may have consumed the donated
+        pools — rebuild them; the re-prefills repopulate everything."""
+        reqs = [r for r in self._slot_req if r is not None]
+        for slot, r in enumerate(self._slot_req):
+            if r is not None:
+                self._evict(slot)
+        if self._k_pool.is_deleted():
+            self._k_pool, self._v_pool = self._pool_factory()
+            self.stats["pool_rebuilds"] += 1
+        self._queue_recovery(reqs, front=True)
+        self.stats["requeued"] += len(reqs)
+        from ..telemetry import flightrec
+
+        flightrec.record(
+            "serving_requeue", count=len(reqs), reason=reason,
+        )
+        if self._hub is not None:
+            self._hub.record_serving_recovery({
+                "event": "requeue", "reason": reason,
+                "rids": [r.rid for r in reqs],
+                "error": None if error is None else f"{type(error).__name__}: {error}"[:300],
+            })
+
+    def drain(self, reason: Optional[str] = None) -> list[int]:
+        """Preemption drain: stop admission, finalize the journal, emit
+        ``kind="serving_recovery"`` records.  In-flight and queued
+        requests stay OPEN in the journal — a fresh replica pointed at the
+        same ``journal_dir`` (``resume_from_journal``) completes every one
+        of them from its emitted prefix, with warm AOT programs.
+        Idempotent; returns the open rids."""
+        open_rids = sorted(
+            [r.rid for r in self._queue]
+            + [r.rid for r in self._slot_req if r is not None]
+        )
+        if self._draining:
+            return open_rids
+        self._draining = True
+        if reason is None:
+            reason = (
+                self._guard.signal_name if self._guard is not None else None
+            ) or "drain"
+        from ..telemetry import flightrec
+
+        flightrec.record(
+            "serving_drain", reason=reason, open=len(open_rids),
+        )
+        if self._hub is not None:
+            self._hub.record_serving_recovery({
+                "event": "drain", "reason": reason, "open_rids": open_rids,
+            })
+        if self._journal is not None:
+            self._journal.log_drain(open_rids)
+            self._journal.close()
+        return open_rids
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def resume_from_journal(self, journal_dir: Optional[str] = None) -> list[int]:
+        """Resubmit every open request from a journal (default: this
+        service's own ``journal_dir``) under its ORIGINAL rid — the rid
+        seeds the per-request RNG stream (``fold_in(base, 2*rid+1)``), so
+        pinning it is what makes the recovered continuation deterministic.
+        Sampling-config mismatches against the journal's metadata fail
+        loudly.  Returns the resumed rids (FIFO order preserved)."""
+        from .recovery import replay_journal
+
+        path = journal_dir or self.config.journal_dir
+        if not path:
+            raise ValueError(
+                "resume_from_journal needs a journal_dir (argument, "
+                "ServingConfig, or $ACCELERATE_SERVING_JOURNAL)"
+            )
+        state = replay_journal(path)
+        for key, ours in (
+            ("temperature", float(self.config.temperature)),
+            ("rng_seed", int(self.config.rng_seed)),
+            ("quantize_weights", self.config.quantize_weights),
+        ):
+            theirs = state.meta.get(key, ours)
+            if theirs != ours:
+                raise ValueError(
+                    f"journal was written by a service with {key}={theirs!r} "
+                    f"but this replica has {key}={ours!r}: recovered "
+                    "continuations would silently diverge"
+                )
+        reqs = []
+        for entry in state.open_requests:
+            req = Request(
+                rid=entry.rid, prompt=entry.prompt,
+                max_new_tokens=entry.max_new_tokens,
+                eos_token_id=entry.eos_token_id,
+                bucket_len=0, blocks_needed=0,  # recomputed by _queue_recovery
+                tokens=list(entry.tokens),
+                submitted_t=time.perf_counter(),
+            )
+            reqs.append(req)
+        rids = [r.rid for r in reqs]
+        if rids:
+            self._next_rid = max(self._next_rid, max(rids) + 1)
+            own_path = self._journal.path if self._journal is not None else None
+            from .recovery import _journal_path
+
+            if self._journal is not None and own_path != _journal_path(path):
+                # resuming from ANOTHER journal: re-log into ours so this
+                # replica's log is self-contained (same-dir resume skips —
+                # the records are already in the file we append to)
+                for req in reqs:
+                    self._journal.log_submit(
+                        req.rid, req.prompt, req.max_new_tokens,
+                        req.eos_token_id, tokens=req.tokens,
+                    )
+        self._queue_recovery(reqs, front=False)
+        from ..telemetry import flightrec
+
+        flightrec.record("serving_resume", count=len(rids))
+        if self._hub is not None and rids:
+            self._hub.record_serving_recovery({
+                "event": "resume", "count": len(rids), "rids": rids,
+            })
+        return rids
+
+    def health(self) -> dict:
+        """Readiness + liveness snapshot for the ``/healthz`` probe
+        (telemetry/metrics.py): ready = programs warmed ∧ pool allocated ∧
+        not draining.  Pure host reads — safe from the endpoint thread."""
+        pool_allocated = self.pool.usable_blocks > 0
+        return {
+            "ready": bool(
+                self._programs_warmed and pool_allocated and not self._draining
+            ),
+            "live": True,
+            "programs_warmed": self._programs_warmed,
+            "pool_allocated": pool_allocated,
+            "draining": self._draining,
+            "slots_active": self.active_slots,
+            "queue_depth": len(self._queue),
+        }
 
     def _flush_device_state(self) -> None:
         """Re-commit the host mirrors to the device (the ``decode_steps >
@@ -593,6 +1043,19 @@ class DecodeService:
         from ..telemetry import flightrec
 
         n = self.config.decode_steps
+        if self._injector is not None:
+            # deterministic preemption rehearsal (resilience pillar 4):
+            # serving_sigterm:step=N delivers a real SIGTERM before engine
+            # step N — the guard's sticky flag is then read right below
+            self._injector.maybe_serving_sigterm(self.stats["steps"])
+        if not self._draining and self._guard is not None and (
+            self._guard.triggered or self._guard.deadline_reached()
+        ):
+            self.drain()
+        if self._draining:
+            # admission stopped; in-flight requests stay open in the
+            # journal for the successor replica
+            return []
         admitted = self._admit()
         if admitted:
             # flight event: admissions (docs/telemetry.md §flight recorder)
@@ -621,66 +1084,122 @@ class DecodeService:
                 watcher=self.watcher, aot=self._aot,
                 kernels=self._kernels,
             )
-            if n == 1:
-                # legacy single-token dispatch, byte-identical to the
-                # pre-multi-token service INCLUDING the per-step mirror
-                # uploads: the program must see the exact avals it always
-                # has (fresh uncommitted int arrays), because inputs
-                # committed with a NamedSharding lower to a DIFFERENT HLO
-                # module — an independently compiled binary whose near-tie
-                # argmaxes can drift 1 ulp from generate()'s programs and
-                # break the bitwise parity contract (caught live on a
-                # prepared single-device run; see engine._decode_jit for
-                # the same argument against a length-1 loop variant).  The
-                # uploads are three tiny int arrays; the per-token cost
-                # that matters — the blocking host sync — is unchanged
-                # here and amortized n-fold on the n>1 path below.
-                import jax.numpy as jnp
+            # transient-fault retry (docs/serving.md §fault tolerance):
+            # the injected/classified-transient fault fires BEFORE the
+            # dispatch consumes the donated pools, so a retry re-dispatches
+            # the SAME compiled program (zero extra compiles).  A real
+            # mid-execution fault that consumed the pools skips straight
+            # to eviction-and-requeue, whose re-prefills rebuild all KV.
+            dispatched = False
+            attempt = 0
+            while True:
+                try:
+                    if self._injector is not None:
+                        self._injector.maybe_decode_fault(self.stats["steps"])
+                    if n == 1:
+                        # legacy single-token dispatch, byte-identical to the
+                        # pre-multi-token service INCLUDING the per-step mirror
+                        # uploads: the program must see the exact avals it always
+                        # has (fresh uncommitted int arrays), because inputs
+                        # committed with a NamedSharding lower to a DIFFERENT HLO
+                        # module — an independently compiled binary whose near-tie
+                        # argmaxes can drift 1 ulp from generate()'s programs and
+                        # break the bitwise parity contract (caught live on a
+                        # prepared single-device run; see engine._decode_jit for
+                        # the same argument against a length-1 loop variant).  The
+                        # uploads are three tiny int arrays; the per-token cost
+                        # that matters — the blocking host sync — is unchanged
+                        # here and amortized n-fold on the n>1 path below.
+                        import jax.numpy as jnp
 
-                (self._k_pool, self._v_pool, nxt, self._rngs) = run_decode(
-                    self._k_pool, self._v_pool, self._g, self._layers,
-                    jnp.asarray(self._tables), jnp.asarray(self._positions),
-                    jnp.asarray(self._tokens), self._rngs, **common,
+                        (self._k_pool, self._v_pool, nxt, self._rngs) = run_decode(
+                            self._k_pool, self._v_pool, self._g, self._layers,
+                            jnp.asarray(self._tables), jnp.asarray(self._positions),
+                            jnp.asarray(self._tokens), self._rngs, **common,
+                        )
+                        self.stats["h2d_uploads"] += 1
+                        self._state_dirty = True  # mirrors stay the source of truth
+                        tok_block = nxt  # reshaped host-side below
+                    else:
+                        (self._k_pool, self._v_pool, tok_block, self._dev_positions,
+                         self._dev_tokens, self._rngs) = run_decode_n(
+                            self._k_pool, self._v_pool, self._g, self._layers,
+                            self._dev_tables, self._dev_positions, self._dev_tokens,
+                            self._rngs, decode_steps=n, **common,
+                        )
+                    dispatched = True
+                    break
+                except Exception as exc:
+                    from ..resilience.backend import backoff_delay
+                    from ..resilience.retry import classify_failure
+
+                    if classify_failure(exc) != "transient":
+                        raise  # user/program errors propagate unchanged
+                    pools_ok = not self._k_pool.is_deleted()
+                    if attempt < self.config.max_decode_retries and pools_ok:
+                        attempt += 1
+                        self.stats["decode_retries"] += 1
+                        delay = backoff_delay(
+                            attempt, self.config.retry_backoff_s, cap_s=5.0
+                        )
+                        flightrec.record(
+                            "serving_retry", step=self.stats["steps"],
+                            attempt=attempt,
+                        )
+                        if self._hub is not None:
+                            self._hub.record_serving_recovery({
+                                "event": "retry", "step": self.stats["steps"],
+                                "attempt": attempt, "wait_ms": delay * 1e3,
+                                "error": f"{type(exc).__name__}: {exc}"[:300],
+                            })
+                        time.sleep(delay)
+                        continue
+                    self._requeue_active(
+                        "retry_exhausted" if pools_ok else "pools_consumed",
+                        error=exc,
+                    )
+                    break
+            if dispatched:
+                # THE host sync of the hot loop: one blocking read per
+                # n-token block, weighted per active slot for the
+                # per-token ratio
+                self.stats["host_syncs"] += 1
+                self.stats["decode_syncs"] += len(active)
+                block_host = np.asarray(tok_block).reshape(
+                    self.config.max_slots, n
                 )
-                self.stats["h2d_uploads"] += 1
-                self._state_dirty = True  # mirrors stay the source of truth
-                tok_block = nxt  # reshaped host-side below
-            else:
-                (self._k_pool, self._v_pool, tok_block, self._dev_positions,
-                 self._dev_tokens, self._rngs) = run_decode_n(
-                    self._k_pool, self._v_pool, self._g, self._layers,
-                    self._dev_tables, self._dev_positions, self._dev_tokens,
-                    self._rngs, decode_steps=n, **common,
-                )
-            # THE host sync of the hot loop: one blocking read per n-token
-            # block, weighted per active slot for the per-token ratio
-            self.stats["host_syncs"] += 1
-            self.stats["decode_syncs"] += len(active)
-            block_host = np.asarray(tok_block).reshape(
-                self.config.max_slots, n
-            )
-            for slot in active:
-                req = self._slot_req[slot]
-                for j in range(n):
-                    tok = int(block_host[slot, j])
-                    req.tokens.append(tok)
-                    self._positions[slot] += 1
-                    self._tokens[slot] = tok
-                    emitted += 1
-                    if len(req.tokens) >= req.max_new_tokens or (
-                        req.eos_token_id is not None
-                        and tok == req.eos_token_id
-                    ):
-                        # tokens past the stop are DISCARDED (never appended
-                        # — the block's tail is pad as far as any consumer
-                        # can see), and eviction lands at the block
-                        # boundary; greedy output stays identical to
-                        # generate() at every n
-                        self._evict(slot)
-                        self._finish(req)
-                        completed.append(req)
-                        slot_evictions += 1
-                        break
+                for slot in active:
+                    req = self._slot_req[slot]
+                    emitted_before = len(req.tokens)
+                    for j in range(n):
+                        tok = int(block_host[slot, j])
+                        req.tokens.append(tok)
+                        self._positions[slot] += 1
+                        self._tokens[slot] = tok
+                        emitted += 1
+                        if len(req.tokens) >= req.max_new_tokens or (
+                            req.eos_token_id is not None
+                            and tok == req.eos_token_id
+                        ):
+                            # tokens past the stop are DISCARDED (never appended
+                            # — the block's tail is pad as far as any consumer
+                            # can see), and eviction lands at the block
+                            # boundary; greedy output stays identical to
+                            # generate() at every n
+                            if self._journal is not None:
+                                self._journal.log_tokens(
+                                    req.rid, req.tokens[emitted_before:]
+                                )
+                            self._evict(slot)
+                            self._finish(req)
+                            completed.append(req)
+                            slot_evictions += 1
+                            break
+                    else:
+                        if self._journal is not None:
+                            self._journal.log_tokens(
+                                req.rid, req.tokens[emitted_before:]
+                            )
         self.stats["decode_tokens"] += emitted
         self.stats["steps"] += 1
         occupancy = len(active) / self.config.max_slots
@@ -716,7 +1235,7 @@ class DecodeService:
         """Drive ``step()`` until the queue and every slot drain (or
         ``max_steps``); returns ``{rid: Request}`` for everything finished."""
         steps = 0
-        while self.has_work:
+        while self.has_work and not self._draining:
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
@@ -743,15 +1262,25 @@ class DecodeService:
         over the sliding completion window.  Pure host reads — safe to call
         from the endpoint's thread while the service is stepping."""
         # the stepping thread appends completions concurrently, and a deque
-        # raises on mutation-during-iteration — retry the snapshot instead
-        # of letting the whole serving section drop out of a scrape
+        # raises on mutation-during-iteration — retry the snapshot (capped:
+        # a scrape must never spin against a hot completion stream), and
+        # surface cap exhaustion as a flight event + counter so a
+        # percentile-less scrape is diagnosable, not silent
         window: list = []
-        for _ in range(4):
+        for _ in range(_METRICS_SNAPSHOT_RETRIES):
             try:
                 window = list(self._latency_window)
                 break
             except RuntimeError:
                 continue
+        else:
+            self.stats["metrics_snapshot_retry_exhausted"] += 1
+            from ..telemetry import flightrec
+
+            flightrec.record(
+                "metrics_snapshot_retry_exhausted",
+                retries=_METRICS_SNAPSHOT_RETRIES,
+            )
         out = {
             "occupancy": self.active_slots / self.config.max_slots,
             "slots_active": self.active_slots,
@@ -773,6 +1302,15 @@ class DecodeService:
             "h2d_uploads_total": self.stats["h2d_uploads"],
             "host_syncs_per_token": round(self.host_syncs_per_token, 4),
             "latency_window": len(window),
+            # fault-tolerance counters (docs/serving.md §fault tolerance)
+            "shed_total": self.stats["shed"],
+            "recovered_total": self.stats["recovered"],
+            "decode_retries_total": self.stats["decode_retries"],
+            "requeued_total": self.stats["requeued"],
+            "metrics_snapshot_retry_exhausted_total": self.stats[
+                "metrics_snapshot_retry_exhausted"
+            ],
+            "draining": self._draining,
             # native histograms (cumulative over the service lifetime);
             # the p50/p99 gauges below stay for human eyeballs — dashboards
             # should quantile() the _bucket series instead

@@ -116,6 +116,10 @@ class Telemetry:
         # serving subsystem events (per-step occupancy/queue depth, per-
         # request TTFT/TPOT completions) — see serving/scheduler.py
         self.serving_events: deque[dict] = deque(maxlen=handler.max_events)
+        # serving fault-tolerance events (decode retry, requeue, drain,
+        # resume, recovered admissions) — see serving/recovery.py and
+        # docs/serving.md §fault tolerance
+        self.serving_recovery_events: deque[dict] = deque(maxlen=handler.max_events)
         # AOT executable cache events (hit/miss/store/warm with cause,
         # bytes, load vs avoided compile ms) — see native/aot_cache.py
         self.aot_cache_events: deque[dict] = deque(maxlen=handler.max_events)
@@ -168,6 +172,9 @@ class Telemetry:
         # live metrics endpoint (metrics.py): providers registered here are
         # rendered by whatever MetricsServer is attached to this hub
         self._metrics_providers: list = []
+        # /healthz readiness sources (metrics.py §healthz): fn() -> dict
+        # with a "ready" bool; the endpoint ANDs them into one 200/503
+        self._health_providers: list = []
         self.metrics_server = None
         self._dataloader_wait_ms = 0.0
         # wait that batches consumed OUTSIDE any captured step incurred
@@ -351,6 +358,20 @@ class Telemetry:
         if self._export_sink:
             self._export_queue.append(dict(record))
 
+    def record_serving_recovery(self, payload: dict) -> None:
+        """Serving fault-tolerance event (decode retry, exhaustion
+        requeue, preemption drain, journal resume, recovered admission)
+        from the decode service — kind-tagged ``"serving_recovery"`` into
+        the same retained history and export stream as the capture records
+        (docs/serving.md §fault tolerance)."""
+        if not self.enabled:
+            return
+        record = dict(payload)
+        record["kind"] = "serving_recovery"
+        self.serving_recovery_events.append(record)
+        if self._export_sink:
+            self._export_queue.append(dict(record))
+
     def record_aot_cache(self, payload: dict) -> None:
         """AOT executable cache event (hit/miss/store/warm with cause,
         bytes, load_ms vs avoided compile_ms) — kind-tagged ``"aot_cache"``
@@ -478,9 +499,9 @@ class Telemetry:
             for record in self.all_records():
                 if record.get("kind") in (
                     "step", "recompile", "program", "collectives",
-                    "resources", "resilience", "serving", "device_step",
-                    "aot_cache", "fleet", "fleet_event", "kernel",
-                    "autopilot",
+                    "resources", "resilience", "serving", "serving_recovery",
+                    "device_step", "aot_cache", "fleet", "fleet_event",
+                    "kernel", "autopilot",
                 ):
                     self._export_queue.append(record)
 
@@ -536,6 +557,7 @@ class Telemetry:
         records += [s.to_dict() for s in self.resource_samples]
         records += [dict(e) for e in self.resilience_events]
         records += [dict(e) for e in self.serving_events]
+        records += [dict(e) for e in self.serving_recovery_events]
         records += [dict(e) for e in self.aot_cache_events]
         records += [dict(e) for e in self.fleet_events]
         records.append(self.summary())
@@ -608,6 +630,14 @@ class Telemetry:
         from .metrics import register_provider
 
         return register_provider(self._metrics_providers, name, fn)
+
+    def register_health_provider(self, name: str, fn) -> str:
+        """Attach a readiness source (``fn() -> dict`` with a ``"ready"``
+        bool) to whatever MetricsServer serves this hub's ``/healthz``;
+        same-name re-registration replaces (latest service wins)."""
+        from .metrics import register_provider
+
+        return register_provider(self._health_providers, name, fn)
 
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
         """Start (or return) the hub's Prometheus endpoint — idempotent;

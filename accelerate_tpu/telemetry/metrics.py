@@ -3,7 +3,10 @@
 A running training job or decode service should be scrapable without
 touching its process: :class:`MetricsServer` runs a daemon
 ``http.server`` thread serving ``GET /metrics`` in Prometheus text
-exposition format (version 0.0.4).  Every scrape renders *live* — the
+exposition format (version 0.0.4), plus a ``GET /healthz``
+readiness+liveness probe (JSON; 200 while every registered health source
+reports ready, 503 otherwise — the decode service registers
+"programs warmed ∧ pool allocated ∧ not draining").  Every scrape renders *live* — the
 server holds no state beyond its provider callables, so the numbers are
 whatever the telemetry hub / :class:`~..serving.DecodeService` report at
 that instant.
@@ -229,6 +232,21 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.split("?", 1)[0] in ("/healthz", "/healthz/"):
+            # readiness + liveness probe (docs/serving.md §fault
+            # tolerance): 200 while every registered health source reports
+            # ready (for the decode service: programs warmed ∧ pool
+            # allocated ∧ not draining), 503 otherwise — the orchestrator's
+            # drain/route-away signal
+            import json as _json
+
+            status, payload = self.server.health_fn()
+            body = (_json.dumps(payload) + "\n").encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif self.path in ("", "/"):
             body = b"accelerate_tpu metrics endpoint; scrape /metrics\n"
             self.send_response(200)
@@ -256,6 +274,7 @@ class MetricsServer:
         self.telemetry = telemetry
         self._requested = (host, int(port))
         self._providers: list = []  # (name, callable) -> dict
+        self._health_providers: list = []  # (name, callable) -> dict
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -266,8 +285,16 @@ class MetricsServer:
 
     def add_service(self, service) -> str:
         """Scrape a :class:`~..serving.DecodeService` (its ``metrics()``
-        snapshot) under the ``serving`` namespace."""
+        snapshot) under the ``serving`` namespace; its ``health()``
+        snapshot joins ``/healthz`` too when the service exposes one."""
+        if hasattr(service, "health"):
+            self.add_health_provider("serving", service.health)
         return self.add_provider("serving", service.metrics)
+
+    def add_health_provider(self, name: str, fn: Callable[[], dict]) -> str:
+        """Register a readiness source for ``/healthz`` (``fn() -> dict``
+        with a ``"ready"`` bool; replace-or-append, latest wins)."""
+        return register_provider(self._health_providers, name, fn)
 
     def _sections(self) -> list:
         sections: list = []
@@ -295,6 +322,29 @@ class MetricsServer:
             body += f"# provider {name} failed: {err}\n"
         return body
 
+    def health(self) -> tuple:
+        """``/healthz`` body: ``(status_code, payload)``.  Liveness is the
+        response itself (the thread answered); readiness is the AND over
+        every registered health source's ``"ready"``.  A raising provider
+        reads as not-ready (fail-closed: an orchestrator must not route
+        traffic at a replica whose own health check is broken); an empty
+        snapshot (a dropped weakref'd service) is skipped."""
+        sources: list = []
+        if self.telemetry is not None:
+            sources.extend(getattr(self.telemetry, "_health_providers", []))
+        sources.extend(self._health_providers)
+        payload: dict = {"live": True, "ready": True, "services": {}}
+        for name, fn in sources:
+            try:
+                snapshot = fn()
+            except Exception as exc:
+                snapshot = {"ready": False, "error": f"{type(exc).__name__}: {exc}"}
+            if not snapshot:
+                continue
+            payload["services"][name] = snapshot
+            payload["ready"] = payload["ready"] and bool(snapshot.get("ready", True))
+        return (200 if payload["ready"] else 503), payload
+
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "MetricsServer":
         if self._httpd is not None:
@@ -302,6 +352,7 @@ class MetricsServer:
         httpd = ThreadingHTTPServer(self._requested, _Handler)
         httpd.daemon_threads = True
         httpd.render_fn = self.render
+        httpd.health_fn = self.health
         self._httpd = httpd
         self._thread = threading.Thread(
             target=httpd.serve_forever, name="atpu-metrics", daemon=True

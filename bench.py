@@ -865,9 +865,12 @@ def _serving_block(on_accel: bool) -> dict:
         for i in range(n_requests)
     ]
 
-    def run_trace(decode_steps: int, trace_max_new: int) -> dict:
+    def run_trace(decode_steps: int, trace_max_new: int,
+                  journal_dir=None) -> dict:
         service = DecodeService(
-            model, ServingConfig(decode_steps=decode_steps, **geometry),
+            model,
+            ServingConfig(decode_steps=decode_steps, journal_dir=journal_dir,
+                          **geometry),
             telemetry=acc.telemetry,
         )
         # warmup: compile the decode program + every prefill bucket the
@@ -962,6 +965,87 @@ def _serving_block(on_accel: bool) -> dict:
             out["serving_multistep_speedup"] = round(
                 multi["tokens_per_sec"] / ab_base["tokens_per_sec"], 2
             )
+
+    # fault-tolerance rows (docs/serving.md §fault tolerance), gated off by
+    # default (BENCH_SERVING_CHAOS=1 enables): the journal-on steady-state
+    # TPOT overhead (<5% is the acceptance bound), and a preemption drill —
+    # a journaled replica abandoned mid-flight, a fresh replica resumed
+    # from its journal.  serving_requests_lost MUST be 0 and the recovery
+    # re-prefills must not compile (warm in-trace programs).
+    import os as _os
+
+    if _os.environ.get("BENCH_SERVING_CHAOS", "0").lower() not in (
+        "0", "", "false"
+    ):
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        scratch = _tempfile.mkdtemp(prefix="bench-serving-chaos-")
+        try:
+            journaled = run_trace(
+                1, max_new, journal_dir=_os.path.join(scratch, "steady")
+            )
+            out["serving_journal_tpot_p50_ms"] = journaled["tpot_p50_ms"]
+            if base["tpot_p50_ms"]:
+                out["serving_journal_tpot_overhead_pct"] = round(
+                    (journaled["tpot_p50_ms"] - base["tpot_p50_ms"])
+                    / base["tpot_p50_ms"] * 100.0, 2
+                )
+
+            # the preemption drill: all requests in flight, replica A dies
+            # (no drain — the raw-WAL worst case) after a few steps
+            drill_dir = _os.path.join(scratch, "drill")
+            svc_a = DecodeService(
+                model, ServingConfig(journal_dir=drill_dir, **geometry),
+                telemetry=acc.telemetry,
+            )
+            for p in prompts:
+                svc_a.submit(p, max_new_tokens=max_new)
+            for _ in range(3):
+                svc_a.step()
+            done_a = sum(
+                1 for r in svc_a.results.values() if r.state == "done"
+            )
+            del svc_a
+            svc_b = DecodeService(
+                model, ServingConfig(journal_dir=drill_dir, **geometry),
+                telemetry=acc.telemetry,
+            )
+            t0 = _time.perf_counter()
+            svc_b.resume_from_journal()
+            while svc_b.metrics()["queue_depth"] > 0:
+                svc_b.step()
+            # recovery_ms: journal replay + re-admission (every resumed
+            # request re-prefilled or slotted) on the fresh replica
+            out["serving_recovery_ms"] = round(
+                (_time.perf_counter() - t0) * 1e3, 2
+            )
+            svc_b.run()
+            done_b = [
+                r for r in svc_b.results.values() if r.state == "done"
+            ]
+            out["serving_requests_lost"] = (
+                n_requests - done_a - len(done_b)
+            )
+            out["serving_recovery_recompile_events"] = svc_b.recompile_events
+            recovered_tpot = sorted(
+                r.tpot_ms for r in done_b if r.tpot_ms is not None
+            )
+            rec_p50 = (
+                round(recovered_tpot[len(recovered_tpot) // 2], 2)
+                if recovered_tpot else None
+            )
+            out["serving_recovered_tpot_p50_ms"] = rec_p50
+            if rec_p50 is not None and base["tpot_p50_ms"]:
+                # recovered-vs-uninterrupted per-token latency delta: the
+                # re-prefill rebuilds KV off the clock path, so recovered
+                # decode should run at steady-state speed
+                out["serving_recovered_tpot_delta_pct"] = round(
+                    (rec_p50 - base["tpot_p50_ms"])
+                    / base["tpot_p50_ms"] * 100.0, 2
+                )
+        finally:
+            _shutil.rmtree(scratch, ignore_errors=True)
     return out
 
 

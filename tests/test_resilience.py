@@ -124,6 +124,53 @@ def test_injector_hang_sleeps_and_records_flight_event(monkeypatch):
     assert events[0]["step"] == 2 and events[0]["seconds"] == 5
 
 
+def test_fault_plan_parses_serving_verbs():
+    plan = FaultPlan.parse("decode_fault:step=2,times=3; serving_sigterm:step=1")
+    kinds = [(d.kind, d.step, d.times) for d in plan.directives]
+    assert kinds == [("decode_fault", 2, 3), ("serving_sigterm", 1, 1)]
+    # both verbs pin an engine step — a plan without one is ambiguous
+    with pytest.raises(ValueError, match="needs step"):
+        FaultPlan.parse("decode_fault:times=2")
+    with pytest.raises(ValueError, match="needs step"):
+        FaultPlan.parse("serving_sigterm")
+    # the unknown-verb message teaches the full vocabulary
+    with pytest.raises(ValueError, match="serving_sigterm"):
+        FaultPlan.parse("decode_fualt:step=1")
+
+
+def test_injector_decode_fault_fires_exactly_times():
+    inj = FaultInjector(FaultPlan.parse("decode_fault:step=1,times=2"))
+    inj.maybe_decode_fault(0)  # wrong engine step: no fault
+    with pytest.raises(InjectedTransientError, match="engine step 1"):
+        inj.maybe_decode_fault(1)
+    with pytest.raises(InjectedTransientError):
+        inj.maybe_decode_fault(1)  # a retry of the same step keeps faulting
+    inj.maybe_decode_fault(1)  # times exhausted: clean
+    # the injected error is classified transient — the serving retry loop
+    # and the training rollback share one classifier
+    try:
+        FaultInjector(
+            FaultPlan.parse("decode_fault:step=0")
+        ).maybe_decode_fault(0)
+    except InjectedTransientError as exc:
+        assert classify_failure(exc) == "transient"
+
+
+def test_injector_serving_sigterm_delivers_real_signal():
+    seen = []
+    saved = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    try:
+        inj = FaultInjector(FaultPlan.parse("serving_sigterm:step=2"))
+        inj.maybe_serving_sigterm(0)
+        assert seen == []  # wrong step: nothing delivered
+        inj.maybe_serving_sigterm(2)
+        assert seen == [signal.SIGTERM]
+        inj.maybe_serving_sigterm(2)  # times exhausted: one delivery only
+        assert seen == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, saved)
+
+
 # ---------------------------------------------------------------------------
 # pillar 1: hardened backend init
 # ---------------------------------------------------------------------------

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""serve_chaos_smoke — `make serve-chaos-smoke`: prove fault-tolerant
+serving end-to-end on CPU in seconds (docs/serving.md §fault tolerance,
+ISSUE 20 acceptance).
+
+Tiny GPT, staggered requests through a journaled replica while the fault
+injector fires a transient decode fault (step 3) and then a real SIGTERM
+(step 6) mid-flight; a fresh replica pointed at the same journal resumes
+every open request.  The scenario runs TWICE against ONE AOT executable
+store.  Exit 0 requires, for both passes:
+
+* the decode fault is retried against the same compiled program (at least
+  one retry, zero recompile events);
+* the SIGTERM drains the first replica with requests still open;
+* the restarted replica completes EVERY journaled request — zero lost;
+* every request's greedy tokens are identical to a single-request
+  ``generate()`` (recovered continuations are bitwise-deterministic);
+
+and additionally for pass 2 (warm store):
+
+* BOTH replicas — including the recovery re-prefills — dispatch with
+  ZERO compiles: replica restart is disk reads, never a compile phase.
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FAULT_PLAN = "decode_fault:step=3,times=1; serving_sigterm:step=6"
+LENGTHS = [3, 9, 17, 30, 5, 24, 12, 40]
+BUDGETS = [15, 12, 18, 9, 16, 11, 14, 10]
+
+
+def run_pass(model, aot_dir: str, pass_idx: int) -> tuple[list[str], str]:
+    import numpy as np
+
+    from accelerate_tpu import CompilationCacheKwargs, DecodeService, ServingConfig
+    from accelerate_tpu.native.aot_cache import AOTCompilationCache
+
+    failures: list[str] = []
+    leg = f"pass={pass_idx}"
+    journal_dir = tempfile.mkdtemp(prefix="chaos-journal-")
+    cfg = dict(max_slots=4, block_size=16, prompt_bucket=16,
+               journal_dir=journal_dir, retry_backoff_s=0.001)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, model.config.vocab_size, (n,), dtype=np.int32)
+        for n in LENGTHS
+    ]
+
+    try:
+        # replica A: journaled, chaos-injected, staggered admissions
+        os.environ["ACCELERATE_FAULT_PLAN"] = FAULT_PLAN
+        try:
+            a = DecodeService(
+                model, ServingConfig(**cfg),
+                aot_cache=AOTCompilationCache(
+                    CompilationCacheKwargs(cache_dir=aot_dir)
+                ),
+            )
+        finally:
+            del os.environ["ACCELERATE_FAULT_PLAN"]
+        rids, pending = [], list(zip(prompts, BUDGETS))
+        while (pending or a.has_work) and not a.draining:
+            for _ in range(2):
+                if pending:
+                    p, b = pending.pop(0)
+                    rids.append(a.submit(p, max_new_tokens=b))
+            a.step()
+        if not a.draining:
+            failures.append(f"[{leg}] SIGTERM never drained replica A")
+        if a.stats["decode_retries"] < 1:
+            failures.append(f"[{leg}] injected decode fault was never retried")
+        if a.recompile_events != 0:
+            failures.append(
+                f"[{leg}] replica A: {a.recompile_events} recompile event(s) "
+                "— the retry did not reuse the compiled program"
+            )
+        open_rids = a.drain()
+        if not open_rids:
+            failures.append(f"[{leg}] nothing was in flight at the SIGTERM")
+        done_a = {r: a.results[r].output_ids for r in rids if r in a.results
+                  and a.results[r].state == "done"}
+        a_compiles = a.watcher.compiles_total
+        a_retries = a.stats["decode_retries"]
+        del a
+
+        # replica B: fresh process stand-in — same journal, same AOT store
+        b = DecodeService(
+            model, ServingConfig(**cfg),
+            aot_cache=AOTCompilationCache(
+                CompilationCacheKwargs(cache_dir=aot_dir)
+            ),
+        )
+        resumed = b.resume_from_journal()
+        if sorted(resumed) != sorted(open_rids):
+            failures.append(
+                f"[{leg}] journal lost requests: drained {open_rids}, "
+                f"resumed {resumed}"
+            )
+        b.run()
+        done_b = {r: b.results[r].output_ids for r in resumed
+                  if r in b.results and b.results[r].state == "done"}
+        lost = sorted(set(rids) - set(done_a) - set(done_b))
+        if lost:
+            failures.append(f"[{leg}] requests lost across the restart: {lost}")
+        for rid, p, budget in zip(rids, prompts, BUDGETS):
+            want = np.asarray(model.generate(p[None], max_new_tokens=budget))[0]
+            got = done_b.get(rid, done_a.get(rid))
+            if got is None or not np.array_equal(got, want):
+                failures.append(
+                    f"[{leg}] request {rid}: tokens diverge from generate() "
+                    "after recovery"
+                )
+        b_compiles = b.watcher.compiles_total
+        if pass_idx == 2 and (a_compiles or b_compiles):
+            failures.append(
+                f"[{leg}] warm-store pass still compiled (replica A: "
+                f"{a_compiles}, replica B incl. recovery re-prefills: "
+                f"{b_compiles}) — restart must be disk reads only"
+            )
+        summary = (
+            f"serve_chaos_smoke[{leg}]: {len(rids)} requests, "
+            f"{len(done_a)} finished pre-preemption, {len(resumed)} resumed, "
+            f"{b.stats['recovered']} recovered, "
+            f"{a_retries} retry(ies) on A, "
+            f"compiles A={a_compiles} B={b_compiles}, 0 lost"
+        )
+        return failures, summary
+    finally:
+        shutil.rmtree(journal_dir, ignore_errors=True)
+
+
+def main() -> int:
+    import accelerate_tpu.nn as nn
+    from accelerate_tpu.models import GPTConfig, GPTLMHeadModel
+
+    nn.manual_seed(0)
+    model = GPTLMHeadModel(GPTConfig.tiny())
+    model.eval()
+
+    aot_dir = tempfile.mkdtemp(prefix="chaos-aot-")
+    failures = []
+    try:
+        for pass_idx in (1, 2):
+            pass_failures, summary = run_pass(model, aot_dir, pass_idx)
+            failures.extend(pass_failures)
+            print(summary)
+    finally:
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
+    for failure in failures:
+        print(f"serve_chaos_smoke: FAIL: {failure}", file=sys.stderr)
+    print(f"serve_chaos_smoke: {'FAILED' if failures else 'ok'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
